@@ -1,0 +1,65 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (~2.0), re-designed for JAX/XLA/pallas.
+
+Design (see SURVEY.md §7): one world instead of the reference's two —
+eager ops are jit-able traced ops, autograd is a tape over jax.vjp,
+parallelism is mesh + sharding specs instead of program rewriting.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+import warnings as _warnings
+# jax runs x32 by default (the right call on TPU); paddle-style int64/float64
+# requests silently narrow — suppress the per-call warning noise.
+_warnings.filterwarnings(
+    "ignore", message="Explicitly requested dtype.*truncated.*")
+
+from .core.tensor import (Tensor, Parameter, no_grad, enable_grad,  # noqa: F401
+                          is_grad_enabled, set_grad_enabled)
+from .core.device import (CPUPlace, CUDAPlace, TPUPlace, XPUPlace,  # noqa: F401
+                          set_device, get_device, device_count,
+                          is_compiled_with_cuda, is_compiled_with_tpu)
+from .core.dtype import set_default_dtype, get_default_dtype  # noqa: F401
+from .core.rng import seed, get_rng_state, set_rng_state  # noqa: F401
+from .core.tape import grad  # noqa: F401
+
+# dtype name aliases (paddle.float32 etc.)
+import jax.numpy as _jnp
+float16 = _jnp.float16
+bfloat16 = _jnp.bfloat16
+float32 = _jnp.float32
+float64 = _jnp.float64
+int8 = _jnp.int8
+int16 = _jnp.int16
+int32 = _jnp.int32
+int64 = _jnp.int64
+uint8 = _jnp.uint8
+bool = _jnp.bool_  # noqa: A001
+complex64 = _jnp.complex64
+complex128 = _jnp.complex128
+
+from .tensor import *  # noqa: F401,F403  (to_tensor, ones, matmul, ...)
+from . import tensor  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import metric  # noqa: F401
+from . import distribution  # noqa: F401
+from . import vision  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
+from . import distributed  # noqa: F401
+from .framework import save, load  # noqa: F401
+from . import utils  # noqa: F401
+from . import ops  # noqa: F401
+
+disable_static = lambda *a, **k: None  # noqa: E731  (always "dygraph")
+enable_static = lambda *a, **k: None  # noqa: E731
+
+
+def in_dynamic_mode():
+    return True

@@ -1,0 +1,217 @@
+"""Automatic mixed precision.
+
+Reference: dygraph AMP — imperative/amp_auto_cast.cc (AmpOperators white/black
+lists + AutoCastGuard) and python/paddle/amp/{auto_cast.py, grad_scaler.py};
+static AMP — fluid/contrib/mixed_precision/{decorator,fp16_lists,fp16_utils}.
+TPU-native: the policy is a dtype-cast hook on op dispatch (eager and traced
+alike), bf16 is the native fast dtype so GradScaler's loss-scaling state
+machine (operators/amp/update_loss_scaling_op) is only exercised for fp16.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core import op as _op
+from ..core.tensor import Tensor
+
+# ops always run in the low-precision dtype (MXU-bound) —
+# reference fp16 white list: fp16_lists.py white_list
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "addmm", "scaled_dot_product_attention",
+}
+# ops that must stay fp32 (numerically sensitive) — reference black_list
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "softmax", "log_softmax",
+    "cross_entropy", "nll_loss", "binary_cross_entropy", "bce_with_logits",
+    "kl_div", "softmax_with_cross_entropy", "mean", "sum", "norm", "var",
+    "std", "layer_norm", "batch_norm", "instance_norm", "group_norm",
+    "logsumexp", "erf", "erfinv", "rsqrt", "pow", "square", "ctc_loss",
+    "cumsum", "cosine_similarity",
+}
+
+
+class _AmpState:
+    enabled = False
+    dtype = jnp.bfloat16
+    level = "O1"
+    white = frozenset()
+    black = frozenset()
+
+
+_state = _AmpState()
+
+
+def _amp_hook(name, raw_leaves, tensor_idx):
+    if not _state.enabled:
+        return raw_leaves
+    in_white = name in _state.white
+    in_black = name in _state.black
+    if _state.level == "O2":
+        cast_low = not in_black
+    else:
+        cast_low = in_white
+    if cast_low:
+        tgt = _state.dtype
+    elif in_black:
+        tgt = jnp.float32
+    else:
+        return raw_leaves
+    out = list(raw_leaves)
+    for i in tensor_idx:
+        x = out[i]
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) \
+                and x.dtype != jnp.dtype(tgt):
+            out[i] = x.astype(tgt)
+    return out
+
+
+_op.set_amp_hook(_amp_hook)
+
+
+class auto_cast:
+    """paddle.amp.auto_cast context manager."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        self.enable = enable
+        self.level = level
+        self.dtype = jnp.float16 if str(dtype) in ("float16", "fp16") else jnp.bfloat16
+        self.white = set(WHITE_LIST) | set(custom_white_list or ())
+        self.black = (set(BLACK_LIST) | set(custom_black_list or ())) - set(custom_white_list or ())
+
+    def __enter__(self):
+        self._prev = (_state.enabled, _state.dtype, _state.level,
+                      _state.white, _state.black)
+        _state.enabled = self.enable
+        _state.dtype = self.dtype
+        _state.level = self.level
+        _state.white = frozenset(self.white)
+        _state.black = frozenset(self.black)
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level,
+         _state.white, _state.black) = self._prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+def is_amp_enabled():
+    return _state.enabled
+
+
+def decorate(models=None, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate — O2 casts model params to the low dtype.
+    Master weights are implicit: optimizer states are kept fp32 (see
+    Optimizer.init_state) which is the multi_precision behavior."""
+    tgt = "float16" if str(dtype) in ("float16", "fp16") else "bfloat16"
+    def _cast(m):
+        if m is not None and level == "O2":
+            m.to(dtype=tgt)
+        return m
+    if isinstance(models, (list, tuple)):
+        models = [_cast(m) for m in models]
+    else:
+        models = _cast(models)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py +
+    operators/amp/update_loss_scaling_op.cc state machine)."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list or []:
+            if p.grad is not None:
+                g = p.grad._data * inv
+                finite = bool(jnp.all(jnp.isfinite(g)))
+                found = found or not finite
+                p.grad._set_data(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        optimizer.clear_grad()
+
+    def update(self):
+        pass  # folded into step, kept for API compat
+
+    def _update(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every,
+                "decr_every_n_nan_or_inf": self._decr_every,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)

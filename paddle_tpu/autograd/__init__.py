@@ -1,0 +1,80 @@
+"""paddle_tpu.autograd (reference: python/paddle/autograd/ — PyLayer, backward)."""
+from __future__ import annotations
+
+from ..core.tensor import no_grad, enable_grad, is_grad_enabled  # noqa: F401
+from ..core.tape import backward, grad  # noqa: F401
+from ..core.op import dispatch
+from ..core.tensor import Tensor, TapeNode
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom op with user-defined forward/backward
+    (reference: python/paddle/autograd/py_layer.py)."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        from ..core.tensor import is_grad_enabled
+        tensors_in = [a for a in args if isinstance(a, Tensor)]
+        need_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensors_in)
+
+        with no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(out, (tuple, list))
+        outs = [out] if single else list(out)
+
+        if need_grad:
+            diff_inputs = [t for t in tensors_in if not t.stop_gradient]
+
+            def vjp_fn(cts):
+                if not isinstance(cts, tuple):
+                    cts = (cts,)
+                ct_tensors = [Tensor(c) for c in cts]
+                with no_grad():
+                    gin = cls.backward(ctx, *ct_tensors)
+                gin = [gin] if isinstance(gin, Tensor) or gin is None else list(gin)
+                raws = []
+                gi = iter(gin)
+                for t in diff_inputs:
+                    g = next(gi, None)
+                    raws.append(None if g is None else
+                                (g._data if isinstance(g, Tensor) else g))
+                return raws
+
+            new_outs = [Tensor(o._data if isinstance(o, Tensor) else o,
+                               stop_gradient=False) for o in outs]
+            node = TapeNode(cls.__name__, vjp_fn, diff_inputs, new_outs)
+            for i, t in enumerate(new_outs):
+                t._node = node
+                t._out_index = i
+            outs = new_outs
+        return outs[0] if single else tuple(outs)

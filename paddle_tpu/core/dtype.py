@@ -1,0 +1,100 @@
+"""Dtype system.
+
+TPU-native replacement for the reference's proto VarType dtypes and software
+float16/bfloat16 emulation (reference: paddle/fluid/platform/float16.h,
+platform/bfloat16.h, framework/framework.proto:107-136).  On TPU these are
+hardware types handled natively by XLA, so this module is just a canonical
+name <-> jnp dtype mapping plus a settable default float dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype registry: paddle-style name -> numpy/jnp dtype.
+_DTYPE_MAP = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint8": jnp.uint8,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+_default_dtype = jnp.float32
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str, np dtype, jnp dtype) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _DTYPE_MAP:
+            return _DTYPE_MAP[name]
+        raise TypeError(f"Unsupported dtype string: {dtype!r}")
+    # jnp dtypes are numpy dtypes / type classes
+    try:
+        return jnp.dtype(dtype)
+    except TypeError:
+        raise TypeError(f"Unsupported dtype: {dtype!r}")
+
+
+def dtype_name(dtype) -> str:
+    """Return the canonical paddle-style name for a dtype."""
+    d = jnp.dtype(dtype)
+    if d == jnp.bool_:
+        return "bool"
+    return d.name
+
+
+def set_default_dtype(d):
+    """Set the default float dtype used by creation ops without explicit dtype."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if jnp.dtype(d) not in (jnp.dtype(jnp.float16), jnp.dtype(jnp.bfloat16),
+                            jnp.dtype(jnp.float32), jnp.dtype(jnp.float64)):
+        raise TypeError("default dtype must be a floating dtype")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return jnp.dtype(_default_dtype).name
+
+
+def default_float_dtype():
+    return _default_dtype
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+
+def promote(*dtypes):
+    return np.result_type(*[jnp.dtype(d) for d in dtypes])

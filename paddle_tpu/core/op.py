@@ -1,0 +1,132 @@
+"""Op dispatch: the single entry point every eager op goes through.
+
+TPU-native equivalent of the reference's Tracer::TraceOp pipeline
+(paddle/fluid/imperative/tracer.cc:59-113): AMP autocast -> kernel run ->
+grad-node creation.  Here the "kernel" is a pure jnp function (XLA-compiled
+and cached by jax's eager dispatch), the grad node is a `jax.vjp` closure, and
+AMP is a dtype-cast policy consulted before the call.  Under `jax.jit` the same
+path runs at trace time only, so compiled code pays zero overhead for it.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+
+from .tensor import Tensor, TapeNode, _is_tracer, is_grad_enabled
+
+# AMP policy hook: set by paddle_tpu.amp.  Signature: (op_name, raw_leaves,
+# tensor_mask) -> raw_leaves (possibly dtype-cast).
+_amp_hook: Optional[Callable] = None
+# Profiler hook: set by paddle_tpu.utils.profiler. Signature: (op_name) -> ctx.
+_profiler_hook: Optional[Callable] = None
+
+
+def set_amp_hook(fn):
+    global _amp_hook
+    _amp_hook = fn
+
+
+def set_profiler_hook(fn):
+    global _profiler_hook
+    _profiler_hook = fn
+
+
+def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
+    """Run `raw_fn` over args where Tensor leaves are unwrapped.
+
+    - If no arg is a Tensor: pure functional call, returns raw values
+      (this is the fast jit path for layers called with plain jax arrays).
+    - If Tensors present but no grad needed: compute, wrap outputs.
+    - Else: `jax.vjp` through the op, record a TapeNode.
+    Output pytree structure of raw_fn is preserved; array leaves become
+    Tensors when any input was a Tensor.
+    """
+    flat, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
+
+    if not tensor_idx:
+        return raw_fn(*args, **kwargs)
+
+    raw = [x._data if isinstance(x, Tensor) else x for x in flat]
+    if _amp_hook is not None:
+        raw = _amp_hook(name, raw, tensor_idx)
+
+    need_grad = (is_grad_enabled()
+                 and any(not flat[i].stop_gradient for i in tensor_idx))
+
+    prof = _profiler_hook(name) if _profiler_hook is not None else None
+    try:
+        if prof is not None:
+            prof.__enter__()
+        if not need_grad:
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, raw)
+            out = raw_fn(*a2, **k2)
+            return jax.tree_util.tree_map(lambda x: Tensor(x, stop_gradient=True), out)
+
+        # differentiable inputs: float/complex Tensors not marked stop_gradient
+        diff_idx = [i for i in tensor_idx
+                    if not flat[i].stop_gradient and _is_diff_dtype(raw[i])]
+        if not diff_idx:
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, raw)
+            out = raw_fn(*a2, **k2)
+            return jax.tree_util.tree_map(lambda x: Tensor(x, stop_gradient=True), out)
+
+        def closed(*diff_vals):
+            leaves = list(raw)
+            for i, v in zip(diff_idx, diff_vals):
+                leaves[i] = v
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, leaves)
+            return raw_fn(*a2, **k2)
+
+        out_raw, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+
+        out_flat, out_tree = jax.tree_util.tree_flatten(out_raw)
+        out_tensors = [Tensor(x, stop_gradient=False) for x in out_flat]
+        node = TapeNode(name, _TreeVjp(vjp_fn, out_tree),
+                        [flat[i] for i in diff_idx], out_tensors)
+        for i, t in enumerate(out_tensors):
+            t._node = node
+            t._out_index = i
+        return jax.tree_util.tree_unflatten(out_tree, out_tensors)
+    finally:
+        if prof is not None:
+            prof.__exit__(None, None, None)
+
+
+class _TreeVjp:
+    """Adapts a pytree-output vjp to the flat cotangent list the tape passes."""
+
+    __slots__ = ("vjp_fn", "out_tree")
+
+    def __init__(self, vjp_fn, out_tree):
+        self.vjp_fn = vjp_fn
+        self.out_tree = out_tree
+
+    def __call__(self, cts):
+        if not isinstance(cts, tuple):
+            cts = (cts,)
+        ct_tree = jax.tree_util.tree_unflatten(self.out_tree, list(cts))
+        return self.vjp_fn(ct_tree)
+
+
+def _is_diff_dtype(x) -> bool:
+    try:
+        dt = x.dtype
+    except AttributeError:
+        return False
+    import jax.numpy as jnp
+    return jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating)
+
+
+def defop(name: str):
+    """Decorator: turn a pure jnp function into a tape-aware eager op."""
+    def deco(raw_fn):
+        def op(*args, **kwargs):
+            return dispatch(name, raw_fn, *args, **kwargs)
+        op.__name__ = name
+        op.raw = raw_fn
+        op.__doc__ = raw_fn.__doc__
+        return op
+    return deco

@@ -1,0 +1,71 @@
+"""RNG state management.
+
+TPU-native replacement for the reference's per-device Generator
+(reference: paddle/fluid/framework/generator.cc, python/paddle/fluid/generator.py).
+JAX randomness is functional (explicit PRNG keys); for paddle-API parity we keep
+a global generator that owns a key and splits a fresh subkey per draw.  The
+functional training path should instead thread keys explicitly (see
+`paddle_tpu.jit`): this global state is only touched at eager op dispatch, so it
+never ends up baked into a compiled program.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class Generator:
+    """A stateful PRNG: owns a key, hands out fresh subkeys."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.key(int(seed))
+        return self
+
+    seed = manual_seed
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Split and return a fresh subkey (advances state)."""
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return jax.random.key_data(self._key)
+
+    def set_state(self, state):
+        self._key = jax.random.wrap_key_data(state)
+
+
+_default_generator = Generator(0)
+
+
+def seed(s: int):
+    """Set the global random seed (paddle.seed)."""
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)

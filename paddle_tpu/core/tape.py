@@ -1,0 +1,172 @@
+"""Reverse-mode tape walk.
+
+TPU-native equivalent of the reference's dygraph autograd engine
+(paddle/fluid/imperative/basic_engine.cc:38,110,184 — PrepareDeps + reverse
+topological queue + GradientAccumulator).  Nodes are `TapeNode`s recorded by
+`core.op.dispatch`; each node's backward is a `jax.vjp` closure, so grad math
+itself runs as compiled XLA, only the graph walk is Python.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from .tensor import Tensor, TapeNode, wrap
+
+
+def _topo_order(root_nodes) -> List[TapeNode]:
+    """DFS topological sort over tape nodes (inputs point upstream)."""
+    order: List[TapeNode] = []
+    seen = set()
+    stack = [(n, False) for n in root_nodes]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in seen:
+                stack.append((t._node, False))
+    return order  # upstream-first; iterate reversed for backward
+
+
+def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
+             retain_graph: bool = False,
+             inputs: Optional[List[Tensor]] = None,
+             accumulate_into_grad: bool = True) -> Optional[Dict[int, object]]:
+    """Run reverse-mode accumulation from `loss`.
+
+    If `inputs` is given, returns {id(tensor): raw_grad} for those tensors
+    (the `paddle.grad` path); otherwise grads are accumulated into `.grad` of
+    leaf tensors (the `.backward()` path, reference
+    dygraph/varbase_patch_methods.py).
+    """
+    if loss._node is None and loss.stop_gradient:
+        raise RuntimeError("backward() on a tensor that does not require grad")
+
+    if grad_tensor is None:
+        if loss.size != 1:
+            raise RuntimeError(
+                "grad_tensor must be provided for non-scalar backward "
+                f"(got shape {loss.shape})")
+        init = jnp.ones_like(loss._data)
+    else:
+        init = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    # cotangent accumulator keyed by tensor identity
+    cotangents: Dict[int, object] = {id(loss): init}
+    wanted = None if inputs is None else {id(t) for t in inputs}
+    results: Dict[int, object] = {}
+
+    if loss._node is None:
+        # leaf with requires-grad: its grad is just init
+        _deposit(loss, init, accumulate_into_grad, wanted, results)
+        return results if inputs is not None else None
+
+    order = _topo_order([loss._node])
+
+    for node in reversed(order):
+        # gather cotangents for this node's outputs
+        out_cts = []
+        any_ct = False
+        for ref, (shape, dt) in zip(node.out_refs, node.out_avals):
+            t = ref()
+            ct = cotangents.pop(id(t), None) if t is not None else None
+            if ct is None:
+                ct = jnp.zeros(shape, dt)
+            else:
+                any_ct = True
+                if t is not None and t._hooks:
+                    for hook in t._hooks:
+                        new = hook(wrap(ct))
+                        if new is not None:
+                            ct = new._data if isinstance(new, Tensor) else jnp.asarray(new)
+            out_cts.append(ct)
+        if not any_ct:
+            continue
+        ct_arg = out_cts[0] if len(out_cts) == 1 else tuple(out_cts)
+        in_grads = node.vjp_fn(ct_arg)
+        for t, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            prev = cotangents.get(id(t))
+            acc = g if prev is None else prev + g
+            if t._node is None:
+                # leaf: deposit and keep out of the queue
+                _deposit(t, acc, accumulate_into_grad, wanted, results)
+                if wanted is not None:
+                    cotangents[id(t)] = acc  # may also be interior-requested
+            else:
+                cotangents[id(t)] = acc
+                if wanted is not None and id(t) in wanted:
+                    results[id(t)] = acc
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals
+
+    if not retain_graph:
+        for node in order:
+            node.inputs = []
+    return results if inputs is not None else None
+
+
+def _deposit(t: Tensor, raw_grad, accumulate, wanted, results):
+    if wanted is not None:
+        if id(t) in wanted:
+            results[id(t)] = raw_grad
+        return
+    if t.stop_gradient:
+        return
+    if t._hooks:
+        for hook in t._hooks:
+            new = hook(wrap(raw_grad))
+            if new is not None:
+                raw_grad = new._data if isinstance(new, Tensor) else jnp.asarray(new)
+    if t.grad is None or not accumulate:
+        t.grad = Tensor(raw_grad, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad._data + raw_grad, stop_gradient=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """paddle.grad: compute grads of outputs wrt inputs without touching .grad.
+
+    Reference: imperative/partial_grad_engine.cc via paddle.grad.
+    `create_graph` is not yet supported (second-order autodiff goes through the
+    functional `jax.grad` path in paddle_tpu.jit instead).
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.jit functional transforms for "
+            "higher-order gradients")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif not isinstance(grad_outputs, (list, tuple)):
+        grad_outputs = [grad_outputs]
+
+    retain = True if retain_graph is None else retain_graph
+    total: Dict[int, object] = {}
+    for out, go in zip(outputs, grad_outputs):
+        res = backward(out, go, retain_graph=retain, inputs=list(inputs),
+                       accumulate_into_grad=False)
+        for k, v in (res or {}).items():
+            total[k] = total[k] + v if k in total else v
+
+    grads = []
+    for t in inputs:
+        if id(t) in total:
+            grads.append(Tensor(total[id(t)], stop_gradient=True))
+        elif allow_unused:
+            grads.append(None)
+        else:
+            raise RuntimeError(
+                "One of the differentiated tensors appears to not have been "
+                "used in the graph. Set allow_unused=True if this is desired.")
+    return grads

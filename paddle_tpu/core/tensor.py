@@ -1,0 +1,331 @@
+"""Eager Tensor with tape-based autograd.
+
+TPU-native collapse of the reference's two-world design (SURVEY.md §1): the
+reference has a C++ dygraph `Tracer`/`VarBase`/`BasicEngine`
+(paddle/fluid/imperative/tracer.cc:59, layer.h:65, basic_engine.cc:38) for eager
+mode and a protobuf ProgramDesc + Executor for graph mode.  Here a single
+`Tensor` wraps a `jax.Array` (or a tracer, when inside `jax.jit`): eager ops
+dispatch straight to XLA, autograd is a Python tape whose per-op backward is
+`jax.vjp` (the analogue of the reference's per-op GradOpMaker,
+framework/grad_op_desc_maker.h), and the *same* ops trace under `jit` where the
+Tensor wrapper is trace-time-only overhead — this is what replaces the whole
+static-graph world.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as _dtype_mod
+
+# ---------------------------------------------------------------------------
+# grad mode
+# ---------------------------------------------------------------------------
+
+_grad_enabled = True
+
+
+class no_grad:
+    """Context manager / decorator disabling tape recording (paddle.no_grad)."""
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    _grad_enabled = bool(mode)
+
+
+# ---------------------------------------------------------------------------
+# tape node
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op: holds the vjp closure and graph edges.
+
+    Equivalent to the reference's GradOpNode created by Tracer::TraceOp
+    (imperative/tracer.cc:113): `inputs` are the differentiable input tensors
+    (tape edges to upstream nodes), `outputs` weakly reference the produced
+    tensors so cotangents can be routed, `vjp_fn` is the op's backward.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_refs", "out_avals", "__weakref__")
+
+    def __init__(self, name, vjp_fn, inputs, outputs):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs: List[Tensor] = inputs
+        self.out_refs = [weakref.ref(t) for t in outputs]
+        # store shape/dtype so we can make zero cotangents for dead outputs
+        self.out_avals = [(t.shape, t.dtype) for t in outputs]
+
+
+# ---------------------------------------------------------------------------
+# Tensor
+# ---------------------------------------------------------------------------
+
+class Tensor:
+    """N-d array wrapping a jax.Array, with paddle-like eager semantics."""
+
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
+                 "name", "persistable", "trainable", "__weakref__", "_hooks")
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array) and not _is_tracer(data):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._node: Optional[TapeNode] = None
+        self._out_index: int = 0
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._hooks = None
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+
+    @property
+    def value(self):
+        return self._data
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def dim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        from .device import Place
+        devs = getattr(self._data, "devices", None)
+        if devs is None or _is_tracer(self._data):
+            from .device import current_jax_device
+            return Place(current_jax_device())
+        return Place(next(iter(self._data.devices())))
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self, *args):
+        return np.asarray(self._data).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name)
+        return t
+
+    def clone(self) -> "Tensor":
+        from . import op as _op
+        return _op.dispatch("clone", lambda x: jnp.copy(x), self)
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return self._data.dtype.itemsize
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def block_until_ready(self):
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from .tape import backward as _backward
+        _backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def zero_grad(self):
+        self.grad = None
+
+    def register_hook(self, hook):
+        """Register a grad hook: fn(grad_tensor) -> new grad or None."""
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        handle = _HookHandle(self._hooks, hook)
+        return handle
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    def _set_data(self, raw):
+        """In-place replace the underlying buffer (optimizer updates)."""
+        self._data = raw
+
+    # -- misc dunder --------------------------------------------------------
+    def __len__(self):
+        if self._data.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        if _is_tracer(self._data):
+            return f"Tensor(shape={self.shape}, dtype={_dtype_mod.dtype_name(self.dtype)}, traced)"
+        return (f"Tensor(shape={self.shape}, dtype={_dtype_mod.dtype_name(self.dtype)}, "
+                f"stop_gradient={sg},\n       {np.asarray(self._data)!r})")
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(np.asarray(self._data))
+
+    def __float__(self):
+        return float(np.asarray(self._data))
+
+    def __index__(self):
+        return int(np.asarray(self._data))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __hash__(self):
+        return id(self)
+
+    # __eq__ and friends are patched in paddle_tpu.tensor.patch to be
+    # elementwise (paddle semantics); identity compare via `is`.
+
+    def __jax_array__(self):
+        return self._data
+
+
+class _HookHandle:
+    def __init__(self, hooks, hook):
+        self._hooks, self._hook = hooks, hook
+
+    def remove(self):
+        try:
+            self._hooks.remove(self._hook)
+        except ValueError:
+            pass
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: framework.py Parameter / ParamBase)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+
+    def __init__(self, data, name=None, trainable=True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter " + super().__repr__()
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def unwrap(x):
+    """Tensor -> raw jax value; passthrough otherwise."""
+    return x._data if isinstance(x, Tensor) else x
+
+
+def wrap(x, stop_gradient=True) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(x, stop_gradient=stop_gradient)
+
+
+# pytree registration: Tensors can live inside jitted pytrees (state dicts).
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._data,), (t.stop_gradient, t.name)),
+    lambda aux, children: Tensor(children[0], stop_gradient=aux[0], name=aux[1]),
+)
+jax.tree_util.register_pytree_node(
+    Parameter,
+    lambda t: ((t._data,), (t.name, t.trainable)),
+    lambda aux, children: Parameter(children[0], name=aux[0], trainable=aux[1]),
+)

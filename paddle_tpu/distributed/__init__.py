@@ -1,0 +1,22 @@
+"""paddle_tpu.distributed (reference: python/paddle/distributed/).
+
+Built out in paddle_tpu/distributed/*: mesh-based parallel env, collective
+API over XLA collectives, fleet facade, launch CLI.
+"""
+import os
+
+
+def get_rank():
+    import jax
+    try:
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def get_world_size():
+    import jax
+    try:
+        return jax.process_count()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))

@@ -1,0 +1,106 @@
+"""Probability distributions (reference: python/paddle/distribution.py —
+Distribution/Uniform/Normal/Categorical)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as _rng
+from ..core.tensor import Tensor, unwrap
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return Tensor(jnp.exp(unwrap(self.log_prob(value))))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(unwrap(low), jnp.float32)
+        self.high = jnp.asarray(unwrap(high), jnp.float32)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.low.shape, self.high.shape)
+        key = jax.random.key(seed) if seed else _rng.next_key()
+        u = jax.random.uniform(key, shape)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = unwrap(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(unwrap(loc), jnp.float32)
+        self.scale = jnp.asarray(unwrap(scale), jnp.float32)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + jnp.broadcast_shapes(self.loc.shape, self.scale.shape)
+        key = jax.random.key(seed) if seed else _rng.next_key()
+        z = jax.random.normal(key, shape)
+        return Tensor(self.loc + z * self.scale)
+
+    def log_prob(self, value):
+        v = unwrap(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+                      + jnp.zeros_like(self.loc))
+
+    def kl_divergence(self, other):
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = jnp.asarray(unwrap(logits), jnp.float32)
+
+    def _probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.key(seed) if seed else _rng.next_key()
+        return Tensor(jax.random.categorical(
+            key, self.logits, shape=tuple(shape) + self.logits.shape[:-1]))
+
+    def log_prob(self, value):
+        v = unwrap(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(jnp.take_along_axis(logp, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        p = self._probs()
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor(-jnp.sum(p * logp, axis=-1))
+
+    def kl_divergence(self, other):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        logq = jax.nn.log_softmax(other.logits, axis=-1)
+        p = self._probs()
+        return Tensor(jnp.sum(p * (logp - logq), axis=-1))

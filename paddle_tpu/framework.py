@@ -1,0 +1,8 @@
+"""paddle.framework equivalents: save/load (filled out in utils/checkpoint)."""
+def save(obj, path, protocol=4):
+    from .utils.checkpoint import save as _save
+    return _save(obj, path, protocol)
+
+def load(path, **kwargs):
+    from .utils.checkpoint import load as _load
+    return _load(path, **kwargs)

@@ -1,0 +1,3 @@
+"""paddle_tpu.hapi (reference: python/paddle/hapi/)."""
+from .model import Model, summary_fn as summary  # noqa: F401
+from . import callbacks  # noqa: F401

@@ -1,0 +1,254 @@
+"""hapi Model — Keras-like fit/evaluate/predict.
+
+Reference: python/paddle/hapi/model.py (Model.fit:808, evaluate:1296,
+predict:1512) with dual Static/DynamicGraphAdapter (model.py:223,608).
+TPU-native: one adapter — the jitted TrainStep (paddle_tpu.jit.TrainStep)
+is the static world, eager fallback is the dygraph world, same code path.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad, unwrap
+from ..jit import TrainStep, functional_call
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._amp_level = None
+        self.stop_training = False
+
+    # ---- setup -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs if amp_configs != "O0" else None
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+        self._train_step = None
+
+    # ---- core steps ---------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        if self._train_step is None:
+            loss_fn = self._loss
+            self._train_step = TrainStep(
+                self.network, lambda out, lbl: loss_fn(out, lbl),
+                self._optimizer, amp_level=self._amp_level)
+        batch = [unwrap(Tensor(np.asarray(x)) if isinstance(x, np.ndarray) else x)
+                 for x in list(inputs) + list(labels)]
+        loss = self._train_step(*batch)
+        metrics_out = []
+        if self._metrics:
+            with no_grad():
+                self.network.eval()
+                preds = self.network(*[Tensor(b) for b in batch[:len(inputs)]])
+                self.network.train()
+            for m in self._metrics:
+                m.update(unwrap(m.compute(preds, Tensor(batch[-1]))))
+                metrics_out.append(m.accumulate())
+        return (loss, metrics_out) if self._metrics else loss
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        self.network.eval()
+        with no_grad():
+            outputs = self.network(*[_as_tensor(x) for x in inputs])
+            loss = None
+            if self._loss is not None and labels:
+                loss = self._loss(outputs, _as_tensor(labels[0]))
+        metrics_out = []
+        for m in self._metrics:
+            m.update(unwrap(m.compute(outputs, _as_tensor(labels[0]))))
+            metrics_out.append(m.accumulate())
+        self.network.train()
+        return loss, metrics_out
+
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.network.eval()
+        with no_grad():
+            out = self.network(*[_as_tensor(x) for x in inputs])
+        self.network.train()
+        return out
+
+    # ---- loops ---------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size,
+                                      shuffle=shuffle, drop_last=drop_last,
+                                      num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if eval_data is not None and not hasattr(eval_data, "__iter__"):
+            eval_data = DataLoader(eval_data, batch_size=batch_size)
+
+        steps = None
+        try:
+            steps = len(train_loader)
+        except TypeError:
+            pass
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=steps, verbose=verbose,
+                                log_freq=log_freq, save_dir=save_dir,
+                                save_freq=save_freq,
+                                metrics=[m.name() for m in self._metrics])
+        self.stop_training = False
+        cbks.on_train_begin()
+        it_count = 0
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbls = _split_batch(batch)
+                out = self.train_batch(ins, lbls)
+                if isinstance(out, tuple):
+                    loss, metric_vals = out
+                    logs = {"loss": float(unwrap(loss))}
+                    for m, v in zip(self._metrics, metric_vals):
+                        logs[_mname(m)] = v
+                else:
+                    logs = {"loss": float(unwrap(out))}
+                cbks.on_train_batch_end(step, logs)
+                it_count += 1
+                if num_iters is not None and it_count >= num_iters:
+                    break
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self._run_eval(eval_data, cbks)
+                logs.update(eval_logs)
+            cbks.on_epoch_end(epoch, logs)
+            if self.stop_training or (num_iters is not None and it_count >= num_iters):
+                break
+        cbks.on_train_end(logs)
+
+    def _run_eval(self, eval_loader, cbks):
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(eval_loader):
+            cbks.on_eval_batch_begin(step)
+            ins, lbls = _split_batch(batch)
+            loss, _ = self.eval_batch(ins, lbls)
+            if loss is not None:
+                losses.append(float(unwrap(loss)))
+            cbks.on_eval_batch_end(step)
+        logs = {}
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs["eval_" + _mname(m)] = m.accumulate()
+        cbks.on_eval_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(eval_data, Dataset):
+            eval_data = DataLoader(eval_data, batch_size=batch_size,
+                                   num_workers=num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                log_freq=log_freq)
+        return self._run_eval(eval_data, cbks)
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+        if isinstance(test_data, Dataset):
+            test_data = DataLoader(test_data, batch_size=batch_size,
+                                   num_workers=num_workers)
+        outputs = []
+        for batch in test_data:
+            ins, _ = _split_batch(batch, has_label=False)
+            out = self.predict_batch(ins)
+            outputs.append(np.asarray(unwrap(out)) if not isinstance(out, (list, tuple))
+                           else [np.asarray(unwrap(o)) for o in out])
+        if stack_outputs and outputs and not isinstance(outputs[0], list):
+            return [np.concatenate(outputs, axis=0)]
+        return [outputs]
+
+    # ---- persistence ----------------------------------------------------------
+    def save(self, path, training=True):
+        from ..utils.checkpoint import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..utils.checkpoint import load as _load
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        import os
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        return summary_fn(self.network, input_size, dtype)
+
+
+def _as_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+def _mname(m):
+    n = m.name()
+    return n if isinstance(n, str) else n[0]
+
+
+def _split_batch(batch, has_label=True):
+    if isinstance(batch, (list, tuple)):
+        if has_label and len(batch) >= 2:
+            return list(batch[:-1]), [batch[-1]]
+        return list(batch), []
+    return [batch], []
+
+
+def summary_fn(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """paddle.summary (reference: hapi/model_summary.py)."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape)) if p.shape else 1
+        total += n
+        if p.trainable:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max([len(r[0]) for r in rows], default=20) + 2
+    lines = [f"{'Param':<{width}}{'Shape':<24}{'Count':>12}"]
+    lines += [f"{r[0]:<{width}}{str(r[1]):<24}{r[2]:>12,}" for r in rows]
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,7 @@
+"""paddle_tpu.io (reference: python/paddle/io/)."""
+from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
+                      ComposeDataset, ChainDataset, ConcatDataset, Subset,
+                      random_split, Sampler, SequenceSampler, RandomSampler,
+                      WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,348 @@
+"""paddle_tpu.jit — the "static graph world" replacement.
+
+Reference: @paddle.jit.to_static / ProgramTranslator
+(python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py:729) turn
+dygraph Python into a ProgramDesc via AST rewriting; save_inference_model
+serializes program + params; AnalysisPredictor serves it.
+
+TPU-native: tracing *is* the program capture — `functional_call` runs a Layer's
+forward with parameters injected as jax values and the tape off, so `jax.jit`
+(+AOT `jax.export`) replaces ProgramDesc/Executor/AnalysisPredictor, buffer
+donation replaces inplace/memory-optimize passes, and `TrainStep` fuses
+forward+backward+optimizer into one compiled XLA program (what the reference
+needs a whole SSA-graph ParallelExecutor for).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, no_grad, unwrap
+from ..nn.layer_base import Layer
+
+
+# ---------------------------------------------------------------------------
+# functional_call: run a Layer with params supplied as values
+# ---------------------------------------------------------------------------
+
+def state_arrays(layer: Layer) -> Dict[str, Any]:
+    """Named param+buffer raw arrays (the layer's pytree leaves)."""
+    return {k: v._data for k, v in layer.state_dict().items()}
+
+
+def functional_call(layer: Layer, state: Dict[str, Any], *args,
+                    training: Optional[bool] = None, **kwargs):
+    """Run layer.forward with `state` (name -> raw array) swapped in.
+
+    Works under jit tracing: swapping happens at trace time only.  Tape is
+    disabled so the pure-functional jax.grad path is used for autodiff.
+    """
+    sd = layer.state_dict()
+    originals = {k: t._data for k, t in sd.items()}
+    modes = None
+    if training is not None:
+        modes = [(l, l.training) for l in layer.sublayers(include_self=True)]
+        for l, _ in modes:
+            l.training = training
+    try:
+        for k, t in sd.items():
+            if k in state:
+                t._data = state[k]
+        with no_grad():
+            out = layer(*_wrap_args(args), **kwargs)
+        return jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x, out,
+            is_leaf=lambda x: isinstance(x, Tensor))
+    finally:
+        for k, t in sd.items():
+            t._data = originals[k]
+        if modes is not None:
+            for l, m in modes:
+                l.training = m
+
+
+def _wrap_args(args):
+    return tuple(Tensor(a) if isinstance(a, (jax.Array, np.ndarray)) or _is_tracer(a)
+                 else a for a in args)
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+# ---------------------------------------------------------------------------
+# to_static
+# ---------------------------------------------------------------------------
+
+class InputSpec:
+    """paddle.static.InputSpec equivalent."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        from ..core.dtype import convert_dtype
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = convert_dtype(dtype)
+        self.name = name
+
+    def to_shape_dtype(self, batch=1):
+        shape = tuple(batch if s == -1 else s for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, self.dtype)
+
+
+class StaticFunction:
+    """Result of @to_static: compiled execution of a Layer/function."""
+
+    def __init__(self, fn: Callable, layer: Optional[Layer] = None,
+                 input_spec=None):
+        self._fn = fn
+        self._layer = layer
+        self._input_spec = input_spec
+        self._compiled = None
+
+    @property
+    def _pure(self):
+        if self._compiled is None:
+            if self._layer is not None:
+                layer, fn = self._layer, self._fn
+
+                def pure(state, *args, **kwargs):
+                    sd = layer.state_dict()
+                    originals = {k: t._data for k, t in sd.items()}
+                    try:
+                        for k, t in sd.items():
+                            if k in state:
+                                t._data = state[k]
+                        with no_grad():
+                            out = fn(*_wrap_args(args), **kwargs)
+                        return jax.tree_util.tree_map(
+                            lambda x: x._data if isinstance(x, Tensor) else x,
+                            out, is_leaf=lambda x: isinstance(x, Tensor))
+                    finally:
+                        for k, t in sd.items():
+                            t._data = originals[k]
+            else:
+                fn = self._fn
+
+                def pure(state, *args, **kwargs):
+                    with no_grad():
+                        out = fn(*_wrap_args(args), **kwargs)
+                    return jax.tree_util.tree_map(
+                        lambda x: x._data if isinstance(x, Tensor) else x,
+                        out, is_leaf=lambda x: isinstance(x, Tensor))
+            self._compiled = jax.jit(pure)
+        return self._compiled
+
+    def __call__(self, *args, **kwargs):
+        state = state_arrays(self._layer) if self._layer is not None else {}
+        raw_args = tuple(unwrap(a) for a in args)
+        out = self._pure(state, *raw_args, **kwargs)
+        return jax.tree_util.tree_map(lambda x: Tensor(x), out)
+
+    def concrete_program(self, *args):
+        return self
+
+    @property
+    def forward(self):
+        return self
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static — here: jit the forward (tape off, donation-ready)."""
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            return StaticFunction(fn.forward, layer=fn, input_spec=input_spec)
+        # bound method of a Layer?
+        self_obj = getattr(fn, "__self__", None)
+        if isinstance(self_obj, Layer):
+            return StaticFunction(fn, layer=self_obj, input_spec=input_spec)
+        return StaticFunction(fn, input_spec=input_spec)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# TrainStep: fused forward+backward+optimizer, fully jitted with donation
+# ---------------------------------------------------------------------------
+
+class TrainStep:
+    """One compiled training step (the perf path used by hapi/bench).
+
+    step(params, opt_state, step_no, lr, *batch) -> (params', opt_state', loss)
+    with `params`/`opt_state` donated — the XLA analogue of the reference's
+    fused-allreduce + inplace-addto passes is simply donation + XLA fusion.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 amp_level: Optional[str] = None, amp_dtype="bfloat16",
+                 mesh=None, batch_sharding=None, remat: bool = False):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.amp_level = amp_level
+        self.amp_dtype = amp_dtype
+        self._names = list(model.state_dict().keys())
+        self._trainable = {k for k, v in model.state_dict().items()
+                           if getattr(v, "trainable", False)}
+        self._compiled = None
+        self._opt_state = None
+        self._remat = remat
+
+    def _forward_loss(self, state, batch):
+        from .. import amp as amp_mod
+        def run(state, batch):
+            out = functional_call(self.model, state, *batch[:-1], training=True)
+            label = Tensor(batch[-1])
+            outs = out if isinstance(out, tuple) else (out,)
+            loss = self.loss_fn(*[Tensor(o) for o in outs], label)
+            return unwrap(loss)
+        if self.amp_level:
+            with amp_mod.auto_cast(level=self.amp_level, dtype=self.amp_dtype):
+                return run(state, batch)
+        return run(state, batch)
+
+    def _build(self, example_state, example_opt, example_batch):
+        opt = self.optimizer
+        trainable = self._trainable
+        wd = getattr(opt, "_wd", 0.0)
+
+        def step(params, opt_state, step_no, lr, batch):
+            def loss_of(train_params):
+                full = dict(params)
+                full.update(train_params)
+                return self._forward_loss(full, batch)
+
+            train_params = {k: v for k, v in params.items() if k in trainable}
+            loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
+            loss, grads = jax.value_and_grad(loss_fn)(train_params)
+            new_params = dict(params)
+            new_opt = dict(opt_state)
+            for k, g in grads.items():
+                p = params[k]
+                if wd and jnp.issubdtype(p.dtype, jnp.floating):
+                    g = g + wd * p
+                np_, ns = opt.update_one(p, g, opt_state[k], lr, step_no)
+                new_params[k] = np_
+                new_opt[k] = ns
+            return new_params, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def init_opt_state(self, state):
+        return {k: self.optimizer.init_state(v) for k, v in state.items()
+                if k in self._trainable}
+
+    def __call__(self, *batch):
+        state = state_arrays(self.model)
+        if self._opt_state is None:
+            self._opt_state = self.init_opt_state(state)
+        if self._compiled is None:
+            self._compiled = self._build(state, self._opt_state, batch)
+        self.optimizer._step_count += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        step_no = jnp.asarray(self.optimizer._step_count, jnp.int32)
+        raw_batch = tuple(unwrap(b) for b in batch)
+        new_state, self._opt_state, loss = self._compiled(
+            state, self._opt_state, step_no, lr, raw_batch)
+        sd = self.model.state_dict()
+        for k, v in new_state.items():
+            sd[k]._set_data(v)
+        return Tensor(loss)
+
+
+# ---------------------------------------------------------------------------
+# save / load (inference model): AOT export via jax.export + weights pickle
+# ---------------------------------------------------------------------------
+
+def save(layer, path, input_spec=None, **config):
+    """paddle.jit.save — serialize compiled fn (StableHLO via jax.export) +
+    weights (reference: save_inference_model, io.py:1198)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {k: np.asarray(v) for k, v in state_arrays(layer).items()}
+    np.savez(path + ".pdiparams.npz", **state)
+    meta = {"class": type(layer).__name__, "input_spec": None}
+    if input_spec is not None:
+        layer.eval()
+        specs = [s.to_shape_dtype() if isinstance(s, InputSpec) else
+                 jax.ShapeDtypeStruct(tuple(s.shape), s.dtype) for s in input_spec]
+
+        def pure(state, *args):
+            return functional_call(layer, state, *args, training=False)
+
+        try:
+            from jax import export as jax_export
+            exported = jax_export.export(jax.jit(pure))(
+                {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in state.items()},
+                *specs)
+            with open(path + ".pdmodel", "wb") as f:
+                f.write(exported.serialize())
+            meta["input_spec"] = [(tuple(s.shape), str(np.dtype(s.dtype))) for s in specs]
+        except Exception as e:  # export unsupported on some backends
+            meta["export_error"] = str(e)
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer:
+    """Loaded inference artifact (reference: TranslatedLayer / AnalysisPredictor)."""
+
+    def __init__(self, exported, state):
+        self._exported = exported
+        self._state = state
+
+    def __call__(self, *args):
+        raw = tuple(unwrap(a) for a in args)
+        out = self._exported.call(self._state, *raw)
+        return jax.tree_util.tree_map(lambda x: Tensor(x), out)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        return self
+
+
+def load(path, **config):
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    data = np.load(path + ".pdiparams.npz")
+    state = {k: jnp.asarray(data[k]) for k in data.files}
+    model_file = path + ".pdmodel"
+    if os.path.exists(model_file):
+        from jax import export as jax_export
+        with open(model_file, "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        return TranslatedLayer(exported, state)
+    raise FileNotFoundError(
+        f"{model_file} not found — layer was saved without input_spec; "
+        "load weights via paddle_tpu.load instead")
+
+
+def enable_to_static(flag=True):
+    pass
+
+
+class ProgramTranslator:
+    """API-compat shim for fluid's ProgramTranslator."""
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag):
+        pass

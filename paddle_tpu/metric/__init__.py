@@ -1,0 +1,178 @@
+"""Metrics (reference: python/paddle/metric/metrics.py — Metric base,
+Accuracy, Precision, Recall, Auc; operators/metrics/)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+
+
+class Metric:
+    def __init__(self):
+        pass
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = np.asarray(unwrap(pred))
+        label_np = np.asarray(unwrap(label))
+        idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        if label_np.ndim == pred_np.ndim and label_np.shape[-1] == 1:
+            label_np = label_np[..., 0]
+        correct = (idx == label_np[..., None])
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        c = np.asarray(unwrap(correct))
+        num = c.shape[0]
+        accs = []
+        for k in self.topk:
+            corr_k = c[..., :k].sum()
+            self.total[self.topk.index(k)] += corr_k
+            self.count[self.topk.index(k)] += num
+            accs.append(corr_k / max(num, 1))
+        return np.asarray(accs[0] if len(accs) == 1 else accs)
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / max(c, 1) for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(unwrap(preds)).reshape(-1)
+        l = np.asarray(unwrap(labels)).reshape(-1)
+        pred_pos = p > 0.5
+        self.tp += int(np.sum(pred_pos & (l == 1)))
+        self.fp += int(np.sum(pred_pos & (l == 0)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    def __init__(self, name="recall"):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(unwrap(preds)).reshape(-1)
+        l = np.asarray(unwrap(labels)).reshape(-1)
+        pred_pos = p > 0.5
+        self.tp += int(np.sum(pred_pos & (l == 1)))
+        self.fn += int(np.sum(~pred_pos & (l == 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """Streaming AUC via thresholded confusion histogram
+    (reference: operators/metrics/auc_op)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc"):
+        super().__init__()
+        self.num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(unwrap(preds))
+        l = np.asarray(unwrap(labels)).reshape(-1)
+        if p.ndim == 2:
+            p = p[:, -1]
+        else:
+            p = p.reshape(-1)
+        bins = np.minimum((p * self.num_thresholds).astype(np.int64),
+                          self.num_thresholds - 1)
+        for b, y in zip(bins, l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds, np.int64)
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # integrate over descending threshold
+        tp = np.cumsum(self._stat_pos[::-1])
+        fp = np.cumsum(self._stat_neg[::-1])
+        tpr = tp / tot_pos
+        fpr = fp / tot_neg
+        return float(np.trapezoid(tpr, fpr)) if hasattr(np, "trapezoid") \
+            else float(np.trapz(tpr, fpr))
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A002
+    """Functional accuracy (reference: layers/metric_op.py accuracy)."""
+    import jax.numpy as jnp
+    from ..core.op import dispatch
+
+    def raw(x, l):
+        topk_idx = jnp.argsort(-x, axis=-1)[..., :k]
+        lbl = l if l.ndim == 1 else l[..., 0]
+        corr = jnp.any(topk_idx == lbl[..., None], axis=-1)
+        return jnp.mean(corr.astype(jnp.float32))
+    return dispatch("accuracy", raw, input, label)

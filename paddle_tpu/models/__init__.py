@@ -1,0 +1,1 @@
+"""flagship model zoo (bert/gpt2/ernie/resnet) — built out."""

@@ -1,0 +1,11 @@
+"""paddle_tpu.nn.functional (reference: python/paddle/nn/functional/)."""
+from .activation import *  # noqa: F401,F403
+from .attention import (scaled_dot_product_attention, sequence_mask,  # noqa: F401
+                        set_flash_attention)
+from .common import *  # noqa: F401,F403
+from .conv import (conv1d, conv2d, conv3d, conv1d_transpose,  # noqa: F401
+                   conv2d_transpose, conv3d_transpose)
+from .loss import *  # noqa: F401,F403
+from .norm import (batch_norm, layer_norm, instance_norm, group_norm,  # noqa: F401
+                   local_response_norm, normalize, rms_norm)
+from .pooling import *  # noqa: F401,F403

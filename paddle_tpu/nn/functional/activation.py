@@ -1,0 +1,164 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py,
+operators/activation_op.cc — 30+ activations in one file)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import dispatch
+from ...core.tensor import unwrap
+
+
+def _un(name, fn):
+    def op(x, name=None):
+        return dispatch(name, fn, x)
+    op.__name__ = name
+    return op
+
+
+relu = _un("relu", jax.nn.relu)
+relu6 = _un("relu6", jax.nn.relu6)
+sigmoid = _un("sigmoid", jax.nn.sigmoid)
+tanh = _un("tanh", jnp.tanh)
+silu = _un("silu", jax.nn.silu)
+swish = _un("swish", jax.nn.silu)
+mish = _un("mish", lambda x: x * jnp.tanh(jax.nn.softplus(x)))
+softsign = _un("softsign", jax.nn.soft_sign)
+tanhshrink = _un("tanhshrink", lambda x: x - jnp.tanh(x))
+hardswish = _un("hardswish", lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0)
+hardsigmoid = _un("hardsigmoid", lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0))
+log_sigmoid = _un("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch("gelu", lambda x: jax.nn.gelu(x, approximate=approximate), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch("leaky_relu",
+                    lambda x: jax.nn.leaky_relu(x, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch("elu", lambda x: jax.nn.elu(x, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return dispatch("selu",
+                    lambda x: scale * jnp.where(x > 0, x, alpha * jnp.expm1(x)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch("celu", lambda x: jax.nn.celu(x, alpha), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def raw(x, w):
+        if w.size == 1:
+            return jnp.where(x > 0, x, w.reshape(()) * x)
+        shape = [1] * x.ndim
+        ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(x > 0, x, w.reshape(shape) * x)
+    return dispatch("prelu", raw, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...core import rng as _rng
+    def raw(x):
+        if training:
+            a = jax.random.uniform(_rng.next_key(), x.shape, x.dtype, lower, upper)
+        else:
+            a = jnp.asarray((lower + upper) / 2.0, x.dtype)
+        return jnp.where(x >= 0, x, a * x)
+    return dispatch("rrelu", raw, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return dispatch("hardtanh", lambda x: jnp.clip(x, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch("hardshrink",
+                    lambda x: jnp.where(jnp.abs(x) > threshold, x, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return dispatch("softshrink",
+                    lambda x: jnp.sign(x) * jnp.maximum(jnp.abs(x) - threshold, 0.0), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def raw(x):
+        bx = beta * x
+        return jnp.where(bx > threshold, x, jax.nn.softplus(bx) / beta)
+    return dispatch("softplus", raw, x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return dispatch("thresholded_relu",
+                    lambda x: jnp.where(x > threshold, x, value), x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as _dt
+    def raw(x):
+        xx = x.astype(_dt.convert_dtype(dtype)) if dtype is not None else x
+        return jax.nn.softmax(xx, axis=axis)
+    return dispatch("softmax", raw, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as _dt
+    def raw(x):
+        xx = x.astype(_dt.convert_dtype(dtype)) if dtype is not None else x
+        return jax.nn.log_softmax(xx, axis=axis)
+    return dispatch("log_softmax", raw, x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core import rng as _rng
+    def raw(x):
+        g = jax.random.gumbel(_rng.next_key(), x.shape, x.dtype)
+        y = jax.nn.softmax((x + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            onehot = (jnp.arange(y.shape[axis]) ==
+                      jnp.moveaxis(idx, axis, -1)).astype(y.dtype)
+            onehot = jnp.moveaxis(onehot, -1, axis)
+            y = jax.lax.stop_gradient(onehot - y) + y
+        return y
+    return dispatch("gumbel_softmax", raw, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def raw(x):
+        c = x.shape[axis]
+        new_shape = x.shape[:axis] + (c // groups, groups) + x.shape[axis + 1:]
+        return jnp.max(x.reshape(new_shape), axis=axis + 1)
+    return dispatch("maxout", raw, x)
+
+
+def glu(x, axis=-1, name=None):
+    def raw(x):
+        a, b = jnp.split(x, 2, axis=axis)
+        return a * jax.nn.sigmoid(b)
+    return dispatch("glu", raw, x)
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    out = softmax(x, axis, dtype)
+    x._set_data(out._data)
+    return x
+
+
+def tanh_(x, name=None):
+    out = tanh(x)
+    x._set_data(out._data)
+    return x
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._set_data(out._data)
+    return x

@@ -1,0 +1,77 @@
+"""Attention functionals.
+
+Reference: operators/fused/multihead_matmul_op.cu (fused QKV attention) and
+fused_attention.  TPU-native: one jittable softmax(QK^T/sqrt(d))V whose hot
+path swaps to the pallas flash-attention kernel (paddle_tpu/ops/flash_attention.py)
+when shapes qualify; XLA otherwise fuses the naive form.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import dispatch
+
+_USE_FLASH = True
+
+
+def set_flash_attention(enabled: bool):
+    global _USE_FLASH
+    _USE_FLASH = bool(enabled)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """q/k/v: (batch, seq, heads, head_dim) — paddle layout."""
+    from ...core import rng as _rng
+    drop_key = _rng.next_key() if (dropout_p > 0.0 and training) else None
+
+    def raw(q, k, v, mask):
+        out = _sdpa_raw(q, k, v, mask, dropout_p if training else 0.0,
+                        is_causal, drop_key)
+        return out
+    return dispatch("scaled_dot_product_attention", raw, query, key, value, attn_mask)
+
+
+def _sdpa_raw(q, k, v, mask, dropout_p, is_causal, drop_key):
+    # try pallas flash path (no mask / causal, no dropout)
+    if _USE_FLASH and dropout_p == 0.0 and mask is None:
+        from ...ops import flash_attention as fa
+        out = fa.flash_attention_bshd(q, k, v, causal=is_causal)
+        if out is not None:
+            return out
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    # (b, s, h, d) -> (b, h, s, d)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if is_causal:
+        sq, sk = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        scores = jnp.where(causal, scores, -1e30)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            scores = jnp.where(mask, scores, -1e30)
+        else:
+            scores = scores + mask
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and drop_key is not None:
+        keep = jax.random.bernoulli(drop_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    """Reference: operators/sequence_ops/sequence_mask_op — the LoD-free way
+    to express ragged sequences on TPU (mask + static shapes)."""
+    from ...core import dtype as _dt
+    from ...core.tensor import unwrap, Tensor
+    lv = unwrap(lengths)
+    m = int(maxlen) if maxlen is not None else int(jax.device_get(jnp.max(lv)))
+    mask = jnp.arange(m) < lv[..., None]
+    return Tensor(mask.astype(_dt.convert_dtype(dtype)))

@@ -1,0 +1,299 @@
+"""Common functionals: linear, dropout, embedding, pad, interpolate, one_hot…
+(reference: python/paddle/nn/functional/common.py, input.py; operators/dropout_op,
+lookup_table_op, pad3d_op, interpolate_op)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import rng as _rng
+from ...core.op import dispatch
+from ...core.tensor import Tensor, unwrap
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle's (in, out) weight layout — lands on the MXU."""
+    def raw(x, w, b):
+        y = jnp.matmul(x, w)
+        return y if b is None else y + b
+    return dispatch("linear", raw, x, weight, bias)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        return dispatch("identity", lambda x: x, x)
+    key = _rng.next_key()
+    def raw(x):
+        shape = list(x.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(x.shape)]
+        keep = 1.0 - p
+        mask = jax.random.bernoulli(key, keep, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+        return jnp.where(mask, x, 0.0).astype(x.dtype)
+    return dispatch("dropout", raw, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return dispatch("identity", lambda x: x, x)
+    key = _rng.next_key()
+    def raw(x):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = 1.0 - p
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(key, keep, x.shape)
+        return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+    return dispatch("alpha_dropout", raw, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Reference: operators/lookup_table_v2_op. `sparse` (SelectedRows grads)
+    is a no-op here: XLA handles gather/scatter-add grads densely and
+    efficiently on TPU."""
+    def raw(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return dispatch("embedding", raw, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return dispatch("one_hot",
+                    lambda x: jax.nn.one_hot(x.astype(jnp.int32), num_classes), x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def raw(label, prior):
+        k = label.shape[-1]
+        if prior is None:
+            return (1 - epsilon) * label + epsilon / k
+        return (1 - epsilon) * label + epsilon * prior
+    return dispatch("label_smooth", raw, label, prior_dist)
+
+
+_PAD_MODE = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):  # noqa: A002
+    pad_list = [int(unwrap(p)) for p in pad] if not isinstance(pad, int) else [pad]
+    def raw(x):
+        nd = x.ndim
+        if len(pad_list) == 2 * nd:
+            # full-rank paddle pad: [d0_l, d0_r, d1_l, d1_r, ...] ordering
+            widths = [(pad_list[2 * i], pad_list[2 * i + 1]) for i in range(nd)]
+        else:
+            # nn-style: pads innermost spatial dims, given reversed like torch
+            n_spatial = len(pad_list) // 2
+            widths = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                spatial_axes = list(range(2, nd))
+            else:
+                spatial_axes = list(range(1, nd - 1))
+            # pad list is [last_dim_l, last_dim_r, second_last_l, ...] per paddle
+            for i, ax in enumerate(reversed(spatial_axes[-n_spatial:])):
+                widths[ax] = (pad_list[2 * i], pad_list[2 * i + 1])
+        kw = {"constant_values": value} if mode == "constant" else {}
+        return jnp.pad(x, widths, mode=_PAD_MODE[mode], **kw)
+    return dispatch("pad", raw, x)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def raw(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return dispatch("cosine_similarity", raw, x1, x2)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def raw(x, y):
+        d = x - y + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return dispatch("pairwise_distance", raw, x, y)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def raw(x):
+        if data_format == "NCHW":
+            n, c, h, w = x.shape
+            x = x.reshape(n, c // (r * r), r, r, h, w)
+            x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+            return x.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = x.shape
+        x = x.reshape(n, h, w, r, r, c // (r * r))
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return x.reshape(n, h * r, w * r, c // (r * r))
+    return dispatch("pixel_shuffle", raw, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def raw(x):
+        if data_format == "NCHW":
+            n, c, h, w = x.shape
+            x = x.reshape(n, c, h // r, r, w // r, r)
+            x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+            return x.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = x.shape
+        x = x.reshape(n, h // r, r, w // r, r, c)
+        x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+        return x.reshape(n, h // r, w // r, c * r * r)
+    return dispatch("pixel_unshuffle", raw, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def raw(x):
+        if data_format == "NCHW":
+            n, c, h, w = x.shape
+            x = x.reshape(n, groups, c // groups, h, w)
+            x = jnp.swapaxes(x, 1, 2)
+            return x.reshape(n, c, h, w)
+        n, h, w, c = x.shape
+        x = x.reshape(n, h, w, groups, c // groups)
+        x = jnp.swapaxes(x, 3, 4)
+        return x.reshape(n, h, w, c)
+    return dispatch("channel_shuffle", raw, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    """Reference: operators/interpolate_v2_op. Supports nearest/bilinear/
+    bicubic/trilinear/area via jax.image.resize."""
+    mode = mode.lower()
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic",
+              "trilinear": "linear", "linear": "linear", "area": "linear"}[mode]
+    def raw(x):
+        if data_format.startswith("NC"):
+            spatial = x.shape[2:]
+            if size is not None:
+                out_sp = tuple(int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size]))
+            else:
+                sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+                out_sp = tuple(int(s * f) for s, f in zip(spatial, sf))
+            out_shape = x.shape[:2] + out_sp
+        else:
+            spatial = x.shape[1:-1]
+            if size is not None:
+                out_sp = tuple(int(unwrap(s)) for s in (size if isinstance(size, (list, tuple)) else [size]))
+            else:
+                sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+                out_sp = tuple(int(s * f) for s, f in zip(spatial, sf))
+            out_shape = (x.shape[0],) + out_sp + (x.shape[-1],)
+        if align_corners and method != "nearest":
+            # build with explicit coordinate map for align_corners semantics
+            return _resize_align_corners(x, out_shape, method, data_format)
+        return jax.image.resize(x, out_shape, method=method)
+    return dispatch("interpolate", raw, x)
+
+
+def _resize_align_corners(x, out_shape, method, data_format):
+    # align_corners: corner pixels map exactly; implement via linear interp gather
+    if data_format.startswith("NC"):
+        sp_axes = list(range(2, x.ndim))
+    else:
+        sp_axes = list(range(1, x.ndim - 1))
+    out = x
+    for ax in sp_axes:
+        n_in, n_out = x.shape[ax], out_shape[ax]
+        if n_out == 1 or n_in == 1:
+            idx = jnp.zeros((n_out,), jnp.float32)
+        else:
+            idx = jnp.linspace(0.0, n_in - 1, n_out)
+        lo = jnp.floor(idx).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n_in - 1)
+        w = (idx - lo).astype(out.dtype)
+        shape = [1] * out.ndim
+        shape[ax] = n_out
+        wb = w.reshape(shape)
+        out = (jnp.take(out, lo, axis=ax) * (1 - wb)
+               + jnp.take(out, hi, axis=ax) * wb)
+        x = out
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def raw(x1, x2, w, b):
+        # w: (out, in1, in2)
+        y = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+        return y if b is None else y + b
+    return dispatch("bilinear", raw, x1, x2, weight, bias)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference: operators/unfold_op, math/im2col)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    def raw(x):
+        n, c, h, w = x.shape
+        xp = jnp.pad(x, ((0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])))
+        oh = (xp.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (xp.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(xp[:, :, di:di + oh * st[0]:st[0], dj:dj + ow * st[1]:st[1]])
+        col = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return col.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return dispatch("unfold", raw, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    os = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    def raw(x):
+        n, ckk, L = x.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = os[0] + pd[0] + pd[2], os[1] + pd[1] + pd[3]
+        oh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        col = x.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, ph, pw), x.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                out = out.at[:, :, di:di + oh * st[0]:st[0],
+                             dj:dj + ow * st[1]:st[1]].add(col[:, :, i, j])
+        return out[:, :, pd[0]:ph - pd[2], pd[1]:pw - pd[3]]
+    return dispatch("fold", raw, x)

@@ -1,0 +1,149 @@
+"""Convolutions (reference: python/paddle/nn/functional/conv.py,
+operators/conv_op.cc + conv_cudnn_op.cu).  TPU-native: a single
+`lax.conv_general_dilated` lowering — XLA tiles convs onto the MXU; there is no
+algo-search/workspace machinery to port."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import dispatch
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _padding(padding, n, data_format):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
+    # paddle also allows [[0,0],[0,0],[h0,h1],[w0,w1]] including batch/channel
+    pads = [tuple(int(q) for q in p) for p in padding]
+    if data_format.startswith("NC"):
+        return pads[2:]
+    return pads[1:-1]
+
+
+def _dims(n, channel_last):
+    if n == 1:
+        return ("NWC", "WIO", "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if n == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = not data_format.startswith("NC")
+    lhs_spec, rhs_spec, out_spec = _dims(n, channel_last)
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    pad = _padding(padding, n, data_format)
+
+    def raw(x, w, b):
+        # paddle weight layout is (out_c, in_c/groups, *k) == OI* — matches rhs_spec
+        if channel_last:
+            w_t = jnp.moveaxis(w, (0, 1), (-1, -2))  # OI* -> *IO
+            w_use = w_t
+        else:
+            w_use = w
+        out = jax.lax.conv_general_dilated(
+            x, w_use, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, feature_group_count=groups,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec))
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    return dispatch(f"conv{n}d", raw, x, weight, bias)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1, df)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, groups,
+                    dilation, n, data_format, output_size=None):
+    channel_last = not data_format.startswith("NC")
+    lhs_spec, rhs_spec, out_spec = _dims(n, channel_last)
+    stride = _norm_tuple(stride, n)
+    dilation = _norm_tuple(dilation, n)
+    opad = _norm_tuple(output_padding, n)
+    pad_arg = _padding(padding, n, data_format)
+
+    def raw(x, w, b):
+        # paddle transpose-conv weight layout: (in_c, out_c/groups, *k) == IO*
+        # grad-of-conv formulation: lhs_dilation=stride
+        if isinstance(pad_arg, str):
+            pads = pad_arg
+        else:
+            k = [(w.shape[2 + i] - 1) * dilation[i] + 1 for i in range(n)]
+            pads = [(k[i] - 1 - pad_arg[i][0],
+                     k[i] - 1 - pad_arg[i][1] + opad[i]) for i in range(n)]
+        w_flip = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        # IO* -> OI* with group interleave
+        i_c, o_cg = w.shape[0], w.shape[1]
+        if groups > 1:
+            wg = w_flip.reshape((groups, i_c // groups, o_cg) + w.shape[2:])
+            wg = jnp.swapaxes(wg, 1, 2)
+            w_oi = wg.reshape((groups * o_cg, i_c // groups) + w.shape[2:])
+        else:
+            w_oi = jnp.swapaxes(w_flip, 0, 1)
+        if channel_last:
+            w_use = jnp.moveaxis(w_oi, (0, 1), (-1, -2))
+        else:
+            w_use = w_oi
+        out = jax.lax.conv_general_dilated(
+            x, w_use, window_strides=(1,) * n, padding=pads,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            feature_group_count=groups,
+            dimension_numbers=(lhs_spec, rhs_spec, out_spec))
+        if b is not None:
+            shape = [1] * out.ndim
+            shape[1 if not channel_last else -1] = b.shape[0]
+            out = out + b.reshape(shape)
+        return out
+    return dispatch(f"conv{n}d_transpose", raw, x, weight, bias)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    df = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 1, df, output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 2, data_format, output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           groups, dilation, 3, data_format, output_size)

@@ -1,0 +1,283 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py,
+operators/softmax_with_cross_entropy_op, cross_entropy_op, bce_loss_op…)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import dispatch
+from ...core.tensor import unwrap
+
+
+def _reduce(loss, reduction, weight_sum=None):
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if weight_sum is not None:
+        return jnp.sum(loss) / weight_sum
+    return jnp.mean(loss)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """Fused softmax+CE (reference: operators/softmax_with_cross_entropy_op.cc).
+    XLA fuses log_softmax+gather; numerically stable."""
+    def raw(logits, label, w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits, 1e-30))
+        if soft_label or (not jnp.issubdtype(label.dtype, jnp.integer)
+                          and label.ndim == logits.ndim
+                          and label.shape == logits.shape):
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                label = label * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(label * logp, axis=axis)
+            return _reduce(loss, reduction)
+        lbl = label.astype(jnp.int32)
+        squeeze = (lbl.ndim == logits.ndim and lbl.shape[axis] == 1)
+        if squeeze:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        if label_smoothing > 0.0:
+            k = logits.shape[axis]
+            onehot = jax.nn.one_hot(lbl, k, axis=axis)
+            soft = onehot * (1 - label_smoothing) + label_smoothing / k
+            loss = -jnp.sum(soft * logp, axis=axis)
+        else:
+            lbl_safe = jnp.where(lbl == ignore_index, 0, lbl)
+            loss = -jnp.take_along_axis(
+                logp, jnp.expand_dims(lbl_safe, axis), axis=axis)
+            loss = jnp.squeeze(loss, axis=axis)
+        mask = (lbl != ignore_index)
+        loss = jnp.where(mask, loss, 0.0)
+        if w is not None:
+            wsel = jnp.take(w, jnp.where(lbl == ignore_index, 0, lbl))
+            wsel = jnp.where(mask, wsel, 0.0)
+            loss = loss * wsel
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+    return dispatch("cross_entropy", raw, input, label, weight)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    out = cross_entropy(logits, label, soft_label=soft_label,
+                        ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+    out = out.unsqueeze(axis) if out.ndim < unwrap(logits).ndim else out
+    if return_softmax:
+        return out, _softmax(logits, axis=axis)
+    return out
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100,  # noqa: A002
+             reduction="mean", name=None):
+    def raw(logp, label, w):
+        lbl = label.astype(jnp.int32)
+        lbl_safe = jnp.where(lbl == ignore_index, 0, lbl)
+        if logp.ndim > 2:
+            # (N, C, d1...) -> move C last
+            lp = jnp.moveaxis(logp, 1, -1)
+            loss = -jnp.take_along_axis(lp, lbl_safe[..., None], axis=-1)[..., 0]
+        else:
+            loss = -jnp.take_along_axis(logp, lbl_safe[..., None], axis=-1)[..., 0]
+        mask = (lbl != ignore_index)
+        loss = jnp.where(mask, loss, 0.0)
+        if w is not None:
+            wsel = jnp.take(w, lbl_safe) * mask.astype(loss.dtype)
+            loss = loss * wsel
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wsel), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask.astype(loss.dtype)), 1.0)
+        return _reduce(loss, reduction)
+    return dispatch("nll_loss", raw, input, label, weight)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):  # noqa: A002
+    def raw(p, y, w):
+        eps = 1e-12
+        loss = -(y * jnp.log(jnp.maximum(p, eps))
+                 + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    return dispatch("binary_cross_entropy", raw, input, label, weight)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None, name=None):
+    def raw(z, y, w, pw):
+        neg_abs = -jnp.abs(z)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            loss = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(neg_abs))
+                                          + jnp.maximum(-z, 0.0))
+        else:
+            loss = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(neg_abs))
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    return dispatch("bce_with_logits", raw, logit, label, weight, pos_weight)
+
+
+def mse_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    def raw(x, y):
+        return _reduce(jnp.square(x - y), reduction)
+    return dispatch("mse_loss", raw, input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):  # noqa: A002
+    def raw(x, y):
+        return _reduce(jnp.abs(x - y), reduction)
+    return dispatch("l1_loss", raw, input, label)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):  # noqa: A002
+    def raw(x, y):
+        d = jnp.abs(x - y)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return dispatch("smooth_l1_loss", raw, input, label)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):  # noqa: A002
+    def raw(logp, y):
+        if log_target:
+            loss = jnp.exp(y) * (y - logp)
+        else:
+            loss = jnp.where(y > 0, y * (jnp.log(jnp.maximum(y, 1e-12)) - logp), 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logp.shape[0]
+        return _reduce(loss, reduction)
+    return dispatch("kl_div", raw, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):  # noqa: A002
+    def raw(x1, x2, y):
+        loss = jnp.maximum(-y * (x1 - x2) + margin, 0.0)
+        return _reduce(loss, reduction)
+    return dispatch("margin_ranking_loss", raw, input, other, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):  # noqa: A002
+    def raw(x, y):
+        loss = jnp.where(y == 1, x, jnp.maximum(margin - x, 0.0))
+        return _reduce(loss, reduction)
+    return dispatch("hinge_embedding_loss", raw, input, label)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    def raw(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(cos - margin, 0.0))
+        return _reduce(loss, reduction)
+    return dispatch("cosine_embedding_loss", raw, input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,  # noqa: A002
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def raw(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        loss = jnp.maximum(d_ap - d_an + margin, 0.0)
+        return _reduce(loss, reduction)
+    return dispatch("triplet_margin_loss", raw, input, positive, negative)
+
+
+def square_error_cost(input, label):  # noqa: A002
+    def raw(x, y):
+        return jnp.square(x - y)
+    return dispatch("square_error_cost", raw, input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):  # noqa: A002
+    def raw(p, y):
+        return -(y * jnp.log(p + epsilon) + (1 - y) * jnp.log(1 - p + epsilon))
+    return dispatch("log_loss", raw, input, label)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def raw(z, y, norm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if norm is not None:
+            loss = loss / norm
+        return _reduce(loss, reduction)
+    return dispatch("sigmoid_focal_loss", raw, logit, label, normalizer)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC (reference: operators/warpctc_op → warp-ctc).  TPU-native: dynamic-
+    programming forward in log space via lax.scan, fully jittable."""
+    def raw(logp, labels, in_len, lbl_len):
+        # logp: (T, N, C) paddle layout
+        T, N, C = logp.shape
+        S = labels.shape[1]
+        # extended label seq with blanks: length 2S+1
+        ext = jnp.full((N, 2 * S + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(labels.astype(jnp.int32))
+        ext_len = 2 * lbl_len.astype(jnp.int32) + 1
+
+        neg_inf = jnp.asarray(-1e30, logp.dtype)
+        alpha0 = jnp.full((N, 2 * S + 1), neg_inf, logp.dtype)
+        alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(N), ext[:, 0]])
+        valid1 = (ext_len > 1)
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(valid1, logp[0, jnp.arange(N), ext[:, 1]], neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, t):
+            lp = logp[t]  # (N, C)
+            a_shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a2)
+            emit = jnp.take_along_axis(lp, ext, axis=1)
+            new_alpha = merged + emit
+            # freeze past input length
+            new_alpha = jnp.where((t < in_len)[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        idx_last = ext_len - 1
+        ar = jnp.arange(N)
+        ll = jnp.logaddexp(alpha[ar, idx_last],
+                           jnp.where(idx_last - 1 >= 0, alpha[ar, jnp.maximum(idx_last - 1, 0)], neg_inf))
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+    return dispatch("ctc_loss", raw, log_probs, labels, input_lengths, label_lengths)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def raw(a, p, y):
+        reg = l2_reg * (jnp.sum(jnp.mean(a * a, axis=1)) + jnp.sum(jnp.mean(p * p, axis=1))) * 0.25
+        logits = a @ p.T
+        same = (y[:, None] == y[None, :]).astype(logits.dtype)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        xe = -jnp.sum(same * jax.nn.log_softmax(logits, axis=1), axis=1)
+        return jnp.mean(xe) + reg
+    return dispatch("npair_loss", raw, anchor, positive, labels)

@@ -1,0 +1,151 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py,
+operators/batch_norm_op, layer_norm_op, group_norm_op, instance_norm_op).
+XLA fuses these elementwise chains into surrounding matmuls/convs on TPU."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.op import dispatch
+from ...core.tensor import Tensor, unwrap
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Returns normalized x; updates running stats in-place when training
+    (the reference's batch_norm_op does the same via MomentumTensor outputs)."""
+    channel_axis = 1 if data_format.startswith("NC") and unwrap(x).ndim > 1 else -1
+    use_batch_stats = training and not use_global_stats
+
+    xv = unwrap(x)
+    axes = tuple(i for i in range(xv.ndim) if i != channel_axis % xv.ndim)
+
+    if use_batch_stats:
+        # compute batch stats eagerly (outside tape) for the running update
+        mean_now = jnp.mean(unwrap(x), axis=axes)
+        var_now = jnp.var(unwrap(x), axis=axes)
+        if running_mean is not None:
+            rm = unwrap(running_mean)
+            rv = unwrap(running_var)
+            running_mean._set_data(momentum * rm + (1 - momentum) * mean_now)
+            running_var._set_data(momentum * rv + (1 - momentum) * var_now)
+
+    def raw(x, w, b, rm, rv):
+        if use_batch_stats:
+            m = jnp.mean(x, axis=axes)
+            v = jnp.var(x, axis=axes)
+        else:
+            m, v = rm, rv
+        shape = [1] * x.ndim
+        shape[channel_axis % x.ndim] = x.shape[channel_axis % x.ndim]
+        inv = jnp.asarray(1.0, x.dtype) / jnp.sqrt(v.reshape(shape) + epsilon)
+        out = (x - m.reshape(shape)) * inv
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+
+    # stop grads through running stats
+    rm_in = unwrap(running_mean) if running_mean is not None else None
+    rv_in = unwrap(running_var) if running_var is not None else None
+    return dispatch("batch_norm", raw, x, weight, bias, rm_in, rv_in)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    ns = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+    n_axes = len(ns)
+    def raw(x, w, b):
+        axes = tuple(range(x.ndim - n_axes, x.ndim))
+        m = jnp.mean(x, axis=axes, keepdims=True)
+        v = jnp.var(x, axis=axes, keepdims=True)
+        out = (x - m) / jnp.sqrt(v + epsilon)
+        if w is not None:
+            out = out * w.reshape(ns)
+        if b is not None:
+            out = out + b.reshape(ns)
+        return out
+    return dispatch("layer_norm", raw, x, weight, bias)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def raw(x, w, b):
+        axes = tuple(range(2, x.ndim))
+        m = jnp.mean(x, axis=axes, keepdims=True)
+        v = jnp.var(x, axis=axes, keepdims=True)
+        out = (x - m) / jnp.sqrt(v + eps)
+        if w is not None:
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            out = out * w.reshape(shape)
+        if b is not None:
+            shape = (1, -1) + (1,) * (x.ndim - 2)
+            out = out + b.reshape(shape)
+        return out
+    return dispatch("instance_norm", raw, x, weight, bias)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def raw(x, w, b):
+        if data_format.startswith("NC"):
+            n, c = x.shape[0], x.shape[1]
+            spatial = x.shape[2:]
+            xg = x.reshape((n, num_groups, c // num_groups) + spatial)
+            axes = tuple(range(2, xg.ndim))
+            m = jnp.mean(xg, axis=axes, keepdims=True)
+            v = jnp.var(xg, axis=axes, keepdims=True)
+            out = ((xg - m) / jnp.sqrt(v + epsilon)).reshape(x.shape)
+            shape = (1, c) + (1,) * len(spatial)
+        else:
+            n, c = x.shape[0], x.shape[-1]
+            spatial = x.shape[1:-1]
+            xg = x.reshape((n,) + spatial + (num_groups, c // num_groups))
+            axes = tuple(range(1, xg.ndim - 2)) + (xg.ndim - 1,)
+            m = jnp.mean(xg, axis=axes, keepdims=True)
+            v = jnp.var(xg, axis=axes, keepdims=True)
+            out = ((xg - m) / jnp.sqrt(v + epsilon)).reshape(x.shape)
+            shape = (1,) + (1,) * len(spatial) + (c,)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+    return dispatch("group_norm", raw, x, weight, bias)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def raw(x):
+        ch_ax = 1 if data_format.startswith("NC") else x.ndim - 1
+        sq = jnp.square(x)
+        c = x.shape[ch_ax]
+        half = size // 2
+        pads = [(0, 0)] * x.ndim
+        pads[ch_ax] = (half, size - half - 1)
+        sqp = jnp.pad(sq, pads)
+        acc = jnp.zeros_like(x)
+        for i in range(size):
+            sl = [slice(None)] * x.ndim
+            sl[ch_ax] = slice(i, i + c)
+            acc = acc + sqp[tuple(sl)]
+        div = (k + alpha * acc) ** beta
+        return x / div
+    return dispatch("local_response_norm", raw, x)
+
+
+def normalize(x, p=2.0, axis=1, epsilon=1e-12, name=None):
+    def raw(x):
+        norm = jnp.sum(jnp.abs(x) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return x / jnp.maximum(norm, epsilon)
+    return dispatch("normalize", raw, x)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """RMSNorm — not in the 2.0 reference but required by modern LLM configs."""
+    def raw(x, w):
+        ms = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (x.astype(jnp.float32) / jnp.sqrt(ms + epsilon)).astype(x.dtype)
+        return out if w is None else out * w
+    return dispatch("rms_norm", raw, x, weight)

@@ -1,0 +1,186 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py,
+operators/pool_op + math/pooling).  Implemented on lax.reduce_window."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import dispatch
+
+
+def _tup(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _pad_spec(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    p = list(padding)
+    if len(p) == n:
+        return [(int(q), int(q)) for q in p]
+    if len(p) == 2 * n:
+        return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(n)]
+    return [tuple(q) for q in p]
+
+
+def _pool(x, kernel, stride, padding, n, data_format, kind, exclusive=True,
+          ceil_mode=False):
+    channel_last = not data_format.startswith("NC")
+    kernel = _tup(kernel, n)
+    stride = _tup(stride if stride is not None else kernel, n)
+    pads = _pad_spec(padding, n)
+
+    def raw(x):
+        if channel_last:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pad_full = ([(0, 0)] + list(pads) + [(0, 0)]) if not isinstance(pads, str) else pads
+        else:
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pad_full = ([(0, 0), (0, 0)] + list(pads)) if not isinstance(pads, str) else pads
+        if isinstance(pad_full, str):
+            pad_cfg = pad_full
+        else:
+            pad_cfg = pad_full
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+            return jax.lax.reduce_window(x, init, jax.lax.max, window, strides,
+                                         pad_cfg)
+        # avg
+        ones = jnp.ones_like(x)
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pad_cfg)
+        if exclusive and not isinstance(pad_cfg, str):
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                        pad_cfg)
+            return s / cnt
+        return s / float(np.prod(kernel))
+    return dispatch(f"{kind}_pool{n}d", raw, x)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCW", "avg", exclusive, ceil_mode)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg", exclusive, ceil_mode)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg", exclusive, ceil_mode)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    out = _pool(x, kernel_size, stride, padding, 1, "NCW", "max", ceil_mode=ceil_mode)
+    return (out, _pool_indices(x, kernel_size, stride, padding, 1, "NCW")) if return_mask else out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode=ceil_mode)
+    return (out, _pool_indices(x, kernel_size, stride, padding, 2, data_format)) if return_mask else out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode=ceil_mode)
+    return (out, _pool_indices(x, kernel_size, stride, padding, 3, data_format)) if return_mask else out
+
+
+def _pool_indices(x, kernel, stride, padding, n, data_format):
+    """Flat argmax indices within each window (paddle return_mask)."""
+    from ...core.tensor import unwrap, Tensor
+    xv = unwrap(x)
+    kernel = _tup(kernel, n)
+    stride = _tup(stride if stride is not None else kernel, n)
+    pads = _pad_spec(padding, n)
+    spatial = xv.shape[2:]
+    flat_idx = jnp.arange(int(np.prod(spatial)), dtype=jnp.float64
+                          if False else jnp.int32).reshape(spatial)
+    flat_idx = jnp.broadcast_to(flat_idx, xv.shape)
+    # select index of max via reduce_window over (value, index) pairs
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pad_full = ([(0, 0), (0, 0)] + list(pads)) if not isinstance(pads, str) else pads
+    init = (jnp.asarray(-jnp.inf, xv.dtype), jnp.asarray(-1, jnp.int32))
+    vals, idxs = jax.lax.reduce_window((xv, flat_idx), init, sel, window,
+                                       strides, pad_full)
+    return Tensor(idxs)
+
+
+def _adaptive_out(in_size, out_size):
+    starts = (np.arange(out_size) * in_size) // out_size
+    ends = ((np.arange(out_size) + 1) * in_size + out_size - 1) // out_size
+    return starts, ends
+
+
+def _adaptive_pool(x, output_size, n, data_format, kind):
+    out_sz = _tup(output_size, n)
+
+    def raw(x):
+        # uniform-window fast path: in divisible by out
+        spatial = x.shape[2:] if data_format.startswith("NC") else x.shape[1:-1]
+        if all(s % o == 0 for s, o in zip(spatial, out_sz)):
+            kernel = tuple(s // o for s, o in zip(spatial, out_sz))
+            window = (1, 1) + kernel if data_format.startswith("NC") else (1,) + kernel + (1,)
+            if kind == "max":
+                init = -jnp.inf
+                return jax.lax.reduce_window(x, init, jax.lax.max, window, window, "VALID")
+            s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, window, "VALID")
+            return s / float(np.prod(kernel))
+        # general: gather per output cell (static python loop; shapes static)
+        axes = list(range(2, 2 + n)) if data_format.startswith("NC") else list(range(1, 1 + n))
+        out = x
+        for d, ax in enumerate(axes):
+            starts, ends = _adaptive_out(out.shape[ax], out_sz[d])
+            slabs = []
+            for s0, e0 in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[ax] = slice(int(s0), int(e0))
+                piece = out[tuple(sl)]
+                red = jnp.max(piece, axis=ax, keepdims=True) if kind == "max" \
+                    else jnp.mean(piece, axis=ax, keepdims=True)
+                slabs.append(red)
+            out = jnp.concatenate(slabs, axis=ax)
+        return out
+    return dispatch(f"adaptive_{kind}_pool{n}d", raw, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "NCW", "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, data_format, "avg")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, data_format, "avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool(x, output_size, 1, "NCW", "max")
+    return out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "NCHW", "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "NCDHW", "max")

@@ -1,0 +1,188 @@
+"""Parameter initializers (reference: python/paddle/fluid/initializer.py —
+Constant/Normal/TruncatedNormal/Uniform/Xavier/MSRA implemented there as
+fill/gaussian_random ops appended to the startup program; here they are pure
+functions producing jax arrays at parameter creation time).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import rng as _rng
+from ..core.tensor import unwrap
+
+
+class Initializer:
+    def _build(self, shape, dtype):
+        raise NotImplementedError
+
+    def __call__(self, shape, dtype=jnp.float32):
+        return self._build(tuple(shape), dtype)
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def _build(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _build(self, shape, dtype):
+        return (self.mean + self.std
+                * jax.random.normal(_rng.next_key(), shape)).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def _build(self, shape, dtype):
+        z = jax.random.truncated_normal(_rng.next_key(), -2.0, 2.0, shape)
+        return (self.mean + self.std * z).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def _build(self, shape, dtype):
+        return jax.random.uniform(_rng.next_key(), shape, jnp.float32,
+                                  self.low, self.high).astype(dtype)
+
+
+def _fan_in_out(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle Linear weights are (in, out)
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weights are (out_c, in_c, *k)
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _build(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (std * jax.random.normal(_rng.next_key(), shape)).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def _build(self, shape, dtype):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(_rng.next_key(), shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope = fan_in, negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _build(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) \
+            if self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        std = gain / math.sqrt(fi)
+        return (std * jax.random.normal(_rng.next_key(), shape)).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope = fan_in, negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _build(self, shape, dtype):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2)) \
+            if self.nonlinearity in ("relu", "leaky_relu") else 1.0
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(_rng.next_key(), shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def _build(self, shape, dtype):
+        arr = jnp.asarray(unwrap(self.value), dtype)
+        return jnp.reshape(arr, shape)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def _build(self, shape, dtype):
+        out = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        k_center = tuple(s // 2 for s in shape[2:])
+        per = oc // self.groups
+        for g in range(self.groups):
+            for i in range(min(per, ic)):
+                out[(g * per + i, i) + k_center] = 1.0
+        return jnp.asarray(out, dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def _build(self, shape, dtype):
+        rows = shape[0]
+        cols = int(np.prod(shape)) // rows
+        flat = jax.random.normal(_rng.next_key(), (max(rows, cols), min(rows, cols)))
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        return (self.gain * q[:rows, :cols].reshape(shape)).astype(dtype)
+
+
+# paddle.nn.initializer default (reference initializer.py: Xavier default for
+# weights, Constant(0) for bias)
+def default_weight_init():
+    return XavierNormal()
+
+
+def default_bias_init():
+    return Constant(0.0)
+
+
+def calculate_gain(nonlinearity, param=None):
+    if nonlinearity in ("sigmoid", "linear", "conv1d", "conv2d", "conv3d"):
+        return 1.0
+    if nonlinearity == "tanh":
+        return 5.0 / 3.0
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    if nonlinearity == "selu":
+        return 3.0 / 4.0
+    raise ValueError(f"unknown nonlinearity {nonlinearity}")

@@ -1,0 +1,1 @@
+from . import container, common, activation, conv, norm, pooling, loss, rnn, transformer  # noqa: F401

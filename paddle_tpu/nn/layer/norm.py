@@ -1,0 +1,213 @@
+"""Norm layers (reference: python/paddle/nn/layer/norm.py — BatchNorm1D/2D/3D,
+LayerNorm, GroupNorm, InstanceNorm, SyncBatchNorm, SpectralNorm)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ..layer_base import Layer
+from .. import functional as F
+from .. import initializer as I
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum, self.epsilon = momentum, epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
+
+    def forward(self, x):
+        return F.batch_norm(x, self._mean, self._variance, self.weight,
+                            self.bias, training=self.training,
+                            momentum=self.momentum, epsilon=self.epsilon,
+                            data_format=self.data_format,
+                            use_global_stats=self.use_global_stats)
+
+
+class BatchNorm(_BatchNormBase):
+    """fluid-style BatchNorm(num_channels) (reference: dygraph/nn.py BatchNorm)."""
+
+    def __init__(self, num_channels, act=None, momentum=0.9, epsilon=1e-5,
+                 param_attr=None, bias_attr=None, dtype="float32",
+                 data_layout="NCHW", in_place=False, use_global_stats=False,
+                 trainable_statistics=False):
+        super().__init__(num_channels, momentum, epsilon, param_attr,
+                         bias_attr, data_layout,
+                         use_global_stats or None)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            out = getattr(F, self._act)(out)
+        return out
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Cross-replica BN (reference: sync_batch_norm_op.cu + sync_batch_norm_pass).
+    Under pjit/shard_map the batch axis stats are computed globally by XLA when
+    the batch is sharded — in the eager single-host path this reduces to BatchNorm.
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, _BatchNormBase) and not isinstance(sub, SyncBatchNorm):
+                sync = SyncBatchNorm(sub.num_features, sub.momentum, sub.epsilon,
+                                     data_format=sub.data_format)
+                sync.weight, sync.bias = sub.weight, sub.bias
+                sync._buffers = sub._buffers
+                layer._sub_layers[name] = sync
+                setattr(layer, name, sync)
+            else:
+                cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, name=None):
+        super().__init__()
+        ns = ((normalized_shape,) if isinstance(normalized_shape, int)
+              else tuple(normalized_shape))
+        self.normalized_shape = ns
+        self.epsilon = epsilon
+        import numpy as np
+        n = int(np.prod(ns))
+        self.weight = self.create_parameter(
+            (n,), attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter((n,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias,
+                            self.epsilon)
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size, epsilon=1e-6, weight_attr=None, name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_groups, self.epsilon = num_groups, epsilon
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_channels,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter((num_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.epsilon, self.weight,
+                            self.bias, self.data_format)
+
+
+class _InstanceNormBase(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        if weight_attr is False or bias_attr is False:
+            self.weight = self.bias = None
+        else:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+            self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self.epsilon)
+
+
+class InstanceNorm1D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm2D(_InstanceNormBase):
+    pass
+
+
+class InstanceNorm3D(_InstanceNormBase):
+    pass
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta,
+                                     self.k, self.data_format)
+
+
+class SpectralNorm(Layer):
+    """Weight spectral normalization via power iteration
+    (reference: operators/spectral_norm_op)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self.dim, self.power_iters, self.eps = dim, power_iters, eps
+        import numpy as np
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            (h,), default_initializer=I.Normal(0, 1))
+        self.weight_v = self.create_parameter(
+            (w,), default_initializer=I.Normal(0, 1))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ...core.op import dispatch
+        dim, eps, iters = self.dim, self.eps, self.power_iters
+
+        def raw(w, u, v):
+            wm = jnp.moveaxis(w, dim, 0)
+            mat = wm.reshape(wm.shape[0], -1)
+            for _ in range(iters):
+                v = mat.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = mat @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            sigma = u @ mat @ v
+            return w / sigma
+        return dispatch("spectral_norm", raw, weight, self.weight_u, self.weight_v)

@@ -1,0 +1,336 @@
+"""Recurrent layers.
+
+Reference: python/paddle/nn/layer/rnn.py (SimpleRNN/LSTM/GRU + cells) backed by
+operators/rnn_op (cudnn) and the fluid dynamic_rnn machinery.  TPU-native:
+a single `lax.scan` over time inside the op — XLA compiles the whole unrolled
+loop; no cudnn descriptor management, no LoD.  Variable-length sequences use
+`sequence_length` masking (the LoD-free formulation).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import dispatch
+from ..layer_base import Layer
+from .. import initializer as I
+
+
+def _uniform_init(hidden_size):
+    std = 1.0 / math.sqrt(hidden_size)
+    return I.Uniform(-std, std)
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...tensor.creation import full
+        batch = batch_ref.shape[batch_dim_idx]
+        return full((batch, self.hidden_size), init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def raw(x, h, wi, wh, bi, bh):
+            out = act(x @ wi.T + bi + h @ wh.T + bh)
+            return out, out
+        return dispatch("simple_rnn_cell", raw, inputs, states,
+                        self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((4 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((4 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = (self.get_initial_states(inputs),
+                      self.get_initial_states(inputs))
+        h, c = states
+
+        def raw(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return h2, (h2, c2)
+        return dispatch("lstm_cell", raw, inputs, h, c, self.weight_ih,
+                        self.weight_hh, self.bias_ih, self.bias_hh)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        init = _uniform_init(hidden_size)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter((3 * hidden_size,), bias_ih_attr,
+                                             is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter((3 * hidden_size,), bias_hh_attr,
+                                             is_bias=True, default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def raw(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(ic + r * hc)
+            h2 = (1 - z) * n + z * h
+            return h2, h2
+        return dispatch("gru_cell", raw, inputs, states, self.weight_ih,
+                        self.weight_hh, self.bias_ih, self.bias_hh)
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (reference: nn/layer/rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse, self.time_major = is_reverse, time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outs, states = _rnn_scan(self.cell, inputs, initial_states,
+                                 sequence_length, self.is_reverse,
+                                 self.time_major)
+        return outs, states
+
+
+def _cell_params(cell):
+    return [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+
+
+def _cell_step(cell, x, state, wi, wh, bi, bh):
+    """Pure-array single step for scan."""
+    if isinstance(cell, LSTMCell):
+        h, c = state
+        gates = x @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return h2, (h2, c2)
+    if isinstance(cell, GRUCell):
+        h = state
+        gi = x @ wi.T + bi
+        gh = h @ wh.T + bh
+        ir, iz, ic = jnp.split(gi, 3, axis=-1)
+        hr, hz, hc = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(ic + r * hc)
+        h2 = (1 - z) * n + z * h
+        return h2, h2
+    h = state
+    act = jnp.tanh if getattr(cell, "activation", "tanh") == "tanh" else jax.nn.relu
+    h2 = act(x @ wi.T + bi + h @ wh.T + bh)
+    return h2, h2
+
+
+def _rnn_scan(cell, inputs, initial_states, sequence_length, is_reverse,
+              time_major):
+    is_lstm = isinstance(cell, LSTMCell)
+
+    def raw(x, seq_len, wi, wh, bi, bh, *init):
+        xs = x if time_major else jnp.swapaxes(x, 0, 1)  # (T, B, F)
+        T, B = xs.shape[0], xs.shape[1]
+        if not init:
+            h0 = jnp.zeros((B, cell.hidden_size), xs.dtype)
+            state0 = (h0, jnp.zeros_like(h0)) if is_lstm else h0
+        else:
+            state0 = (init[0], init[1]) if is_lstm else init[0]
+        if is_reverse:
+            xs = jnp.flip(xs, axis=0)
+
+        def step(carry, xt):
+            state, t = carry
+            out, new_state = _cell_step(cell, xt, state, wi, wh, bi, bh)
+            if seq_len is not None:
+                tt = (T - 1 - t) if is_reverse else t
+                mask = (tt < seq_len)[:, None]
+                new_state = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(mask, n, o), new_state, state)
+                out = jnp.where(mask, out, jnp.zeros_like(out))
+            return (new_state, t + 1), out
+
+        (final_state, _), outs = jax.lax.scan(step, (state0, 0), xs)
+        if is_reverse:
+            outs = jnp.flip(outs, axis=0)
+        if not time_major:
+            outs = jnp.swapaxes(outs, 0, 1)
+        return outs, final_state
+
+    init_states = []
+    if initial_states is not None:
+        init_states = list(initial_states) if isinstance(initial_states, (tuple, list)) \
+            else [initial_states]
+    from ...core.tensor import unwrap
+    seq = unwrap(sequence_length) if sequence_length is not None else None
+    return dispatch("rnn_scan",
+                    lambda x, wi, wh, bi, bh, *init: raw(x, seq, wi, wh, bi, bh, *init),
+                    inputs, *_cell_params(cell), *init_states)
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw, sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw, sequence_length)
+        from ...tensor.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Stacked (bi)directional RNN (reference: nn/layer/rnn.py SimpleRNN/LSTM/GRU)."""
+
+    CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.hidden_size = hidden_size
+        kw = {}
+        if self.CELL is SimpleRNNCell:
+            kw["activation"] = activation
+        from .container import LayerList
+        self.layers = LayerList()
+        num_dir = 2 if self.bidirectional else 1
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 else hidden_size * num_dir
+            if self.bidirectional:
+                self.layers.append(BiRNN(
+                    self.CELL(in_sz, hidden_size, **kw),
+                    self.CELL(in_sz, hidden_size, **kw), time_major))
+            else:
+                self.layers.append(RNN(self.CELL(in_sz, hidden_size, **kw),
+                                       time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from .. import functional as F
+        from ...tensor.manipulation import stack
+        out = inputs
+        finals = []
+        for i, rnn in enumerate(self.layers):
+            init_i = None
+            if initial_states is not None:
+                init_i = _slice_states(initial_states, i, self.bidirectional)
+            out, st = rnn(out, init_i, sequence_length)
+            finals.append(st)
+            if self.dropout > 0 and i < self.num_layers - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        return out, _stack_states(finals, self.bidirectional,
+                                  isinstance(self, LSTM))
+
+
+def _slice_states(states, i, bidirectional):
+    # paddle states layout: (num_layers * num_dir, B, H) or tuple of those
+    num_dir = 2 if bidirectional else 1
+    def pick(s, j):
+        return s[i * num_dir + j]
+    if isinstance(states, (tuple, list)):  # lstm (h, c)
+        h, c = states
+        if bidirectional:
+            return ((pick(h, 0), pick(c, 0)), (pick(h, 1), pick(c, 1)))
+        return (pick(h, 0), pick(c, 0))
+    if bidirectional:
+        return (pick(states, 0), pick(states, 1))
+    return pick(states, 0)
+
+
+def _stack_states(finals, bidirectional, is_lstm):
+    from ...tensor.manipulation import stack
+    flat = []
+    for st in finals:
+        if bidirectional:
+            flat.extend([st[0], st[1]])
+        else:
+            flat.append(st)
+    if is_lstm:
+        hs = stack([f[0] for f in flat], axis=0)
+        cs = stack([f[1] for f in flat], axis=0)
+        return (hs, cs)
+    return stack(flat, axis=0)
+
+
+class SimpleRNN(_RNNBase):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    CELL = GRUCell

@@ -1,0 +1,6 @@
+"""paddle_tpu.ops — custom TPU kernels (pallas) and fused ops.
+
+Replaces the reference's hand-written CUDA fused ops
+(paddle/fluid/operators/fused/) with pallas/Mosaic kernels.
+"""
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,120 @@
+"""Flash attention for TPU (pallas).
+
+Replaces the reference's fused attention CUDA kernel
+(paddle/fluid/operators/fused/multihead_matmul_op.cu) with an online-softmax
+blocked kernel that never materializes the (seq, seq) score matrix in HBM —
+the key to long-context MFU on TPU (see /opt/skills/guides/pallas_guide.md).
+
+`flash_attention_bshd` returns None when the kernel doesn't apply (wrong
+platform/shape); callers fall back to the XLA-fused naive path.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def flash_attention_bshd(q, k, v, causal=False):
+    """q/k/v: (batch, seq, heads, head_dim). Returns same layout, or None."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if not _on_tpu():
+        return None
+    if d not in (64, 128, 256):
+        return None
+    if sq % 128 != 0 or sk % 128 != 0:
+        return None
+    if k.shape[2] != h:  # grouped-query: caller expands kv heads first
+        return None
+    try:
+        qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+        kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
+        vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+        out = _flash_bhsd(qt, kt, vt, causal)
+        return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+    except Exception:
+        return None
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _flash_bhsd(q, k, v, causal):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    blk_q = min(512, sq)
+    blk_k = min(512, sk)
+    n_k = sk // blk_k
+    scale = 1.0 / math.sqrt(d)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, -1e30)
+            l_ref[...] = jnp.zeros_like(l_ref)
+
+        def _compute():
+            qb = q_ref[0].astype(jnp.float32) * scale
+            kb = k_ref[0].astype(jnp.float32)
+            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if causal:
+                rows = qi * blk_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 0)
+                cols = ki * blk_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 1)
+                s = jnp.where(rows >= cols, s, -1e30)
+            m_prev = m_ref[...]
+            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_cur)
+            alpha = jnp.exp(m_prev - m_cur)
+            l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            m_ref[...] = m_cur
+            vb = v_ref[0].astype(jnp.float32)
+            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
+                p, vb, preferred_element_type=jnp.float32)
+
+        if causal:
+            @pl.when((ki * blk_k) <= (qi * blk_q + blk_q - 1))
+            def _go():
+                _compute()
+        else:
+            _compute()
+
+        @pl.when(ki == n_k - 1)
+        def _finish():
+            o_ref[0] = (acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
+                        ).astype(o_ref.dtype)
+
+    grid = (bh, sq // blk_q, n_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, d), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+            pltpu.VMEM((blk_q, 1), jnp.float32),
+        ],
+    )(q, k, v)

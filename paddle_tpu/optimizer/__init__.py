@@ -1,0 +1,4 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
+from . import lr  # noqa: F401
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,  # noqa: F401
+                        Adagrad, RMSProp, Adadelta, Lamb, LarsMomentum, Ftrl)

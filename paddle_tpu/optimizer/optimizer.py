@@ -1,0 +1,430 @@
+"""Optimizers.
+
+Reference: python/paddle/optimizer/ + device update kernels in
+paddle/fluid/operators/optimizers/ (sgd/momentum/adam/lamb/... CUDA kernels).
+TPU-native design: each optimizer defines a *pure functional* update
+(`init_state` / `update_one`) over raw jax arrays; the eager `.step()` applies
+it to the whole parameter pytree in ONE jitted XLA call (the analogue of the
+reference's fused optimizer kernels), and the same pure core is reused by the
+jit training path (paddle_tpu.jit.TrainStep) and by the FSDP/ZeRO sharding
+layer, where XLA partitions the update across the mesh.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .lr import LRScheduler
+
+
+class Optimizer:
+    """Base optimizer with paddle's eager API (step/clear_grad/minimize)."""
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=False):
+        if parameters is not None:
+            parameters = list(parameters)
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._name = name
+        # weight_decay: float -> L2 coefficient added to grads (paddle
+        # regularizer semantics); AdamW overrides with decoupled decay.
+        if weight_decay is None:
+            self._wd = 0.0
+        elif isinstance(weight_decay, (int, float)):
+            self._wd = float(weight_decay)
+        else:  # L1Decay/L2Decay object
+            self._wd = float(getattr(weight_decay, "_coeff",
+                                     getattr(weight_decay, "coeff", 0.0)))
+        self._step_count = 0
+        self._states: Dict[int, dict] = {}
+        self._jit_update = None
+        self._accumulators: Dict[str, Dict[int, Tensor]] = {}
+
+    # ---- functional core (override in subclasses) -------------------------
+    def init_state(self, p) -> dict:
+        return {}
+
+    def update_one(self, p, g, state: dict, lr, step) -> tuple:
+        """(new_param, new_state) from raw arrays. Pure."""
+        raise NotImplementedError
+
+    # ---- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return (self._learning_rate
+                if isinstance(self._learning_rate, LRScheduler) else None)
+
+    # ---- eager step ---------------------------------------------------------
+    def step(self):
+        params = [p for p in self._parameter_list
+                  if p.trainable and p.grad is not None]
+        if not params:
+            self._step_count += 1
+            return
+        grads = [p.grad for p in params]
+        if self._grad_clip is not None:
+            pg = self._grad_clip(list(zip(params, grads)))
+            grads = [g for _, g in pg]
+        # decoupled regularizer path: per-param regularizer overrides global wd
+        self._step_count += 1
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step = jnp.asarray(self._step_count, jnp.int32)
+
+        p_raw = [p._data for p in params]
+        g_raw = [g._data for g in grads]
+        states = [self._get_state(p) for p in params]
+        lr_mults = tuple(float(p.optimize_attr.get("learning_rate", 1.0))
+                         for p in params)
+
+        if self._jit_update is None:
+            wd = self._wd
+            def _tree_update(p_raw, g_raw, states, lr, step):
+                outs, new_states = [], []
+                for p, g, s, m in zip(p_raw, g_raw, states, lr_mults):
+                    if wd and jnp.issubdtype(p.dtype, jnp.floating):
+                        g = g + wd * p
+                    np_, ns = self.update_one(p, g, s, lr * m, step)
+                    outs.append(np_)
+                    new_states.append(ns)
+                return outs, new_states
+            self._jit_update = jax.jit(_tree_update)
+
+        new_p, new_states = self._jit_update(p_raw, g_raw, states, lr, step)
+        for p, np_, ns in zip(params, new_p, new_states):
+            p._set_data(np_)
+            self._states[id(p)] = ns
+
+    def _get_state(self, p):
+        s = self._states.get(id(p))
+        if s is None:
+            s = self.init_state(p._data)
+            self._states[id(p)] = s
+        return s
+
+    def clear_grad(self, set_to_zero=True):
+        for p in self._parameter_list or []:
+            p.grad = None
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Dygraph minimize: backward + step (reference fluid Optimizer.minimize)."""
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in self._parameter_list or []]
+
+    # ---- state dict ----------------------------------------------------------
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                s = self._states.get(id(p))
+                if s:
+                    for k, v in s.items():
+                        out[f"param{i}.{k}"] = Tensor(v)
+        sched = self._lr_scheduler
+        if sched is not None:
+            out["LR_Scheduler"] = sched.state_dict()
+        return out
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("_step_count", 0))
+        if "LR_Scheduler" in state_dict and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                st = {}
+                prefix = f"param{i}."
+                for k, v in state_dict.items():
+                    if isinstance(k, str) and k.startswith(prefix):
+                        st[k[len(prefix):]] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                if st:
+                    self._states[id(p)] = st
+        self._jit_update = None
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    """reference: operators/optimizers/sgd_op."""
+
+    def update_one(self, p, g, state, lr, step):
+        return p - lr.astype(p.dtype) * g.astype(p.dtype), state
+
+
+class Momentum(Optimizer):
+    """reference: operators/optimizers/momentum_op (incl. nesterov)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def init_state(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    def update_one(self, p, g, state, lr, step):
+        g = g.astype(p.dtype)
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr.astype(p.dtype) * (g + self._momentum * v)
+        else:
+            new_p = p - lr.astype(p.dtype) * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    """reference: operators/optimizers/adam_op (+ beta pow accumulators)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {"moment1": jnp.zeros_like(p, jnp.float32),
+                "moment2": jnp.zeros_like(p, jnp.float32)}
+
+    def update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._decoupled_wd = float(weight_decay) if weight_decay else 0.0
+        self._apply_decay_param_fun = apply_decay_param_fun
+        # remember which params get decay (by position) — resolved at step time
+        self._decay_mask = None
+
+    def step(self):
+        if self._decay_mask is None and self._parameter_list is not None:
+            fn = self._apply_decay_param_fun
+            self._decay_mask = {
+                id(p): (fn(p.name) if fn is not None and p.name else True)
+                for p in self._parameter_list}
+        super().step()
+
+    def update_one(self, p, g, state, lr, step):
+        new_p, new_state = super().update_one(p, g, state, lr, step)
+        if self._decoupled_wd and jnp.issubdtype(p.dtype, jnp.floating):
+            new_p = new_p - (lr * self._decoupled_wd * p.astype(jnp.float32)
+                             ).astype(p.dtype)
+        return new_p, new_state
+
+
+class Adamax(Optimizer):
+    """reference: operators/optimizers/adamax_op."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def init_state(self, p):
+        return {"moment": jnp.zeros_like(p, jnp.float32),
+                "inf_norm": jnp.zeros_like(p, jnp.float32)}
+
+    def update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g32
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g32))
+        t = step.astype(jnp.float32)
+        upd = lr / (1 - self._beta1 ** t) * m / (u + self._eps)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), \
+            {"moment": m, "inf_norm": u}
+
+
+class Adagrad(Optimizer):
+    """reference: operators/optimizers/adagrad_op."""
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def init_state(self, p):
+        return {"moment": jnp.full_like(p, self._init_acc, jnp.float32)}
+
+    def update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        acc = state["moment"] + jnp.square(g32)
+        upd = lr * g32 / (jnp.sqrt(acc) + self._eps)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), {"moment": acc}
+
+
+class RMSProp(Optimizer):
+    """reference: operators/optimizers/rmsprop_op (centered variant included)."""
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def init_state(self, p):
+        s = {"mean_square": jnp.zeros_like(p, jnp.float32),
+             "momentum": jnp.zeros_like(p, jnp.float32)}
+        if self._centered:
+            s["mean_grad"] = jnp.zeros_like(p, jnp.float32)
+        return s
+
+    def update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g32)
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+        else:
+            mg = None
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + lr * g32 / denom
+        new_state = {"mean_square": ms, "momentum": mom}
+        if mg is not None:
+            new_state["mean_grad"] = mg
+        return (p.astype(jnp.float32) - mom).astype(p.dtype), new_state
+
+
+class Adadelta(Optimizer):
+    """reference: operators/optimizers/adadelta_op."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps = rho, epsilon
+
+    def init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p, jnp.float32),
+                "avg_squared_update": jnp.zeros_like(p, jnp.float32)}
+
+    def update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * jnp.square(g32)
+        upd = (jnp.sqrt(state["avg_squared_update"] + self._eps)
+               / jnp.sqrt(asg + self._eps)) * g32
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * jnp.square(upd)
+        return (p.astype(jnp.float32) - lr * upd).astype(p.dtype), \
+            {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (reference: operators/optimizers/lamb_op)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lamb_wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def init_state(self, p):
+        return {"moment1": jnp.zeros_like(p, jnp.float32),
+                "moment2": jnp.zeros_like(p, jnp.float32)}
+
+    def update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        m = self._beta1 * state["moment1"] + (1 - self._beta1) * g32
+        v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g32)
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - self._beta1 ** t)
+        vhat = v / (1 - self._beta2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._eps) + self._lamb_wd * p32
+        w_norm = jnp.linalg.norm(p32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return (p32 - lr * trust * r).astype(p.dtype), \
+            {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Optimizer):
+    """reference: operators/optimizers/lars_momentum_op."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+
+    def init_state(self, p):
+        return {"velocity": jnp.zeros_like(p, jnp.float32)}
+
+    def update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + self._lars_wd * w_norm + 1e-12),
+            1.0)
+        v = self._momentum * state["velocity"] + lr * local_lr * (
+            g32 + self._lars_wd * p32)
+        return (p32 - v).astype(p.dtype), {"velocity": v}
+
+
+class Ftrl(Optimizer):
+    """reference: operators/optimizers/ftrl_op."""
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def init_state(self, p):
+        return {"squared": jnp.zeros_like(p, jnp.float32),
+                "linear": jnp.zeros_like(p, jnp.float32)}
+
+    def update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        p32 = p.astype(jnp.float32)
+        new_sq = state["squared"] + jnp.square(g32)
+        lp = -self._lr_power
+        sigma = (new_sq ** lp - state["squared"] ** lp) / lr
+        new_lin = state["linear"] + g32 - sigma * p32
+        pre = jnp.where(jnp.abs(new_lin) > self._l1,
+                        (jnp.sign(new_lin) * self._l1 - new_lin)
+                        / (new_sq ** lp / lr + 2 * self._l2),
+                        0.0)
+        return pre.astype(p.dtype), {"squared": new_sq, "linear": new_lin}

@@ -1,0 +1,1 @@
+"""parallel primitives namespace — see paddle_tpu.distributed."""

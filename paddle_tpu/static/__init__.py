@@ -1,0 +1,14 @@
+"""paddle.static compatibility layer.
+
+The reference's static-graph world (ProgramDesc + Executor) collapses into
+jit tracing here (SURVEY.md §7); this module keeps the paddle.static names
+usable: InputSpec for export signatures, save/load_inference_model over
+jax.export artifacts.
+"""
+from ..jit import InputSpec, save as save_inference_model_jit, load as load_inference_model  # noqa: F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kw):
+    raise NotImplementedError(
+        "Use paddle_tpu.jit.save(layer, path, input_spec=[...]) — tracing "
+        "replaces Program construction on TPU")

@@ -1,0 +1,14 @@
+"""paddle_tpu.tensor — the full tensor-op namespace (reference: python/paddle/tensor/)."""
+from .attribute import *  # noqa: F401,F403
+from .creation import *  # noqa: F401,F403
+from .einsum import einsum  # noqa: F401
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .patch import apply_patches, unbind  # noqa: F401
+
+apply_patches()

@@ -1,0 +1,41 @@
+"""Tensor attribute ops (reference: python/paddle/tensor/attribute.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def shape(x, name=None):
+    """paddle.shape: returns the shape as a 1-D int32 tensor."""
+    return Tensor(jnp.asarray(unwrap(x).shape, jnp.int32))
+
+
+def rank(x, name=None):
+    return Tensor(jnp.asarray(unwrap(x).ndim, jnp.int32))
+
+
+def numel(x, name=None):
+    import numpy as np
+    return Tensor(jnp.asarray(int(np.prod(unwrap(x).shape)), jnp.int64))
+
+
+def real(x, name=None):
+    return dispatch("real", jnp.real, x)
+
+
+def imag(x, name=None):
+    return dispatch("imag", jnp.imag, x)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.integer)
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating)

@@ -1,0 +1,221 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.device import current_jax_device
+from ..core.op import defop, dispatch
+from ..core.tensor import Tensor, Parameter, unwrap
+
+
+def _resolve_dtype(dtype, default=None):
+    if dtype is None:
+        return default
+    return _dt.convert_dtype(dtype)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor: create a Tensor from python/numpy/Tensor data."""
+    if isinstance(data, Tensor):
+        arr = data._data
+        if dtype is not None:
+            arr = arr.astype(_dt.convert_dtype(dtype))
+        return Tensor(arr, stop_gradient=stop_gradient)
+    arr = np.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(np.dtype(_dt.convert_dtype(dtype)))
+    elif arr.dtype == np.float64:
+        arr = arr.astype(np.dtype(_dt.default_float_dtype()))
+    dev = place.jax_device if place is not None and hasattr(place, "jax_device") \
+        else current_jax_device()
+    return Tensor(jax.device_put(arr, dev), stop_gradient=stop_gradient)
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(unwrap(s)) if not isinstance(s, int) else s for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    dtype = _resolve_dtype(dtype, _dt.default_float_dtype())
+    return Tensor(jnp.zeros(_shape_list(shape), dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    dtype = _resolve_dtype(dtype, _dt.default_float_dtype())
+    return Tensor(jnp.ones(_shape_list(shape), dtype))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill_value = unwrap(fill_value)
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = jnp.bool_
+        elif isinstance(fill_value, int):
+            dtype = jnp.int32 if abs(int(fill_value)) < 2**31 else jnp.int64
+        else:
+            dtype = _dt.default_float_dtype()
+    else:
+        dtype = _dt.convert_dtype(dtype)
+    return Tensor(jnp.full(_shape_list(shape), fill_value, dtype))
+
+
+@defop("zeros_like")
+def _zeros_like_raw(x, dtype=None):
+    return jnp.zeros_like(x, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return _zeros_like_raw(x, dtype=_resolve_dtype(dtype))
+
+
+@defop("ones_like")
+def _ones_like_raw(x, dtype=None):
+    return jnp.ones_like(x, dtype=dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return _ones_like_raw(x, dtype=_resolve_dtype(dtype))
+
+
+@defop("full_like")
+def _full_like_raw(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return _full_like_raw(x, unwrap(fill_value), dtype=_resolve_dtype(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = (_dt.default_float_dtype()
+                 if any(isinstance(v, float) for v in (start, end, step))
+                 else jnp.int64)
+    else:
+        dtype = _dt.convert_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    dtype = _resolve_dtype(dtype, _dt.default_float_dtype())
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)), dtype=dtype))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dtype = _resolve_dtype(dtype, _dt.default_float_dtype())
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               base=unwrap(base), dtype=dtype))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dtype = _resolve_dtype(dtype, _dt.default_float_dtype())
+    return Tensor(jnp.eye(int(num_rows),
+                          int(num_columns) if num_columns is not None else None,
+                          dtype=dtype))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+@defop("diag")
+def _diag_raw(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+        return jnp.where(mask, d, padding_value)
+    return jnp.diag(x, k=offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    return _diag_raw(x, offset=offset, padding_value=padding_value)
+
+
+@defop("diagflat")
+def _diagflat_raw(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def diagflat(x, offset=0, name=None):
+    return _diagflat_raw(x, offset=offset)
+
+
+@defop("tril")
+def _tril_raw(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril_raw(x, diagonal=diagonal)
+
+
+@defop("triu")
+def _triu_raw(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu_raw(x, diagonal=diagonal)
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*arrs, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None):
+    """paddle.assign: copy input into output (or a fresh tensor)."""
+    data = jnp.asarray(unwrap(x))
+    if output is None:
+        return Tensor(data)
+    output._set_data(data)
+    return output
+
+
+def clone(x, name=None):
+    return x.clone() if isinstance(x, Tensor) else Tensor(jnp.copy(unwrap(x)))
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..nn import initializer as init
+    dtype = _dt.convert_dtype(dtype)
+    if default_initializer is None:
+        default_initializer = (init.Constant(0.0) if is_bias
+                               else init.XavierNormal())
+    data = default_initializer._build(tuple(shape), dtype)
+    return Parameter(data, name=name)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt.convert_dtype(dtype)))
+
+
+def complex(real, imag, name=None):
+    return dispatch("complex", lambda r, i: jax.lax.complex(r, i), real, imag)
+
+
+def clone_detached(x):
+    return Tensor(jnp.copy(unwrap(x)))

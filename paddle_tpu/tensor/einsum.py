@@ -1,0 +1,11 @@
+"""einsum (reference: python/paddle/tensor/einsum.py) — direct jnp lowering,
+XLA fuses to dot_general on the MXU."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op import dispatch
+
+
+def einsum(equation, *operands):
+    return dispatch("einsum", lambda *ops: jnp.einsum(equation, *ops), *operands)

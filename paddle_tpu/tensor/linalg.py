@@ -1,0 +1,220 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py, operators/matmul_op,
+operators/math/blas.h).  matmul maps directly onto the MXU via XLA dot_general."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def raw(x, y):
+        a = jnp.swapaxes(x, -1, -2) if transpose_x and x.ndim >= 2 else x
+        b = jnp.swapaxes(y, -1, -2) if transpose_y and y.ndim >= 2 else y
+        return jnp.matmul(a, b)
+    return dispatch("matmul", raw, x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return dispatch("bmm", jnp.matmul, x, y)
+
+
+def mv(x, vec, name=None):
+    return dispatch("mv", jnp.matmul, x, vec)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def raw(x):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdim))
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(x), axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(x), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((x != 0).astype(x.dtype), axis=ax, keepdims=keepdim)
+        if p == 2:
+            return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax, keepdims=keepdim))
+        if p == 1:
+            return jnp.sum(jnp.abs(x), axis=ax, keepdims=keepdim)
+        return jnp.sum(jnp.abs(x) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return dispatch("norm", raw, x)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    return norm(x, p=p, axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def raw(x):
+        return jnp.linalg.norm(x, ord=None if p == "fro" else p,
+                               axis=tuple(axis), keepdims=keepdim)
+    return dispatch("matrix_norm", raw, x)
+
+
+def dist(x, y, p=2.0, name=None):
+    return norm(dispatch("sub", jnp.subtract, x, y), p=float(p))
+
+
+def cond(x, p=None, name=None):
+    return dispatch("cond", lambda x: jnp.linalg.cond(x, p=p), x)
+
+
+def solve(x, y, name=None):
+    return dispatch("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def raw(x, y):
+        a = jnp.swapaxes(x, -1, -2) if transpose else x
+        return jax.scipy.linalg.solve_triangular(
+            a, y, lower=not upper if not transpose else upper,
+            unit_diagonal=unitriangular)
+    return dispatch("triangular_solve", raw, x, y)
+
+
+def cholesky(x, upper=False, name=None):
+    def raw(x):
+        L = jnp.linalg.cholesky(x)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return dispatch("cholesky", raw, x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def raw(x, y):
+        return jax.scipy.linalg.cho_solve((y, not upper), x)
+    return dispatch("cholesky_solve", raw, x, y)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def raw(x):
+        lu_, piv = jax.scipy.linalg.lu_factor(x)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+    out = dispatch("lu", raw, x)
+    if get_infos:
+        info = Tensor(jnp.zeros((), jnp.int32))
+        return out[0], out[1], info
+    return out
+
+
+def qr(x, mode="reduced", name=None):
+    out = dispatch("qr", lambda x: jnp.linalg.qr(x, mode=mode), x)
+    return out
+
+
+def svd(x, full_matrices=False, name=None):
+    def raw(x):
+        u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+        return u, s, jnp.swapaxes(vh, -1, -2).conj()
+    return dispatch("svd", raw, x)
+
+
+def svdvals(x, name=None):
+    return dispatch("svdvals", lambda x: jnp.linalg.svd(x, compute_uv=False), x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return dispatch("pinv", lambda x: jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian), x)
+
+
+def eig(x, name=None):
+    # CPU-only in jax; route via host (reference eig is also CPU-only: operators/eig_op.h)
+    arr = np.asarray(unwrap(x))
+    w, v = np.linalg.eig(arr)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(unwrap(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+def eigh(x, UPLO="L", name=None):
+    out = dispatch("eigh", lambda x: tuple(jnp.linalg.eigh(x, UPLO=UPLO)), x)
+    return out
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return dispatch("eigvalsh", lambda x: jnp.linalg.eigvalsh(x, UPLO=UPLO), x)
+
+
+def matrix_power(x, n, name=None):
+    return dispatch("matrix_power", lambda x: jnp.linalg.matrix_power(x, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return dispatch("matrix_rank",
+                    lambda x: jnp.linalg.matrix_rank(x, tol=unwrap(tol)), x)
+
+
+def det(x, name=None):
+    return dispatch("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def raw(x):
+        sign, logdet = jnp.linalg.slogdet(x)
+        return jnp.stack([sign, logdet])
+    return dispatch("slogdet", raw, x)
+
+
+def multi_dot(x, name=None):
+    return dispatch("multi_dot", lambda *xs: jnp.linalg.multi_dot(list(xs)), *x)
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):  # noqa: A002
+    def raw(x):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (jnp.min(x), jnp.max(x))
+        h, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
+        return h.astype(jnp.int64)
+    return dispatch("histogram", raw, input)
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    xv = np.asarray(unwrap(x))
+    wv = np.asarray(unwrap(weights)) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(xv, weights=wv, minlength=minlength)))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return dispatch("corrcoef", lambda x: jnp.corrcoef(x, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    def raw(x, fw, aw):
+        return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                       fweights=fw, aweights=aw)
+    return dispatch("cov", raw, x, fweights, aweights)
+
+
+def householder_product(x, tau, name=None):
+    def raw(x, tau):
+        m, n = x.shape[-2], x.shape[-1]
+        eye = jnp.eye(m, dtype=x.dtype)
+        q = jnp.broadcast_to(eye, x.shape[:-2] + (m, m)).copy() if x.ndim > 2 else eye
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, x[..., :, i].at[..., i].set(1.0))
+            v = x[..., :, i] * (jnp.arange(m) > i) + (jnp.arange(m) == i)
+            h = jnp.eye(m, dtype=x.dtype) - tau[..., i] * jnp.outer(v, v)
+            return q @ h
+        for i in range(n):
+            q = body(i, q)
+        return q[..., :, :n]
+    return dispatch("householder_product", raw, x, tau)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def raw(x, y):
+        sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+        return sol, res, rank, sv
+    return dispatch("lstsq", raw, x, y)

@@ -1,0 +1,81 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return dispatch(name, fn, x, y)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+
+
+def logical_not(x, name=None):
+    return dispatch("logical_not", jnp.logical_not, x)
+
+
+def bitwise_not(x, name=None):
+    return dispatch("bitwise_not", jnp.bitwise_not, x)
+
+
+def equal_all(x, y, name=None):
+    def raw(x, y):
+        if x.shape != y.shape:
+            return jnp.asarray(False)
+        return jnp.all(x == y)
+    return dispatch("equal_all", raw, x, y)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return dispatch("allclose",
+                    lambda x, y: jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                    x, y)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return dispatch("isclose",
+                    lambda x, y: jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                    x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(unwrap(x).shape)) == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def isreal(x, name=None):
+    return dispatch("isreal", lambda x: jnp.isreal(x), x)
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)

@@ -1,0 +1,439 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py,
+operators/concat/split/stack/slice/transpose/reshape)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def _ints(seq):
+    if isinstance(seq, Tensor):
+        seq = seq.tolist()
+    if isinstance(seq, (int, np.integer)):
+        return int(seq)
+    return [int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s) for s in seq]
+
+
+def cast(x, dtype):
+    dt = _dt.convert_dtype(dtype)
+    return dispatch("cast", lambda x: x.astype(dt), x)
+
+
+def reshape(x, shape, name=None):
+    shape = _ints(shape)
+    return dispatch("reshape", lambda x: jnp.reshape(x, shape), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._set_data(out._data)
+    x._node, x._out_index = out._node, out._out_index
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def raw(x):
+        nd = x.ndim
+        sa = start_axis % nd if nd else 0
+        ea = stop_axis % nd if nd else 0
+        newshape = x.shape[:sa] + (-1,) + x.shape[ea + 1:]
+        return jnp.reshape(x, newshape)
+    return dispatch("flatten", raw, x)
+
+
+def transpose(x, perm, name=None):
+    perm = _ints(perm)
+    return dispatch("transpose", lambda x: jnp.transpose(x, perm), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return dispatch("moveaxis", lambda x: jnp.moveaxis(x, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return dispatch("swapaxes", lambda x: jnp.swapaxes(x, axis0, axis1), x)
+
+
+transpose_ = transpose
+t = lambda x, name=None: dispatch("t", lambda x: x.T, x)  # noqa: E731
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _ints(axis)
+    ax = [ax] if isinstance(ax, int) else ax
+    def raw(x):
+        out = x
+        for a in sorted([a % (out.ndim + 1 + i) if a < 0 else a for i, a in enumerate(ax)]):
+            out = jnp.expand_dims(out, a)
+        return out
+    return dispatch("unsqueeze", raw, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def raw(x):
+        if axis is None:
+            return jnp.squeeze(x)
+        ax = _ints(axis)
+        ax = [ax] if isinstance(ax, int) else ax
+        ax = tuple(a % x.ndim for a in ax)
+        ax = tuple(a for a in ax if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=ax) if ax else x
+    return dispatch("squeeze", raw, x)
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis))
+    return dispatch("concat", lambda *xs: jnp.concatenate(xs, axis=axis), *x)
+
+
+def stack(x, axis=0, name=None):
+    return dispatch("stack", lambda *xs: jnp.stack(xs, axis=axis), *x)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    def raw(x):
+        n = num or x.shape[axis]
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(x, n, axis=axis))
+    out = dispatch("unstack", raw, x)
+    return list(out)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis))
+    def raw(x):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(x, num_or_sections, axis=axis))
+        secs = _ints(num_or_sections)
+        total = x.shape[axis]
+        known = [s for s in secs if s != -1]
+        secs = [s if s != -1 else total - int(np.sum(known)) for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        return tuple(jnp.split(x, idx, axis=axis))
+    return list(dispatch("split", raw, x))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def raw(x):
+        return tuple(jnp.array_split(x, num_or_indices, axis=axis)) \
+            if isinstance(num_or_indices, int) else tuple(jnp.split(x, _ints(num_or_indices), axis=axis))
+    return list(dispatch("tensor_split", raw, x))
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return dispatch("tile", lambda x: jnp.tile(x, reps), x)
+
+
+def expand(x, shape, name=None):
+    shape = _ints(shape)
+    def raw(x):
+        tgt = list(shape)
+        # -1 means keep original dim
+        off = len(tgt) - x.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = x.shape[i - off]
+        return jnp.broadcast_to(x, tgt)
+    return dispatch("expand", raw, x)
+
+
+def expand_as(x, y, name=None):
+    return dispatch("expand_as", lambda x, y: jnp.broadcast_to(x, y.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    shape = _ints(shape)
+    return dispatch("broadcast_to", lambda x: jnp.broadcast_to(x, shape), x)
+
+
+def broadcast_tensors(inputs, name=None):
+    out = dispatch("broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *inputs)
+    return list(out)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    axes, starts, ends = _ints(axes), _ints(starts), _ints(ends)
+    def raw(x):
+        idx = [slice_builtin(None)] * x.ndim
+        for a, s, e in zip(axes, starts, ends):
+            idx[a] = slice_builtin(s, e)
+        return x[tuple(idx)]
+    return dispatch("slice", raw, x)
+
+
+slice_builtin = builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = map(_ints, (axes, starts, ends, strides))
+    def raw(x):
+        idx = [slice_builtin(None)] * x.ndim
+        for a, s, e, st in zip(axes, starts, ends, strides):
+            idx[a] = slice_builtin(s, e, st)
+        return x[tuple(idx)]
+    return dispatch("strided_slice", raw, x)
+
+
+def gather(x, index, axis=0, name=None):
+    axis_ = int(unwrap(axis)) if axis is not None else 0
+    return dispatch("gather", lambda x, i: jnp.take(x, i.astype(jnp.int32), axis=axis_), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def raw(x, index):
+        idx = tuple(jnp.moveaxis(index, -1, 0))
+        return x[idx]
+    return dispatch("gather_nd", raw, x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    def raw(x, i):
+        if broadcast:
+            tgt = list(x.shape)
+            tgt[axis] = i.shape[axis]
+            i = jnp.broadcast_to(i, tgt)
+        return jnp.take_along_axis(x, i, axis=axis)
+    return dispatch("take_along_axis", raw, arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):  # noqa: A002
+    def raw(x, i, v):
+        v = jnp.broadcast_to(jnp.asarray(v, x.dtype), i.shape)
+        dnums = jnp.indices(i.shape)
+        full_idx = [dnums[d] for d in range(x.ndim)]
+        full_idx[axis] = i
+        full_idx = tuple(full_idx)
+        if reduce == "assign":
+            return x.at[full_idx].set(v)
+        if reduce in ("add", "sum"):
+            return x.at[full_idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return x.at[full_idx].multiply(v)
+        raise ValueError(f"unknown reduce {reduce}")
+    return dispatch("put_along_axis", raw, arr, indices, values)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def raw(x, index, updates):
+        index = index.reshape(-1).astype(jnp.int32)
+        if overwrite:
+            return x.at[index].set(updates)
+        base = x.at[index].set(jnp.zeros_like(updates))
+        return base.at[index].add(updates)
+    return dispatch("scatter", raw, x, index, updates)
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._set_data(out._data)
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def raw(index, updates):
+        out = jnp.zeros(_ints(shape), updates.dtype)
+        idx = tuple(jnp.moveaxis(index, -1, 0))
+        return out.at[idx].add(updates)
+    return dispatch("scatter_nd", raw, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def raw(x, index, updates):
+        idx = tuple(jnp.moveaxis(index, -1, 0))
+        return x.at[idx].add(updates)
+    return dispatch("scatter_nd_add", raw, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return dispatch("index_select",
+                    lambda x, i: jnp.take(x, i.astype(jnp.int32), axis=axis), x, index)
+
+
+def index_sample(x, index):
+    def raw(x, index):
+        rows = jnp.arange(x.shape[0])[:, None]
+        return x[rows, index.astype(jnp.int32)]
+    return dispatch("index_sample", raw, x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def raw(x, i, v):
+        idx = [slice_builtin(None)] * x.ndim
+        i = i.astype(jnp.int32)
+        sl = [slice_builtin(None)] * x.ndim
+        sl[axis] = i
+        return x.at[tuple(sl)].add(v)
+    return dispatch("index_add", raw, x, index, value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def raw(x, v, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i for i in idx)
+        if accumulate:
+            return x.at[idx].add(v)
+        return x.at[idx].set(v)
+    return dispatch("index_put", raw, x, value, *indices)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic-shape op: eager only (cannot be jitted; reference has same op)
+    xv, mv = unwrap(x), unwrap(mask)
+    return Tensor(xv[np.asarray(mv)])
+
+
+def masked_fill(x, mask, value, name=None):
+    return dispatch("masked_fill",
+                    lambda x, m, v: jnp.where(m, jnp.asarray(v, x.dtype), x), x, mask, value)
+
+
+def masked_scatter(x, mask, value, name=None):
+    xv, mv, vv = np.asarray(unwrap(x)), np.asarray(unwrap(mask)), np.asarray(unwrap(value))
+    out = xv.copy()
+    out[mv] = vv.reshape(-1)[: int(mv.sum())]
+    return Tensor(jnp.asarray(out))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return dispatch("roll", lambda x: jnp.roll(x, shifts, axis=axis), x)
+
+
+def flip(x, axis, name=None):
+    ax = _ints(axis)
+    return dispatch("flip", lambda x: jnp.flip(x, axis=ax), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return dispatch("rot90", lambda x: jnp.rot90(x, k=k, axes=tuple(axes)), x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def raw(x, r):
+        return jnp.repeat(x, r, axis=axis,
+                          total_repeat_length=None if isinstance(repeats, int) else int(np.sum(np.asarray(r))))
+    return dispatch("repeat_interleave", raw, x, unwrap(repeats))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic-shape: eager only, via numpy (reference unique_op is also host-side)
+    arr = np.asarray(unwrap(x))
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(unwrap(x)).reshape(-1) if axis is None else np.asarray(unwrap(x))
+    mask = np.ones(arr.shape[0] if axis is None else arr.shape[axis], bool)
+    flat = arr if axis is None else np.moveaxis(arr, axis, 0).reshape(arr.shape[axis], -1)
+    if axis is None:
+        mask[1:] = arr[1:] != arr[:-1]
+    else:
+        mask[1:] = (flat[1:] != flat[:-1]).any(axis=1)
+    out = arr[mask] if axis is None else np.compress(mask, arr, axis=axis)
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        outs.append(Tensor(jnp.asarray(np.cumsum(mask) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(mask)
+        counts = np.diff(np.append(idx, mask.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def as_complex(x, name=None):
+    return dispatch("as_complex", lambda x: jax.lax.complex(x[..., 0], x[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return dispatch("as_real", lambda x: jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1), x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return dispatch("view_dtype", lambda x: x.view(_dt.convert_dtype(shape_or_dtype)), x)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [dispatch("atleast_1d", jnp.atleast_1d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [dispatch("atleast_2d", jnp.atleast_2d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [dispatch("atleast_3d", jnp.atleast_3d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch("diagonal",
+                    lambda x: jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def raw(x):
+        n = x.shape[-1] + np.abs(offset)
+        out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+        idx = jnp.arange(x.shape[-1])
+        r = idx + (np.maximum(-offset, 0))
+        c = idx + (np.maximum(offset, 0))
+        out = out.at[..., r, c].set(x)
+        src = list(range(out.ndim))
+        d1, d2 = dim1 % out.ndim, dim2 % out.ndim
+        return jnp.moveaxis(out, (out.ndim - 2, out.ndim - 1), (d1, d2))
+    return dispatch("diag_embed", raw, x)
+
+
+def unfold(x, axis, size, step, name=None):
+    def raw(x):
+        n = (x.shape[axis] - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        moved = jnp.moveaxis(x, axis, 0)
+        win = moved[idx]  # (n, size, ...)
+        win = jnp.moveaxis(win, (0, 1), (axis, x.ndim))
+        return win
+    return dispatch("unfold", raw, x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._set_data(out._data)
+    return x
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: A002
+    def raw(x):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo, hi = shard_id * shard_size, (shard_id + 1) * shard_size
+        inside = (x >= lo) & (x < hi)
+        return jnp.where(inside, x - lo, ignore_value)
+    return dispatch("shard_index", raw, input)

@@ -1,0 +1,377 @@
+"""Elementwise & reduction math ops.
+
+Reference surface: python/paddle/tensor/math.py plus the elementwise broadcast
+machinery of paddle/fluid/operators/elementwise/ (46 files).  Broadcasting is
+numpy-style via jnp; the reference's legacy `axis` attr on elementwise ops is
+supported by reshape-alignment in `_align_axis`.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.op import defop, dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def _axis_tuple(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _align_axis(x, y, axis):
+    """Legacy elementwise `axis` attr: broadcast y into x starting at `axis`
+    (reference: operators/elementwise/elementwise_op_function.h)."""
+    if axis == -1 or axis is None:
+        return y
+    pad = x.ndim - axis - y.ndim
+    if pad > 0:
+        return jnp.reshape(y, y.shape + (1,) * pad)
+    return y
+
+
+# ---- binary elementwise ----------------------------------------------------
+
+def _binop(name, fn):
+    def raw(x, y, axis=-1):
+        y = _align_axis(x, y, axis) if hasattr(x, "ndim") and hasattr(y, "ndim") else y
+        return fn(x, y)
+
+    def op(x, y, axis=-1, name=None, out=None):
+        r = dispatch(name, raw, x, y, axis=axis)
+        return r
+    op.__name__ = name
+    return op
+
+
+add = _binop("add", jnp.add)
+subtract = _binop("subtract", jnp.subtract)
+multiply = _binop("multiply", jnp.multiply)
+divide = _binop("divide", jnp.true_divide)
+floor_divide = _binop("floor_divide", jnp.floor_divide)
+remainder = _binop("remainder", jnp.remainder)
+mod = remainder
+floor_mod = remainder
+pow_ = _binop("pow", jnp.power)
+maximum = _binop("maximum", jnp.maximum)
+minimum = _binop("minimum", jnp.minimum)
+fmax = _binop("fmax", jnp.fmax)
+fmin = _binop("fmin", jnp.fmin)
+atan2 = _binop("atan2", jnp.arctan2)
+logaddexp = _binop("logaddexp", jnp.logaddexp)
+nextafter = _binop("nextafter", jnp.nextafter)
+copysign = _binop("copysign", jnp.copysign)
+heaviside = _binop("heaviside", jnp.heaviside)
+hypot = _binop("hypot", jnp.hypot)
+ldexp = _binop("ldexp", lambda x, y: x * jnp.power(2.0, y).astype(x.dtype) if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating) else jnp.ldexp(x, y))
+
+elementwise_add = add
+elementwise_sub = subtract
+elementwise_mul = multiply
+elementwise_div = divide
+
+
+def pow(x, y, name=None):  # noqa: A001 - paddle API name
+    return pow_(x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """paddle.scale (reference: operators/scale_op.cc)."""
+    def raw(x, s, b):
+        s = jnp.asarray(s, x.dtype) if not hasattr(s, "dtype") else s.astype(x.dtype)
+        if bias_after_scale:
+            return x * s + jnp.asarray(b, x.dtype)
+        return (x + jnp.asarray(b, x.dtype)) * s
+    out = dispatch("scale", raw, x, scale, bias)
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def multiplex(inputs, index, name=None):
+    def raw(index, *ins):
+        stacked = jnp.stack(ins, axis=0)
+        idx = index.reshape(-1).astype(jnp.int32)
+        return stacked[idx, jnp.arange(stacked.shape[1])]
+    return dispatch("multiplex", raw, index, *inputs)
+
+
+# ---- unary elementwise -----------------------------------------------------
+
+def _unop(name, fn):
+    def op(x, name=None):
+        return dispatch(name, fn, x)
+    op.__name__ = name
+    return op
+
+
+exp = _unop("exp", jnp.exp)
+expm1 = _unop("expm1", jnp.expm1)
+log = _unop("log", jnp.log)
+log2 = _unop("log2", jnp.log2)
+log10 = _unop("log10", jnp.log10)
+log1p = _unop("log1p", jnp.log1p)
+sqrt = _unop("sqrt", jnp.sqrt)
+rsqrt = _unop("rsqrt", jax.lax.rsqrt)
+square = _unop("square", jnp.square)
+abs = _unop("abs", jnp.abs)  # noqa: A001
+sign = _unop("sign", jnp.sign)
+ceil = _unop("ceil", jnp.ceil)
+floor = _unop("floor", jnp.floor)
+round = _unop("round", jnp.round)  # noqa: A001
+trunc = _unop("trunc", jnp.trunc)
+frac = _unop("frac", lambda x: x - jnp.trunc(x))
+sin = _unop("sin", jnp.sin)
+cos = _unop("cos", jnp.cos)
+tan = _unop("tan", jnp.tan)
+asin = _unop("asin", jnp.arcsin)
+acos = _unop("acos", jnp.arccos)
+atan = _unop("atan", jnp.arctan)
+sinh = _unop("sinh", jnp.sinh)
+cosh = _unop("cosh", jnp.cosh)
+tanh = _unop("tanh", jnp.tanh)
+asinh = _unop("asinh", jnp.arcsinh)
+acosh = _unop("acosh", jnp.arccosh)
+atanh = _unop("atanh", jnp.arctanh)
+reciprocal = _unop("reciprocal", lambda x: 1.0 / x)
+neg = _unop("neg", jnp.negative)
+erf = _unop("erf", jax.scipy.special.erf)
+erfinv = _unop("erfinv", jax.scipy.special.erfinv)
+lgamma = _unop("lgamma", jax.scipy.special.gammaln)
+digamma = _unop("digamma", jax.scipy.special.digamma)
+sigmoid = _unop("sigmoid", jax.nn.sigmoid)
+angle = _unop("angle", jnp.angle)
+conj = _unop("conj", jnp.conj)
+real = _unop("real", jnp.real)
+imag = _unop("imag", jnp.imag)
+deg2rad = _unop("deg2rad", jnp.deg2rad)
+rad2deg = _unop("rad2deg", jnp.rad2deg)
+i0 = _unop("i0", jnp.i0)
+exponential_ = None  # inplace random: defined in random.py
+
+
+def logit(x, eps=None, name=None):
+    def raw(x):
+        z = x if eps is None else jnp.clip(x, eps, 1.0 - eps)
+        return jnp.log(z / (1.0 - z))
+    return dispatch("logit", raw, x)
+
+
+def clip(x, min=None, max=None, name=None):  # noqa: A002
+    def raw(x, mn, mx):
+        return jnp.clip(x, mn, mx)
+    return dispatch("clip", raw, x, unwrap(min), unwrap(max))
+
+
+def isnan(x, name=None):
+    return dispatch("isnan", jnp.isnan, x)
+
+
+def isinf(x, name=None):
+    return dispatch("isinf", jnp.isinf, x)
+
+
+def isfinite(x, name=None):
+    return dispatch("isfinite", jnp.isfinite, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return dispatch("nan_to_num",
+                    lambda x: jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def increment(x, value=1.0, name=None):
+    out = add(x, jnp.asarray(value, x.dtype))
+    x._set_data(out._data)
+    return x
+
+
+# ---- reductions ------------------------------------------------------------
+
+def _reduce(name, fn):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _axis_tuple(axis)
+        def raw(x):
+            r = fn(x, axis=ax, keepdims=keepdim)
+            if dtype is not None:
+                r = r.astype(_dt.convert_dtype(dtype))
+            return r
+        return dispatch(name, raw, x)
+    op.__name__ = name
+    return op
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    ax = _axis_tuple(axis)
+    dt = _dt.convert_dtype(dtype) if dtype is not None else None
+    def raw(x):
+        acc = dt
+        if acc is None and jnp.issubdtype(x.dtype, jnp.integer):
+            acc = jnp.int64
+        return jnp.sum(x, axis=ax, keepdims=keepdim, dtype=acc)
+    return dispatch("sum", raw, x)
+
+
+mean = _reduce("mean", jnp.mean)
+prod = _reduce("prod", jnp.prod)
+max = _reduce("max", jnp.max)  # noqa: A001
+min = _reduce("min", jnp.min)  # noqa: A001
+amax = _reduce("amax", jnp.max)
+amin = _reduce("amin", jnp.min)
+nansum = _reduce("nansum", jnp.nansum)
+nanmean = _reduce("nanmean", jnp.nanmean)
+logsumexp = _reduce("logsumexp", jax.scipy.special.logsumexp)
+all = _reduce("all", jnp.all)  # noqa: A001
+any = _reduce("any", jnp.any)  # noqa: A001
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis_tuple(axis)
+    return dispatch("count_nonzero",
+                    lambda x: jnp.count_nonzero(x, axis=ax, keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def raw(x):
+        if axis is None:
+            r = jnp.cumsum(x.reshape(-1))
+        else:
+            r = jnp.cumsum(x, axis=int(axis))
+        return r.astype(_dt.convert_dtype(dtype)) if dtype else r
+    return dispatch("cumsum", raw, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    def raw(x):
+        r = jnp.cumprod(x, axis=int(dim))
+        return r.astype(_dt.convert_dtype(dtype)) if dtype else r
+    return dispatch("cumprod", raw, x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def raw(x):
+        ax = 0 if axis is None else int(axis)
+        xr = x.reshape(-1) if axis is None else x
+        vals = jax.lax.associative_scan(jnp.maximum, xr, axis=ax)
+        # indices: argmax of running max
+        eq = xr == vals
+        idx = jnp.arange(xr.shape[ax]).reshape([-1 if i == ax % xr.ndim else 1 for i in range(xr.ndim)])
+        idx = jnp.broadcast_to(idx, xr.shape)
+        masked = jnp.where(eq, idx, -1)
+        ind = jax.lax.associative_scan(jnp.maximum, masked, axis=ax)
+        return vals, ind.astype(_dt.convert_dtype(dtype))
+    return dispatch("cummax", raw, x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def raw(x):
+        ax = 0 if axis is None else int(axis)
+        xr = x.reshape(-1) if axis is None else x
+        vals = jax.lax.associative_scan(jnp.minimum, xr, axis=ax)
+        eq = xr == vals
+        idx = jnp.arange(xr.shape[ax]).reshape([-1 if i == ax % xr.ndim else 1 for i in range(xr.ndim)])
+        idx = jnp.broadcast_to(idx, xr.shape)
+        masked = jnp.where(eq, idx, -1)
+        ind = jax.lax.associative_scan(jnp.maximum, masked, axis=ax)
+        return vals, ind.astype(_dt.convert_dtype(dtype))
+    return dispatch("cummin", raw, x)
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def raw(x):
+        xr = x.reshape(-1) if axis is None else x
+        ax = 0 if axis is None else int(axis)
+        return jax.lax.associative_scan(jnp.logaddexp, xr, axis=ax)
+    return dispatch("logcumsumexp", raw, x)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return dispatch("diff",
+                    lambda x, p, a: jnp.diff(x, n=n, axis=axis, prepend=p, append=a),
+                    x, prepend, append)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch("trace",
+                    lambda x: jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def kron(x, y, name=None):
+    return dispatch("kron", jnp.kron, x, y)
+
+
+def inner(x, y, name=None):
+    return dispatch("inner", jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return dispatch("outer", jnp.outer, x, y)
+
+
+def dot(x, y, name=None):
+    def raw(x, y):
+        if x.ndim == 1:
+            return jnp.dot(x, y)
+        return jnp.sum(x * y, axis=-1)
+    return dispatch("dot", raw, x, y)
+
+
+def cross(x, y, axis=9, name=None):
+    def raw(x, y):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, d in enumerate(x.shape) if d == 3)
+        return jnp.cross(x, y, axis=ax)
+    return dispatch("cross", raw, x, y)
+
+
+def gcd(x, y, name=None):
+    return dispatch("gcd", jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return dispatch("lcm", jnp.lcm, x, y)
+
+
+def lerp(x, y, weight, name=None):
+    return dispatch("lerp", lambda x, y, w: x + w * (y - x), x, y, weight)
+
+
+def polygamma(x, n, name=None):
+    return dispatch("polygamma", lambda x: jax.scipy.special.polygamma(n, x), x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):  # noqa: A002
+    return dispatch("addmm",
+                    lambda i, x, y: beta * i + alpha * jnp.matmul(x, y), input, x, y)
+
+
+def inverse(x, name=None):
+    return dispatch("inverse", jnp.linalg.inv, x)
+
+
+def rsqrt_(x):
+    out = rsqrt(x)
+    x._set_data(out._data)
+    return x
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return dispatch("stanh", lambda x: scale_b * jnp.tanh(scale_a * x), x)
+
+
+def renorm(x, p, axis, max_norm):
+    def raw(x):
+        dims = [i for i in range(x.ndim) if i != axis % x.ndim]
+        norms = jnp.sum(jnp.abs(x) ** p, axis=tuple(dims), keepdims=True) ** (1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return x * factor
+    return dispatch("renorm", raw, x)

@@ -1,0 +1,173 @@
+"""Attach ops as Tensor methods + operator overloads.
+
+Reference: python/paddle/fluid/dygraph/math_op_patch.py and
+varbase_patch_methods.py — the reference monkey-patches its C++ VarBase the
+same way; here we patch the jax-backed Tensor once at import.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+from . import (attribute, creation, einsum, linalg, logic, manipulation, math,
+               random, search, stat)
+
+_MODULES = (math, manipulation, linalg, logic, search, stat, creation,
+            attribute, random)
+
+# names that are attributes/properties on Tensor and must not be clobbered
+_SKIP = {"shape", "rank", "numel", "real", "imag", "is_tensor", "to_tensor",
+         "slice"}
+
+_METHOD_NAMES = {
+    # math
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "floor_mod", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
+    "scale", "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "abs", "sign", "ceil", "floor", "round", "trunc", "frac", "sin",
+    "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh",
+    "acosh", "atanh", "reciprocal", "neg", "erf", "erfinv", "lgamma",
+    "digamma", "sigmoid", "angle", "conj", "deg2rad", "rad2deg", "logit",
+    "clip", "isnan", "isinf", "isfinite", "nan_to_num", "sum", "mean", "prod",
+    "max", "min", "amax", "amin", "nansum", "nanmean", "logsumexp", "all",
+    "any", "count_nonzero", "cumsum", "cumprod", "cummax", "cummin",
+    "logcumsumexp", "diff", "trace", "kron", "inner", "outer", "dot", "cross",
+    "gcd", "lcm", "lerp", "addmm", "inverse", "stanh", "increment",
+    "multiplex", "heaviside",
+    # manipulation
+    "cast", "reshape", "reshape_", "flatten", "flatten_", "transpose",
+    "moveaxis", "swapaxes", "t", "unsqueeze", "squeeze", "concat", "split",
+    "chunk", "tensor_split", "tile", "expand", "expand_as", "broadcast_to",
+    "gather", "gather_nd", "take_along_axis", "put_along_axis", "scatter",
+    "scatter_", "scatter_nd_add", "index_select", "index_sample", "index_add",
+    "index_put", "masked_select", "masked_fill", "roll", "flip", "rot90",
+    "repeat_interleave", "unique", "unique_consecutive", "as_complex",
+    "as_real", "diagonal", "diag_embed", "unfold", "unstack", "view",
+    "view_as", "unbind",
+    # linalg
+    "matmul", "mm", "bmm", "mv", "norm", "dist", "cholesky", "qr", "svd",
+    "pinv", "matrix_power", "det", "slogdet", "histogram", "bincount",
+    # logic
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "logical_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not", "equal_all",
+    "allclose", "isclose", "is_empty",
+    # search
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "kthvalue", "mode", "bucketize",
+    # stat
+    "var", "std", "median", "nanmedian", "quantile", "nanquantile",
+    # random inplace
+    "uniform_", "normal_", "bernoulli_", "exponential_",
+}
+
+
+def _find(name):
+    for m in _MODULES:
+        fn = getattr(m, name, None)
+        if fn is not None and callable(fn):
+            return fn
+    return None
+
+
+def apply_patches():
+    for name in _METHOD_NAMES:
+        if name in _SKIP or hasattr(Tensor, name):
+            continue
+        fn = _find(name)
+        if fn is not None:
+            setattr(Tensor, name, fn)
+
+    # explicit bindings where names collide with properties
+    Tensor.astype = lambda self, dtype: manipulation.cast(self, dtype)
+    Tensor.cast = lambda self, dtype: manipulation.cast(self, dtype)
+    Tensor.unbind = lambda self, axis=0: unbind(self, axis)
+
+    # in-place arithmetic used by optimizers / dygraph code
+    def _make_inplace(op):
+        def fn(self, *args, **kwargs):
+            out = op(self, *args, **kwargs)
+            self._set_data(out._data)
+            return self
+        return fn
+    Tensor.add_ = _make_inplace(math.add)
+    Tensor.subtract_ = _make_inplace(math.subtract)
+    Tensor.multiply_ = _make_inplace(math.multiply)
+    Tensor.divide_ = _make_inplace(math.divide)
+    Tensor.scale_ = _make_inplace(math.scale)
+    Tensor.clip_ = _make_inplace(math.clip)
+    Tensor.zero_ = lambda self: (self._set_data(jnp.zeros_like(self._data)), self)[1]
+    Tensor.fill_ = lambda self, v: (self._set_data(jnp.full_like(self._data, unwrap(v))), self)[1]
+    Tensor.copy_ = lambda self, other, blocking=True: (
+        self._set_data(jnp.asarray(unwrap(other), self._data.dtype)), self)[1]
+
+    # operator overloads (paddle semantics: elementwise, broadcasting)
+    Tensor.__add__ = lambda s, o: math.add(s, _coerce(o))
+    Tensor.__radd__ = lambda s, o: math.add(_coerce(o), s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, _coerce(o))
+    Tensor.__rsub__ = lambda s, o: math.subtract(_coerce(o), s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, _coerce(o))
+    Tensor.__rmul__ = lambda s, o: math.multiply(_coerce(o), s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, _coerce(o))
+    Tensor.__rtruediv__ = lambda s, o: math.divide(_coerce(o), s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, _coerce(o))
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(_coerce(o), s)
+    Tensor.__mod__ = lambda s, o: math.remainder(s, _coerce(o))
+    Tensor.__pow__ = lambda s, o: math.pow_(s, _coerce(o))
+    Tensor.__rpow__ = lambda s, o: math.pow_(_coerce(o), s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, _coerce(o))
+    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(_coerce(o), s)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, _coerce(o))
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, _coerce(o))
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, _coerce(o))
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, _coerce(o))
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, _coerce(o))
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, _coerce(o))
+    Tensor.__and__ = lambda s, o: logic.logical_and(s, _coerce(o)) \
+        if s.dtype == jnp.bool_ else logic.bitwise_and(s, _coerce(o))
+    Tensor.__or__ = lambda s, o: logic.logical_or(s, _coerce(o)) \
+        if s.dtype == jnp.bool_ else logic.bitwise_or(s, _coerce(o))
+    Tensor.__xor__ = lambda s, o: logic.logical_xor(s, _coerce(o)) \
+        if s.dtype == jnp.bool_ else logic.bitwise_xor(s, _coerce(o))
+    Tensor.__invert__ = lambda s: logic.logical_not(s) \
+        if s.dtype == jnp.bool_ else logic.bitwise_not(s)
+
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+
+    Tensor.T = property(lambda s: dispatch("T", lambda x: x.T, s))
+    Tensor.mT = property(lambda s: dispatch("mT", lambda x: jnp.swapaxes(x, -1, -2), s))
+
+
+def _coerce(o):
+    return o
+
+
+def _getitem(self, idx):
+    idx = _unwrap_index(idx)
+    return dispatch("getitem", lambda x: x[idx], self)
+
+
+def _setitem(self, idx, value):
+    idx = _unwrap_index(idx)
+    v = unwrap(value)
+    new = self._data.at[idx].set(jnp.asarray(v, self._data.dtype))
+    self._set_data(new)
+    return self
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+def unbind(x, axis=0, name=None):
+    return manipulation.unstack(x, axis=axis)

@@ -1,0 +1,138 @@
+"""Random ops (reference: python/paddle/tensor/random.py, operators/gaussian_random_op,
+uniform_random_op, dropout RNG).
+
+Eager calls draw a fresh subkey from the global generator (core.rng); under
+`jax.jit` these are still fine because the key is a concrete value captured at
+trace time — for deterministic compiled training loops, thread keys explicitly
+through the functional API instead.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as _dt
+from ..core import rng as _rng
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(unwrap(s)) for s in shape)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    dt = _dt.convert_dtype(dtype) if dtype else _dt.default_float_dtype()
+    key = jax.random.key(seed) if seed else _rng.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dt,
+                                     minval=unwrap(min), maxval=unwrap(max)))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):  # noqa: A002
+    out = uniform(x.shape, x.dtype, min, max, seed)
+    x._set_data(out._data)
+    return x
+
+
+def randn(shape, dtype=None, name=None):
+    dt = _dt.convert_dtype(dtype) if dtype else _dt.default_float_dtype()
+    return Tensor(jax.random.normal(_rng.next_key(), _shape(shape), dt))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m, s = jnp.asarray(unwrap(mean)), jnp.asarray(unwrap(std))
+        shp = jnp.broadcast_shapes(m.shape, s.shape)
+        return Tensor(m + s * jax.random.normal(_rng.next_key(), shp, m.dtype if m.dtype != jnp.int32 else jnp.float32))
+    z = randn(shape if shape is not None else [1])
+    return Tensor(unwrap(mean) + unwrap(std) * z._data)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    out = Tensor(mean + std * jax.random.normal(_rng.next_key(), tuple(x.shape), x.dtype))
+    x._set_data(out._data)
+    return x
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    dt = _dt.convert_dtype(dtype) if dtype else _dt.default_float_dtype()
+    key = jax.random.key(seed) if seed else _rng.next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape), dt))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    dt = _dt.convert_dtype(dtype)
+    return Tensor(jax.random.randint(_rng.next_key(), _shape(shape), int(low), int(high), dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dt = _dt.convert_dtype(dtype) if dtype else unwrap(x).dtype
+    return randint(low, high, tuple(unwrap(x).shape), dt)
+
+
+def randperm(n, dtype="int64", name=None):
+    dt = _dt.convert_dtype(dtype)
+    return Tensor(jax.random.permutation(_rng.next_key(), int(n)).astype(dt))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    xv = unwrap(x)
+    key = _rng.next_key()
+    p = xv / jnp.sum(xv, axis=-1, keepdims=True)
+    if replacement:
+        out = jax.random.categorical(key, jnp.log(jnp.maximum(p, 1e-38)),
+                                     shape=(num_samples,) + xv.shape[:-1]).T \
+            if xv.ndim > 1 else jax.random.categorical(key, jnp.log(jnp.maximum(p, 1e-38)),
+                                                       shape=(num_samples,))
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: Gumbel top-k trick
+    g = jax.random.gumbel(key, xv.shape)
+    scores = jnp.log(jnp.maximum(p, 1e-38)) + g
+    _, idx = jax.lax.top_k(scores, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    xv = unwrap(x)
+    return Tensor(jax.random.bernoulli(_rng.next_key(), xv, xv.shape).astype(xv.dtype))
+
+
+def bernoulli_(x, p=0.5, name=None):
+    out = jax.random.bernoulli(_rng.next_key(), p, tuple(x.shape)).astype(x.dtype)
+    x._set_data(out)
+    return x
+
+
+def poisson(x, name=None):
+    xv = unwrap(x)
+    return Tensor(jax.random.poisson(_rng.next_key(), xv, xv.shape).astype(xv.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = jax.random.exponential(_rng.next_key(), tuple(x.shape), x.dtype) / lam
+    x._set_data(out)
+    return x
+
+
+def binomial(count, prob, name=None):
+    c, p = unwrap(count), unwrap(prob)
+    return Tensor(jax.random.binomial(_rng.next_key(), c, p).astype(jnp.int64))
+
+
+def log_normal(mean=1.0, std=2.0, shape=(1,), name=None):
+    return Tensor(jnp.exp(unwrap(mean) + unwrap(std)
+                          * jax.random.normal(_rng.next_key(), _shape(shape))))

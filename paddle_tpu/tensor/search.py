@@ -1,0 +1,134 @@
+"""Search / sort ops (reference: python/paddle/tensor/search.py, operators/top_k_v2_op,
+arg_max_op, where_op)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as _dt
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = _dt.convert_dtype(dtype)
+    def raw(x):
+        r = jnp.argmax(x.reshape(-1) if axis is None else x,
+                       axis=None if axis is None else int(axis), keepdims=keepdim and axis is not None)
+        return r.astype(dt)
+    return dispatch("argmax", raw, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = _dt.convert_dtype(dtype)
+    def raw(x):
+        r = jnp.argmin(x.reshape(-1) if axis is None else x,
+                       axis=None if axis is None else int(axis), keepdims=keepdim and axis is not None)
+        return r.astype(dt)
+    return dispatch("argmin", raw, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def raw(x):
+        idx = jnp.argsort(x, axis=axis, stable=True, descending=descending)
+        return idx.astype(jnp.int64)
+    return dispatch("argsort", raw, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def raw(x):
+        s = jnp.sort(x, axis=axis, stable=True, descending=descending)
+        return s
+    return dispatch("sort", raw, x)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):  # noqa: A002
+    k = int(unwrap(k))
+    def raw(x):
+        ax = x.ndim - 1 if axis is None else axis % x.ndim
+        xm = jnp.moveaxis(x, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(xm, k)
+        else:
+            vals, idx = jax.lax.top_k(-xm, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+    return dispatch("topk", raw, x)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return dispatch("where", lambda c, x, y: jnp.where(c, x, y), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    arr = np.asarray(unwrap(x))
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.reshape(-1, 1))) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def raw(s, v):
+        r = jnp.searchsorted(s, v, side="right" if right else "left") if s.ndim == 1 else \
+            jnp.stack([jnp.searchsorted(s[i], v[i], side="right" if right else "left")
+                       for i in range(s.shape[0])])
+        return r.astype(jnp.int32 if out_int32 else jnp.int64)
+    return dispatch("searchsorted", raw, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def raw(x):
+        ax = axis % x.ndim
+        s = jnp.sort(x, axis=ax)
+        i = jnp.argsort(x, axis=ax, stable=True)
+        vals = jnp.take(s, k - 1, axis=ax)
+        idx = jnp.take(i, k - 1, axis=ax).astype(jnp.int64)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+        return vals, idx
+    return dispatch("kthvalue", raw, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def _scatter_last(run_id):
+        flat = run_id.reshape(-1, run_id.shape[-1])
+        out = jnp.zeros_like(flat)
+        rows = jnp.arange(flat.shape[0])[:, None]
+        out = out.at[rows, flat].add(1)
+        return out.reshape(run_id.shape)
+
+    def raw(x):
+        ax = axis % x.ndim
+        xm = jnp.moveaxis(x, ax, -1)
+        s = jnp.sort(xm, axis=-1)
+        n = s.shape[-1]
+        runs = jnp.concatenate([jnp.ones(s.shape[:-1] + (1,), bool),
+                                s[..., 1:] != s[..., :-1]], axis=-1)
+        run_id = jnp.cumsum(runs, axis=-1) - 1
+        cnt = _scatter_last(run_id)
+        best_run = jnp.argmax(cnt, axis=-1)
+        first_pos = jnp.argmax(run_id == best_run[..., None], axis=-1)
+        vals = jnp.take_along_axis(s, first_pos[..., None], axis=-1)[..., 0]
+        eq = xm == vals[..., None]
+        pos = jnp.arange(n)
+        idx = jnp.max(jnp.where(eq, pos, -1), axis=-1).astype(jnp.int64)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx
+    return dispatch("mode", raw, x)
+
+
+import jax  # noqa: E402  (used by topk raw)

@@ -1,0 +1,65 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.op import dispatch
+from ..core.tensor import unwrap
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    from .math import mean as _mean
+    return _mean(x, axis=axis, keepdim=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _ax(axis)
+    return dispatch("var",
+                    lambda x: jnp.var(x, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _ax(axis)
+    return dispatch("std",
+                    lambda x: jnp.std(x, axis=ax, ddof=1 if unbiased else 0, keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _ax(axis)
+    def raw(x):
+        if mode == "avg":
+            return jnp.median(x, axis=ax, keepdims=keepdim)
+        # mode == 'min': lower median
+        n = x.size if ax is None else x.shape[ax]
+        q = (n - 1) // 2 / (n - 1) if n > 1 else 0.5
+        return jnp.quantile(x, q, axis=ax, keepdims=keepdim, method="lower")
+    return dispatch("median", raw, x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _ax(axis)
+    return dispatch("nanmedian", lambda x: jnp.nanmedian(x, axis=ax, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _ax(axis)
+    qv = unwrap(q)
+    return dispatch("quantile",
+                    lambda x: jnp.quantile(x, jnp.asarray(qv), axis=ax, keepdims=keepdim,
+                                           method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _ax(axis)
+    qv = unwrap(q)
+    return dispatch("nanquantile",
+                    lambda x: jnp.nanquantile(x, jnp.asarray(qv), axis=ax, keepdims=keepdim,
+                                              method=interpolation), x)

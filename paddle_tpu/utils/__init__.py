@@ -1,0 +1,7 @@
+"""paddle_tpu.utils."""
+from . import checkpoint, flags, profiler  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+
+def try_import(name):
+    import importlib
+    return importlib.import_module(name)

@@ -1,0 +1,60 @@
+"""Checkpoint save/load.
+
+Reference: paddle.save/load (fluid/dygraph/checkpoint.py), save ops
+(operators/save_combine_op.cc), auto-checkpoint
+(fluid/incubate/checkpoint/auto_checkpoint.py).  TPU-native: state dicts of
+jax arrays serialized either via pickle-of-numpy (paddle-compatible API) or
+orbax for sharded async checkpoints of distributed runs (see
+paddle_tpu.distributed.checkpoint).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_numpy_tree(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._data)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_numpy_tree(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    if hasattr(obj, "dtype") and hasattr(obj, "shape"):
+        return np.asarray(obj)
+    return obj
+
+
+def _to_tensor_tree(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(jnp.asarray(obj))
+    if isinstance(obj, dict):
+        return {k: _to_tensor_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_tensor_tree(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    """paddle.save: state_dict / nested structure -> file."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **kwargs):
+    """paddle.load."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+    return _to_tensor_tree(obj)

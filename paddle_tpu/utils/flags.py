@@ -1,0 +1,64 @@
+"""Global flag registry.
+
+Reference: C++ gflags (platform/flags.cc, 27 defs) exported to Python via
+global_value_getter_setter.cc and FLAGS_* env bootstrap
+(python/paddle/fluid/__init__.py:143).  TPU-native: a plain registry +
+env-var bootstrap; XLA/jax config knobs are mapped where meaningful.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_FLAGS: Dict[str, Any] = {
+    # numerics / debugging (reference flags.cc:44 FLAGS_check_nan_inf)
+    "FLAGS_check_nan_inf": False,
+    "FLAGS_benchmark": False,
+    "FLAGS_eager_delete_tensor_gb": 0.0,   # no-op: XLA manages memory
+    "FLAGS_fraction_of_gpu_memory_to_use": 0.92,
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_cpu_deterministic": False,
+    "FLAGS_max_inplace_grad_add": 0,
+    "FLAGS_use_pinned_memory": True,
+    "FLAGS_paddle_num_threads": 1,
+    # tpu-specific additions
+    "FLAGS_use_flash_attention": True,
+    "FLAGS_amp_dtype": "bfloat16",
+    "FLAGS_allocator_strategy": "xla",
+}
+
+
+def _bootstrap_from_env():
+    for key in list(_FLAGS):
+        env = os.environ.get(key)
+        if env is not None:
+            cur = _FLAGS[key]
+            if isinstance(cur, bool):
+                _FLAGS[key] = env.lower() in ("1", "true", "yes")
+            elif isinstance(cur, int):
+                _FLAGS[key] = int(env)
+            elif isinstance(cur, float):
+                _FLAGS[key] = float(env)
+            else:
+                _FLAGS[key] = env
+
+
+_bootstrap_from_env()
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        return {flags: _FLAGS.get(flags)}
+    return {f: _FLAGS.get(f) for f in flags}
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        _FLAGS[k] = v
+        if k == "FLAGS_use_flash_attention":
+            from ..nn.functional.attention import set_flash_attention
+            set_flash_attention(bool(v))
+
+
+def get_flag(name, default=None):
+    return _FLAGS.get(name, default)

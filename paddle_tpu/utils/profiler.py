@@ -1,0 +1,114 @@
+"""Profiler.
+
+Reference: platform/profiler.h RecordEvent/EnableProfiler + CUPTI
+DeviceTracer -> chrome trace (platform/device_tracer.h).  TPU-native:
+jax.profiler (XLA/TensorBoard trace) for the device timeline + a host-side
+op-span recorder hooked into core.op dispatch for eager-mode op accounting.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Optional
+
+import jax
+
+from ..core import op as _op
+
+_records = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_enabled = False
+
+
+class _Span:
+    __slots__ = ("name", "t0")
+
+    def __init__(self, name):
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        rec = _records[self.name]
+        rec[0] += 1
+        rec[1] += time.perf_counter() - self.t0
+        return False
+
+
+def _hook(name):
+    return _Span(name)
+
+
+def start_profiler(state="All", tracer_option="Default", log_dir=None):
+    """reference: fluid.profiler.start_profiler"""
+    global _enabled
+    _enabled = True
+    _records.clear()
+    _op.set_profiler_hook(_hook)
+    if log_dir:
+        jax.profiler.start_trace(log_dir)
+        start_profiler._trace_dir = log_dir
+    else:
+        start_profiler._trace_dir = None
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    global _enabled
+    _enabled = False
+    _op.set_profiler_hook(None)
+    if getattr(start_profiler, "_trace_dir", None):
+        jax.profiler.stop_trace()
+    rows = sorted(_records.items(), key=lambda kv: -kv[1][1])
+    lines = [f"{'op':<32}{'calls':>10}{'total_s':>14}{'avg_ms':>12}"]
+    for name, (cnt, tot) in rows[:50]:
+        lines.append(f"{name:<32}{cnt:>10}{tot:>14.4f}{tot / cnt * 1e3:>12.4f}")
+    report = "\n".join(lines)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(report)
+    else:
+        print(report)
+    return dict(_records)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None, log_dir=None):
+    """`with paddle_tpu.utils.profiler.profiler():` context."""
+    start_profiler(state, log_dir=log_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+class RecordEvent:
+    """RAII host span (reference: platform/profiler.h:127)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._span = None
+        self._jax_ctx = None
+
+    def __enter__(self):
+        self._span = _Span(self.name).__enter__()
+        try:
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(*exc)
+        self._span.__exit__(*exc)
+        return False
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+
+def summary():
+    return dict(_records)

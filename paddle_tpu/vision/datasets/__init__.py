@@ -1,0 +1,1 @@
+"""vision datasets (filled out in build-out)."""

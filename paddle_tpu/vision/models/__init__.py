@@ -1,0 +1,2 @@
+from . import lenet  # noqa: F401
+from .lenet import LeNet  # noqa: F401

@@ -1,0 +1,1 @@
+"""vision transforms (filled out in build-out)."""

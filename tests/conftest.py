@@ -1,0 +1,27 @@
+"""Test config: run everything on a virtual 8-device CPU mesh
+(SURVEY.md §4 implication (c): multi-device tests without hardware via
+xla_force_host_platform_device_count)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force CPU even if the shell exports axon/tpu
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags +
+                               " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon sitecustomize registers the TPU backend at interpreter start and
+# pins jax_platforms; override it before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    np.random.seed(0)
+    paddle.seed(0)
+    yield

@@ -1,0 +1,73 @@
+"""OpTest equivalent: numeric-vs-analytic gradient harness.
+
+Reference: python/paddle/fluid/tests/unittests/op_test.py:226 —
+check_output compares op results against numpy; check_grad compares the op's
+analytic gradient against central finite differences
+(get_numeric_gradient, op_test.py:101).  Here the analytic grad comes from the
+tape (jax.vjp) and the numeric grad from the same eager op on perturbed inputs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor, unwrap
+
+
+def numeric_grad(fn, inputs, idx, delta=1e-3, out_grad=None):
+    """Central-difference dL/dx for scalar L = sum(fn(*inputs) * out_grad)."""
+    base = [np.asarray(x, np.float64) for x in inputs]
+
+    def scalar(*xs):
+        out = fn(*[paddle.to_tensor(x.astype(np.float32)) for x in xs])
+        out = unwrap(out)
+        o = np.asarray(out, np.float64)
+        if out_grad is None:
+            return o.sum()
+        return (o * out_grad).sum()
+
+    x = base[idx]
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        mi = it.multi_index
+        orig = x[mi]
+        x[mi] = orig + delta
+        fp = scalar(*base)
+        x[mi] = orig - delta
+        fm = scalar(*base)
+        x[mi] = orig
+        g[mi] = (fp - fm) / (2 * delta)
+        it.iternext()
+    return g
+
+
+def check_grad(fn, inputs, grad_inputs_idx=None, atol=1e-3, rtol=1e-2,
+               delta=1e-3):
+    """Assert tape gradient == finite-difference gradient for each input."""
+    inputs = [np.asarray(x, np.float32) for x in inputs]
+    idxs = grad_inputs_idx if grad_inputs_idx is not None else range(len(inputs))
+
+    tensors = [paddle.to_tensor(x, stop_gradient=False) for x in inputs]
+    out = fn(*tensors)
+    loss = out.sum() if out.size > 1 else out
+    loss.backward()
+
+    for i in idxs:
+        analytic = tensors[i].grad
+        assert analytic is not None, f"no grad for input {i}"
+        numeric = numeric_grad(fn, inputs, i, delta=delta)
+        np.testing.assert_allclose(
+            np.asarray(analytic._data, np.float64), numeric,
+            atol=atol, rtol=rtol,
+            err_msg=f"analytic vs numeric grad mismatch for input {i}")
+
+
+def check_output(fn, inputs, expected, atol=1e-5, rtol=1e-5):
+    tensors = [paddle.to_tensor(np.asarray(x)) for x in inputs]
+    out = fn(*tensors)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    exps = expected if isinstance(expected, (list, tuple)) else [expected]
+    for o, e in zip(outs, exps):
+        np.testing.assert_allclose(np.asarray(unwrap(o)), np.asarray(e),
+                                   atol=atol, rtol=rtol)

@@ -1,0 +1,160 @@
+"""Autograd tape tests + numeric gradient checks
+(reference pattern: op_test.py check_grad + test_imperative_basic.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_grad
+
+
+def test_backward_simple():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x
+    y.backward()
+    assert x.grad.item() == pytest.approx(6.0)
+
+
+def test_backward_accumulates():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    (x * x).backward()
+    (x * 3).backward()
+    assert x.grad.item() == pytest.approx(7.0)
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_multi_use():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * x + x.exp() + x
+    y.sum().backward()
+    expect = 2 * np.array([1, 2]) + np.exp([1, 2]) + 1
+    np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach() * 3 + y
+    z.backward()
+    assert x.grad.item() == pytest.approx(2.0)
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_grad_api():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = paddle.to_tensor(3.0, stop_gradient=False)
+    z = x * x * y
+    gx, gy = paddle.grad(z, [x, y])
+    assert gx.item() == pytest.approx(12.0)
+    assert gy.item() == pytest.approx(4.0)
+    assert x.grad is None  # grad() must not touch .grad
+
+
+def test_grad_allow_unused():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = paddle.to_tensor(3.0, stop_gradient=False)
+    (g,) = paddle.grad(x * 2, [y], allow_unused=True)
+    assert g is None
+    with pytest.raises(RuntimeError):
+        paddle.grad(x * 2, [y])
+
+
+def test_backward_nonscalar_needs_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    with pytest.raises(RuntimeError):
+        (x * 2).backward()
+    (x * 2).backward(paddle.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy()) or g * 2)
+    (x * 3).backward()
+    assert seen
+    assert x.grad.item() == pytest.approx(6.0)
+
+
+def test_numeric_grad_elementwise():
+    check_grad(lambda a, b: a * b + a.exp(), [np.random.rand(3, 4), np.random.rand(3, 4)])
+    check_grad(lambda a: paddle.tanh(a), [np.random.randn(5)])
+    check_grad(lambda a: a.sigmoid(), [np.random.randn(5)])
+    check_grad(lambda a: (a * a).sqrt(), [np.random.rand(4) + 0.5])
+
+
+def test_numeric_grad_matmul():
+    check_grad(lambda a, b: paddle.matmul(a, b),
+               [np.random.randn(3, 4), np.random.randn(4, 2)])
+    check_grad(lambda a, b: paddle.matmul(a, b, transpose_y=True),
+               [np.random.randn(3, 4), np.random.randn(2, 4)])
+
+
+def test_numeric_grad_reductions():
+    check_grad(lambda a: a.sum(axis=0), [np.random.randn(3, 4)])
+    check_grad(lambda a: a.mean(), [np.random.randn(3, 4)])
+    check_grad(lambda a: a.max(axis=1), [np.random.randn(3, 4)])
+
+
+def test_numeric_grad_softmax_ce():
+    logits = np.random.randn(4, 5)
+    check_grad(lambda a: F.softmax(a), [logits])
+    labels = np.array([0, 2, 1, 4])
+
+    def ce(a):
+        return F.cross_entropy(a, paddle.to_tensor(labels))
+    check_grad(ce, [logits], atol=2e-3)
+
+
+def test_numeric_grad_layers():
+    check_grad(lambda x, w, b: F.linear(x, w, b),
+               [np.random.randn(2, 3), np.random.randn(3, 4), np.random.randn(4)])
+    check_grad(lambda x: F.gelu(x), [np.random.randn(6)], atol=2e-3)
+    check_grad(lambda x: F.layer_norm(x, 4), [np.random.randn(3, 4)], atol=2e-3)
+
+
+def test_numeric_grad_conv():
+    check_grad(lambda x, w: F.conv2d(x, w, stride=1, padding=1),
+               [np.random.randn(1, 2, 5, 5), np.random.randn(3, 2, 3, 3)],
+               atol=5e-3)
+
+
+def test_numeric_grad_indexing():
+    check_grad(lambda x: paddle.gather(x, paddle.to_tensor([0, 2])),
+               [np.random.randn(4, 3)])
+    check_grad(lambda x: x.reshape([6]), [np.random.randn(2, 3)])
+    check_grad(lambda x: x.transpose([1, 0]), [np.random.randn(2, 3)])
+
+
+def test_second_use_after_backward_retain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = x * x
+    y.backward(retain_graph=True)
+    y.backward(retain_graph=False)
+    assert x.grad.item() == pytest.approx(8.0)
+
+
+def test_pylayer():
+    from paddle_tpu.autograd import PyLayer
+
+    class Double(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            return grad * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 2])

@@ -1,0 +1,187 @@
+"""Optimizer + LR scheduler + AMP tests
+(reference pattern: unittests/test_adam_op.py, test_sgd_op.py, test_lr_scheduler.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import optimizer as opt
+
+
+def _quadratic_steps(optimizer_fn, n=50):
+    """Minimize ||x - 5||^2; returns final x."""
+    x = paddle.core.tensor.Parameter(paddle.to_tensor([0.0])._data)
+    o = optimizer_fn([x])
+    for _ in range(n):
+        loss = ((x - 5.0) ** 2).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    return float(x.numpy()[0])
+
+
+def test_sgd_converges():
+    assert abs(_quadratic_steps(lambda p: opt.SGD(0.1, parameters=p), 100) - 5) < 0.01
+
+
+def test_momentum_converges():
+    assert abs(_quadratic_steps(lambda p: opt.Momentum(0.05, 0.9, parameters=p), 100) - 5) < 0.1
+
+
+def test_adam_converges():
+    assert abs(_quadratic_steps(lambda p: opt.Adam(0.5, parameters=p), 100) - 5) < 0.1
+
+
+def test_adamw_rmsprop_etc_run():
+    cases = [
+        ("AdamW", lambda p: opt.AdamW(0.3, parameters=p, weight_decay=0.01), 80, 1.0),
+        ("RMSProp", lambda p: opt.RMSProp(0.1, parameters=p), 80, 1.0),
+        ("Adagrad", lambda p: opt.Adagrad(0.5, parameters=p), 80, 1.0),
+        ("Adamax", lambda p: opt.Adamax(0.5, parameters=p), 80, 1.0),
+        # adadelta's effective lr self-tunes from ~sqrt(eps): slow by design
+        ("Adadelta", lambda p: opt.Adadelta(50.0, parameters=p), 300, 2.0),
+        ("Lamb", lambda p: opt.Lamb(0.1, parameters=p), 80, 1.0),
+        # lars trust-ratio targets large-batch conv nets; just check progress
+        ("Lars", lambda p: opt.LarsMomentum(0.05, parameters=p), 200, 4.0),
+    ]
+    for name, factory, steps, tol in cases:
+        final = _quadratic_steps(factory, steps)
+        assert abs(final - 5) < tol, f"{name}: {final}"
+
+
+def test_adam_matches_reference_formula():
+    # one step of adam vs hand-rolled numpy
+    w0 = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.5, -0.3], np.float32)
+    p = paddle.core.tensor.Parameter(paddle.to_tensor(w0)._data)
+    o = opt.Adam(learning_rate=0.01, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=[p])
+    p.grad = paddle.to_tensor(g)
+    o.step()
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    expect = w0 - 0.01 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5)
+
+
+def test_weight_decay_l2():
+    w0 = np.array([2.0], np.float32)
+    p = paddle.core.tensor.Parameter(paddle.to_tensor(w0)._data)
+    o = opt.SGD(0.1, parameters=[p], weight_decay=0.5)
+    p.grad = paddle.to_tensor(np.array([0.0], np.float32))
+    o.step()
+    np.testing.assert_allclose(p.numpy(), 2.0 - 0.1 * 0.5 * 2.0, rtol=1e-6)
+
+
+def test_grad_clip_in_optimizer():
+    p = paddle.core.tensor.Parameter(paddle.to_tensor([0.0])._data)
+    o = opt.SGD(1.0, parameters=[p], grad_clip=nn.ClipGradByGlobalNorm(0.1))
+    p.grad = paddle.to_tensor([100.0])
+    o.step()
+    np.testing.assert_allclose(p.numpy(), [-0.1], rtol=1e-4)
+
+
+def test_lr_schedulers():
+    s = opt.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    cos = opt.lr.CosineAnnealingDecay(1.0, T_max=10)
+    assert cos() == pytest.approx(1.0)
+    for _ in range(10):
+        cos.step()
+    assert cos() == pytest.approx(0.0, abs=1e-6)
+
+    warm = opt.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    assert warm() == pytest.approx(0.0)
+    for _ in range(10):
+        warm.step()
+    assert warm() == pytest.approx(0.1)
+
+    noam = opt.lr.NoamDecay(d_model=512, warmup_steps=100)
+    vals = []
+    for _ in range(200):
+        noam.step()
+        vals.append(noam())
+    assert np.argmax(vals) == pytest.approx(99, abs=2)
+
+
+def test_optimizer_with_scheduler():
+    p = paddle.core.tensor.Parameter(paddle.to_tensor([0.0])._data)
+    sched = opt.lr.StepDecay(0.1, step_size=1, gamma=0.5)
+    o = opt.SGD(sched, parameters=[p])
+    assert o.get_lr() == pytest.approx(0.1)
+    sched.step()
+    assert o.get_lr() == pytest.approx(0.05)
+
+
+def test_optimizer_state_dict():
+    p = paddle.core.tensor.Parameter(paddle.to_tensor([1.0, 2.0])._data)
+    o = opt.Adam(0.1, parameters=[p])
+    p.grad = paddle.to_tensor([0.1, 0.1])
+    o.step()
+    sd = o.state_dict()
+    assert sd["_step_count"] == 1
+    o2 = opt.Adam(0.1, parameters=[p])
+    o2.set_state_dict(sd)
+    assert o2._step_count == 1
+    np.testing.assert_allclose(
+        np.asarray(o2._states[id(p)]["moment1"]),
+        np.asarray(o._states[id(p)]["moment1"]))
+
+
+def test_amp_autocast_bf16():
+    import jax.numpy as jnp
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        a = paddle.ones([4, 4])
+        b = paddle.ones([4, 4])
+        c = paddle.matmul(a, b)
+        assert c.dtype == jnp.bfloat16
+        s = nn.functional.softmax(c.astype("float32"))  # black-list op stays fp32
+    assert paddle.matmul(a, b).dtype == jnp.float32
+
+
+def test_grad_scaler_fp16_flow():
+    p = paddle.core.tensor.Parameter(paddle.to_tensor([1.0])._data)
+    o = opt.SGD(0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    loss = (p * 2).sum()
+    scaled = scaler.scale(loss)
+    assert scaled.item() == pytest.approx(loss.item() * 4.0)
+    scaled.backward()
+    scaler.step(o)
+    # grad was unscaled before the update: dL/dp = 2
+    np.testing.assert_allclose(p.numpy(), [1.0 - 0.2], rtol=1e-5)
+
+
+def test_grad_scaler_inf_skips_step():
+    p = paddle.core.tensor.Parameter(paddle.to_tensor([1.0])._data)
+    o = opt.SGD(0.1, parameters=[p])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                   decr_every_n_nan_or_inf=1)
+    p.grad = paddle.to_tensor([np.inf])
+    scaler.step(o)
+    np.testing.assert_allclose(p.numpy(), [1.0])  # update skipped
+    assert scaler._scale == pytest.approx(2.0)  # scale halved
+
+
+def test_train_linear_regression_e2e():
+    np.random.seed(0)
+    X = np.random.randn(128, 3).astype(np.float32)
+    true_w = np.array([[1.5], [-2.0], [0.7]], np.float32)
+    Y = X @ true_w + 0.3
+    model = nn.Linear(3, 1)
+    o = opt.Adam(0.1, parameters=model.parameters())
+    for i in range(150):
+        pred = model(paddle.to_tensor(X))
+        loss = nn.functional.mse_loss(pred, paddle.to_tensor(Y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    np.testing.assert_allclose(model.weight.numpy(), true_w, atol=0.05)
+    np.testing.assert_allclose(model.bias.numpy(), [0.3], atol=0.05)
