@@ -217,6 +217,10 @@ class TrainStep:
         opt = self.optimizer
         trainable = self._trainable
         wd = getattr(opt, "_wd", 0.0)
+        dwd = getattr(opt, "_decoupled_wd", 0.0)
+        # structured param names let AdamW's apply_decay_param_fun work here
+        decay = {k: (opt._decay_applies(k) if hasattr(opt, "_decay_applies")
+                     else True) for k in trainable}
 
         def step(params, opt_state, step_no, lr, batch):
             def loss_of(train_params):
@@ -231,9 +235,13 @@ class TrainStep:
             new_opt = dict(opt_state)
             for k, g in grads.items():
                 p = params[k]
-                if wd and jnp.issubdtype(p.dtype, jnp.floating):
+                is_float = jnp.issubdtype(p.dtype, jnp.floating)
+                if wd and decay[k] and is_float:
                     g = g + wd * p
                 np_, ns = opt.update_one(p, g, opt_state[k], lr, step_no)
+                if dwd and decay[k] and is_float:
+                    np_ = (np_.astype(jnp.float32)
+                           - lr * dwd * p.astype(jnp.float32)).astype(p.dtype)
                 new_params[k] = np_
                 new_opt[k] = ns
             return new_params, new_opt, loss

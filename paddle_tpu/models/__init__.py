@@ -1,1 +1,13 @@
-"""flagship model zoo (bert/gpt2/ernie/resnet) — built out."""
+"""Flagship model zoo: BERT / GPT-2 / ERNIE pretraining models for the
+BASELINE.md benchmark configs (#3 BERT DP, #4 ERNIE sharding, #5 GPT-2 PP)."""
+from .bert import (BertConfig, BertModel, BertForPretraining,  # noqa: F401
+                   BertPretrainingCriterion,
+                   BertForSequenceClassification,
+                   bert_base_config, bert_large_config)
+from .gpt import (GPTConfig, GPTModel, GPTForPretraining,  # noqa: F401
+                  GPTPretrainingCriterion, GPTBlock,
+                  gpt2_small_config, gpt2_medium_config, gpt2_large_config)
+from .ernie import (ErnieConfig, ErnieModel, ErnieForPretraining,  # noqa: F401
+                    ErniePretrainingCriterion,
+                    ErnieForSequenceClassification,
+                    ernie_base_config, ernie_large_config)

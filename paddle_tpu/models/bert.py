@@ -1,0 +1,259 @@
+"""BERT model family (flagship encoder model; benchmark config #3/#4).
+
+The reference repo carries BERT/ERNIE-style transformers through its test
+models (dist_transformer.py) and through `paddle.nn.TransformerEncoder`
+(python/paddle/nn/layer/transformer.py); the pretraining configs targeted by
+BASELINE.md (BERT-base/large, ERNIE-large) are built here natively.
+
+TPU-first notes: attention routes through F.scaled_dot_product_attention →
+pallas flash kernel; all matmuls are (B*S, H)×(H, ...) shapes that tile onto
+the MXU; the whole model jits into a single XLA program (no per-op dispatch).
+"""
+from __future__ import annotations
+
+import math
+
+from ..nn.layer_base import Layer
+from ..nn.layer.common import Linear, Dropout, Embedding
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.container import LayerList
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import ParamAttr
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072,
+                 hidden_act="gelu", hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1, max_position_embeddings=512,
+                 type_vocab_size=2, initializer_range=0.02, pad_token_id=0):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.pad_token_id = pad_token_id
+
+
+def bert_base_config(**kw):
+    return BertConfig(**kw)
+
+
+def bert_large_config(**kw):
+    base = dict(hidden_size=1024, num_hidden_layers=24,
+                num_attention_heads=16, intermediate_size=4096)
+    base.update(kw)
+    return BertConfig(**base)
+
+
+def _winit(std):
+    return ParamAttr(initializer=I.Normal(0.0, std))
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        std = cfg.initializer_range
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size,
+                                         weight_attr=_winit(std))
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size,
+                                             weight_attr=_winit(std))
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size,
+                                               weight_attr=_winit(std))
+        self.layer_norm = LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def _sum_embeddings(self, input_ids, token_type_ids=None,
+                        position_ids=None):
+        """word+position+token_type sum before norm/dropout (subclass hook)."""
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor, unwrap
+        ids = unwrap(input_ids)
+        seq = ids.shape[-1]
+        if position_ids is None:
+            position_ids = Tensor(
+                jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32), ids.shape))
+        if token_type_ids is None:
+            token_type_ids = Tensor(jnp.zeros_like(ids))
+        return (self.word_embeddings(input_ids)
+                + self.position_embeddings(position_ids)
+                + self.token_type_embeddings(token_type_ids))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        emb = self._sum_embeddings(input_ids, token_type_ids, position_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertSelfAttention(Layer):
+    """Fused-QKV attention block (the reference's fused/multihead_matmul
+    equivalent): one (H, 3H) matmul then flash attention."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        std = cfg.initializer_range
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.hidden_size = cfg.hidden_size
+        self.qkv = Linear(cfg.hidden_size, 3 * cfg.hidden_size,
+                          weight_attr=_winit(std))
+        self.out = Linear(cfg.hidden_size, cfg.hidden_size,
+                          weight_attr=_winit(std))
+        self.attn_dropout = cfg.attention_probs_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout,
+            training=self.training)
+        return self.out(ctx.reshape([b, s, self.hidden_size]))
+
+
+class BertLayer(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        std = cfg.initializer_range
+        self.attention = BertSelfAttention(cfg)
+        self.attn_norm = LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.ffn_in = Linear(cfg.hidden_size, cfg.intermediate_size,
+                             weight_attr=_winit(std))
+        self.ffn_out = Linear(cfg.intermediate_size, cfg.hidden_size,
+                              weight_attr=_winit(std))
+        self.ffn_norm = LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.act = cfg.hidden_act
+
+    def forward(self, x, attn_mask=None):
+        x = self.attn_norm(x + self.dropout(self.attention(x, attn_mask)))
+        act = getattr(F, self.act)
+        h = self.ffn_out(act(self.ffn_in(x)))
+        return self.ffn_norm(x + self.dropout(h))
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size,
+                            weight_attr=_winit(cfg.initializer_range))
+
+    def forward(self, hidden):
+        return F.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig = None, **kw):
+        super().__init__()
+        self.config = cfg or BertConfig(**kw)
+        cfg = self.config
+        self.embeddings = self._make_embeddings(cfg)
+        self.layers = LayerList([BertLayer(cfg)
+                                 for _ in range(cfg.num_hidden_layers)])
+        self.pooler = BertPooler(cfg)
+
+    def _make_embeddings(self, cfg):
+        return BertEmbeddings(cfg)
+
+    def make_attn_mask(self, input_ids, attention_mask=None):
+        """(B,S) padding mask / None -> additive (B,1,1,S) float mask / None."""
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor, unwrap
+        if attention_mask is None:
+            return None
+        m = unwrap(attention_mask)
+        if m.ndim == 2:
+            m = m[:, None, None, :]
+        return Tensor((1.0 - m.astype(jnp.float32)) * -1e4)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        mask = self.make_attn_mask(input_ids, attention_mask)
+        h = self.embeddings(input_ids, token_type_ids, position_ids)
+        for layer in self.layers:
+            h = layer(h, mask)
+        return h, self.pooler(h)
+
+
+class BertLMHead(Layer):
+    """MLM head with tied decoder weight (transform + layernorm + logits)."""
+
+    def __init__(self, cfg: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size,
+                                weight_attr=_winit(cfg.initializer_range))
+        self.layer_norm = LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.act = cfg.hidden_act
+        self.decoder_weight = embedding_weights  # (V, H), tied
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
+
+    def forward(self, hidden):
+        from ..tensor.linalg import matmul
+        h = self.layer_norm(getattr(F, self.act)(self.transform(hidden)))
+        return matmul(h, self.decoder_weight, transpose_y=True) \
+            + self.decoder_bias
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP pretraining model (benchmark flagship)."""
+
+    def __init__(self, cfg: BertConfig = None, **kw):
+        super().__init__()
+        self.bert = BertModel(cfg, **kw)
+        cfg = self.bert.config
+        self.cls = BertLMHead(cfg, self.bert.embeddings.word_embeddings.weight)
+        self.nsp = Linear(cfg.hidden_size, 2,
+                          weight_attr=_winit(cfg.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq_out, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                    attention_mask)
+        return self.cls(seq_out), self.nsp(pooled)
+
+
+class BertPretrainingCriterion(Layer):
+    """Masked-LM + next-sentence loss (ignore_index=-100 for unmasked)."""
+
+    def __init__(self, vocab_size=None):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels=None):
+        mlm = F.cross_entropy(
+            prediction_scores.reshape([-1, prediction_scores.shape[-1]]),
+            masked_lm_labels.reshape([-1]), ignore_index=-100,
+            reduction="mean")
+        if next_sentence_labels is None:
+            return mlm
+        nsp = F.cross_entropy(seq_relationship_score,
+                              next_sentence_labels.reshape([-1]),
+                              reduction="mean")
+        return mlm + nsp
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig = None, num_classes=2, dropout=None, **kw):
+        super().__init__()
+        self.bert = BertModel(cfg, **kw)
+        cfg = self.bert.config
+        self.dropout = Dropout(dropout if dropout is not None
+                               else cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, num_classes,
+                                 weight_attr=_winit(cfg.initializer_range))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.classifier(self.dropout(pooled))

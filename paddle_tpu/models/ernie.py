@@ -1,0 +1,113 @@
+"""ERNIE model family (benchmark config #4: ERNIE-large + ZeRO sharding,
+reference Fleet sharding_optimizer.py path).
+
+Architecturally a BERT encoder with a task-type embedding and relu-gelu
+configurable activation — shares the TPU-first blocks from models.bert.
+"""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer
+from ..nn.layer.common import Linear, Dropout, Embedding
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import ParamAttr
+from .bert import (BertConfig, BertModel, BertEmbeddings, BertLMHead,
+                   BertPretrainingCriterion)
+
+
+class ErnieConfig(BertConfig):
+    def __init__(self, vocab_size=18000, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=3072,
+                 hidden_act="relu", task_type_vocab_size=3, use_task_id=True,
+                 **kw):
+        super().__init__(vocab_size=vocab_size, hidden_size=hidden_size,
+                         num_hidden_layers=num_hidden_layers,
+                         num_attention_heads=num_attention_heads,
+                         intermediate_size=intermediate_size,
+                         hidden_act=hidden_act, **kw)
+        self.task_type_vocab_size = task_type_vocab_size
+        self.use_task_id = use_task_id
+
+
+def ernie_base_config(**kw):
+    return ErnieConfig(**kw)
+
+
+def ernie_large_config(**kw):
+    base = dict(hidden_size=1024, num_hidden_layers=24,
+                num_attention_heads=16, intermediate_size=4096)
+    base.update(kw)
+    return ErnieConfig(**base)
+
+
+class ErnieEmbeddings(BertEmbeddings):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__(cfg)
+        if cfg.use_task_id:
+            self.task_type_embeddings = Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size,
+                weight_attr=ParamAttr(
+                    initializer=I.Normal(0.0, cfg.initializer_range)))
+        self.use_task_id = cfg.use_task_id
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor, unwrap
+        emb = self._sum_embeddings(input_ids, token_type_ids, position_ids)
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = Tensor(jnp.zeros_like(unwrap(input_ids)))
+            emb = emb + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class ErnieModel(BertModel):
+    def __init__(self, cfg: ErnieConfig = None, **kw):
+        super().__init__(cfg or ErnieConfig(**kw))
+
+    def _make_embeddings(self, cfg):
+        return ErnieEmbeddings(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        mask = self.make_attn_mask(input_ids, attention_mask)
+        h = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        for layer in self.layers:
+            h = layer(h, mask)
+        return h, self.pooler(h)
+
+
+class ErnieForPretraining(Layer):
+    def __init__(self, cfg: ErnieConfig = None, **kw):
+        super().__init__()
+        self.ernie = ErnieModel(cfg, **kw)
+        cfg = self.ernie.config
+        self.cls = BertLMHead(cfg,
+                              self.ernie.embeddings.word_embeddings.weight)
+        self.nsp = Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        seq_out, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                                     attention_mask, task_type_ids)
+        return self.cls(seq_out), self.nsp(pooled)
+
+
+ErniePretrainingCriterion = BertPretrainingCriterion
+
+
+class ErnieForSequenceClassification(Layer):
+    def __init__(self, cfg: ErnieConfig = None, num_classes=2, **kw):
+        super().__init__()
+        self.ernie = ErnieModel(cfg, **kw)
+        cfg = self.ernie.config
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                               attention_mask)
+        return self.classifier(self.dropout(pooled))

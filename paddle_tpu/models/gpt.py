@@ -1,0 +1,181 @@
+"""GPT-2 model family (decoder-only; benchmark config #5: GPT-2 medium with
+pipeline parallel + recompute, reference PipelineOptimizer + RecomputeOptimizer
+paths in python/paddle/fluid/optimizer.py:3693,4491).
+
+TPU-first: pre-LN blocks, causal flash attention (pallas), fused QKV, tied
+LM head.  Blocks are written so `parallel.pipeline` can stack their params on
+a leading axis and `lax.scan` over them (identical per-layer structure).
+"""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer, ParamAttr
+from ..nn.layer.common import Linear, Dropout, Embedding
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.container import LayerList
+from ..nn import functional as F
+from ..nn import initializer as I
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768, num_hidden_layers=12,
+                 num_attention_heads=12, intermediate_size=None,
+                 hidden_act="gelu", hidden_dropout_prob=0.1,
+                 attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=1024, initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.initializer_range = initializer_range
+
+
+def gpt2_small_config(**kw):
+    return GPTConfig(**kw)
+
+
+def gpt2_medium_config(**kw):
+    base = dict(hidden_size=1024, num_hidden_layers=24, num_attention_heads=16)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def gpt2_large_config(**kw):
+    base = dict(hidden_size=1280, num_hidden_layers=36, num_attention_heads=20)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def _winit(std):
+    return ParamAttr(initializer=I.Normal(0.0, std))
+
+
+class GPTBlock(Layer):
+    """Pre-LN transformer decoder block with fused QKV + causal flash attn."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        std = cfg.initializer_range
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.hidden_size = cfg.hidden_size
+        self.ln1 = LayerNorm(cfg.hidden_size, epsilon=1e-5)
+        self.qkv = Linear(cfg.hidden_size, 3 * cfg.hidden_size,
+                          weight_attr=_winit(std))
+        self.proj = Linear(cfg.hidden_size, cfg.hidden_size,
+                           weight_attr=_winit(std))
+        self.ln2 = LayerNorm(cfg.hidden_size, epsilon=1e-5)
+        self.ffn_in = Linear(cfg.hidden_size, cfg.intermediate_size,
+                             weight_attr=_winit(std))
+        self.ffn_out = Linear(cfg.intermediate_size, cfg.hidden_size,
+                              weight_attr=_winit(std))
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.attn_dropout = cfg.attention_probs_dropout_prob
+        self.act = cfg.hidden_act
+
+    def attend(self, x, cache=None):
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        new_cache = None
+        if cache is not None:
+            from ..tensor.manipulation import concat
+            pk, pv = cache
+            if pk.shape[1]:
+                k = concat([pk, k], axis=1)
+                v = concat([pv, v], axis=1)
+            new_cache = (k, v)
+        # causal whenever more than one query: with a kv cache the mask
+        # offsets by (sk - sq), i.e. query i attends keys <= past + i
+        # (both the naive tril(k=sk-sq) and the flash kernel honor this).
+        ctx = F.scaled_dot_product_attention(
+            q, k, v, is_causal=(s > 1),
+            dropout_p=self.attn_dropout, training=self.training)
+        return self.proj(ctx.reshape([b, s, self.hidden_size])), new_cache
+
+    def forward(self, x, cache=None):
+        a, new_cache = self.attend(self.ln1(x), cache)
+        x = x + self.dropout(a)
+        h = self.ffn_out(getattr(F, self.act)(self.ffn_in(self.ln2(x))))
+        x = x + self.dropout(h)
+        return x if cache is None else (x, new_cache)
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig = None, **kw):
+        super().__init__()
+        self.config = cfg or GPTConfig(**kw)
+        cfg = self.config
+        std = cfg.initializer_range
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size,
+                                         weight_attr=_winit(std))
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size,
+                                             weight_attr=_winit(std))
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.blocks = LayerList([GPTBlock(cfg)
+                                 for _ in range(cfg.num_hidden_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=1e-5)
+
+    def embed(self, input_ids, position_ids=None, past_len=0):
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor, unwrap
+        ids = unwrap(input_ids)
+        if position_ids is None:
+            pos = jnp.arange(past_len, past_len + ids.shape[-1],
+                             dtype=jnp.int32)
+            position_ids = Tensor(jnp.broadcast_to(pos, ids.shape))
+        return self.dropout(self.word_embeddings(input_ids)
+                            + self.position_embeddings(position_ids))
+
+    def forward(self, input_ids, position_ids=None, cache=None):
+        past_len = 0 if cache is None else cache[0][0].shape[1]
+        h = self.embed(input_ids, position_ids, past_len)
+        new_caches = []
+        for i, blk in enumerate(self.blocks):
+            if cache is not None:
+                h, c = blk(h, cache[i])
+                new_caches.append(c)
+            else:
+                h = blk(h)
+        h = self.ln_f(h)
+        return h if cache is None else (h, new_caches)
+
+    def gen_cache(self, batch_size=1):
+        from ..tensor.creation import zeros
+        cfg = self.config
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        return [(zeros([batch_size, 0, cfg.num_attention_heads, hd]),
+                 zeros([batch_size, 0, cfg.num_attention_heads, hd]))
+                for _ in range(cfg.num_hidden_layers)]
+
+
+class GPTForPretraining(Layer):
+    """Causal-LM pretraining head (tied embedding weights)."""
+
+    def __init__(self, cfg: GPTConfig = None, **kw):
+        super().__init__()
+        self.gpt = GPTModel(cfg, **kw)
+
+    def forward(self, input_ids, position_ids=None, cache=None):
+        from ..tensor.linalg import matmul
+        out = self.gpt(input_ids, position_ids, cache)
+        h = out[0] if isinstance(out, tuple) else out
+        logits = matmul(h, self.gpt.word_embeddings.weight, transpose_y=True)
+        return logits if cache is None else (logits, out[1])
+
+
+class GPTPretrainingCriterion(Layer):
+    def forward(self, logits, labels, loss_mask=None):
+        loss = F.cross_entropy(logits.reshape([-1, logits.shape[-1]]),
+                               labels.reshape([-1]), reduction="none")
+        if loss_mask is not None:
+            m = loss_mask.reshape([-1])
+            from ..tensor.math import sum as tsum  # noqa: A004
+            return tsum(loss * m) / tsum(m)
+        from ..tensor.stat import mean
+        return mean(loss)

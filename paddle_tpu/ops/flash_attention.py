@@ -57,6 +57,9 @@ def _flash_bhsd(q, k, v, causal):
     blk_k = min(512, sk)
     n_k = sk // blk_k
     scale = 1.0 / math.sqrt(d)
+    # causal offset for sq != sk (kv-cache decode): query i sees keys
+    # <= i + (sk - sq), matching the naive path's tril(..., k=sk-sq)
+    causal_off = sk - sq
 
     def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
         qi = pl.program_id(1)
@@ -78,7 +81,7 @@ def _flash_bhsd(q, k, v, causal):
                     jnp.int32, (blk_q, blk_k), 0)
                 cols = ki * blk_k + jax.lax.broadcasted_iota(
                     jnp.int32, (blk_q, blk_k), 1)
-                s = jnp.where(rows >= cols, s, -1e30)
+                s = jnp.where(rows + causal_off >= cols, s, -1e30)
             m_prev = m_ref[...]
             m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
             p = jnp.exp(s - m_cur)
@@ -90,7 +93,7 @@ def _flash_bhsd(q, k, v, causal):
                 p, vb, preferred_element_type=jnp.float32)
 
         if causal:
-            @pl.when((ki * blk_k) <= (qi * blk_q + blk_q - 1))
+            @pl.when((ki * blk_k) <= (qi * blk_q + blk_q - 1 + causal_off))
             def _go():
                 _compute()
         else:

@@ -42,8 +42,18 @@ class Optimizer:
                                      getattr(weight_decay, "coeff", 0.0)))
         self._step_count = 0
         self._states: Dict[int, dict] = {}
-        self._jit_update = None
+        # jitted tree-update closures keyed by (n_params, lr_mults, decay_bits)
+        # — the closure bakes those in, so a changed grad-bearing param set
+        # must map to a fresh closure, not silently reuse a stale one.
+        self._jit_cache: Dict[tuple, object] = {}
         self._accumulators: Dict[str, Dict[int, Tensor]] = {}
+        # decoupled (AdamW-style) decay coefficient; 0 on plain optimizers
+        self._decoupled_wd = 0.0
+
+    def _decay_applies(self, name) -> bool:
+        """Whether weight decay applies to the param with this name
+        (AdamW's apply_decay_param_fun hook; True for plain optimizers)."""
+        return True
 
     # ---- functional core (override in subclasses) -------------------------
     def init_state(self, p) -> dict:
@@ -90,21 +100,30 @@ class Optimizer:
         states = [self._get_state(p) for p in params]
         lr_mults = tuple(float(p.optimize_attr.get("learning_rate", 1.0))
                          for p in params)
+        decay_bits = tuple(self._decay_applies(p.name) for p in params)
 
-        if self._jit_update is None:
-            wd = self._wd
+        cache_key = (len(params), lr_mults, decay_bits)
+        jit_update = self._jit_cache.get(cache_key)
+        if jit_update is None:
+            wd, dwd = self._wd, self._decoupled_wd
             def _tree_update(p_raw, g_raw, states, lr, step):
                 outs, new_states = [], []
-                for p, g, s, m in zip(p_raw, g_raw, states, lr_mults):
-                    if wd and jnp.issubdtype(p.dtype, jnp.floating):
+                for p, g, s, m, db in zip(p_raw, g_raw, states, lr_mults,
+                                          decay_bits):
+                    is_float = jnp.issubdtype(p.dtype, jnp.floating)
+                    if wd and db and is_float:
                         g = g + wd * p
                     np_, ns = self.update_one(p, g, s, lr * m, step)
+                    if dwd and db and is_float:
+                        np_ = (np_.astype(jnp.float32)
+                               - lr * m * dwd * p.astype(jnp.float32)
+                               ).astype(p.dtype)
                     outs.append(np_)
                     new_states.append(ns)
                 return outs, new_states
-            self._jit_update = jax.jit(_tree_update)
+            jit_update = self._jit_cache[cache_key] = jax.jit(_tree_update)
 
-        new_p, new_states = self._jit_update(p_raw, g_raw, states, lr, step)
+        new_p, new_states = jit_update(p_raw, g_raw, states, lr, step)
         for p, np_, ns in zip(params, new_p, new_states):
             p._set_data(np_)
             self._states[id(p)] = ns
@@ -156,7 +175,7 @@ class Optimizer:
                         st[k[len(prefix):]] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
                 if st:
                     self._states[id(p)] = st
-        self._jit_update = None
+        self._jit_cache.clear()
 
     set_dict = set_state_dict
 
@@ -228,23 +247,12 @@ class AdamW(Adam):
                          None, grad_clip, lazy_mode, multi_precision, name)
         self._decoupled_wd = float(weight_decay) if weight_decay else 0.0
         self._apply_decay_param_fun = apply_decay_param_fun
-        # remember which params get decay (by position) — resolved at step time
-        self._decay_mask = None
 
-    def step(self):
-        if self._decay_mask is None and self._parameter_list is not None:
-            fn = self._apply_decay_param_fun
-            self._decay_mask = {
-                id(p): (fn(p.name) if fn is not None and p.name else True)
-                for p in self._parameter_list}
-        super().step()
-
-    def update_one(self, p, g, state, lr, step):
-        new_p, new_state = super().update_one(p, g, state, lr, step)
-        if self._decoupled_wd and jnp.issubdtype(p.dtype, jnp.floating):
-            new_p = new_p - (lr * self._decoupled_wd * p.astype(jnp.float32)
-                             ).astype(p.dtype)
-        return new_p, new_state
+    def _decay_applies(self, name) -> bool:
+        fn = self._apply_decay_param_fun
+        if fn is None or not name:
+            return True
+        return bool(fn(name))
 
 
 class Adamax(Optimizer):
