@@ -60,6 +60,11 @@ def default_generator() -> Generator:
 
 
 def next_key():
+    ctx = _active_ctx()
+    if ctx is not None:
+        sub = jax.random.fold_in(ctx.key, ctx.count)
+        ctx.count += 1
+        return sub
     return _default_generator.next_key()
 
 
@@ -69,3 +74,40 @@ def get_rng_state():
 
 def set_rng_state(state):
     _default_generator.set_state(state)
+
+
+# ---------------------------------------------------------------------------
+# traced-key context: randomness inside jitted programs
+# ---------------------------------------------------------------------------
+# Under `jax.jit`, calling next_key() at trace time would bake a *constant*
+# key into the compiled program — every step would reuse identical dropout
+# masks.  Compiled paths (jit.TrainStep, parallel.ShardedTrainStep) instead
+# pass a fresh key argument per step and trace the forward inside key_ctx():
+# next_key() then derives per-call-site subkeys from the traced key via
+# fold_in, so masks differ every step while staying jit-pure.
+import contextlib as _contextlib
+
+_traced_ctx = threading.local()
+
+
+class _KeyCtx:
+    __slots__ = ("key", "count")
+
+    def __init__(self, key):
+        self.key = key
+        self.count = 0
+
+
+@_contextlib.contextmanager
+def key_ctx(key):
+    """Use `key` (possibly a tracer) as the randomness root for this trace."""
+    prev = getattr(_traced_ctx, "ctx", None)
+    _traced_ctx.ctx = _KeyCtx(key)
+    try:
+        yield
+    finally:
+        _traced_ctx.ctx = prev
+
+
+def _active_ctx():
+    return getattr(_traced_ctx, "ctx", None)

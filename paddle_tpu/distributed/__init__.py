@@ -1,22 +1,13 @@
 """paddle_tpu.distributed (reference: python/paddle/distributed/).
 
-Built out in paddle_tpu/distributed/*: mesh-based parallel env, collective
-API over XLA collectives, fleet facade, launch CLI.
+Collectives over XLA (collective.py), mesh-based parallel env (env.py),
+fleet facade (fleet/), launch CLI (launch.py), spawn (spawn.py).
 """
-import os
-
-
-def get_rank():
-    import jax
-    try:
-        return jax.process_index()
-    except Exception:
-        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
-
-
-def get_world_size():
-    import jax
-    try:
-        return jax.process_count()
-    except Exception:
-        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+from .env import (ParallelEnv, init_parallel_env, get_rank,  # noqa: F401
+                  get_world_size, is_initialized)
+from .collective import (ReduceOp, all_reduce, all_gather,  # noqa: F401
+                         broadcast, reduce, scatter, reduce_scatter,
+                         alltoall, send, recv, ppermute, p2p, barrier)
+from .parallel_layer import DataParallel  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import fleet  # noqa: F401

@@ -1,0 +1,220 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/collective.py:38-455 (all_reduce/
+broadcast/all_gather/scatter/barrier over the c_* collective ops,
+operators/collective/c_allreduce_op.h:109 → ncclAllReduce).
+
+TPU-native: collectives are XLA ops (`lax.psum/all_gather/ppermute/...`)
+scheduled by the compiler over ICI — no comm streams, no ring-id bootstrap.
+Two regimes:
+
+- **Inside `shard_map`/`pmap`** (an SPMD region with a named axis): the calls
+  lower to real XLA collectives on that axis.  This is the moral equivalent
+  of the reference's per-rank subprocess running a c_allreduce op.
+- **Eager, single controller**: arrays are either replicated (collective is
+  the identity) or sharded (use `parallel` APIs / jit shardings instead), so
+  the eager fallbacks implement the degenerate world-size-1 semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor, wrap, unwrap
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _in_spmd(axis_name) -> bool:
+    """True when tracing inside shard_map/pmap with this named axis bound."""
+    try:
+        lax.axis_index(axis_name)
+        return True
+    except NameError:
+        return False
+    except Exception:
+        return False
+
+
+def _reduce(x, op, axis_name):
+    if op in (ReduceOp.SUM, "sum"):
+        return lax.psum(x, axis_name)
+    if op in (ReduceOp.MAX, "max"):
+        return lax.pmax(x, axis_name)
+    if op in (ReduceOp.MIN, "min"):
+        return lax.pmin(x, axis_name)
+    if op in (ReduceOp.AVG, "avg"):
+        return lax.pmean(x, axis_name)
+    if op in (ReduceOp.PROD, "prod"):
+        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               axis_name="dp"):
+    """reference collective.py:99 (c_allreduce_sum c_allreduce_op.h:157)."""
+    t = wrap(tensor)
+    if _in_spmd(axis_name):
+        out = _reduce(unwrap(t), op, axis_name)
+        result = Tensor(out, stop_gradient=t.stop_gradient)
+    else:
+        result = t  # world of one: reduction is identity
+    if isinstance(tensor, Tensor):
+        tensor._data = result._data  # paddle mutates in place
+    return result
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis_name="dp"):
+    """reference collective.py:155 — gathers shards along a new leading dim
+    then concatenates on axis 0 (paddle semantics)."""
+    t = wrap(tensor)
+    if _in_spmd(axis_name):
+        gathered = lax.all_gather(unwrap(t), axis_name)  # (world, ...)
+        n = gathered.shape[0]
+        parts = [Tensor(gathered[i]) for i in range(n)]
+    else:
+        parts = [t]
+    if tensor_list is not None:
+        tensor_list.extend(parts)
+    from ..tensor.manipulation import concat
+    return concat(parts, axis=0)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True, axis_name="dp"):
+    """reference collective.py:38 (c_broadcast)."""
+    t = wrap(tensor)
+    if _in_spmd(axis_name):
+        gathered = lax.all_gather(unwrap(t), axis_name)
+        out = gathered[src]
+        result = Tensor(out, stop_gradient=t.stop_gradient)
+    else:
+        result = t
+    if isinstance(tensor, Tensor):
+        tensor._data = result._data
+    return result
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True,
+           axis_name="dp"):
+    """reference collective.py (c_reduce_*): SPMD form reduces everywhere
+    (XLA has no single-destination reduce; all ranks hold the result)."""
+    return all_reduce(tensor, op=op, group=group, axis_name=axis_name)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True,
+            axis_name="dp"):
+    """reference collective.py:311 — rank i gets tensor_list[i]."""
+    if _in_spmd(axis_name):
+        idx = lax.axis_index(axis_name)
+        stacked = jnp.stack([unwrap(wrap(t)) for t in tensor_list])
+        out = Tensor(stacked[idx])
+    else:
+        out = wrap(tensor_list[0] if tensor_list else tensor)
+    if isinstance(tensor, Tensor):
+        tensor._data = out._data
+    return out
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True, axis_name="dp"):
+    """Sharded-sum: each rank gets its slice of the summed tensor."""
+    inp = tensor_or_tensor_list
+    if isinstance(inp, (list, tuple)):
+        from ..tensor.manipulation import concat
+        inp = concat([wrap(t) for t in inp], axis=0)
+    t = wrap(inp)
+    if _in_spmd(axis_name):
+        out = lax.psum_scatter(unwrap(t), axis_name, scatter_dimension=0,
+                               tiled=True)
+        result = Tensor(out)
+    else:
+        result = t
+    if isinstance(tensor, Tensor):
+        tensor._data = result._data
+    return result
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True,
+             axis_name="dp"):
+    """reference collective.py alltoall — rank r sends in[i] to rank i."""
+    stacked = jnp.stack([unwrap(wrap(t)) for t in in_tensor_list])
+    if _in_spmd(axis_name):
+        out = lax.all_to_all(stacked, axis_name, split_axis=0, concat_axis=0,
+                             tiled=False)
+    else:
+        out = stacked
+    parts = [Tensor(out[i]) for i in range(out.shape[0])]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(parts)
+    return parts
+
+
+def send(tensor, dst=0, group=None, sync_op=True, axis_name="dp"):
+    """p2p send (reference send_v2).  SPMD programs are single-program: an
+    absolute-rank send only makes sense as part of a permutation every rank
+    participates in.  Use `ppermute(t, shift=...)` for the ring pattern
+    (pipeline handoff) or `p2p(t, pairs=[(src, dst), ...])` for explicit
+    pairs; a bare eager send in a world of one is a no-op."""
+    if _in_spmd(axis_name):
+        raise NotImplementedError(
+            "absolute-rank send() cannot be expressed in an SPMD program — "
+            "use paddle_tpu.distributed.ppermute(shift=...) for ring "
+            "patterns or p2p(pairs=[(src, dst)]) for explicit pairs")
+    return wrap(tensor)
+
+
+def recv(tensor, src=0, group=None, sync_op=True, axis_name="dp"):
+    """p2p recv (reference recv_v2) — see send()."""
+    if _in_spmd(axis_name):
+        raise NotImplementedError(
+            "absolute-rank recv() cannot be expressed in an SPMD program — "
+            "use paddle_tpu.distributed.ppermute(shift=...) for ring "
+            "patterns or p2p(pairs=[(src, dst)]) for explicit pairs")
+    return wrap(tensor)
+
+
+def p2p(tensor, pairs, axis_name="dp"):
+    """Explicit point-to-point permutation: rank src sends its tensor to
+    rank dst for every (src, dst) in `pairs`; ranks not named as a dst
+    receive zeros (lax.ppermute semantics)."""
+    t = wrap(tensor)
+    if not _in_spmd(axis_name):
+        return t
+    return Tensor(lax.ppermute(unwrap(t), axis_name, list(pairs)))
+
+
+def ppermute(tensor, perm=None, shift=1, axis_name="dp"):
+    """Ring shift (lax.ppermute): rank i -> rank (i+shift) % world."""
+    t = wrap(tensor)
+    if not _in_spmd(axis_name):
+        return t
+    n = lax.psum(1, axis_name)
+    if perm is None:
+        perm = [(i, (i + shift) % n) for i in range(n)]
+    return Tensor(lax.ppermute(unwrap(t), axis_name, perm))
+
+
+def barrier(group=None, axis_name="dp"):
+    """reference collective.py:455 (barrier op / gloo barrier): XLA programs
+    are compiler-scheduled so an explicit barrier is only meaningful across
+    processes — use a tiny psum as the synchronization token."""
+    if _in_spmd(axis_name):
+        lax.psum(jnp.zeros((), jnp.int32), axis_name)
+    elif jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu.barrier")
+
+
+def get_rank_in_spmd(axis_name="dp"):
+    return lax.axis_index(axis_name)
+
+
+def get_world_size_in_spmd(axis_name="dp"):
+    return lax.psum(1, axis_name)

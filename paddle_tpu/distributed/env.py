@@ -1,0 +1,106 @@
+"""Distributed environment / bootstrap.
+
+Reference: RoleMaker env parsing + gloo rendezvous
+(python/paddle/distributed/fleet/base/role_maker.py), nccl-id TCP exchange
+(c_gen_nccl_id_op.cc, imperative/nccl_context.cc), ParallelEnv
+(python/paddle/fluid/dygraph/parallel.py).
+
+TPU-native: `jax.distributed.initialize` replaces the entire bootstrap — the
+coordinator address takes the role of both the gloo HTTP store and the
+ncclUniqueId exchange; afterwards every process sees the global device set
+and XLA handles cross-host collectives over ICI/DCN.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Per-process view of the distributed run (paddle.distributed.ParallelEnv)."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        try:
+            self._rank = jax.process_index()
+            self._world_size = jax.process_count()
+        except Exception:
+            pass
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return 0
+
+    @property
+    def device_type(self):
+        return jax.default_backend()
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+def init_parallel_env(strategy=None) -> ParallelEnv:
+    """paddle.distributed.init_parallel_env — multi-process bootstrap.
+
+    Single-process (the common TPU single-controller case): no-op.
+    Multi-process (PADDLE_TRAINERS_NUM > 1): jax.distributed.initialize with
+    the first endpoint as coordinator.
+    """
+    global _initialized
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if nranks > 1 and not _initialized:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        coordinator = os.environ.get("PADDLE_COORDINATOR", eps[0] if eps[0]
+                                     else None)
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=nranks, process_id=rank)
+        _initialized = True
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    try:
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def get_world_size() -> int:
+    try:
+        return jax.process_count()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+def is_initialized() -> bool:
+    return _initialized or get_world_size() == 1

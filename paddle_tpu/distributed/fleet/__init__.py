@@ -1,0 +1,119 @@
+"""Fleet — the distributed-training facade.
+
+Reference: python/paddle/distributed/fleet/base/fleet_base.py:62,129,583,978
+(fleet.init → RoleMaker env parse + rendezvous; distributed_optimizer wraps
+the inner optimizer; minimize ranks + applies meta-optimizers that rewrite
+the program).
+
+TPU-native: init resolves the mesh from DistributedStrategy + device count
+(replacing RoleMaker ring building), distributed_optimizer returns a wrapper
+whose `minimize`/`step` work eagerly for API parity, and the strategy's real
+effect is on `fleet.train_step(...)` / parallel.ShardedTrainStep — sharding
+specs instead of program rewriting.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ...parallel import (DistributedStrategy, create_mesh, set_mesh,
+                         get_mesh, ShardedTrainStep)
+from ..env import ParallelEnv, init_parallel_env, get_rank, get_world_size
+from .. import collective as _collective
+
+_fleet_initialized = False
+_strategy: Optional[DistributedStrategy] = None
+
+
+class UserDefinedRoleMaker:
+    """compat shim (reference role_maker.py) — env-var driven."""
+
+    def __init__(self, is_collective=True, **kw):
+        self._is_collective = is_collective
+
+
+PaddleCloudRoleMaker = UserDefinedRoleMaker
+
+
+def init(role_maker=None, is_collective=True, strategy=None):
+    """fleet.init (fleet_base.py:129)."""
+    global _fleet_initialized, _strategy
+    _strategy = strategy or DistributedStrategy()
+    init_parallel_env()
+    n = len(jax.devices())
+    axes = _strategy.mesh_axes(n)
+    set_mesh(create_mesh(axes))
+    _fleet_initialized = True
+
+
+def is_first_worker() -> bool:
+    return get_rank() == 0
+
+
+def worker_index() -> int:
+    return get_rank()
+
+
+def worker_num() -> int:
+    return get_world_size()
+
+
+def barrier_worker():
+    _collective.barrier()
+
+
+class DistributedOptimizer:
+    """fleet.distributed_optimizer result: wraps the user optimizer.
+
+    Eager use (API parity): behaves exactly like the inner optimizer.
+    The strategy is consumed when a compiled step is built via
+    fleet.distributed_train_step / parallel.ShardedTrainStep.
+    """
+
+    def __init__(self, optimizer, strategy: DistributedStrategy):
+        self._inner = optimizer
+        self.user_defined_strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program, parameters,
+                                    no_grad_set)
+
+    def step(self):
+        return self._inner.step()
+
+    def clear_grad(self):
+        return self._inner.clear_grad()
+
+
+def distributed_optimizer(optimizer, strategy=None) -> DistributedOptimizer:
+    """fleet_base.py:583."""
+    global _strategy
+    st = strategy or _strategy or DistributedStrategy()
+    _strategy = st
+    return DistributedOptimizer(optimizer, st)
+
+
+def distributed_model(model):
+    """fleet_base.py distributed_model: dygraph DDP wrap."""
+    from ..parallel_layer import DataParallel
+    return DataParallel(model)
+
+
+def distributed_train_step(model, loss_fn, optimizer,
+                           strategy=None) -> ShardedTrainStep:
+    """Build the compiled SPMD train step for the current fleet mesh —
+    the TPU-native 'minimize': where the reference rewrites programs, we
+    hand back one jitted step with sharded params/opt/batch."""
+    st = strategy or _strategy or DistributedStrategy()
+    inner = getattr(optimizer, "_inner", optimizer)
+    return ShardedTrainStep(model, loss_fn, inner, strategy=st,
+                            mesh=get_mesh(create_default=True))
+
+
+def get_strategy() -> Optional[DistributedStrategy]:
+    return _strategy

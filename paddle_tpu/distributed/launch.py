@@ -1,0 +1,107 @@
+"""Launcher CLI: ``python -m paddle_tpu.distributed.launch train.py``.
+
+Reference: python/paddle/distributed/fleet/launch.py:196,248,319 +
+launch_utils.py:56,257,429 — builds a Cluster/Pod model from --ips/--gpus,
+starts one subprocess per device with PADDLE_TRAINER_ID/... env vars,
+redirects logs, and watches children (tearing the pod down on any failure —
+the launcher IS the reference's failure-detection story for collective jobs).
+
+TPU-native: one process per *host* (not per chip; XLA owns all local chips),
+`jax.distributed.initialize` replaces the nccl-id exchange.  --nproc_per_node
+with JAX_PLATFORMS=cpu still works for CI-style multi-process testing.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--ips", type=str, default="127.0.0.1",
+                   help="comma-separated host ips (reference --ips)")
+    p.add_argument("--host_rank", type=int, default=0,
+                   help="this host's index into --ips")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 for TPU; >1 for CPU testing)")
+    p.add_argument("--coordinator_port", type=int, default=12355)
+    p.add_argument("--log_dir", type=str, default=None)
+    p.add_argument("training_script", type=str)
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def start_local_trainers(args) -> int:
+    """Fork local trainer processes with PADDLE_* env (launch_utils.py:429)."""
+    ips = args.ips.split(",")
+    nnodes = len(ips)
+    nproc = args.nproc_per_node
+    world = nnodes * nproc
+    coordinator = f"{ips[0]}:{args.coordinator_port}"
+    endpoints = ",".join(f"{ip}:{args.coordinator_port + i}"
+                         for ip in ips for i in range(nproc))
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    for local in range(nproc):
+        rank = args.host_rank * nproc + local
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": endpoints,
+            "PADDLE_CURRENT_ENDPOINT":
+                f"{ips[args.host_rank]}:{args.coordinator_port + local}",
+            "PADDLE_COORDINATOR": coordinator,
+        })
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        log = (open(os.path.join(args.log_dir, f"worker.{rank}.log"), "w")
+               if args.log_dir else None)
+        procs.append((rank, subprocess.Popen(cmd, env=env, stdout=log,
+                                             stderr=subprocess.STDOUT
+                                             if log else None), log))
+
+    # watch loop: any child failing tears down the pod
+    # (reference launch_utils.py watch_local_trainers)
+    code = 0
+    try:
+        while procs:
+            alive = []
+            for rank, proc, log in procs:
+                ret = proc.poll()
+                if ret is None:
+                    alive.append((rank, proc, log))
+                elif ret != 0:
+                    print(f"[launch] worker {rank} FAILED (exit {ret}); "
+                          "terminating pod", file=sys.stderr)
+                    code = ret
+                    for _, p2, _ in procs:
+                        if p2.poll() is None:
+                            p2.send_signal(signal.SIGTERM)
+                    procs = []
+                    alive = []
+                    break
+            procs = alive
+            if procs:
+                time.sleep(1)
+    except KeyboardInterrupt:
+        for _, p, _ in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        code = 130
+    return code
+
+
+def launch(argv=None) -> int:
+    args = _parse_args(argv)
+    return start_local_trainers(args)
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,51 @@
+"""DataParallel — dygraph DDP wrapper.
+
+Reference: python/paddle/fluid/dygraph/parallel.py:289 (DataParallel wraps a
+Layer; imperative::Reducer buckets grads and all-reduces them on comm
+streams, imperative/reducer.h:116).
+
+TPU-native: there are no per-rank processes to reduce across in the
+single-controller model — the batch axis of a jitted step is sharded over
+the "dp" mesh axis and XLA emits the gradient reduction (see
+parallel.ShardedTrainStep).  This wrapper keeps API parity for eager code:
+it forwards to the inner layer, and `scale_loss`/`apply_collective_grads`
+are the identity (world of one per controller).  Multi-process eager DDP
+(jax.distributed + pmap-style) is intentionally not the perf path.
+"""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    # delegate everything stateful to the wrapped layer
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

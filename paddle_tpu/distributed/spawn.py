@@ -1,0 +1,50 @@
+"""paddle.distributed.spawn equivalent (reference: python/paddle/distributed/
+spawn.py:238 — forks nprocs workers, sets trainer env, joins with error
+propagation)."""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+
+
+def _worker(fn, rank, nprocs, port, args, errq):
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_COORDINATOR"] = f"127.0.0.1:{port}"
+    os.environ["PADDLE_TRAINER_ENDPOINTS"] = ",".join(
+        f"127.0.0.1:{port + i}" for i in range(nprocs))
+    os.environ["PADDLE_CURRENT_ENDPOINT"] = f"127.0.0.1:{port + rank}"
+    try:
+        fn(*args)
+    except Exception:
+        errq.put((rank, traceback.format_exc()))
+        raise
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, port=23456,
+          **options):
+    ctx = mp.get_context("spawn")
+    errq = ctx.Queue()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, nprocs, port, args, errq),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class Context:
+        processes = procs
+
+        def join(self, timeout=None):
+            for p in procs:
+                p.join(timeout)
+            if not errq.empty():
+                rank, tb = errq.get()
+                raise RuntimeError(f"worker {rank} failed:\n{tb}")
+
+    c = Context()
+    if join:
+        c.join()
+    return c

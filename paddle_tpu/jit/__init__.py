@@ -177,6 +177,30 @@ def not_to_static(fn):
 # TrainStep: fused forward+backward+optimizer, fully jitted with donation
 # ---------------------------------------------------------------------------
 
+def forward_loss(model, loss_fn, state, batch, rng_key=None, amp_level=None,
+                 amp_dtype="bfloat16"):
+    """Shared traced forward+loss used by TrainStep / ShardedTrainStep:
+    functional_call with a per-step rng root (fresh dropout masks each step)
+    and optional bf16 autocast."""
+    import contextlib
+    from .. import amp as amp_mod
+    from ..core import rng as _rng
+
+    def run():
+        out = functional_call(model, state, *batch[:-1], training=True)
+        label = Tensor(batch[-1])
+        outs = out if isinstance(out, tuple) else (out,)
+        loss = loss_fn(*[Tensor(o) for o in outs], label)
+        return unwrap(loss)
+
+    keyctx = (_rng.key_ctx(rng_key) if rng_key is not None
+              else contextlib.nullcontext())
+    with keyctx:
+        if amp_level:
+            with amp_mod.auto_cast(level=amp_level, dtype=amp_dtype):
+                return run()
+        return run()
+
 class TrainStep:
     """One compiled training step (the perf path used by hapi/bench).
 
@@ -200,50 +224,28 @@ class TrainStep:
         self._opt_state = None
         self._remat = remat
 
-    def _forward_loss(self, state, batch):
-        from .. import amp as amp_mod
-        def run(state, batch):
-            out = functional_call(self.model, state, *batch[:-1], training=True)
-            label = Tensor(batch[-1])
-            outs = out if isinstance(out, tuple) else (out,)
-            loss = self.loss_fn(*[Tensor(o) for o in outs], label)
-            return unwrap(loss)
-        if self.amp_level:
-            with amp_mod.auto_cast(level=self.amp_level, dtype=self.amp_dtype):
-                return run(state, batch)
-        return run(state, batch)
+    def _forward_loss(self, state, batch, rng_key=None):
+        return forward_loss(self.model, self.loss_fn, state, batch, rng_key,
+                            self.amp_level, self.amp_dtype)
 
     def _build(self, example_state, example_opt, example_batch):
+        from ..optimizer.functional import apply_updates, decay_flags
         opt = self.optimizer
         trainable = self._trainable
-        wd = getattr(opt, "_wd", 0.0)
-        dwd = getattr(opt, "_decoupled_wd", 0.0)
         # structured param names let AdamW's apply_decay_param_fun work here
-        decay = {k: (opt._decay_applies(k) if hasattr(opt, "_decay_applies")
-                     else True) for k in trainable}
+        decay = decay_flags(opt, trainable)
 
-        def step(params, opt_state, step_no, lr, batch):
+        def step(params, opt_state, step_no, lr, rng_key, batch):
             def loss_of(train_params):
                 full = dict(params)
                 full.update(train_params)
-                return self._forward_loss(full, batch)
+                return self._forward_loss(full, batch, rng_key)
 
             train_params = {k: v for k, v in params.items() if k in trainable}
             loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
             loss, grads = jax.value_and_grad(loss_fn)(train_params)
-            new_params = dict(params)
-            new_opt = dict(opt_state)
-            for k, g in grads.items():
-                p = params[k]
-                is_float = jnp.issubdtype(p.dtype, jnp.floating)
-                if wd and decay[k] and is_float:
-                    g = g + wd * p
-                np_, ns = opt.update_one(p, g, opt_state[k], lr, step_no)
-                if dwd and decay[k] and is_float:
-                    np_ = (np_.astype(jnp.float32)
-                           - lr * dwd * p.astype(jnp.float32)).astype(p.dtype)
-                new_params[k] = np_
-                new_opt[k] = ns
+            new_params, new_opt = apply_updates(
+                opt, params, grads, opt_state, lr, step_no, decay)
             return new_params, new_opt, loss
 
         return jax.jit(step, donate_argnums=(0, 1))
@@ -261,9 +263,11 @@ class TrainStep:
         self.optimizer._step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step_no = jnp.asarray(self.optimizer._step_count, jnp.int32)
+        from ..core import rng as _rng
+        rng_key = _rng.next_key()  # fresh per step: dropout masks differ
         raw_batch = tuple(unwrap(b) for b in batch)
         new_state, self._opt_state, loss = self._compiled(
-            state, self._opt_state, step_no, lr, raw_batch)
+            state, self._opt_state, step_no, lr, rng_key, raw_batch)
         sd = self.model.state_dict()
         for k, v in new_state.items():
             sd[k]._set_data(v)

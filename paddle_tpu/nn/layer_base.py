@@ -28,6 +28,17 @@ class HookRemoveHelper:
         self._hooks.pop(self._key, None)
 
 
+_param_name_counter = [0]
+
+
+def _unique_param_name(layer, attr_name: str) -> str:
+    """Auto name like "linear_3.bias" (reference: unique_name.generate +
+    ParamAttr naming).  Carries the layer-type and bias/weight markers that
+    AdamW's apply_decay_param_fun recipes filter on ("bias"/"norm")."""
+    _param_name_counter[0] += 1
+    return f"{type(layer).__name__.lower()}_{_param_name_counter[0]}.{attr_name}"
+
+
 class Layer:
     """Base class for all network layers (paddle.nn.Layer)."""
 
@@ -72,6 +83,8 @@ class Layer:
         return p
 
     def add_parameter(self, name, parameter):
+        if parameter is not None and parameter.name is None:
+            parameter.name = _unique_param_name(self, name)
         self._parameters[name] = parameter
         return parameter
 
@@ -101,6 +114,8 @@ class Layer:
         if isinstance(value, Parameter):
             if params is None:
                 raise RuntimeError("call Layer.__init__ before assigning params")
+            if value.name is None:
+                value.name = _unique_param_name(self, name)
             params[name] = value
             buffers.pop(name, None) if buffers else None
             object.__setattr__(self, name, value)

@@ -53,8 +53,10 @@ def _flash_bhsd(q, k, v, causal):
 
     bh, sq, d = q.shape
     sk = k.shape[1]
-    blk_q = min(512, sq)
-    blk_k = min(512, sk)
+    # block sizes must DIVIDE the seq lens (callers guarantee multiples of
+    # 128) or whole key blocks would be dropped / query rows left unwritten
+    blk_q = next(b for b in (512, 256, 128) if sq % b == 0)
+    blk_k = next(b for b in (512, 256, 128) if sk % b == 0)
     n_k = sk // blk_k
     scale = 1.0 / math.sqrt(d)
     # causal offset for sq != sk (kv-cache decode): query i sees keys

@@ -1,0 +1,88 @@
+"""Ring attention — sequence/context parallelism over the "sp" mesh axis.
+
+The reference has NO sequence parallelism (SURVEY.md §2.3: its long-sequence
+story is LoD batching + cudnn RNNs); this is the TPU-native long-context
+upgrade the spec calls first-class: q/k/v are sharded along the sequence axis,
+each device computes blockwise attention against the k/v block it currently
+holds while the blocks rotate around the ring (`lax.ppermute` over ICI),
+with online-softmax accumulation so the full (S, S) score matrix never
+exists.  Compute overlaps the ppermute transfer (XLA schedules the ring
+collective concurrently with the einsum).
+
+Differentiable end-to-end: jax transposes ppermute/scan, so jax.grad gives
+the backward ring for free.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _ring_attention_local(q, k, v, n_sp: int, s_local: int, causal: bool,
+                          axis_name: str):
+    """Per-device body: q/k/v are (b, s_local, h, d) local shards."""
+    me = lax.axis_index(axis_name)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale   # (b,h,sq,d)
+    b, h, sq, d = qt.shape
+
+    acc0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    perm = [(i, (i + 1) % n_sp) for i in range(n_sp)]
+
+    def step(carry, j):
+        k_blk, v_blk, acc, m, l = carry
+        src = (me - j) % n_sp       # which global block k_blk holds
+        kt = jnp.swapaxes(k_blk, 1, 2).astype(jnp.float32)
+        vt = jnp.swapaxes(v_blk, 1, 2).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+        if causal:
+            q_pos = me * s_local + lax.broadcasted_iota(
+                jnp.int32, (sq, s_local), 0)
+            k_pos = src * s_local + lax.broadcasted_iota(
+                jnp.int32, (sq, s_local), 1)
+            s = jnp.where((q_pos >= k_pos)[None, None], s, -1e30)
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + p.sum(-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vt)
+        # rotate k/v blocks around the ring
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, acc, m_new, l), None
+
+    (_, _, acc, m, l), _ = lax.scan(
+        step, (k, v, acc0, m0, l0), jnp.arange(n_sp))
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # (b, s_local, h, d)
+
+
+def ring_attention(q, k, v, mesh: Mesh, causal: bool = False,
+                   axis_name: str = "sp", batch_axis: Optional[str] = "dp"):
+    """Full-array API: q/k/v (batch, seq, heads, head_dim); seq must divide
+    the sp axis size.  Used under jit; shards seq over `axis_name` and batch
+    over `batch_axis`, returns the attention output with the same layout.
+    """
+    n_sp = mesh.shape[axis_name]
+    s = q.shape[1]
+    if s % n_sp:
+        raise ValueError(f"seq len {s} not divisible by sp={n_sp}")
+    s_local = s // n_sp
+    bspec = batch_axis if (batch_axis and mesh.shape.get(batch_axis, 1) > 1
+                           ) else None
+    spec = P(bspec, axis_name, None, None)
+
+    fn = jax.shard_map(
+        partial(_ring_attention_local, n_sp=n_sp, s_local=s_local,
+                causal=causal, axis_name=axis_name),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

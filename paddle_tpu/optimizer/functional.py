@@ -1,0 +1,44 @@
+"""Shared functional optimizer-update core.
+
+Single source of truth for the per-parameter update loop (weight-decay
+gating via apply_decay_param_fun, coupled L2, decoupled AdamW decay) used by
+every compiled step: Optimizer.step (eager), jit.TrainStep,
+parallel.ShardedTrainStep and parallel.pipeline.PipelinedTrainStep.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+
+
+def decay_flags(optimizer, names) -> Dict[str, bool]:
+    """Resolve apply_decay_param_fun per param name (True = decay applies)."""
+    return {n: optimizer._decay_applies(n) for n in names}
+
+
+def apply_updates(optimizer, params: dict, grads: dict, opt_state: dict,
+                  lr, step_no, decay: Dict[str, bool],
+                  lr_mults: Dict[str, float] = None):
+    """Pure: returns (new_params, new_opt_state) for the keys in `grads`.
+
+    Params without grads pass through unchanged.
+    """
+    wd = getattr(optimizer, "_wd", 0.0)
+    dwd = getattr(optimizer, "_decoupled_wd", 0.0)
+    new_params = dict(params)
+    new_opt = dict(opt_state)
+    for k, g in grads.items():
+        p = params[k]
+        is_float = jnp.issubdtype(p.dtype, jnp.floating)
+        db = decay.get(k, True)
+        m = (lr_mults or {}).get(k, 1.0)
+        if wd and db and is_float:
+            g = g + wd * p
+        np_, ns = optimizer.update_one(p, g, opt_state[k], lr * m, step_no)
+        if dwd and db and is_float:
+            np_ = (np_.astype(jnp.float32)
+                   - lr * m * dwd * p.astype(jnp.float32)).astype(p.dtype)
+        new_params[k] = np_
+        new_opt[k] = ns
+    return new_params, new_opt

@@ -1,1 +1,14 @@
-"""parallel primitives namespace — see paddle_tpu.distributed."""
+"""paddle_tpu.parallel — mesh/sharding-based parallelism.
+
+TPU-native replacement for the reference's meta-optimizer program rewriting
+(SURVEY.md §2.2-2.3): parallelism = mesh axes + PartitionSpecs + one jitted
+SPMD train step; XLA inserts all collectives.
+"""
+from .mesh import (create_mesh, set_mesh, get_mesh, axis_size,  # noqa: F401
+                   sharding, replicated, AXES)
+from .strategy import (DistributedStrategy, HybridConfig,  # noqa: F401
+                       ShardingConfig, RecomputeConfig, AMPConfig,
+                       GradientMergeConfig)
+from .sharding import (tp_spec, param_specs, shardings_of,  # noqa: F401
+                       apply_fsdp)
+from .train_step import ShardedTrainStep  # noqa: F401

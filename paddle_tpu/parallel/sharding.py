@@ -1,0 +1,112 @@
+"""Parameter-sharding rules: Megatron-style tensor parallelism + ZeRO/FSDP
+as PartitionSpecs.
+
+Reference equivalents:
+- TP: absent in the reference (SURVEY.md §2.3 — nothing splits a matmul);
+  on TPU it is free via operand sharding, so it's included.
+- ZeRO sharding: Fleet ShardingOptimizer's program rewrite
+  (meta_optimizers/sharding_optimizer.py:96-118 — param→rank assignment +
+  inserted c_broadcast/c_allreduce).  Here the same memory win is a
+  PartitionSpec on params/optimizer states; XLA GSPMD inserts the
+  all-gathers/reduce-scatters the rewrite used to insert by hand.
+
+Linear weights are (in_features, out_features) [paddle layout], so:
+- column-parallel (split output): P(None, "tp")  — qkv / ffn_in
+- row-parallel  (split input):    P("tp", None)  — out proj / ffn_out
+- embeddings (vocab, hidden):     P("tp", None)  — vocab-sharded
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, Optional
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# name-pattern → spec builders; first match wins.  Patterns cover the
+# in-tree model zoo (models/bert.py, models/gpt.py) and the generic
+# nn.MultiHeadAttention/TransformerEncoder naming.
+_COL_W = re.compile(
+    r"(qkv|ffn_in|linear1|q_proj|k_proj|v_proj)\.weight$")
+_COL_B = re.compile(
+    r"(qkv|ffn_in|linear1|q_proj|k_proj|v_proj)\.bias$")
+_ROW_W = re.compile(
+    r"(\bout\b|proj|ffn_out|linear2|out_proj)\.weight$")
+_EMB_W = re.compile(r"(word|position|token_type|task_type)_embeddings\.weight$")
+
+
+def tp_spec(name: str, shape) -> Optional[P]:
+    """Tensor-parallel PartitionSpec for a parameter, or None (replicate)."""
+    if _COL_W.search(name) and len(shape) == 2:
+        return P(None, "tp")
+    if _COL_B.search(name) and len(shape) == 1:
+        return P("tp")
+    if _ROW_W.search(name) and len(shape) == 2:
+        return P("tp", None)
+    if _EMB_W.search(name) and len(shape) == 2:
+        return P("tp", None)
+    return None
+
+
+def _divisible(dim: int, mesh: Mesh, axis: str) -> bool:
+    n = mesh.shape.get(axis, 1)
+    return n > 1 and dim % n == 0
+
+
+def apply_fsdp(spec: Optional[P], shape, mesh: Mesh, axis: str = "dp"
+               ) -> Optional[P]:
+    """Additionally shard the largest un-sharded dim over `axis` (ZeRO-3).
+
+    P(None, 'tp') on (H, 3H) -> P('dp', 'tp'); P() on (V, H) -> P('dp', None).
+    Dims that don't divide evenly stay replicated (XLA requires even tiles
+    only per-shard padding; keep it simple and skip).
+    """
+    entries = list(spec) if spec is not None else [None] * len(shape)
+    while len(entries) < len(shape):
+        entries.append(None)
+    # choose the largest free dim that divides
+    best, best_dim = -1, -1
+    for i, (e, d) in enumerate(zip(entries, shape)):
+        if e is None and _divisible(d, mesh, axis) and d > best_dim:
+            best, best_dim = i, d
+    if best < 0:
+        return spec
+    entries[best] = axis
+    return P(*entries)
+
+
+def param_specs(names_shapes: Dict[str, tuple], mesh: Mesh,
+                tensor_parallel: bool = False, fsdp: bool = False,
+                custom_rule: Optional[Callable] = None) -> Dict[str, P]:
+    """Resolve a PartitionSpec per parameter name."""
+    specs = {}
+    for name, shape in names_shapes.items():
+        spec = None
+        if custom_rule is not None:
+            spec = custom_rule(name, shape)
+        if spec is None and tensor_parallel and mesh.shape.get("tp", 1) > 1:
+            spec = tp_spec(name, shape)
+            # tp spec only valid if the sharded dim divides
+            if spec is not None:
+                ok = all(e is None or _divisible(d, mesh, e)
+                         for e, d in zip(tuple(spec) + (None,) * len(shape),
+                                         shape))
+                if not ok:
+                    spec = None
+        if fsdp:
+            spec = apply_fsdp(spec, shape, mesh)
+        specs[name] = spec if spec is not None else P()
+    return specs
+
+
+def shardings_of(specs: Dict[str, P], mesh: Mesh
+                 ) -> Dict[str, NamedSharding]:
+    return {k: NamedSharding(mesh, v) for k, v in specs.items()}
+
+
+def state_sharding_like(param_shape, param_sharding: NamedSharding, leaf
+                        ) -> NamedSharding:
+    """Optimizer-state leaves inherit their parameter's sharding when shapes
+    match (adam moments) and are replicated otherwise (beta-pow scalars)."""
+    if hasattr(leaf, "shape") and tuple(leaf.shape) == tuple(param_shape):
+        return param_sharding
+    return NamedSharding(param_sharding.mesh, P())

@@ -1,0 +1,188 @@
+"""ShardedTrainStep — one compiled SPMD training step over a device mesh.
+
+This single class replaces the reference's entire program-rewriting
+parallelism stack (SURVEY.md §2.2 meta-optimizers):
+- GraphExecutionOptimizer's inserted c_allreduce_sum per grad  → batch
+  sharded P("dp"): XLA emits the gradient psum itself.
+- ShardingOptimizer's param→rank broadcast/allreduce rewrite
+  (sharding_optimizer.py:96-118)                               → FSDP
+  PartitionSpecs on params/opt states; GSPMD inserts all-gather /
+  reduce-scatter.
+- Megatron-style TP (absent in reference, free on TPU)         → column/row
+  PartitionSpecs from parallel.sharding.
+- RecomputeOptimizer (backward.py:689)                         → jax.checkpoint.
+- GradientMergeOptimizer (optimizer.py:4969)                   → lax.scan over
+  microbatches accumulating grads.
+- AMP meta-optimizer                                           → bf16 autocast
+  inside the jitted step.
+All of it is one jax.jit with in/out shardings + donation.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor, unwrap
+from ..jit import functional_call, state_arrays
+from ..nn.layer_base import Layer
+from . import sharding as shd
+from .mesh import get_mesh
+from .strategy import DistributedStrategy
+
+
+class ShardedTrainStep:
+    """step(*batch) -> loss; params/opt states live sharded on the mesh."""
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 strategy: Optional[DistributedStrategy] = None,
+                 mesh: Optional[Mesh] = None,
+                 batch_spec=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.strategy = strategy or DistributedStrategy()
+        self.mesh = mesh or get_mesh(create_default=True)
+        st = self.strategy
+        self._remat = st.recompute
+        self._amp = st.amp
+        self._amp_dtype = st.amp_configs.dtype
+        self._k_steps = (st.gradient_merge_configs.k_steps
+                         if st.gradient_merge else 1)
+        sd = model.state_dict()
+        self._trainable = {k for k, v in sd.items()
+                           if getattr(v, "trainable", False)}
+        fsdp = st.sharding and st.sharding_configs.stage >= 3
+        self._zero12 = st.sharding and st.sharding_configs.stage in (1, 2)
+        self.param_specs = shd.param_specs(
+            {k: tuple(v.shape) for k, v in sd.items()}, self.mesh,
+            tensor_parallel=st.tensor_parallel, fsdp=fsdp,
+            custom_rule=st.sharding_rule)
+        self.param_shardings = shd.shardings_of(self.param_specs, self.mesh)
+        # batch elements shard over dp on axis 0 (+ sp on seq axis 1 when
+        # sequence parallel)
+        if batch_spec is None:
+            batch_spec = (P("dp", "sp") if st.sequence_parallel else P("dp"))
+        self._batch_sharding = NamedSharding(self.mesh, batch_spec)
+        self._compiled = None
+        self._opt_state = None
+        self._placed = False
+
+    # -- placement -----------------------------------------------------------
+    def place_params(self):
+        """Move model params onto the mesh with their shardings (the analogue
+        of ParallelExecutor::BCastParamsToDevices, parallel_executor.cc:637)."""
+        sd = self.model.state_dict()
+        for k, t in sd.items():
+            t._set_data(jax.device_put(t._data, self.param_shardings[k]))
+        self._placed = True
+
+    def _opt_shardings(self, opt_state):
+        sd = self.model.state_dict()
+        out = {}
+        for k, st in opt_state.items():
+            pshard = self.param_shardings[k]
+            pshape = tuple(sd[k].shape)
+            if self._zero12:
+                # ZeRO-1/2: moments sharded over dp even though params
+                # aren't (the ShardingOptimizer memory win)
+                mesh = self.mesh
+                spec = shd.apply_fsdp(self.param_specs[k], pshape, mesh)
+                pshard = NamedSharding(mesh, spec if spec is not None else P())
+            out[k] = {n: shd.state_sharding_like(pshape, pshard, leaf)
+                      for n, leaf in st.items()}
+        return out
+
+    # -- compiled step -------------------------------------------------------
+    def _forward_loss(self, state, batch, rng_key=None):
+        from ..jit import forward_loss
+        return forward_loss(self.model, self.loss_fn, state, batch, rng_key,
+                            "O1" if self._amp else None, self._amp_dtype)
+
+    def _build(self, opt_shardings):
+        from ..optimizer.functional import apply_updates, decay_flags
+        opt = self.optimizer
+        trainable = self._trainable
+        decay = decay_flags(opt, trainable)
+        k_steps = self._k_steps
+        avg = (self.strategy.gradient_merge_configs.avg
+               if self.strategy.gradient_merge else True)
+
+        def grads_of(params, batch, rng_key):
+            def loss_of(tp):
+                full = dict(params)
+                full.update(tp)
+                return self._forward_loss(full, batch, rng_key)
+            train_params = {k: v for k, v in params.items() if k in trainable}
+            fn = jax.checkpoint(loss_of) if self._remat else loss_of
+            return jax.value_and_grad(fn)(train_params)
+
+        def step(params, opt_state, step_no, lr, rng_key, batch):
+            if k_steps > 1:
+                # gradient merge: split batch into k microbatches, scan
+                def micro(carry, mb_and_i):
+                    mb, i = mb_and_i
+                    acc, _ = carry
+                    loss, g = grads_of(params, mb,
+                                       jax.random.fold_in(rng_key, i))
+                    acc = jax.tree_util.tree_map(jnp.add, acc, g)
+                    return (acc, loss), None
+                split = tuple(
+                    b.reshape((k_steps, b.shape[0] // k_steps) + b.shape[1:])
+                    for b in batch)
+                zero = {k: jnp.zeros(params[k].shape, jnp.float32)
+                        for k in trainable}
+                (grads, loss), _ = jax.lax.scan(
+                    micro, (zero, jnp.zeros((), jnp.float32)),
+                    (split, jnp.arange(k_steps)))
+                if avg:
+                    grads = jax.tree_util.tree_map(
+                        lambda g: g / k_steps, grads)
+            else:
+                loss, grads = grads_of(params, batch, rng_key)
+            new_params, new_opt = apply_updates(
+                opt, params, grads, opt_state, lr, step_no, decay)
+            return new_params, new_opt, loss
+
+        n_batch = self._n_batch
+        in_shardings = (self.param_shardings, opt_shardings, None, None, None,
+                        (self._batch_sharding,) * n_batch)
+        out_shardings = (self.param_shardings, opt_shardings, None)
+        return jax.jit(step, in_shardings=in_shardings,
+                       out_shardings=out_shardings, donate_argnums=(0, 1))
+
+    def init_opt_state(self, state):
+        return {k: self.optimizer.init_state(v) for k, v in state.items()
+                if k in self._trainable}
+
+    def __call__(self, *batch):
+        if not self._placed:
+            self.place_params()
+        state = state_arrays(self.model)
+        if self._opt_state is None:
+            raw = self.init_opt_state(state)
+            shardings = self._opt_shardings(raw)
+            self._opt_state = jax.device_put(raw, shardings)
+            self._opt_state_shardings = shardings
+        if self._compiled is None:
+            self._n_batch = len(batch)
+            self._compiled = self._build(self._opt_state_shardings)
+        self.optimizer._step_count += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        step_no = jnp.asarray(self.optimizer._step_count, jnp.int32)
+        from ..core import rng as _rng
+        rng_key = _rng.next_key()
+        raw_batch = tuple(jax.device_put(unwrap(b), self._batch_sharding)
+                          for b in batch)
+        new_state, self._opt_state, loss = self._compiled(
+            state, self._opt_state, step_no, lr, rng_key, raw_batch)
+        sd = self.model.state_dict()
+        for k, v in new_state.items():
+            sd[k]._set_data(v)
+        return Tensor(loss)
+
+    # -- introspection -------------------------------------------------------
+    def describe_shardings(self) -> Dict[str, str]:
+        return {k: str(v) for k, v in self.param_specs.items()}
