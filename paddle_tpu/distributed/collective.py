@@ -53,7 +53,17 @@ def _reduce(x, op, axis_name):
     if op in (ReduceOp.AVG, "avg"):
         return lax.pmean(x, axis_name)
     if op in (ReduceOp.PROD, "prod"):
-        return jnp.exp(lax.psum(jnp.log(x), axis_name))
+        # exp(psum(log|x|)) with sign parity + zero handling (a bare
+        # log(x) would NaN on negatives and -inf on zeros)
+        absx = jnp.abs(x)
+        zero = absx == 0
+        logsum = lax.psum(jnp.where(zero, 0.0, jnp.log(jnp.where(
+            zero, 1.0, absx))), axis_name)
+        n_neg = lax.psum((x < 0).astype(jnp.int32), axis_name)
+        any_zero = lax.pmax(zero.astype(jnp.int32), axis_name)
+        sign = 1.0 - 2.0 * (n_neg % 2).astype(x.dtype)
+        return jnp.where(any_zero > 0, jnp.zeros_like(x),
+                         sign * jnp.exp(logsum).astype(x.dtype))
     raise ValueError(f"unknown reduce op {op!r}")
 
 
@@ -88,11 +98,13 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis_name="dp"):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True, axis_name="dp"):
-    """reference collective.py:38 (c_broadcast)."""
+    """reference collective.py:38 (c_broadcast).  Masked psum — one tensor's
+    worth of traffic, not an all_gather of the whole axis."""
     t = wrap(tensor)
     if _in_spmd(axis_name):
-        gathered = lax.all_gather(unwrap(t), axis_name)
-        out = gathered[src]
+        x = unwrap(t)
+        mine = lax.axis_index(axis_name) == src
+        out = lax.psum(jnp.where(mine, x, jnp.zeros_like(x)), axis_name)
         result = Tensor(out, stop_gradient=t.stop_gradient)
     else:
         result = t
@@ -112,9 +124,12 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True,
             axis_name="dp"):
     """reference collective.py:311 — rank i gets tensor_list[i]."""
     if _in_spmd(axis_name):
+        # select this rank's slice without materializing the full stack in
+        # the compiled program more than once (XLA DCEs the unused rows)
         idx = lax.axis_index(axis_name)
         stacked = jnp.stack([unwrap(wrap(t)) for t in tensor_list])
-        out = Tensor(stacked[idx])
+        out = Tensor(lax.dynamic_index_in_dim(stacked, idx, 0,
+                                              keepdims=False))
     else:
         out = wrap(tensor_list[0] if tensor_list else tensor)
     if isinstance(tensor, Tensor):

@@ -38,11 +38,34 @@ def spawn(func, args=(), nprocs=1, join=True, daemon=False, port=23456,
         processes = procs
 
         def join(self, timeout=None):
-            for p in procs:
-                p.join(timeout)
+            """Join all workers; if any dies non-zero, terminate the rest
+            (they may be blocked in a collective waiting on the dead rank —
+            reference spawn.py tears the pod down the same way)."""
+            import time
+            deadline = None if timeout is None else time.time() + timeout
+            while True:
+                alive = [p for p in procs if p.is_alive()]
+                failed = [p for p in procs
+                          if not p.is_alive() and p.exitcode not in (0, None)]
+                if failed:
+                    for p in alive:
+                        p.terminate()
+                    for p in alive:
+                        p.join(5)
+                    break
+                if not alive:
+                    break
+                if deadline is not None and time.time() > deadline:
+                    break
+                time.sleep(0.2)
             if not errq.empty():
                 rank, tb = errq.get()
                 raise RuntimeError(f"worker {rank} failed:\n{tb}")
+            bad = [p.exitcode for p in procs
+                   if p.exitcode not in (0, None)]
+            if bad:
+                raise RuntimeError(
+                    f"worker process(es) exited with codes {bad}")
 
     c = Context()
     if join:

@@ -85,6 +85,19 @@ class Model:
 
     def predict_batch(self, inputs):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        # datasets often yield (input..., label); drop trailing extras the
+        # network can't accept (reference uses the _inputs spec for this)
+        import inspect
+        try:
+            sig = inspect.signature(self.network.forward)
+            n_pos = sum(1 for p in sig.parameters.values()
+                        if p.kind in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD))
+            if not any(p.kind == p.VAR_POSITIONAL
+                       for p in sig.parameters.values()):
+                inputs = list(inputs)[:n_pos]
+        except (TypeError, ValueError):
+            pass
         self.network.eval()
         with no_grad():
             out = self.network(*[_as_tensor(x) for x in inputs])

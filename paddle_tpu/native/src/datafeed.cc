@@ -1,0 +1,236 @@
+// Native data-feed engine.
+//
+// TPU-native equivalent of the reference's C++ input pipeline:
+//   - paddle/fluid/framework/data_feed.cc (MultiSlotDataFeed: worker threads
+//     parse text slot files into feed tensors)
+//   - paddle/fluid/operators/reader/buffered_reader.cc (double-buffered
+//     prefetch queue decoupling host parsing from device consumption)
+//
+// Design: N reader threads stream assigned files, parse records into fixed
+// -shape batch buffers, and push them into a bounded ring queue.  The Python
+// side (paddle_tpu.native.TextSlotDataFeed) pops ready batches zero-copy
+// into numpy via ctypes.  Formats:
+//   text:   one sample per line: "<label>\t<f0>,<f1>,...,<fD-1>"
+//   binary: fixed records: int64 label + D float32 features, little-endian
+//
+// Build: g++ -O3 -shared -fPIC -pthread (see paddle_tpu/native/__init__.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <fstream>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Batch {
+  std::vector<float> feats;     // batch_size * dim
+  std::vector<int64_t> labels;  // batch_size
+  int rows = 0;
+};
+
+class BoundedQueue {
+ public:
+  explicit BoundedQueue(size_t cap) : cap_(cap) {}
+
+  void Push(std::unique_ptr<Batch> b) {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_full_.wait(lk, [&] { return q_.size() < cap_ || closed_; });
+    if (closed_) return;
+    q_.push_back(std::move(b));
+    not_empty_.notify_one();
+  }
+
+  // Returns nullptr when the queue is closed and drained.
+  std::unique_ptr<Batch> Pop() {
+    std::unique_lock<std::mutex> lk(mu_);
+    not_empty_.wait(lk, [&] { return !q_.empty() || (closed_ && done_); });
+    if (q_.empty()) return nullptr;
+    auto b = std::move(q_.front());
+    q_.pop_front();
+    not_full_.notify_one();
+    return b;
+  }
+
+  void CloseWhenDone() {  // producers finished
+    std::lock_guard<std::mutex> lk(mu_);
+    done_ = true;
+    closed_ = true;
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+  void Abort() {  // consumer going away: unblock producers
+    std::lock_guard<std::mutex> lk(mu_);
+    closed_ = true;
+    done_ = true;
+    q_.clear();
+    not_empty_.notify_all();
+    not_full_.notify_all();
+  }
+
+ private:
+  size_t cap_;
+  std::mutex mu_;
+  std::condition_variable not_empty_, not_full_;
+  std::deque<std::unique_ptr<Batch>> q_;
+  bool closed_ = false;
+  bool done_ = false;
+};
+
+class DataFeed {
+ public:
+  DataFeed(std::vector<std::string> files, int batch_size, int dim,
+           int n_threads, int queue_cap, bool binary, bool drop_last)
+      : files_(std::move(files)),
+        batch_size_(batch_size),
+        dim_(dim),
+        binary_(binary),
+        drop_last_(drop_last),
+        queue_(queue_cap > 0 ? queue_cap : 8) {
+    next_file_.store(0);
+    active_.store(n_threads > 0 ? n_threads : 1);
+    int nt = n_threads > 0 ? n_threads : 1;
+    for (int i = 0; i < nt; ++i) {
+      threads_.emplace_back([this] { Worker(); });
+    }
+  }
+
+  ~DataFeed() {
+    queue_.Abort();
+    for (auto& t : threads_) {
+      if (t.joinable()) t.join();
+    }
+  }
+
+  // Returns rows copied (0 = exhausted).
+  int Next(float* out_feats, int64_t* out_labels) {
+    auto b = queue_.Pop();
+    if (!b) return 0;
+    std::memcpy(out_feats, b->feats.data(),
+                sizeof(float) * size_t(b->rows) * dim_);
+    std::memcpy(out_labels, b->labels.data(), sizeof(int64_t) * b->rows);
+    return b->rows;
+  }
+
+ private:
+  void EmitRow(Batch* cur, const float* feats, int64_t label) {
+    std::memcpy(cur->feats.data() + size_t(cur->rows) * dim_, feats,
+                sizeof(float) * dim_);
+    cur->labels[cur->rows] = label;
+    ++cur->rows;
+  }
+
+  std::unique_ptr<Batch> NewBatch() const {
+    auto b = std::make_unique<Batch>();
+    b->feats.resize(size_t(batch_size_) * dim_);
+    b->labels.resize(batch_size_);
+    return b;
+  }
+
+  void Worker() {
+    std::vector<float> row(dim_);
+    auto cur = NewBatch();
+    for (;;) {
+      size_t fi = next_file_.fetch_add(1);
+      if (fi >= files_.size()) break;
+      if (binary_) {
+        ReadBinary(files_[fi], &cur);
+      } else {
+        ReadText(files_[fi], &cur, &row);
+      }
+    }
+    if (cur->rows > 0 && !drop_last_) queue_.Push(std::move(cur));
+    if (active_.fetch_sub(1) == 1) queue_.CloseWhenDone();
+  }
+
+  void ReadText(const std::string& path, std::unique_ptr<Batch>* cur,
+                std::vector<float>* row) {
+    std::ifstream in(path);
+    if (!in) {
+      std::fprintf(stderr, "[pdtpu datafeed] cannot open %s\n", path.c_str());
+      return;
+    }
+    std::string line;
+    while (std::getline(in, line)) {
+      if (line.empty()) continue;
+      const char* p = line.c_str();
+      char* end = nullptr;
+      int64_t label = std::strtoll(p, &end, 10);
+      if (end == p) continue;  // malformed label: skip line
+      p = (*end == '\t' || *end == ' ') ? end + 1 : end;
+      int d = 0;
+      while (d < dim_ && *p) {
+        (*row)[d++] = std::strtof(p, &end);
+        if (end == p) break;
+        p = (*end == ',') ? end + 1 : end;
+      }
+      if (d != dim_) continue;  // malformed feature count: skip line
+      EmitRow(cur->get(), row->data(), label);
+      if ((*cur)->rows == batch_size_) {
+        queue_.Push(std::move(*cur));
+        *cur = NewBatch();
+      }
+    }
+  }
+
+  void ReadBinary(const std::string& path, std::unique_ptr<Batch>* cur) {
+    std::ifstream in(path, std::ios::binary);
+    if (!in) {
+      std::fprintf(stderr, "[pdtpu datafeed] cannot open %s\n", path.c_str());
+      return;
+    }
+    const size_t rec = sizeof(int64_t) + sizeof(float) * dim_;
+    std::vector<char> buf(rec);
+    while (in.read(buf.data(), rec)) {
+      int64_t label;
+      std::memcpy(&label, buf.data(), sizeof(int64_t));
+      EmitRow(cur->get(),
+              reinterpret_cast<const float*>(buf.data() + sizeof(int64_t)),
+              label);
+      if ((*cur)->rows == batch_size_) {
+        queue_.Push(std::move(*cur));
+        *cur = NewBatch();
+      }
+    }
+  }
+
+  std::vector<std::string> files_;
+  const int batch_size_;
+  const int dim_;
+  const bool binary_;
+  const bool drop_last_;
+  BoundedQueue queue_;
+  std::vector<std::thread> threads_;
+  std::atomic<size_t> next_file_;
+  std::atomic<int> active_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pdtpu_feed_create(const char** files, int nfiles, int batch_size,
+                        int dim, int n_threads, int queue_cap, int binary,
+                        int drop_last) {
+  std::vector<std::string> fs(files, files + nfiles);
+  return new DataFeed(std::move(fs), batch_size, dim, n_threads, queue_cap,
+                      binary != 0, drop_last != 0);
+}
+
+int pdtpu_feed_next(void* h, float* out_feats, int64_t* out_labels) {
+  return static_cast<DataFeed*>(h)->Next(out_feats, out_labels);
+}
+
+void pdtpu_feed_destroy(void* h) { delete static_cast<DataFeed*>(h); }
+
+}  // extern "C"

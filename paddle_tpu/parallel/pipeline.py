@@ -107,6 +107,16 @@ class PipelinedTrainStep:
         lps = n_layers // self.n_stages
         staged = {k: v.reshape((self.n_stages, lps) + v.shape[1:])
                   for k, v in stacked.items()}
+        # per-suffix trainability: a stacked leaf is updated only if every
+        # layer's entry is a trainable Parameter (buffers and frozen params
+        # stay fixed, matching TrainStep/ShardedTrainStep semantics)
+        pat = re.compile(self.block_re)
+        by_suffix = {}
+        for k in state:
+            m = pat.match(k)
+            if m:
+                by_suffix.setdefault(m.group(2), []).append(k in self._trainable)
+        self._staged_trainable = {s: all(v) for s, v in by_suffix.items()}
         return staged, rest, lps
 
     def _block_apply(self, params_one_layer, h):
@@ -222,6 +232,8 @@ class PipelinedTrainStep:
             loss, (g_staged, g_rest) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1))(staged, rest, ids_m, lbl_m, rng_key)
             opt_staged, opt_rest = opt_state
+            g_staged = {k: v for k, v in g_staged.items()
+                        if self._staged_trainable.get(k, True)}
             new_staged, new_opt_staged = apply_updates(
                 opt, staged, g_staged, opt_staged, lr, step_no, decay_staged)
             g_rest = {k: v for k, v in g_rest.items() if k in trainable}

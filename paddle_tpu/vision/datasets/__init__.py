@@ -1,1 +1,195 @@
-"""vision datasets (filled out in build-out)."""
+"""Vision datasets (reference: python/paddle/vision/datasets/ — MNIST,
+FashionMNIST, Cifar10/100, Flowers, DatasetFolder).
+
+Zero-egress environment: no downloads.  Each dataset reads the standard
+on-disk format when paths are given (idx-ubyte for MNIST, pickled batches
+for CIFAR, image folders), and raises a clear error otherwise.  For tests
+and benchmarks, `FakeData` generates deterministic synthetic samples.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset (tests/benchmarks)."""
+
+    def __init__(self, num_samples=256, image_shape=(3, 32, 32),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._seed = seed
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + int(idx))
+        img = rng.randint(0, 256, self.image_shape).astype(np.uint8)
+        label = np.array(rng.randint(0, self.num_classes), np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32) / 255.0
+        return img, label
+
+
+def _read_idx_images(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad MNIST image magic {magic}"
+        return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+
+def _read_idx_labels(path):
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad MNIST label magic {magic}"
+        return np.frombuffer(f.read(), np.uint8)
+
+
+class MNIST(Dataset):
+    """reference: vision/datasets/mnist.py — idx-ubyte reader."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if download:
+            raise RuntimeError(
+                f"{type(self).__name__}: downloads unavailable (no network); "
+                "pass image_path/label_path to local idx-ubyte files")
+        if image_path is None or label_path is None:
+            raise ValueError(
+                f"{type(self).__name__} requires image_path and label_path "
+                "(downloads unavailable in this environment)")
+        self.images = _read_idx_images(image_path)
+        self.labels = _read_idx_labels(label_path)
+        self.transform = transform
+        self.mode = mode
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.array(int(self.labels[idx]), np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = (img.astype(np.float32) / 255.0)[None]
+        return img, label
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """reference: vision/datasets/cifar.py — pickled-batch tar reader."""
+
+    _fine = False
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if download:
+            raise RuntimeError("downloads unavailable (no network); pass "
+                               "data_file pointing at the cifar tar.gz")
+        if data_file is None:
+            raise ValueError("Cifar requires data_file (no downloads)")
+        self.transform = transform
+        self.mode = mode
+        want = (("data_batch" if mode == "train" else "test_batch")
+                if not self._fine else
+                ("train" if mode == "train" else "test"))
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base.startswith(want):
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    images.append(np.asarray(d[b"data"]))
+                    key = b"labels" if b"labels" in d else b"fine_labels"
+                    labels.extend(d[key])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC for transforms
+        label = np.array(int(self.labels[idx]), np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.transpose(2, 0, 1).astype(np.float32) / 255.0
+        return img, label
+
+
+class Cifar100(Cifar10):
+    _fine = True
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """reference: vision/datasets/folder.py — class-per-subdir layout.
+    Loads .npy arrays natively; image formats need an installed PIL (gated)."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                path = os.path.join(cdir, fname)
+                ok = (is_valid_file(path) if is_valid_file else
+                      fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append((path, self.class_to_idx[c]))
+        self.loader = loader or self._default_loader
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise RuntimeError(
+                "loading image files requires PIL; store .npy arrays or "
+                "pass a custom loader") from e
+        return np.asarray(Image.open(path).convert("RGB"))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, label = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array(label, np.int64)
+
+
+ImageFolder = DatasetFolder

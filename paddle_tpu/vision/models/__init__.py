@@ -1,2 +1,7 @@
-from . import lenet  # noqa: F401
+"""Vision model zoo (reference: python/paddle/vision/models/)."""
 from .lenet import LeNet  # noqa: F401
+from .resnet import (ResNet, BasicBlock, BottleneckBlock,  # noqa: F401
+                     resnet18, resnet34, resnet50, resnet101, resnet152)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenet import (MobileNetV1, MobileNetV2,  # noqa: F401
+                        mobilenet_v1, mobilenet_v2)

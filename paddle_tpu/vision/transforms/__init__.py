@@ -1,1 +1,346 @@
-"""vision transforms (filled out in build-out)."""
+"""Vision transforms (reference: python/paddle/vision/transforms/ —
+numpy/PIL host-side preprocessing).  All transforms are numpy-based host ops
+(they run in DataLoader workers, never on the TPU); ToTensor produces the
+CHW float32 array the models consume.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class BaseTransform:
+    def __call__(self, x):
+        return self._apply_image(np.asarray(x))
+
+
+def _chw(img):
+    """HWC/HW -> HWC ndarray."""
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+class ToTensor(BaseTransform):
+    """HWC [0,255] -> CHW float32 [0,1] (reference to_tensor)."""
+
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        # scale by dtype (deterministic), like the reference: uint8 -> /255
+        if img.dtype == np.uint8:
+            img = img.astype(np.float32) / 255.0
+        else:
+            img = img.astype(np.float32)
+        if self.data_format == "CHW":
+            img = np.transpose(img, (2, 0, 1))
+        return img
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            n = img.shape[0]
+            return (img - self.mean[:n, None, None]) / self.std[:n, None, None]
+        n = img.shape[-1]
+        return (img - self.mean[:n]) / self.std[:n]
+
+
+class Resize(BaseTransform):
+    """Nearest/bilinear resize without PIL (numpy index math)."""
+
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if (h, w) == (th, tw):
+            return img
+        if self.interpolation == "nearest":
+            ys = (np.arange(th) * h / th).astype(int).clip(0, h - 1)
+            xs = (np.arange(tw) * w / tw).astype(int).clip(0, w - 1)
+            return img[ys][:, xs]
+        # bilinear
+        ys = (np.arange(th) + 0.5) * h / th - 0.5
+        xs = (np.arange(tw) + 0.5) * w / tw - 0.5
+        y0 = np.floor(ys).astype(int).clip(0, h - 1)
+        x0 = np.floor(xs).astype(int).clip(0, w - 1)
+        y1 = (y0 + 1).clip(0, h - 1)
+        x1 = (x0 + 1).clip(0, w - 1)
+        wy = (ys - y0).clip(0, 1)[:, None, None]
+        wx = (xs - x0).clip(0, 1)[None, :, None]
+        f = img.astype(np.float32)
+        out = (f[y0][:, x0] * (1 - wy) * (1 - wx)
+               + f[y0][:, x1] * (1 - wy) * wx
+               + f[y1][:, x0] * wy * (1 - wx)
+               + f[y1][:, x1] * wy * wx)
+        return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = max(0, (h - th) // 2)
+        j = max(0, (w - tw) // 2)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        if self.padding:
+            p = self.padding
+            img = np.pad(img, ((p, p), (p, p), (0, 0)))
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = random.randint(0, max(0, h - th))
+        j = random.randint(0, max(0, w - tw))
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _chw(img)[:, ::-1].copy()
+        return _chw(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return _chw(img)[::-1].copy()
+        return _chw(img)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self._resize = Resize(self.size, interpolation)
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if cw <= w and ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                return self._resize(img[i:i + ch, j:j + cw])
+        return self._resize(CenterCrop(min(h, w))(img))
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(_chw(img), self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        if isinstance(padding, int):
+            padding = (padding,) * 4
+        self.padding = padding
+        self.fill = fill
+        self.mode = padding_mode
+
+    def _apply_image(self, img):
+        l, t, r, b = (self.padding if len(self.padding) == 4
+                      else tuple(self.padding) * 2)
+        img = _chw(img)
+        if self.mode == "constant":
+            return np.pad(img, ((t, b), (l, r), (0, 0)),
+                          constant_values=self.fill)
+        return np.pad(img, ((t, b), (l, r), (0, 0)), mode=self.mode)
+
+
+class RandomRotation(BaseTransform):
+    """Arbitrary-angle rotation via inverse-map bilinear sampling (numpy;
+    no scipy/PIL needed)."""
+
+    def __init__(self, degrees, fill=0):
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = tuple(degrees)
+        self.fill = fill
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        angle = np.deg2rad(random.uniform(*self.degrees))
+        h, w = img.shape[:2]
+        cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+        yy, xx = np.meshgrid(np.arange(h, dtype=np.float32),
+                             np.arange(w, dtype=np.float32), indexing="ij")
+        c, s = np.cos(angle), np.sin(angle)
+        # inverse rotation: output pixel samples source location
+        sx = c * (xx - cx) + s * (yy - cy) + cx
+        sy = -s * (xx - cx) + c * (yy - cy) + cy
+        x0 = np.floor(sx).astype(int)
+        y0 = np.floor(sy).astype(int)
+        wx = (sx - x0)[..., None]
+        wy = (sy - y0)[..., None]
+        valid = (sx >= 0) & (sx <= w - 1) & (sy >= 0) & (sy <= h - 1)
+        x0c = x0.clip(0, w - 1)
+        y0c = y0.clip(0, h - 1)
+        x1c = (x0 + 1).clip(0, w - 1)
+        y1c = (y0 + 1).clip(0, h - 1)
+        f = img.astype(np.float32)
+        out = (f[y0c, x0c] * (1 - wy) * (1 - wx) + f[y0c, x1c] * (1 - wy) * wx
+               + f[y1c, x0c] * wy * (1 - wx) + f[y1c, x1c] * wy * wx)
+        out = np.where(valid[..., None], out, np.float32(self.fill))
+        return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        img = _chw(img).astype(np.float32)
+        if img.shape[2] >= 3:
+            g = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+                 + 0.114 * img[..., 2])
+        else:
+            g = img[..., 0]
+        return np.repeat(g[:, :, None], self.n, axis=2)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip(_chw(img).astype(np.float32) * alpha, 0,
+                       255 if np.asarray(img).dtype == np.uint8 else 1e30)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = value
+
+    def _apply_image(self, img):
+        img = _chw(img).astype(np.float32)
+        mean = img.mean()
+        a = 1 + np.random.uniform(-self.value, self.value)
+        return np.clip((img - mean) * a + mean, 0, 255)
+
+
+class ColorJitter(BaseTransform):
+    """brightness/contrast/saturation/hue jitter.  Saturation = blend with
+    luma; hue = rotation in the YIQ chroma plane (the classic matrix trick,
+    avoiding an HSV round-trip)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.brightness = brightness
+        self.contrast = contrast
+        self.saturation = saturation
+        self.hue = hue
+
+    def _apply_image(self, img):
+        img = _chw(img)
+        hi = 255.0 if img.dtype == np.uint8 else max(1.0, float(img.max()))
+        out = img.astype(np.float32)
+        if self.brightness:
+            out = out * (1 + np.random.uniform(-self.brightness,
+                                               self.brightness))
+        if self.contrast:
+            mean = out.mean()
+            out = (out - mean) * (1 + np.random.uniform(
+                -self.contrast, self.contrast)) + mean
+        if self.saturation and out.shape[2] >= 3:
+            luma = (0.299 * out[..., 0] + 0.587 * out[..., 1]
+                    + 0.114 * out[..., 2])[..., None]
+            a = 1 + np.random.uniform(-self.saturation, self.saturation)
+            out = np.concatenate(
+                [luma + a * (out[..., :3] - luma), out[..., 3:]], axis=2)
+        if self.hue and out.shape[2] >= 3:
+            theta = np.random.uniform(-self.hue, self.hue) * 2 * np.pi
+            c, s = np.cos(theta), np.sin(theta)
+            to_yiq = np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.322],
+                               [0.211, -0.523, 0.312]], np.float32)
+            rot = np.array([[1, 0, 0], [0, c, -s], [0, s, c]], np.float32)
+            m = np.linalg.inv(to_yiq) @ rot @ to_yiq
+            out = np.concatenate(
+                [out[..., :3] @ m.T, out[..., 3:]], axis=2)
+        return np.clip(out, 0, hi)
+
+
+# functional aliases (paddle.vision.transforms.functional subset)
+def to_tensor(img, data_format="CHW"):
+    return ToTensor(data_format)(img)
+
+
+def normalize(img, mean, std, data_format="CHW"):
+    return Normalize(mean, std, data_format)(img)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    return _chw(np.asarray(img))[:, ::-1].copy()
+
+
+def vflip(img):
+    return _chw(np.asarray(img))[::-1].copy()
+
+
+def center_crop(img, size):
+    return CenterCrop(size)(img)
+
+
+def crop(img, top, left, height, width):
+    return _chw(np.asarray(img))[top:top + height, left:left + width]

@@ -1,0 +1,88 @@
+"""hapi Model.fit end-to-end (reference: tests/book/test_recognize_digits.py
+pattern — train a small model until the loss drops, with metrics, eval,
+checkpoint round-trip)."""
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models, datasets
+
+
+class _SepDataset(paddle.io.Dataset):
+    """Linearly separable 2-class image blobs — learnable in a few steps."""
+
+    def __init__(self, n=64):
+        rng = np.random.RandomState(0)
+        self.labels = rng.randint(0, 2, (n,)).astype("int64")
+        base = np.where(self.labels[:, None, None, None] > 0, 0.8, -0.8)
+        self.images = (base + 0.1 * rng.randn(n, 1, 28, 28)).astype("float32")
+
+    def __len__(self):
+        return len(self.labels)
+
+    def __getitem__(self, i):
+        return self.images[i], self.labels[i]
+
+
+def test_model_fit_eval_predict(tmp_path):
+    net = models.LeNet(num_classes=2)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=1e-3,
+                                        parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy())
+    ds = _SepDataset(64)
+    losses = []
+
+    class Recorder(paddle.hapi.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            losses.append(logs["loss"])
+
+    model.fit(ds, epochs=2, batch_size=16, verbose=0,
+              callbacks=[Recorder()])
+    assert np.mean(losses[-4:]) < np.mean(losses[:4])
+
+    ev = model.evaluate(ds, batch_size=16, verbose=0)
+    assert ev["eval_acc"] > 0.9
+
+    preds = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (64, 2)
+
+    path = str(tmp_path / "ckpt" / "final")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    model.save(path)
+    net2 = models.LeNet(num_classes=2)
+    model2 = paddle.Model(net2)
+    model2.prepare(
+        optimizer=paddle.optimizer.Adam(parameters=net2.parameters()),
+        loss=paddle.nn.CrossEntropyLoss())
+    model2.load(path)
+    x = paddle.to_tensor(ds.images[:4])
+    net.eval(); net2.eval()
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_model_summary():
+    net = models.LeNet()
+    info = paddle.summary(net)
+    assert info["total_params"] > 0
+    assert info["total_params"] == info["trainable_params"]
+
+
+def test_callbacks_early_stopping():
+    net = models.LeNet(num_classes=2)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.0,
+                                        parameters=net.parameters()),
+        loss=paddle.nn.CrossEntropyLoss())
+    ds = _SepDataset(32)
+    es = paddle.hapi.callbacks.EarlyStopping(monitor="eval_loss", patience=0,
+                                             verbose=0)
+    model.fit(ds, eval_data=ds, epochs=5, batch_size=16, verbose=0,
+              callbacks=[es])
+    # lr=0 -> no improvement -> stops well before 5 epochs
+    assert model.stop_training

@@ -1,0 +1,154 @@
+"""Vision models/datasets/transforms tests (reference:
+test_vision_models.py, test_transforms.py, test_datasets.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models, transforms, datasets
+
+
+@pytest.mark.parametrize("ctor,depth", [
+    (models.resnet18, 18), (models.resnet50, 50)])
+def test_resnet_forward(ctor, depth):
+    m = ctor(num_classes=10)
+    m.eval()
+    x = paddle.to_tensor(np.random.randn(2, 3, 64, 64).astype("float32"))
+    out = m(x)
+    assert out.shape == [2, 10]
+
+
+def test_resnet_train_step():
+    m = models.resnet18(num_classes=4)
+    opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                    parameters=m.parameters())
+    ce = paddle.nn.CrossEntropyLoss()
+    x = paddle.to_tensor(np.random.randn(4, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(np.random.randint(0, 4, (4,)).astype("int64"))
+    losses = []
+    for _ in range(4):
+        loss = ce(m(x), y)
+        loss.backward()
+        opt.step(); opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_vgg_and_mobilenet_forward():
+    x = paddle.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
+    vgg = models.vgg11(num_classes=5, with_pool=True)
+    vgg.eval()
+    assert vgg(x).shape == [1, 5]
+    mv1 = models.mobilenet_v1(num_classes=5)
+    mv1.eval()
+    assert mv1(x).shape == [1, 5]
+    mv2 = models.mobilenet_v2(num_classes=5)
+    mv2.eval()
+    assert mv2(x).shape == [1, 5]
+
+
+def test_transforms_pipeline():
+    t = transforms.Compose([
+        transforms.Resize(40),
+        transforms.RandomCrop(32),
+        transforms.RandomHorizontalFlip(0.5),
+        transforms.ToTensor(),
+        transforms.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    img = np.random.randint(0, 256, (48, 48, 3)).astype(np.uint8)
+    out = t(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+    assert -1.1 <= out.min() and out.max() <= 1.1
+
+
+def test_resize_bilinear_identity():
+    img = np.random.randint(0, 256, (32, 32, 3)).astype(np.uint8)
+    out = transforms.Resize(32)(img)
+    np.testing.assert_array_equal(out, img)
+
+
+def test_center_crop_and_pad():
+    img = np.arange(36, dtype=np.uint8).reshape(6, 6, 1)
+    out = transforms.CenterCrop(4)(img)
+    assert out.shape == (4, 4, 1)
+    padded = transforms.Pad(2)(img)
+    assert padded.shape == (10, 10, 1)
+
+
+def test_fake_data_with_loader():
+    ds = datasets.FakeData(num_samples=32, image_shape=(1, 28, 28),
+                           num_classes=10)
+    loader = paddle.io.DataLoader(ds, batch_size=8, shuffle=True)
+    batches = list(loader)
+    assert len(batches) == 4
+    imgs, labels = batches[0]
+    assert tuple(imgs.shape) == (8, 1, 28, 28)
+    # determinism
+    a = ds[3][0]
+    b = ds[3][0]
+    np.testing.assert_array_equal(a, b)
+
+
+def test_dataset_folder_npy(tmp_path):
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            np.save(d / f"{i}.npy",
+                    np.random.randint(0, 255, (8, 8, 3)).astype(np.uint8))
+    ds = datasets.DatasetFolder(str(tmp_path))
+    assert len(ds) == 6
+    assert ds.classes == ["cat", "dog"]
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and int(label) == 0
+
+
+def test_mnist_requires_paths():
+    with pytest.raises(ValueError):
+        datasets.MNIST()
+
+
+def test_mnist_idx_reader(tmp_path):
+    import struct, gzip
+    imgs = np.random.randint(0, 256, (10, 28, 28)).astype(np.uint8)
+    labels = np.random.randint(0, 10, (10,)).astype(np.uint8)
+    ip = str(tmp_path / "imgs.gz"); lp = str(tmp_path / "lbls.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 10, 28, 28) + imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 10) + labels.tobytes())
+    ds = datasets.MNIST(image_path=ip, label_path=lp)
+    assert len(ds) == 10
+    img, lbl = ds[0]
+    assert img.shape == (1, 28, 28) and int(lbl) == int(labels[0])
+
+
+def test_random_rotation_small_angle():
+    """RandomRotation(degrees) must honor the requested angle range
+    (regression: it previously rotated by 90-degree steps regardless)."""
+    img = np.zeros((21, 21, 1), np.float32)
+    img[10, 15] = 1.0  # point right of center
+    rot = transforms.RandomRotation(5)
+    out = rot(img)
+    # a <=5-degree rotation keeps the point within a couple pixels
+    y, x = np.unravel_index(np.argmax(out[..., 0]), out[..., 0].shape)
+    assert abs(int(y) - 10) <= 2 and abs(int(x) - 15) <= 2
+
+
+def test_to_tensor_dtype_keyed():
+    """uint8 scales by 255 even if the max pixel is tiny; float passes."""
+    dark = np.zeros((4, 4, 3), np.uint8)
+    dark[0, 0, 0] = 1
+    out = transforms.ToTensor()(dark)
+    assert abs(out[0, 0, 0] - 1 / 255.0) < 1e-6
+    f = np.ones((4, 4, 3), np.float32) * 0.5
+    np.testing.assert_allclose(transforms.ToTensor()(f)[0], 0.5)
+
+
+def test_color_jitter_saturation_hue():
+    img = np.random.randint(0, 256, (8, 8, 3)).astype(np.uint8)
+    out = transforms.ColorJitter(saturation=0.5, hue=0.1)(img)
+    assert out.shape == (8, 8, 3)
+    # zero-saturation blend keeps luma: saturation=0,hue=0 is identity-ish
+    ident = transforms.ColorJitter()(img)
+    np.testing.assert_allclose(ident, img.astype(np.float32), atol=1e-3)
