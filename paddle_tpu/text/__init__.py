@@ -1,1 +1,189 @@
-"""paddle_tpu.text (datasets/models) — built out."""
+"""paddle_tpu.text (reference: python/paddle/text/ — dataset readers:
+Imdb, Imikolov, Movielens, UCIHousing, WMT14/16, Conll05).
+
+Zero-egress: readers parse the standard local archives; `FakeTextDataset`
+provides synthetic LM data for tests/benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import io
+import re
+import tarfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["FakeTextDataset", "Imdb", "Imikolov", "UCIHousing",
+           "ViterbiDecoder", "viterbi_decode"]
+
+
+class FakeTextDataset(Dataset):
+    """Deterministic synthetic token-id LM dataset."""
+
+    def __init__(self, num_samples=256, seq_len=128, vocab_size=1024, seed=0):
+        self.num_samples = num_samples
+        self.seq_len = seq_len
+        self.vocab_size = vocab_size
+        self._seed = seed
+
+    def __len__(self):
+        return self.num_samples
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self._seed + int(idx))
+        ids = rng.randint(0, self.vocab_size,
+                          (self.seq_len,)).astype(np.int32)
+        return ids[:-1], ids[1:].astype(np.int64)
+
+
+def _tokenize(text):
+    return re.findall(r"[a-z]+", text.lower())
+
+
+class Imdb(Dataset):
+    """reference: text/datasets/imdb.py — reads the aclImdb tar archive."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150):
+        if data_file is None:
+            raise ValueError("Imdb requires data_file (no downloads here)")
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        docs, labels, freq = [], [], {}
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                match = pat.match(m.name)
+                if not match:
+                    continue
+                toks = _tokenize(tf.extractfile(m).read().decode(
+                    "utf-8", "ignore"))
+                docs.append(toks)
+                labels.append(0 if match.group(1) == "pos" else 1)
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+        vocab = [w for w, c in sorted(freq.items(),
+                                      key=lambda x: (-x[1], x[0]))
+                 if c > cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(t, unk) for t in d],
+                                np.int64) for d in docs]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+
+class Imikolov(Dataset):
+    """reference: text/datasets/imikolov.py — PTB-style n-gram dataset."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50):
+        if data_file is None:
+            raise ValueError("Imikolov requires data_file")
+        name = ("./simple-examples/data/ptb.train.txt" if mode == "train"
+                else "./simple-examples/data/ptb.valid.txt")
+        freq, lines = {}, []
+        with tarfile.open(data_file, "r:*") as tf:
+            f = tf.extractfile(name)
+            for line in io.TextIOWrapper(f, encoding="utf-8"):
+                toks = line.split()
+                lines.append(toks)
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+        vocab = sorted(w for w, c in freq.items()
+                       if c >= min_word_freq and w != "<unk>")
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.samples = []
+        for toks in lines:
+            ids = [self.word_idx.get(t, unk)
+                   for t in ["<s>"] + toks + ["<e>"]]
+            if data_type.upper() == "NGRAM":
+                for i in range(window_size, len(ids) + 1):
+                    self.samples.append(
+                        np.asarray(ids[i - window_size:i], np.int64))
+            else:
+                self.samples.append(np.asarray(ids, np.int64))
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        s = self.samples[idx]
+        return s[:-1], s[-1:]
+
+
+class UCIHousing(Dataset):
+    """reference: text/datasets/uci_housing.py — whitespace table, 14 cols."""
+
+    def __init__(self, data_file=None, mode="train"):
+        if data_file is None:
+            raise ValueError("UCIHousing requires data_file")
+        op = gzip.open if data_file.endswith(".gz") else open
+        with op(data_file, "rt") as f:
+            rows = [list(map(float, line.split()))
+                    for line in f if line.strip()]
+        data = np.asarray(rows, np.float32)
+        feats, target = data[:, :-1], data[:, -1:]
+        # normalize features like the reference (max/min/avg per column)
+        mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+        feats = (feats - avg) / np.maximum(mx - mn, 1e-6)
+        split = int(len(data) * 0.8)
+        if mode == "train":
+            self.feats, self.target = feats[:split], target[:split]
+        else:
+            self.feats, self.target = feats[split:], target[split:]
+
+    def __len__(self):
+        return len(self.feats)
+
+    def __getitem__(self, idx):
+        return self.feats[idx], self.target[idx]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """Viterbi decoding (reference: paddle.text.ViterbiDecoder /
+    operators/viterbi_decode) — lax.scan over time, jittable on TPU."""
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor, unwrap
+
+    pots = unwrap(potentials)          # (B, T, N)
+    trans = unwrap(transition_params)  # (N, N)
+
+    def step(score, emit):
+        cand = score[:, :, None] + trans[None]   # (B, N_prev, N)
+        best = cand.max(axis=1) + emit
+        idx = cand.argmax(axis=1)
+        return best, idx
+
+    init = pots[:, 0]
+    scores, backptrs = jax.lax.scan(step, init,
+                                    jnp.swapaxes(pots[:, 1:], 0, 1))
+    last_tag = scores.argmax(-1)       # (B,)
+
+    def backtrack(tag, bp):
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    _, path = jax.lax.scan(backtrack, last_tag, backptrs, reverse=True)
+    path = jnp.concatenate([jnp.swapaxes(path, 0, 1),
+                            last_tag[:, None]], axis=1)
+    return Tensor(scores.max(-1)), Tensor(path.astype(jnp.int64))
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
