@@ -36,13 +36,41 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return dispatch("scaled_dot_product_attention", raw, query, key, value, attn_mask)
 
 
+def _flash_kv_bias(mask, batch, sk):
+    """Convert an attention mask to the flash kernel's (B, Sk) additive
+    per-key bias, or raise ValueError when its shape can't be expressed."""
+    if mask.ndim == 4:
+        if mask.shape[1] != 1 or mask.shape[2] != 1:
+            raise ValueError("per-head/per-query mask")
+        mask = mask[:, 0, 0, :]
+    if mask.ndim != 2 or mask.shape != (batch, sk):
+        raise ValueError("unsupported mask shape")
+    if mask.dtype == jnp.bool_:
+        return jnp.where(mask, 0.0, -1e30).astype(jnp.float32)
+    return mask.astype(jnp.float32)
+
+
 def _sdpa_raw(q, k, v, mask, dropout_p, is_causal, drop_key):
-    # try pallas flash path (no mask / causal, no dropout)
-    if _USE_FLASH and dropout_p == 0.0 and mask is None:
+    # pallas flash path: handles causal, (B,Sk) padding bias, and in-kernel
+    # dropout; falls back to the XLA naive form otherwise
+    if _USE_FLASH:
         from ...ops import flash_attention as fa
-        out = fa.flash_attention_bshd(q, k, v, causal=is_causal)
-        if out is not None:
-            return out
+        try:
+            bias = None if mask is None else _flash_kv_bias(
+                mask, q.shape[0], k.shape[1])
+        except ValueError:
+            bias = False  # inexpressible mask: skip flash
+        if bias is not False:
+            seed = None
+            if dropout_p > 0.0 and drop_key is not None:
+                seed = jax.random.bits(
+                    drop_key, (1,), dtype=jnp.uint32).astype(jnp.int32)
+            out = fa.flash_attention_bshd(
+                q, k, v, causal=is_causal, bias=bias,
+                dropout_p=dropout_p if drop_key is not None else 0.0,
+                dropout_seed=seed)
+            if out is not None:
+                return out
     scale = 1.0 / math.sqrt(q.shape[-1])
     # (b, s, h, d) -> (b, h, s, d)
     qt = jnp.swapaxes(q, 1, 2)

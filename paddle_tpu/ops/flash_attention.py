@@ -1,9 +1,23 @@
-"""Flash attention for TPU (pallas).
+"""Flash attention (forward + backward) for TPU via pallas.
 
-Replaces the reference's fused attention CUDA kernel
-(paddle/fluid/operators/fused/multihead_matmul_op.cu) with an online-softmax
-blocked kernel that never materializes the (seq, seq) score matrix in HBM —
-the key to long-context MFU on TPU (see /opt/skills/guides/pallas_guide.md).
+Replaces the reference's fused attention CUDA kernels
+(paddle/fluid/operators/fused/multihead_matmul_op.cu, fused_attention) with an
+online-softmax blocked kernel pair that never materializes the (seq, seq)
+score matrix in HBM — the key to long-context MFU on TPU.
+
+Design notes (see /opt/skills/guides/pallas_guide.md):
+- q/k/v stay in their input dtype (bf16 under AMP) going into the MXU dots
+  with `preferred_element_type=f32` accumulation; only the softmax state is
+  kept in f32.
+- The backward is the FlashAttention-2 recompute scheme: the forward saves
+  only O and the per-row logsumexp; two backward kernels recompute the score
+  blocks and produce dQ (grid over q blocks) and dK/dV (grid over k blocks).
+- Dropout is applied *inside* the kernel from a counter-based hash of the
+  absolute (head, row, col) coordinates + a seed, so the keep mask is
+  bit-identical between forward and backward regardless of block tiling, and
+  it runs under `interpret=True` on CPU (the TPU PRNG primitives do not).
+- Masking: `causal`, an additive per-key bias (B, Sk) covering padding masks,
+  and q/kv segment ids (packed-sequence masking) are fused into the kernel.
 
 `flash_attention_bshd` returns None when the kernel doesn't apply (wrong
 platform/shape); callers fall back to the XLA-fused naive path.
@@ -15,20 +29,41 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_INTERPRET = False  # tests flip this to run the kernels via the interpreter
+
+_NEG_INF = -1e30
 
 
-def _on_tpu() -> bool:
+def _available() -> bool:
+    if _INTERPRET:
+        return True
     try:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:
         return False
 
 
-def flash_attention_bshd(q, k, v, causal=False):
-    """q/k/v: (batch, seq, heads, head_dim). Returns same layout, or None."""
+def _block(size: int) -> int:
+    return next(b for b in (512, 256, 128) if size % b == 0)
+
+
+def flash_attention_bshd(q, k, v, causal=False, bias=None, q_segment_ids=None,
+                         kv_segment_ids=None, dropout_p=0.0, dropout_seed=None):
+    """q/k/v: (batch, seq, heads, head_dim). Returns same layout, or None.
+
+    bias: additive f32 per-key bias (batch, seq_k) — the padding-mask case.
+    q_segment_ids / kv_segment_ids: int32 (batch, seq) packed-sequence ids;
+    positions attend only within equal ids.
+    dropout_p with dropout_seed (int32 array shape (1,)): in-kernel attention
+    probability dropout.
+    """
     b, sq, h, d = q.shape
     sk = k.shape[1]
-    if not _on_tpu():
+    if not _available():
         return None
     if d not in (64, 128, 256):
         return None
@@ -36,90 +71,439 @@ def flash_attention_bshd(q, k, v, causal=False):
         return None
     if k.shape[2] != h:  # grouped-query: caller expands kv heads first
         return None
+    if dropout_p > 0.0 and dropout_seed is None:
+        return None
+    if (q_segment_ids is None) != (kv_segment_ids is None):
+        return None
     try:
         qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
         kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
         vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
-        out = _flash_bhsd(qt, kt, vt, causal)
+        # reshape mask inputs so every pallas block satisfies the TPU tiling
+        # rule (last two dims divisible by (8,128) or equal to the array's):
+        # per-key vectors ride the lane axis as (B, 1, Sk), per-query ids the
+        # sublane axis as (B, Sq, 1)
+        if bias is not None:
+            bias = bias.astype(jnp.float32)[:, None, :]
+        if q_segment_ids is not None:
+            q_segment_ids = q_segment_ids.astype(jnp.int32)[:, :, None]
+        if kv_segment_ids is not None:
+            kv_segment_ids = kv_segment_ids.astype(jnp.int32)[:, None, :]
+        if dropout_seed is None:
+            dropout_seed = jnp.zeros((1,), jnp.int32)
+        out = _flash(qt, kt, vt, bias, q_segment_ids, kv_segment_ids,
+                     dropout_seed, bool(causal), float(dropout_p), h)
         return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
     except Exception:
         return None
 
 
-@functools.partial(jax.jit, static_argnums=(3,))
-def _flash_bhsd(q, k, v, causal):
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+# ---------------------------------------------------------------------------
+# in-kernel dropout: murmur3-finalizer hash of absolute coordinates
 
+
+def _keep_mask(seed_ref, bh, rows, cols, dropout_p):
+    """Deterministic per-(seed, head, row, col) keep mask, tiling-independent."""
+    x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
+    x = x ^ (seed_ref[0].astype(jnp.uint32)
+             + bh.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    thresh = min(int(dropout_p * 4294967296.0), 4294967295)
+    return x >= jnp.uint32(thresh)
+
+
+def _coords(qi, ki, blk_q, blk_k):
+    rows = qi * blk_q + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    cols = ki * blk_k + jax.lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+    return rows, cols
+
+
+def _mask_specs(has_bias, has_seg, heads, blk_q, blk_k, q_pos):
+    """BlockSpecs for the optional [bias, qseg, kseg] inputs (in that order).
+    `q_pos` says which of the two non-batch grid axes (0 or 1) walks the
+    q blocks. Per-key inputs are (B, 1, Sk), per-query ones (B, Sq, 1)."""
+    k_pos = 1 - q_pos
+
+    def spec_k(pos):
+        return pl.BlockSpec(
+            (1, 1, blk_k),
+            lambda b, a1, a2, s, _p=pos: (b // heads, 0, (a1, a2)[_p]))
+
+    def spec_q(pos):
+        return pl.BlockSpec(
+            (1, blk_q, 1),
+            lambda b, a1, a2, s, _p=pos: (b // heads, (a1, a2)[_p], 0))
+
+    out = []
+    if has_bias:
+        out.append(spec_k(k_pos))
+    if has_seg:
+        out.append(spec_q(q_pos))
+        out.append(spec_k(k_pos))
+    return out
+
+
+def _masked_scores(q_ref, k_ref, bias_ref, qseg_ref, kseg_ref, qi, ki,
+                   blk_q, blk_k, scale, causal, causal_off):
+    """Recompute one (blk_q, blk_k) score block with all masks applied (f32)."""
+    s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0]  # (1, blk_k) broadcast over rows
+    if causal or qseg_ref is not None:
+        rows, cols = _coords(qi, ki, blk_q, blk_k)
+        if causal:
+            s = jnp.where(rows + causal_off >= cols, s, _NEG_INF)
+        if qseg_ref is not None:
+            # (blk_q, 1) == (1, blk_k) -> (blk_q, blk_k)
+            s = jnp.where(qseg_ref[0] == kseg_ref[0], s, _NEG_INF)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# forward
+
+
+def _fwd_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
+                blk_q, blk_k, n_k, scale, causal_off):
+    it = iter(refs)
+    q_ref, k_ref, v_ref = next(it), next(it), next(it)
+    bias_ref = next(it) if has_bias else None
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
+    o_ref, lse_ref = next(it), next(it)
+    acc_ref, m_ref, l_ref = next(it), next(it), next(it)
+
+    bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    def _compute():
+        s = _masked_scores(q_ref, k_ref, bias_ref, qseg_ref, kseg_ref,
+                           qi, ki, blk_q, blk_k, scale, causal, causal_off)
+        m_prev = m_ref[...]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_cur)
+        alpha = jnp.exp(m_prev - m_cur)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_ref[...] = m_cur
+        if dropout_p > 0.0:
+            rows, cols = _coords(qi, ki, blk_q, blk_k)
+            keep = _keep_mask(seed_ref, bh, rows, cols, dropout_p)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * blk_q + blk_q - 1 + causal_off >= ki * blk_k)
+        def _go():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        l = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l)
+
+
+def _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    # block sizes must DIVIDE the seq lens (callers guarantee multiples of
-    # 128) or whole key blocks would be dropped / query rows left unwritten
-    blk_q = next(b for b in (512, 256, 128) if sq % b == 0)
-    blk_k = next(b for b in (512, 256, 128) if sk % b == 0)
-    n_k = sk // blk_k
+    blk_q, blk_k = _block(sq), _block(sk)
+    n_q, n_k = sq // blk_q, sk // blk_k
     scale = 1.0 / math.sqrt(d)
-    # causal offset for sq != sk (kv-cache decode): query i sees keys
-    # <= i + (sk - sq), matching the naive path's tril(..., k=sk-sq)
+
+    in_specs = [
+        pl.BlockSpec((1, blk_q, d), lambda b, i, j, s: (b, i, 0)),
+        pl.BlockSpec((1, blk_k, d), lambda b, i, j, s: (b, j, 0)),
+        pl.BlockSpec((1, blk_k, d), lambda b, i, j, s: (b, j, 0)),
+    ]
+    inputs = [q, k, v]
+    in_specs += _mask_specs(bias is not None, qseg is not None, heads,
+                            blk_q, blk_k, q_pos=0)
+    if bias is not None:
+        inputs.append(bias)
+    if qseg is not None:
+        inputs.extend([qseg, kseg])
+
+    kernel = functools.partial(
+        _fwd_kernel, has_bias=bias is not None, has_seg=qseg is not None,
+        causal=causal, dropout_p=dropout_p, blk_q=blk_q, blk_k=blk_k,
+        n_k=n_k, scale=scale, causal_off=sk - sq)
+
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, n_q, n_k),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, blk_q, d), lambda b, i, j, s: (b, i, 0)),
+                pl.BlockSpec((1, blk_q, 1), lambda b, i, j, s: (b, i, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((blk_q, d), jnp.float32),
+                pltpu.VMEM((blk_q, 1), jnp.float32),
+                pltpu.VMEM((blk_q, 1), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(seed, *inputs)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2 recompute scheme)
+
+
+def _bwd_dq_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
+                   blk_q, blk_k, n_k, scale, causal_off):
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
+        next(it), next(it), next(it), next(it), next(it), next(it))
+    bias_ref = next(it) if has_bias else None
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
+    dq_ref = next(it)
+    dq_acc = next(it)
+
+    bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def _compute():
+        s = _masked_scores(q_ref, k_ref, bias_ref, qseg_ref, kseg_ref,
+                           qi, ki, blk_q, blk_k, scale, causal, causal_off)
+        p = jnp.exp(s - lse_ref[0])
+        dpd = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            rows, cols = _coords(qi, ki, blk_q, blk_k)
+            keep = _keep_mask(seed_ref, bh, rows, cols, dropout_p)
+            dp = jnp.where(keep, dpd * (1.0 / (1.0 - dropout_p)), 0.0)
+        else:
+            dp = dpd
+        ds = p * (dp - delta_ref[0])
+        dq_acc[...] += jax.lax.dot(
+            ds.astype(k_ref.dtype), k_ref[0],
+            preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        @pl.when(qi * blk_q + blk_q - 1 + causal_off >= ki * blk_k)
+        def _go():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
+                    blk_q, blk_k, n_q, scale, causal_off):
+    it = iter(refs)
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
+        next(it), next(it), next(it), next(it), next(it), next(it))
+    bias_ref = next(it) if has_bias else None
+    qseg_ref = next(it) if has_seg else None
+    kseg_ref = next(it) if has_seg else None
+    dk_ref, dv_ref = next(it), next(it)
+    dbias_ref = next(it) if has_bias else None
+    dk_acc, dv_acc = next(it), next(it)
+    db_acc = next(it) if has_bias else None
+
+    bh, ki, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+        if has_bias:
+            db_acc[...] = jnp.zeros_like(db_acc)
+
+    def _compute():
+        s = _masked_scores(q_ref, k_ref, bias_ref, qseg_ref, kseg_ref,
+                           qi, ki, blk_q, blk_k, scale, causal, causal_off)
+        p = jnp.exp(s - lse_ref[0])
+        dpd = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout_p > 0.0:
+            rows, cols = _coords(qi, ki, blk_q, blk_k)
+            keep = _keep_mask(seed_ref, bh, rows, cols, dropout_p)
+            inv = 1.0 / (1.0 - dropout_p)
+            pd = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dpd * inv, 0.0)
+        else:
+            pd, dp = p, dpd
+        dv_acc[...] += jax.lax.dot_general(
+            pd.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0])
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if has_bias:  # d(bias_k) = sum over q rows of dS (heads summed later)
+            db_acc[...] += jnp.sum(ds, axis=0, keepdims=True)
+
+    if causal:
+        @pl.when(qi * blk_q + blk_q - 1 + causal_off >= ki * blk_k)
+        def _go():
+            _compute()
+    else:
+        _compute()
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+        if has_bias:
+            dbias_ref[0] = db_acc[...]
+
+
+def _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, do,
+              causal, dropout_p, heads):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    blk_q, blk_k = _block(sq), _block(sk)
+    n_q, n_k = sq // blk_q, sk // blk_k
+    scale = 1.0 / math.sqrt(d)
     causal_off = sk - sq
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref):
-        qi = pl.program_id(1)
-        ki = pl.program_id(2)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # (bh, sq, 1)
 
-        @pl.when(ki == 0)
-        def _init():
-            acc_ref[...] = jnp.zeros_like(acc_ref)
-            m_ref[...] = jnp.full_like(m_ref, -1e30)
-            l_ref[...] = jnp.zeros_like(l_ref)
+    base_specs = [
+        pl.BlockSpec((1, blk_q, d), lambda b, i, j, s: (b, i, 0)),   # q
+        pl.BlockSpec((1, blk_k, d), lambda b, i, j, s: (b, j, 0)),   # k
+        pl.BlockSpec((1, blk_k, d), lambda b, i, j, s: (b, j, 0)),   # v
+        pl.BlockSpec((1, blk_q, d), lambda b, i, j, s: (b, i, 0)),   # do
+        pl.BlockSpec((1, blk_q, 1), lambda b, i, j, s: (b, i, 0)),   # lse
+        pl.BlockSpec((1, blk_q, 1), lambda b, i, j, s: (b, i, 0)),   # delta
+    ]
+    extras = ([] if bias is None else [bias]) + \
+        ([] if qseg is None else [qseg, kseg])
+    extra_specs = _mask_specs(bias is not None, qseg is not None, heads,
+                              blk_q, blk_k, q_pos=0)
+    inputs = [q, k, v, do, lse, delta] + extras
 
-        def _compute():
-            qb = q_ref[0].astype(jnp.float32) * scale
-            kb = k_ref[0].astype(jnp.float32)
-            s = jax.lax.dot_general(qb, kb, (((1,), (1,)), ((), ())),
-                                    preferred_element_type=jnp.float32)
-            if causal:
-                rows = qi * blk_q + jax.lax.broadcasted_iota(
-                    jnp.int32, (blk_q, blk_k), 0)
-                cols = ki * blk_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (blk_q, blk_k), 1)
-                s = jnp.where(rows + causal_off >= cols, s, -1e30)
-            m_prev = m_ref[...]
-            m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-            p = jnp.exp(s - m_cur)
-            alpha = jnp.exp(m_prev - m_cur)
-            l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
-            m_ref[...] = m_cur
-            vb = v_ref[0].astype(jnp.float32)
-            acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
-                p, vb, preferred_element_type=jnp.float32)
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, has_bias=bias is not None,
+            has_seg=qseg is not None, causal=causal, dropout_p=dropout_p,
+            blk_q=blk_q, blk_k=blk_k, n_k=n_k, scale=scale,
+            causal_off=causal_off),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, n_q, n_k),
+            in_specs=base_specs + extra_specs,
+            out_specs=[
+                pl.BlockSpec((1, blk_q, d), lambda b, i, j, s: (b, i, 0)),
+            ],
+            scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(seed, *inputs)[0]
 
-        if causal:
-            @pl.when((ki * blk_k) <= (qi * blk_q + blk_q - 1 + causal_off))
-            def _go():
-                _compute()
-        else:
-            _compute()
+    # dkv grid: (bh, k block, q block) — q/do/lse/delta indexed by the inner
+    # grid axis, k/v by the outer one
+    kv_specs = [
+        pl.BlockSpec((1, blk_q, d), lambda b, j, i, s: (b, i, 0)),   # q
+        pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),   # k
+        pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),   # v
+        pl.BlockSpec((1, blk_q, d), lambda b, j, i, s: (b, i, 0)),   # do
+        pl.BlockSpec((1, blk_q, 1), lambda b, j, i, s: (b, i, 0)),   # lse
+        pl.BlockSpec((1, blk_q, 1), lambda b, j, i, s: (b, i, 0)),   # delta
+    ]
+    kv_extra = _mask_specs(bias is not None, qseg is not None, heads,
+                           blk_q, blk_k, q_pos=1)
 
-        @pl.when(ki == n_k - 1)
-        def _finish():
-            o_ref[0] = (acc_ref[...] / jnp.maximum(l_ref[...], 1e-30)
-                        ).astype(o_ref.dtype)
+    kv_outs = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, has_bias=bias is not None,
+            has_seg=qseg is not None, causal=causal, dropout_p=dropout_p,
+            blk_q=blk_q, blk_k=blk_k, n_q=n_q, scale=scale,
+            causal_off=causal_off),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(bh, n_k, n_q),
+            in_specs=kv_specs + kv_extra,
+            out_specs=[
+                pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),
+                pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),
+            ] + ([pl.BlockSpec((1, 1, blk_k), lambda b, j, i, s: (b, 0, j))]
+                 if bias is not None else []),
+            scratch_shapes=[
+                pltpu.VMEM((blk_k, d), jnp.float32),
+                pltpu.VMEM((blk_k, d), jnp.float32),
+            ] + ([pltpu.VMEM((1, blk_k), jnp.float32)]
+                 if bias is not None else []),
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ] + ([jax.ShapeDtypeStruct((bh, 1, sk), jnp.float32)]
+             if bias is not None else []),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_INTERPRET,
+    )(seed, *inputs)
+    dk, dv = kv_outs[0], kv_outs[1]
+    dbias = None
+    if bias is not None:  # per-(batch*head) key sums -> sum heads -> (B,1,Sk)
+        dbias = kv_outs[2].reshape(bias.shape[0], heads, 1, sk).sum(axis=1)
+    return dq, dk, dv, dbias
 
-    grid = (bh, sq // blk_q, n_k)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, blk_k, d), lambda b, i, j: (b, j, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, blk_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        scratch_shapes=[
-            pltpu.VMEM((blk_q, d), jnp.float32),
-            pltpu.VMEM((blk_q, 1), jnp.float32),
-            pltpu.VMEM((blk_q, 1), jnp.float32),
-        ],
-    )(q, k, v)
+
+# ---------------------------------------------------------------------------
+# custom_vjp glue
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
+def _flash(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads):
+    o, _ = _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads)
+    return o
+
+
+def _flash_fwd(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads):
+    o, lse = _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p,
+                       heads)
+    return o, (q, k, v, bias, qseg, kseg, seed, o, lse)
+
+
+def _flash_bwd(causal, dropout_p, heads, res, g):
+    q, k, v, bias, qseg, kseg, seed, o, lse = res
+    dq, dk, dv, dbias = _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, g,
+                                  causal, dropout_p, heads)
+    dqseg = None if qseg is None else np.zeros(qseg.shape, jax.dtypes.float0)
+    dkseg = None if kseg is None else np.zeros(kseg.shape, jax.dtypes.float0)
+    dseed = np.zeros(seed.shape, jax.dtypes.float0)
+    return dq, dk, dv, dbias, dqseg, dkseg, dseed
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)

@@ -1,0 +1,233 @@
+"""Flash-attention kernel tests (pallas interpret mode on CPU).
+
+Covers SURVEY.md §2.1 "Operators: fused" (the reference's
+fused/multihead_matmul_op.cu): forward parity vs the naive softmax(QK^T)V,
+backward parity vs jax.grad of the naive form, mask/causal/segment handling,
+and in-kernel dropout (statistics, determinism, fwd/bwd consistency).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops import flash_attention as fa
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    fa._INTERPRET = True
+    yield
+    fa._INTERPRET = False
+
+
+def naive(q, k, v, causal=False, bias=None, qseg=None, kseg=None):
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    qt, kt, vt = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) * scale
+    if bias is not None:
+        s = s + bias[:, None, None, :]
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        s = jnp.where(mask, s, -1e30)
+    if qseg is not None:
+        ok = qseg[:, None, :, None] == kseg[:, None, None, :]
+        s = jnp.where(ok, s, -1e30)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vt), 1, 2)
+
+
+def rand_qkv(b=2, sq=256, sk=256, h=2, d=64, dtype=jnp.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda s: jnp.asarray(
+        rng.standard_normal((b, s, h, d)).astype(np.float32)).astype(dtype)
+    return mk(sq), mk(sq if sq == sk else sk), mk(sq if sq == sk else sk)
+
+
+def test_fwd_matches_naive():
+    q, k, v = rand_qkv()
+    out = fa.flash_attention_bshd(q, k, v)
+    assert out is not None
+    np.testing.assert_allclose(out, naive(q, k, v), rtol=2e-5, atol=2e-5)
+
+
+def test_fwd_causal_multiblock():
+    # 384 forces 128-blocks (3 per axis) so the online-softmax carry is real
+    q, k, v = rand_qkv(sq=384, sk=384)
+    out = fa.flash_attention_bshd(q, k, v, causal=True)
+    assert out is not None
+    np.testing.assert_allclose(out, naive(q, k, v, causal=True),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fwd_rectangular_causal():
+    # kv-cache decode shape: sq < sk with causal offset
+    q, k, v = rand_qkv(sq=128, sk=384)
+    out = fa.flash_attention_bshd(q, k, v, causal=True)
+    assert out is not None
+    np.testing.assert_allclose(out, naive(q, k, v, causal=True),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fwd_padding_bias():
+    q, k, v = rand_qkv()
+    lengths = np.array([200, 120])
+    bias = jnp.asarray(np.where(np.arange(256)[None, :] < lengths[:, None],
+                                0.0, -1e30).astype(np.float32))
+    out = fa.flash_attention_bshd(q, k, v, bias=bias)
+    assert out is not None
+    np.testing.assert_allclose(out, naive(q, k, v, bias=bias),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fwd_segment_ids():
+    q, k, v = rand_qkv()
+    seg = jnp.asarray((np.arange(256)[None, :] // 64 +
+                       np.array([[0], [10]])).astype(np.int32))
+    out = fa.flash_attention_bshd(q, k, v, q_segment_ids=seg,
+                                  kv_segment_ids=seg)
+    assert out is not None
+    np.testing.assert_allclose(out, naive(q, k, v, qseg=seg, kseg=seg),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bf16_fwd():
+    q, k, v = rand_qkv(dtype=jnp.bfloat16)
+    out = fa.flash_attention_bshd(q, k, v, causal=True)
+    assert out is not None and out.dtype == jnp.bfloat16
+    ref = naive(q, k, v, causal=True)
+    np.testing.assert_allclose(out.astype(np.float32),
+                               ref.astype(np.float32), rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_grad_matches_naive(causal):
+    q, k, v = rand_qkv(sq=256, sk=256)
+    co = jnp.asarray(np.random.RandomState(1).standard_normal(
+        (2, 256, 2, 64)).astype(np.float32))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention_bshd(q, k, v, causal=causal) * co)
+
+    def loss_naive(q, k, v):
+        return jnp.sum(naive(q, k, v, causal=causal) * co)
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_n = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_n):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_grad_with_bias_and_segments():
+    q, k, v = rand_qkv(sq=256, sk=256)
+    lengths = np.array([256, 160])
+    bias = jnp.asarray(np.where(np.arange(256)[None, :] < lengths[:, None],
+                                0.0, -1e30).astype(np.float32))
+    seg = jnp.asarray((np.arange(256)[None, :] // 128).astype(np.int32)
+                      * np.ones((2, 1), np.int32))
+    co = jnp.asarray(np.random.RandomState(1).standard_normal(
+        (2, 256, 2, 64)).astype(np.float32))
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * co)
+
+    flash = loss(lambda q, k, v: fa.flash_attention_bshd(
+        q, k, v, bias=bias, q_segment_ids=seg, kv_segment_ids=seg))
+    ref = loss(lambda q, k, v: naive(q, k, v, bias=bias, qseg=seg, kseg=seg))
+    g_f = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+    g_n = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_n):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_bias_gradient():
+    """A differentiable additive bias gets a real gradient through the flash
+    path (not silent zeros)."""
+    q, k, v = rand_qkv()
+    bias = jnp.asarray(np.random.RandomState(3).standard_normal(
+        (2, 256)).astype(np.float32))
+    co = jnp.asarray(np.random.RandomState(1).standard_normal(
+        (2, 256, 2, 64)).astype(np.float32))
+
+    g_f = jax.grad(lambda b: jnp.sum(
+        fa.flash_attention_bshd(q, k, v, bias=b) * co))(bias)
+    g_n = jax.grad(lambda b: jnp.sum(naive(q, k, v, bias=b) * co))(bias)
+    np.testing.assert_allclose(g_f, g_n, rtol=1e-4, atol=1e-4)
+
+
+def test_segment_ids_must_be_paired():
+    q, k, v = rand_qkv()
+    seg = jnp.zeros((2, 256), jnp.int32)
+    assert fa.flash_attention_bshd(q, k, v, kv_segment_ids=seg) is None
+    assert fa.flash_attention_bshd(q, k, v, q_segment_ids=seg) is None
+
+
+def test_dropout_statistics_and_determinism():
+    q, k, v = rand_qkv()
+    seed = jnp.asarray([1234], jnp.int32)
+    out1 = fa.flash_attention_bshd(q, k, v, dropout_p=0.3, dropout_seed=seed)
+    out2 = fa.flash_attention_bshd(q, k, v, dropout_p=0.3, dropout_seed=seed)
+    out3 = fa.flash_attention_bshd(q, k, v, dropout_p=0.3,
+                                   dropout_seed=jnp.asarray([99], jnp.int32))
+    assert out1 is not None
+    np.testing.assert_array_equal(out1, out2)  # same seed -> same mask
+    assert float(jnp.max(jnp.abs(out1 - out3))) > 1e-4  # seed matters
+    # dropout is unbiased: mean over seeds approaches the no-dropout output
+    acc = jnp.zeros_like(out1)
+    n = 24
+    for s in range(n):
+        acc = acc + fa.flash_attention_bshd(
+            q, k, v, dropout_p=0.3, dropout_seed=jnp.asarray([s], jnp.int32))
+    base = naive(q, k, v)
+    err = float(jnp.mean(jnp.abs(acc / n - base)))
+    scale = float(jnp.mean(jnp.abs(base)))
+    assert err < 0.25 * scale
+
+
+def test_dropout_grad_consistency():
+    """vjp of the dropout kernel matches the directional numeric derivative,
+    i.e. forward and backward regenerate the identical keep mask."""
+    q, k, v = rand_qkv(b=1, sq=128, sk=128, h=1)
+    seed = jnp.asarray([7], jnp.int32)
+    co = jnp.asarray(np.random.RandomState(1).standard_normal(
+        (1, 128, 1, 64)).astype(np.float32))
+    tang = jnp.asarray(np.random.RandomState(2).standard_normal(
+        q.shape).astype(np.float32))
+
+    def f(q):
+        return jnp.sum(fa.flash_attention_bshd(
+            q, k, v, dropout_p=0.25, dropout_seed=seed) * co)
+
+    g = jax.grad(f)(q)
+    eps = 1e-3
+    num = (f(q + eps * tang) - f(q - eps * tang)) / (2 * eps)
+    ana = jnp.sum(g * tang)
+    np.testing.assert_allclose(float(ana), float(num), rtol=2e-3, atol=2e-3)
+
+
+def test_sdpa_routes_through_flash():
+    """F.scaled_dot_product_attention with dropout and a padding mask must
+    hit the flash kernel (the r1 gap: dropout/mask used to disqualify it)."""
+    import paddle_tpu  # noqa: F401  (registers tensor type)
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.core.tensor import Tensor
+
+    calls = {"n": 0}
+    orig = fa.flash_attention_bshd
+
+    def spy(*a, **kw):
+        out = orig(*a, **kw)
+        if out is not None:
+            calls["n"] += 1
+        return out
+
+    fa.flash_attention_bshd, saved = spy, orig
+    try:
+        q = Tensor(rand_qkv()[0])
+        mask = Tensor(jnp.ones((2, 1, 1, 256), jnp.float32) * 0.0)
+        out = F.scaled_dot_product_attention(q, q, q, attn_mask=mask,
+                                             dropout_p=0.1, training=True)
+        assert calls["n"] == 1
+        assert out.shape == [2, 256, 2, 64]
+    finally:
+        fa.flash_attention_bshd = saved
