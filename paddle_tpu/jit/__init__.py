@@ -273,6 +273,26 @@ class TrainStep:
             sd[k]._set_data(v)
         return Tensor(loss)
 
+    # -- checkpointing (single-device variant of ShardedTrainStep's) ---------
+    def save_checkpoint(self, directory, step=None, extra_meta=None):
+        from ..distributed import checkpoint as dck
+        state = state_arrays(self.model)
+        if self._opt_state is None:
+            self._opt_state = self.init_opt_state(state)
+        return dck.save_train_state(
+            directory, state, self._opt_state,
+            step if step is not None else self.optimizer._step_count,
+            extra_meta)
+
+    def restore_checkpoint(self, directory):
+        from ..distributed import checkpoint as dck
+        res = dck.restore_sharded(directory)
+        if res is None:
+            return None
+        meta, self._opt_state = dck.apply_train_state(
+            self.model, self.optimizer, res)
+        return meta
+
 
 # ---------------------------------------------------------------------------
 # save / load (inference model): AOT export via jax.export + weights pickle

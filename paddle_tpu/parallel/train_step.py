@@ -157,15 +157,23 @@ class ShardedTrainStep:
         return {k: self.optimizer.init_state(v) for k, v in state.items()
                 if k in self._trainable}
 
+    def _ensure_opt_shardings(self):
+        """Derive optimizer-state shardings from shapes only (eval_shape) —
+        no throwaway device allocation on the restore path."""
+        if getattr(self, "_opt_state_shardings", None) is None:
+            state = state_arrays(self.model)
+            shapes = jax.eval_shape(self.init_opt_state, state)
+            self._opt_state_shardings = self._opt_shardings(shapes)
+        return self._opt_state_shardings
+
     def __call__(self, *batch):
         if not self._placed:
             self.place_params()
         state = state_arrays(self.model)
         if self._opt_state is None:
             raw = self.init_opt_state(state)
-            shardings = self._opt_shardings(raw)
+            shardings = self._ensure_opt_shardings()
             self._opt_state = jax.device_put(raw, shardings)
-            self._opt_state_shardings = shardings
         if self._compiled is None:
             self._n_batch = len(batch)
             self._compiled = self._build(self._opt_state_shardings)
@@ -182,6 +190,39 @@ class ShardedTrainStep:
         for k, v in new_state.items():
             sd[k]._set_data(v)
         return Tensor(loss)
+
+    # -- checkpointing -------------------------------------------------------
+    def save_checkpoint(self, directory: str, step: Optional[int] = None,
+                        extra_meta: Optional[dict] = None) -> str:
+        """Snapshot sharded params + optimizer state without host gather
+        (each process writes only its own shards)."""
+        from ..distributed import checkpoint as dck
+        if not self._placed:
+            self.place_params()
+        state = state_arrays(self.model)
+        if self._opt_state is None:
+            self._opt_state = jax.device_put(self.init_opt_state(state),
+                                             self._ensure_opt_shardings())
+        return dck.save_train_state(
+            directory, state, self._opt_state,
+            step if step is not None else self.optimizer._step_count,
+            extra_meta)
+
+    def restore_checkpoint(self, directory: str) -> Optional[dict]:
+        """Restore the newest checkpoint onto this step's shardings; resumes
+        the optimizer step count + rng stream. Returns meta or None."""
+        from ..distributed import checkpoint as dck
+        if not self._placed:
+            self.place_params()
+        res = dck.restore_sharded(
+            directory, mesh=self.mesh,
+            shardings={"params": self.param_shardings,
+                       "opt": self._ensure_opt_shardings()})
+        if res is None:
+            return None
+        meta, self._opt_state = dck.apply_train_state(
+            self.model, self.optimizer, res)
+        return meta
 
     # -- introspection -------------------------------------------------------
     def describe_shardings(self) -> Dict[str, str]:
