@@ -152,14 +152,21 @@ class GradScaler:
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        from ..core.selected_rows import RowSparseGrad
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list or []:
-            if p.grad is not None:
-                g = p.grad._data * inv
-                finite = bool(jnp.all(jnp.isfinite(g)))
-                found = found or not finite
-                p.grad._set_data(g)
+            if p.grad is None:
+                continue
+            if isinstance(p.grad, RowSparseGrad):
+                vals = p.grad.values * inv
+                found = found or not bool(jnp.all(jnp.isfinite(vals)))
+                p.grad = RowSparseGrad(p.grad.rows, vals, p.grad.dense_shape)
+                continue
+            g = p.grad._data * inv
+            finite = bool(jnp.all(jnp.isfinite(g)))
+            found = found or not finite
+            p.grad._set_data(g)
         self._found_inf = found
 
     def step(self, optimizer):

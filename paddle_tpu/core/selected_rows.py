@@ -1,0 +1,254 @@
+"""RowSparseGrad — the TPU-native SelectedRows.
+
+Reference: paddle/fluid/framework/selected_rows.h:1 (a rows index vector +
+value tensor on a (height, width) frame, produced by sparse lookup-table
+grads) and paddle/fluid/operators/optimizers/adam_op.h:1 (lazy mode: only
+touched rows get a moment/param update).
+
+TPU-native design: under jit every shape is static, so a "set of touched
+rows" cannot be a dynamically-sized array.  The rep therefore keeps the FULL
+lookup-count rows/values arrays — duplicates included — and
+`optimizer.sparse.merge_rows` (the analogue of scatter::MergeAdd) segment-sums
+duplicates into same-shape buffers with out-of-range sentinels that the
+row-wise lazy update drops via `mode="drop"` scatters.  Grads stay
+O(lookups·width) instead of O(vocab·width) end to end.
+
+Two delivery paths:
+- eager: `F.embedding(..., sparse=True)` records a tape node whose vjp emits
+  a RowSparseGrad; `Optimizer.step` applies the lazy row update.
+- jit (TrainStep): a SparseGradContext threads per-lookup zero leaves through
+  `jax.value_and_grad` (the embedding adds a zeros tensor to the gathered
+  rows, so the zeros' cotangent IS the per-lookup grad) and the step applies
+  the same lazy update inside the compiled program.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, TapeNode, unwrap
+
+
+class RowSparseGrad:
+    """rows (N,) int32 lookup ids + values (N, width); dense_shape=(height, width).
+
+    Duplicate rows are allowed (merged lazily by the optimizer).  Supports
+    `+` with another RowSparseGrad (concat — SelectedRows accumulation) and
+    with a dense array (densifies).
+    """
+
+    __slots__ = ("rows", "values", "dense_shape")
+
+    def __init__(self, rows, values, dense_shape):
+        self.rows = rows
+        self.values = values
+        self.dense_shape = tuple(int(s) for s in dense_shape)
+
+    @property
+    def shape(self):
+        return self.dense_shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @property
+    def is_sparse(self):
+        return True
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.rows].add(self.values, mode="drop")
+
+    def __add__(self, other):
+        if isinstance(other, RowSparseGrad):
+            return RowSparseGrad(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values.astype(jnp.result_type(
+                    self.values, other.values)),
+                    other.values.astype(jnp.result_type(
+                        self.values, other.values))]),
+                self.dense_shape)
+        return self.to_dense() + other
+
+    __radd__ = __add__
+
+    def numpy(self):
+        """Dense materialization (Tensor.gradient parity for sparse grads)."""
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"RowSparseGrad(rows={self.rows.shape[0]}, "
+                f"dense_shape={self.dense_shape}, dtype={self.dtype})")
+
+
+jax.tree_util.register_pytree_node(
+    RowSparseGrad,
+    lambda g: ((g.rows, g.values), g.dense_shape),
+    lambda aux, kids: RowSparseGrad(kids[0], kids[1], aux),
+)
+
+
+# ---------------------------------------------------------------------------
+# jit path: sparse-grad collection context
+
+
+class SparseGradContext:
+    """Trace-time channel between F.embedding and the compiled train step.
+
+    mode "record": a shape-probe pass (jax.eval_shape) that notes each sparse
+    lookup's (n_lookups, width, dtype) so the step can allocate zero leaves.
+    mode "apply": the real trace; the embedding adds `zeros[key]` to its
+    gathered rows (so d zeros == per-lookup grad) and logs the lookup ids.
+    Keys are `param_name@call_index` — stable across both passes because both
+    trace the same forward.
+    """
+
+    def __init__(self, mode: str, zeros: Optional[Dict] = None):
+        self.mode = mode
+        self.zeros = zeros or {}
+        self.specs: Dict[str, tuple] = {}
+        self.ids: Dict[str, jax.Array] = {}
+        self._counts: Dict[str, int] = {}
+
+    def key_for(self, name: str) -> str:
+        i = self._counts.get(name, 0)
+        self._counts[name] = i + 1
+        return f"{name}@{i}"
+
+
+_CTX: Optional[SparseGradContext] = None
+
+
+def current_ctx() -> Optional[SparseGradContext]:
+    return _CTX
+
+
+@contextlib.contextmanager
+def use_ctx(ctx: SparseGradContext):
+    global _CTX
+    prev = _CTX
+    _CTX = ctx
+    try:
+        yield ctx
+    finally:
+        _CTX = prev
+
+
+def param_name(key: str) -> str:
+    return key.rsplit("@", 1)[0]
+
+
+def ctx_embedding(ctx: SparseGradContext, x, weight, padding_idx=None):
+    """Embedding lookup inside a TrainStep trace with sparse grads requested.
+
+    NOTE (matches the reference's sparse lookup-table restrictions): a
+    sparse=True weight must ONLY be consumed through F.embedding — sharing it
+    with dense ops (e.g. a tied LM head) silently drops those other grads,
+    because the weight is excluded from the differentiated param set.
+    """
+    ids = unwrap(x).astype(jnp.int32)
+    w = unwrap(weight)
+    name = getattr(weight, "name", None) or "embedding"
+    key = ctx.key_for(name)
+    width = w.shape[1]
+    n = int(np.prod(ids.shape))
+
+    if ctx.mode == "record":
+        ctx.specs[key] = (n, width, w.dtype)
+        out = jnp.take(w, ids, axis=0)
+    else:
+        z = ctx.zeros[key]
+        ctx.ids[key] = ids.reshape(-1)
+        out = (jnp.take(jax.lax.stop_gradient(w), ids, axis=0)
+               + z.reshape(ids.shape + (width,)))
+    if padding_idx is not None:
+        out = jnp.where((ids == padding_idx)[..., None],
+                        jnp.zeros((), out.dtype), out)
+    return Tensor(out, stop_gradient=True)
+
+
+# ---------------------------------------------------------------------------
+# misuse guard: a sparse weight consumed outside F.embedding would silently
+# lose those gradients (it is excluded from the differentiated params), so the
+# train-step build probes the traced forward and hard-errors instead.
+
+
+def check_embedding_only_use(probe_fn, sparse_vals: Dict[str, jax.Array]):
+    """Raise ValueError if any sparse param feeds an op other than the
+    stop_gradient that ctx_embedding wraps it in (e.g. a tied LM head).
+
+    probe_fn(sparse_vals_dict) must run the forward with an apply-mode
+    SparseGradContext active.  Conservative: unrecognized call-like
+    primitives consuming a sparse weight also error.
+    """
+    closed = jax.make_jaxpr(probe_fn)(sparse_vals)
+    leaves, _ = jax.tree_util.tree_flatten(sparse_vals)
+    keys = sorted(sparse_vals)
+    tracked = {v: k for v, k in zip(closed.jaxpr.invars[:len(leaves)], keys)}
+    bad = _find_dense_consumers(closed.jaxpr, tracked)
+    if bad:
+        uses = ", ".join(sorted({f"'{k}' used by {p}" for k, p in bad}))
+        raise ValueError(
+            "Embedding(sparse=True) weights must only be consumed via "
+            f"F.embedding, but the traced forward also uses: {uses}. "
+            "Those gradients would be silently dropped — untie the weight "
+            "or use sparse=False.")
+
+
+def _find_dense_consumers(jaxpr, tracked):
+    bad = []
+    for eqn in jaxpr.eqns:
+        hits = [(i, v) for i, v in enumerate(eqn.invars)
+                if not isinstance(v, jax.extend.core.Literal) and v in tracked]
+        if not hits:
+            continue
+        if eqn.primitive.name == "stop_gradient":
+            continue  # the sanctioned ctx_embedding path
+        inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+        if inner is not None and eqn.primitive.name in (
+                "pjit", "closed_call", "remat2", "custom_vjp_call",
+                "custom_jvp_call"):
+            ij = getattr(inner, "jaxpr", inner)
+            # these call primitives map eqn.invars positionally onto the
+            # inner jaxpr's invars
+            inner_tracked = {ij.invars[i]: tracked[v] for i, v in hits
+                             if i < len(ij.invars)}
+            bad += _find_dense_consumers(ij, inner_tracked)
+        else:
+            bad += [(tracked[v], eqn.primitive.name) for _, v in hits]
+    return bad
+
+
+# ---------------------------------------------------------------------------
+# eager path: tape node emitting a RowSparseGrad
+
+
+def eager_sparse_embedding(x, weight, padding_idx=None):
+    ids = unwrap(x).astype(jnp.int32)
+    w = weight._data
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None:
+        out = jnp.where((ids == padding_idx)[..., None],
+                        jnp.zeros((), out.dtype), out)
+    out_t = Tensor(out, stop_gradient=False)
+    flat_ids = ids.reshape(-1)
+    width = w.shape[1]
+    dense_shape = w.shape
+    pad = padding_idx
+
+    def vjp_fn(ct):
+        vals = ct.reshape(-1, width)
+        if pad is not None:
+            vals = jnp.where((flat_ids == pad)[:, None],
+                             jnp.zeros((), vals.dtype), vals)
+        return (RowSparseGrad(flat_ids, vals, dense_shape),)
+
+    node = TapeNode("embedding_sparse_grad", vjp_fn, [weight], [out_t])
+    out_t._node = node
+    out_t._out_index = 0
+    return out_t

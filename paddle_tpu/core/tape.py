@@ -115,11 +115,23 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
 
 
 def _deposit(t: Tensor, raw_grad, accumulate, wanted, results):
+    from .selected_rows import RowSparseGrad
     if wanted is not None:
         if id(t) in wanted:
             results[id(t)] = raw_grad
         return
     if t.stop_gradient:
+        return
+    if isinstance(raw_grad, RowSparseGrad):
+        # SelectedRows grad: stored as-is on .grad (reference keeps the
+        # sparse rep on the VarBase grad too); hooks don't apply
+        if t.grad is None or not accumulate:
+            t.grad = raw_grad
+        elif isinstance(t.grad, RowSparseGrad):
+            t.grad = t.grad + raw_grad
+        else:
+            t.grad = Tensor(t.grad._data + raw_grad.to_dense(),
+                            stop_gradient=True)
         return
     if t._hooks:
         for hook in t._hooks:
@@ -128,6 +140,8 @@ def _deposit(t: Tensor, raw_grad, accumulate, wanted, results):
                 raw_grad = new._data if isinstance(new, Tensor) else jnp.asarray(new)
     if t.grad is None or not accumulate:
         t.grad = Tensor(raw_grad, stop_gradient=True)
+    elif isinstance(t.grad, RowSparseGrad):
+        t.grad = Tensor(t.grad.to_dense() + raw_grad, stop_gradient=True)
     else:
         t.grad = Tensor(t.grad._data + raw_grad, stop_gradient=True)
 
@@ -159,10 +173,13 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         for k, v in (res or {}).items():
             total[k] = total[k] + v if k in total else v
 
+    from .selected_rows import RowSparseGrad
     grads = []
     for t in inputs:
         if id(t) in total:
-            grads.append(Tensor(total[id(t)], stop_gradient=True))
+            g = total[id(t)]
+            grads.append(g if isinstance(g, RowSparseGrad)
+                         else Tensor(g, stop_gradient=True))
         elif allow_unused:
             grads.append(None)
         else:

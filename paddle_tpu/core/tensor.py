@@ -290,7 +290,8 @@ class _HookHandle:
 class Parameter(Tensor):
     """Trainable tensor (reference: framework.py Parameter / ParamBase)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed",
+                 "sparse_grad")
 
     def __init__(self, data, name=None, trainable=True):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -300,6 +301,7 @@ class Parameter(Tensor):
         self.regularizer = None
         self.need_clip = True
         self.is_distributed = False
+        self.sparse_grad = False  # set by Embedding(sparse=True)
 
     def __repr__(self):
         return "Parameter " + super().__repr__()

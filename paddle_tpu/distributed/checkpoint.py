@@ -162,12 +162,20 @@ def _write_atomic(path: str, content: str):
 # -- restore ----------------------------------------------------------------
 
 class _ShardStore:
-    """Lazily-opened shard files for one checkpoint step dir."""
+    """Lazily-opened shard files for one checkpoint step dir.
 
-    def __init__(self, step_dir: str):
-        self.files = [np.load(os.path.join(step_dir, f))
-                      for f in sorted(os.listdir(step_dir))
-                      if f.startswith("shards-") and f.endswith(".npz")]
+    Only shards-pNNNNN.npz with N < the manifest's n_processes are read: a
+    re-save into an existing step dir from a smaller process count must not
+    overlay stale higher-numbered shard files from the earlier save.
+    """
+
+    def __init__(self, step_dir: str, n_processes: Optional[int] = None):
+        names = [f for f in sorted(os.listdir(step_dir))
+                 if f.startswith("shards-p") and f.endswith(".npz")]
+        if n_processes is not None:
+            names = [f for f in names
+                     if int(f[len("shards-p"):-len(".npz")]) < n_processes]
+        self.files = [np.load(os.path.join(step_dir, f)) for f in names]
         self._full_cache: Dict[str, np.ndarray] = {}
 
     def lookup(self, name: str, index, shape, dtype):
@@ -234,7 +242,7 @@ def restore_sharded(directory: str, mesh: Optional[Mesh] = None,
         return None
     with open(os.path.join(step_dir, "manifest.json")) as f:
         manifest = json.load(f)
-    store = _ShardStore(step_dir)
+    store = _ShardStore(step_dir, manifest.get("n_processes"))
     flat_shardings = _flatten(shardings) if shardings else {}
 
     out = {}
@@ -272,18 +280,23 @@ _STEP_DIR_RE = re.compile(r"^step-(\d+)$")
 
 
 def save_train_state(directory: str, params, opt_state, step: int,
-                     extra_meta: Optional[dict] = None) -> str:
-    """Snapshot params + optimizer state + the host rng stream, so a resumed
-    run reproduces the uninterrupted one even with dropout active."""
+                     extra_meta: Optional[dict] = None,
+                     optimizer=None) -> str:
+    """Snapshot params + optimizer state + the host rng stream + the LR
+    scheduler state, so a resumed run reproduces the uninterrupted one even
+    with dropout and a warmup/decay schedule active."""
     from ..core import rng as _rng
     extra = dict(extra_meta or {})
     extra["__rng__"] = np.asarray(_rng.get_rng_state()).tolist()
+    sched = getattr(optimizer, "_lr_scheduler", None)
+    if sched is not None:
+        extra["__lr_sched__"] = sched.state_dict()
     return save_sharded({"params": params, "opt": opt_state}, directory,
                         step, extra)
 
 
 def apply_train_state(model, optimizer, restored):
-    """Write a restore_sharded result back into model/optimizer/rng.
+    """Write a restore_sharded result back into model/optimizer/rng/scheduler.
     Returns (meta_dict, opt_state_tree)."""
     from ..core import rng as _rng
     tree, step, extra = restored
@@ -294,6 +307,11 @@ def apply_train_state(model, optimizer, restored):
     rng_state = extra.pop("__rng__", None)
     if rng_state is not None:
         _rng.set_rng_state(jnp.asarray(rng_state, jnp.uint32))
+    sched_state = extra.pop("__lr_sched__", None)
+    if sched_state is not None:
+        sched = getattr(optimizer, "_lr_scheduler", None)
+        if sched is not None:
+            sched.set_state_dict(sched_state)
     return {"step": step, **extra}, tree["opt"]
 
 

@@ -220,6 +220,23 @@ class TrainStep:
         self._names = list(model.state_dict().keys())
         self._trainable = {k for k, v in model.state_dict().items()
                            if getattr(v, "trainable", False)}
+        # params flagged by Embedding(sparse=True): their grads flow as
+        # RowSparseGrad through the zeros-cotangent channel (selected_rows.py)
+        self._sparse = {k for k, v in model.state_dict().items()
+                        if getattr(v, "sparse_grad", False)}
+        self._sig_cache = {}
+        self._sparse_checked = False
+        if self._sparse:
+            by_obj = {}
+            for k, v in model.state_dict().items():
+                by_obj.setdefault(id(v), []).append(k)
+            for names in by_obj.values():
+                if len(names) > 1 and self._sparse.intersection(names):
+                    raise ValueError(
+                        f"Embedding(sparse=True) weight registered under "
+                        f"multiple names {names} (tied weight) — sparse "
+                        "grads would drop the other uses' gradients; use "
+                        "sparse=False")
         self._compiled = None
         self._opt_state = None
         self._remat = remat
@@ -235,6 +252,40 @@ class TrainStep:
         # structured param names let AdamW's apply_decay_param_fun work here
         decay = decay_flags(opt, trainable)
 
+        sparse_specs, sparse_names = {}, set()
+        if self._sparse:
+            # shape-probe pass: learn each sparse lookup's (n, width, dtype)
+            from ..core import selected_rows as sr
+            rec = sr.SparseGradContext("record")
+            with sr.use_ctx(rec):
+                jax.eval_shape(
+                    lambda s, b: self._forward_loss(
+                        s, b, jax.random.PRNGKey(0)),
+                    example_state, example_batch)
+            sparse_specs = rec.specs
+            # ctx keys carry the param's unique .name; map back to state keys
+            name_to_key = {getattr(v, "name", None) or k: k
+                           for k, v in self.model.state_dict().items()}
+            sparse_names = {name_to_key[sr.param_name(k)]
+                            for k in sparse_specs}
+
+            # misuse guard: error out (rather than silently drop grads) if a
+            # sparse weight is also consumed densely, e.g. by a tied LM head.
+            # The verdict is shape-independent — one probe trace suffices.
+            if not self._sparse_checked:
+                def probe(sparse_vals):
+                    zs = {k: jnp.zeros((n, w), dt)
+                          for k, (n, w, dt) in sparse_specs.items()}
+                    full = dict(example_state)
+                    full.update(sparse_vals)
+                    ctx = sr.SparseGradContext("apply", zeros=zs)
+                    with sr.use_ctx(ctx):
+                        return self._forward_loss(full, example_batch,
+                                                  jax.random.PRNGKey(0))
+                sr.check_embedding_only_use(
+                    probe, {k: example_state[k] for k in sparse_names})
+                self._sparse_checked = True
+
         def step(params, opt_state, step_no, lr, rng_key, batch):
             def loss_of(train_params):
                 full = dict(params)
@@ -248,7 +299,35 @@ class TrainStep:
                 opt, params, grads, opt_state, lr, step_no, decay)
             return new_params, new_opt, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        def step_sparse(params, opt_state, step_no, lr, rng_key, batch):
+            from ..core import selected_rows as sr
+            zeros = {k: jnp.zeros((n, w), dt)
+                     for k, (n, w, dt) in sparse_specs.items()}
+
+            def loss_of(train_params, zvals):
+                full = dict(params)
+                full.update(train_params)
+                ctx = sr.SparseGradContext("apply", zeros=zvals)
+                with sr.use_ctx(ctx):
+                    loss = self._forward_loss(full, batch, rng_key)
+                return loss, ctx.ids
+
+            train_params = {k: v for k, v in params.items()
+                            if k in trainable and k not in sparse_names}
+            loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
+            (loss, ids), (grads, zgrads) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1), has_aux=True)(train_params, zeros)
+            grads = dict(grads)
+            for key, zg in zgrads.items():
+                name = name_to_key[sr.param_name(key)]
+                rsg = sr.RowSparseGrad(ids[key], zg, params[name].shape)
+                grads[name] = (grads[name] + rsg) if name in grads else rsg
+            new_params, new_opt = apply_updates(
+                opt, params, grads, opt_state, lr, step_no, decay)
+            return new_params, new_opt, loss
+
+        return jax.jit(step_sparse if sparse_specs else step,
+                       donate_argnums=(0, 1))
 
     def init_opt_state(self, state):
         return {k: self.optimizer.init_state(v) for k, v in state.items()
@@ -258,6 +337,16 @@ class TrainStep:
         state = state_arrays(self.model)
         if self._opt_state is None:
             self._opt_state = self.init_opt_state(state)
+        if self._sparse:
+            # sparse lookup counts are baked into the compiled step, so each
+            # batch-shape signature needs its own build (the dense path just
+            # lets jax.jit retrace)
+            sig = tuple((tuple(unwrap(b).shape), str(unwrap(b).dtype))
+                        for b in batch)
+            self._compiled = self._sig_cache.get(sig)
+            if self._compiled is None:
+                self._compiled = self._sig_cache[sig] = self._build(
+                    state, self._opt_state, batch)
         if self._compiled is None:
             self._compiled = self._build(state, self._opt_state, batch)
         self.optimizer._step_count += 1
@@ -282,7 +371,7 @@ class TrainStep:
         return dck.save_train_state(
             directory, state, self._opt_state,
             step if step is not None else self.optimizer._step_count,
-            extra_meta)
+            extra_meta, optimizer=self.optimizer)
 
     def restore_checkpoint(self, directory):
         from ..distributed import checkpoint as dck

@@ -67,10 +67,16 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
-    """Utility form: clips .grad of parameters in place, returns total norm."""
+    """Utility form: clips .grad of parameters in place, returns total norm.
+    RowSparseGrad grads are densified first (global-norm clipping needs the
+    merged view — same restriction as the reference's sparse grads)."""
+    from ..core.selected_rows import RowSparseGrad
     params = [p for p in parameters if p.grad is not None]
     if not params:
         return Tensor(jnp.asarray(0.0))
+    for p in params:
+        if isinstance(p.grad, RowSparseGrad):
+            p.grad = Tensor(p.grad.to_dense(), stop_gradient=True)
     if norm_type == float("inf"):
         total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._data)) for p in params]))
     else:

@@ -64,9 +64,23 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    """Reference: operators/lookup_table_v2_op. `sparse` (SelectedRows grads)
-    is a no-op here: XLA handles gather/scatter-add grads densely and
-    efficiently on TPU."""
+    """Reference: operators/lookup_table_v2_op (+ its SelectedRows grad
+    kernel).  With `sparse=True` the weight's gradient is a
+    `core.selected_rows.RowSparseGrad` (lookup ids + per-lookup cotangents)
+    consumed by the optimizers' lazy row-wise update — O(lookups·width)
+    instead of densifying the full table every step.  Restriction (as in the
+    reference): a sparse weight must only be consumed via embedding lookups.
+    """
+    if sparse:
+        from ...core import selected_rows as sr
+        from ...core.tensor import is_grad_enabled
+        ctx = sr.current_ctx()
+        if ctx is not None:  # inside a TrainStep trace collecting sparse grads
+            return sr.ctx_embedding(ctx, x, weight, padding_idx)
+        if (isinstance(weight, Tensor) and is_grad_enabled()
+                and not weight.stop_gradient):
+            return sr.eager_sparse_embedding(x, weight, padding_idx)
+
     def raw(ids, w):
         out = jnp.take(w, ids.astype(jnp.int32), axis=0)
         if padding_idx is not None:

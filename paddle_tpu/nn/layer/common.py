@@ -90,6 +90,8 @@ class Embedding(Layer):
         self.weight = self.create_parameter(
             (num_embeddings, embedding_dim), attr=weight_attr,
             default_initializer=I.Normal(0.0, 1.0))
+        if sparse:
+            self.weight.sparse_grad = True
         if self.padding_idx is not None:
             self.weight._set_data(
                 self.weight._data.at[self.padding_idx].set(0.0))

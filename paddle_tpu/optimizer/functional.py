@@ -24,12 +24,24 @@ def apply_updates(optimizer, params: dict, grads: dict, opt_state: dict,
 
     Params without grads pass through unchanged.
     """
+    from ..core.selected_rows import RowSparseGrad
+    from .sparse import lazy_row_update
     wd = getattr(optimizer, "_wd", 0.0)
     dwd = getattr(optimizer, "_decoupled_wd", 0.0)
     new_params = dict(params)
     new_opt = dict(opt_state)
     for k, g in grads.items():
         p = params[k]
+        if isinstance(g, RowSparseGrad):
+            if not optimizer._elementwise_update:
+                g = g.to_dense()  # Lamb/Lars need full-tensor norms
+            else:
+                # SelectedRows path: lazy row-wise update (adam_op.h
+                # lazy_mode)
+                new_params[k], new_opt[k] = lazy_row_update(
+                    optimizer, p, g, opt_state[k], lr, step_no,
+                    decay.get(k, True), (lr_mults or {}).get(k, 1.0))
+                continue
         is_float = jnp.issubdtype(p.dtype, jnp.floating)
         db = decay.get(k, True)
         m = (lr_mults or {}).get(k, 1.0)

@@ -23,6 +23,12 @@ from .lr import LRScheduler
 class Optimizer:
     """Base optimizer with paddle's eager API (step/clear_grad/minimize)."""
 
+    # True when update_one is purely element-wise, which the lazy sparse
+    # row update requires (it feeds update_one a touched-rows slab, not the
+    # full parameter); Lamb/Lars compute full-tensor norms, so their sparse
+    # grads are densified instead.
+    _elementwise_update = True
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, multi_precision=False):
         if parameters is not None:
@@ -81,12 +87,44 @@ class Optimizer:
 
     # ---- eager step ---------------------------------------------------------
     def step(self):
+        from ..core.selected_rows import RowSparseGrad
         params = [p for p in self._parameter_list
                   if p.trainable and p.grad is not None]
         if not params:
             self._step_count += 1
             return
         grads = [p.grad for p in params]
+        # SelectedRows grads take the lazy row-wise path; a grad_clip
+        # densifies them first (the reference likewise forbids global-norm
+        # clipping over sparse grads).
+        sparse = [(i, g) for i, g in enumerate(grads)
+                  if isinstance(g, RowSparseGrad)]
+        if sparse and (self._grad_clip is not None
+                       or not self._elementwise_update):
+            for i, g in sparse:
+                grads[i] = Tensor(g.to_dense(), stop_gradient=True)
+            sparse = []
+        if sparse:
+            from .sparse import lazy_row_update
+            lr = jnp.asarray(self.get_lr(), jnp.float32)
+            step = jnp.asarray(self._step_count + 1, jnp.int32)
+            for i, g in sorted(sparse, reverse=True):
+                p = params[i]
+                db = self._decay_applies(p.name)
+                m = float(p.optimize_attr.get("learning_rate", 1.0))
+                key = ("sparse", db, m)
+                fn = self._jit_cache.get(key)
+                if fn is None:
+                    fn = self._jit_cache[key] = jax.jit(
+                        lambda pv, gv, sv, lrv, stv, _db=db, _m=m:
+                        lazy_row_update(self, pv, gv, sv, lrv, stv, _db, _m))
+                new_p, ns = fn(p._data, g, self._get_state(p), lr, step)
+                p._set_data(new_p)
+                self._states[id(p)] = ns
+                del params[i], grads[i]
+            if not params:
+                self._step_count += 1
+                return
         if self._grad_clip is not None:
             pg = self._grad_clip(list(zip(params, grads)))
             grads = [g for _, g in pg]
@@ -356,6 +394,8 @@ class Adadelta(Optimizer):
 class Lamb(Optimizer):
     """Layer-wise adaptive moments (reference: operators/optimizers/lamb_op)."""
 
+    _elementwise_update = False  # trust ratio needs full-tensor norms
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, name=None):
@@ -386,6 +426,8 @@ class Lamb(Optimizer):
 
 class LarsMomentum(Optimizer):
     """reference: operators/optimizers/lars_momentum_op."""
+
+    _elementwise_update = False  # local lr needs full-tensor norms
 
     def __init__(self, learning_rate=0.001, momentum=0.9,
                  lars_coeff=0.001, lars_weight_decay=0.0005, parameters=None,

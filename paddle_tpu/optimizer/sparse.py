@@ -1,0 +1,74 @@
+"""Lazy row-wise optimizer update for RowSparseGrad params.
+
+Reference: paddle/fluid/operators/optimizers/adam_op.h:1 (lazy_mode — only
+rows present in the SelectedRows grad get their moments/param updated) and
+paddle/fluid/operators/math/selected_rows_functor.cc (scatter::MergeAdd).
+
+TPU-native: `merge_rows` segment-sums duplicate lookup ids into static-shape
+buffers (invalid tail slots get an out-of-range sentinel id), then the update
+gathers only the touched param/state rows, runs the optimizer's scalar-free
+`update_one` on the (N, width) slab, and scatters back with `mode="drop"` so
+sentinel rows vanish.  Work is O(lookups·width), not O(height·width).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.selected_rows import RowSparseGrad
+
+
+def merge_rows(rows, values, height: int):
+    """SelectedRows MergeAdd: sum duplicate row entries.
+
+    Returns (uids, summed): uids (N,) int32 where the first k slots hold the
+    unique row ids and the rest the sentinel `height`; summed (N, width) f32
+    holds the per-unique-row gradient sums in the matching slots.
+    """
+    n = rows.shape[0]
+    order = jnp.argsort(rows)
+    sr = rows[order]
+    sv = values[order].astype(jnp.float32)
+    is_rep = jnp.concatenate(
+        [jnp.ones((1,), bool), sr[1:] != sr[:-1]]) if n > 1 else \
+        jnp.ones((n,), bool)
+    seg = jnp.cumsum(is_rep) - 1
+    summed = jax.ops.segment_sum(sv, seg, num_segments=n)
+    uids = jax.ops.segment_max(sr, seg, num_segments=n)
+    valid = jnp.arange(n) < seg[-1] + 1
+    uids = jnp.where(valid, uids, height)
+    return uids.astype(jnp.int32), summed
+
+
+def _row_leaf(s, height: int) -> bool:
+    return (hasattr(s, "shape") and getattr(s, "ndim", 0) >= 1
+            and s.shape[0] == height)
+
+
+def lazy_row_update(optimizer, p, grad: RowSparseGrad, state, lr, step_no,
+                    decay_flag: bool = True, lr_mult: float = 1.0):
+    """Pure: (new_param, new_state) touching only the grad's rows."""
+    height = p.shape[0]
+    uids, g = merge_rows(grad.rows, grad.values, height)
+    safe = jnp.clip(uids, 0, height - 1)
+
+    p_rows = p[safe]
+    state_rows = jax.tree_util.tree_map(
+        lambda s: s[safe] if _row_leaf(s, height) else s, state)
+
+    wd = getattr(optimizer, "_wd", 0.0)
+    dwd = getattr(optimizer, "_decoupled_wd", 0.0)
+    if wd and decay_flag:
+        g = g + wd * p_rows.astype(jnp.float32)
+    new_rows, ns_rows = optimizer.update_one(p_rows, g, state_rows,
+                                             lr * lr_mult, step_no)
+    if dwd and decay_flag:
+        new_rows = (new_rows.astype(jnp.float32)
+                    - lr * lr_mult * dwd * p_rows.astype(jnp.float32)
+                    ).astype(p_rows.dtype)
+
+    new_p = p.at[uids].set(new_rows.astype(p.dtype), mode="drop")
+    new_state = jax.tree_util.tree_map(
+        lambda s, ns: s.at[uids].set(ns, mode="drop")
+        if _row_leaf(s, height) else ns, state, ns_rows)
+    return new_p, new_state

@@ -206,7 +206,7 @@ class ShardedTrainStep:
         return dck.save_train_state(
             directory, state, self._opt_state,
             step if step is not None else self.optimizer._step_count,
-            extra_meta)
+            extra_meta, optimizer=self.optimizer)
 
     def restore_checkpoint(self, directory: str) -> Optional[dict]:
         """Restore the newest checkpoint onto this step's shardings; resumes

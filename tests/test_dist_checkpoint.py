@@ -201,3 +201,43 @@ def test_train_epoch_range_resumes(tmp_path):
     assert done == [0, 1, 2]
     resumed = list(dck.train_epoch_range(5, dck.CheckpointManager(str(tmp_path))))
     assert resumed == [3, 4]
+
+
+def test_lr_scheduler_state_survives_resume(tmp_path):
+    """A resumed run must continue the LR schedule, not restart warmup."""
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.optimizer import lr as lr_mod
+
+    def make():
+        paddle.seed(0)
+        model, crit = _gpt_tiny()
+        sched = lr_mod.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        opt = paddle.optimizer.Adam(learning_rate=sched,
+                                    parameters=model.parameters())
+        return TrainStep(model, lambda l, y: crit(l, y), opt), sched
+
+    batches = _batches(5)
+    step, sched = make()
+    for ids, lbl in batches:
+        step(paddle.to_tensor(ids), paddle.to_tensor(lbl))
+        sched.step()
+    step.save_checkpoint(str(tmp_path), step=5)
+    lr_before = sched()
+
+    step2, sched2 = make()
+    meta = step2.restore_checkpoint(str(tmp_path))
+    assert meta["step"] == 5
+    assert sched2.last_epoch == sched.last_epoch
+    assert abs(sched2() - lr_before) < 1e-12
+
+
+def test_restore_ignores_stale_higher_numbered_shards(tmp_path):
+    """A re-save from fewer processes must not overlay stale shard files."""
+    tree = {"w": jnp.arange(8.0)}
+    dck.save_sharded(tree, str(tmp_path), step=7)
+    step_dir = os.path.join(str(tmp_path), "step-000000007")
+    # forge a stale shard file from a previous higher-process-count save
+    np.savez(os.path.join(step_dir, "shards-p00003.npz"),
+             **{"w@0": np.full(8, 999.0, np.float32)})
+    out, step, _ = dck.restore_sharded(str(tmp_path))
+    np.testing.assert_allclose(np.asarray(out["w"]), np.arange(8.0))

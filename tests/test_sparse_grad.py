@@ -1,0 +1,264 @@
+"""Sparse (SelectedRows-equivalent) embedding gradient tests.
+
+Reference pattern: unittests/test_lookup_table_v2_op.py (sparse grad path)
+and test_adam_op.py lazy-mode cases.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core.selected_rows import RowSparseGrad
+from paddle_tpu.optimizer.sparse import merge_rows
+
+V, H = 20, 8
+
+
+def _ids(shape=(4, 3), high=V, seed=0):
+    rng = np.random.RandomState(seed)
+    return paddle.to_tensor(rng.randint(0, high, shape).astype("int64"))
+
+
+def test_eager_sparse_grad_is_row_sparse_and_matches_dense():
+    w_np = np.random.RandomState(1).randn(V, H).astype("float32")
+    ids = _ids()
+
+    # dense reference
+    wd = paddle.core.tensor.Parameter(paddle.to_tensor(w_np)._data, name="wd")
+    out = F.embedding(ids, wd, sparse=False)
+    (out * out).sum().backward()
+    dense_grad = np.asarray(wd.grad.numpy())
+
+    ws = paddle.core.tensor.Parameter(paddle.to_tensor(w_np)._data, name="ws")
+    out = F.embedding(ids, ws, sparse=True)
+    (out * out).sum().backward()
+    assert isinstance(ws.grad, RowSparseGrad)
+    assert ws.grad.rows.shape == (12,)
+    np.testing.assert_allclose(np.asarray(ws.grad.to_dense()), dense_grad,
+                               rtol=1e-6)
+
+
+def test_padding_idx_rows_get_zero_grad():
+    w_np = np.random.RandomState(1).randn(V, H).astype("float32")
+    ids = paddle.to_tensor(np.array([[0, 3, 3, 5]], dtype="int64"))
+    w = paddle.core.tensor.Parameter(paddle.to_tensor(w_np)._data, name="w")
+    out = F.embedding(ids, w, padding_idx=3, sparse=True)
+    out.sum().backward()
+    g = np.asarray(w.grad.to_dense())
+    assert np.all(g[3] == 0)
+    assert np.all(g[0] == 1) and np.all(g[5] == 1)
+
+
+def test_merge_rows_sums_duplicates():
+    rows = paddle.to_tensor(np.array([5, 2, 5, 2, 7], "int64"))._data
+    vals = paddle.to_tensor(
+        np.arange(10, dtype="float32").reshape(5, 2))._data
+    uids, summed = merge_rows(rows, vals, V)
+    uids, summed = np.asarray(uids), np.asarray(summed)
+    got = {int(r): summed[i].tolist() for i, r in enumerate(uids) if r < V}
+    assert got == {2: [8.0, 10.0], 5: [4.0, 6.0], 7: [8.0, 9.0]}
+    # invalid tail slots carry the out-of-range sentinel
+    assert sorted(uids)[-2:] == [V, V]
+
+
+def _one_step(sparse, ids_np, lr=0.1, steps=1, seed=3):
+    paddle.seed(0)
+    w_np = np.random.RandomState(seed).randn(V, H).astype("float32")
+    emb = nn.Embedding(V, H, sparse=sparse)
+    emb.weight._set_data(paddle.to_tensor(w_np)._data)
+    o = paddle.optimizer.Adam(lr, parameters=emb.parameters())
+    for step_ids in ids_np:
+        out = emb(paddle.to_tensor(step_ids))
+        (out * out).sum().backward()
+        o.step()
+        o.clear_grad()
+    return np.asarray(emb.weight.numpy())
+
+
+def test_lazy_adam_first_step_matches_dense():
+    ids = [np.array([[1, 4, 4, 9]], dtype="int64")]
+    np.testing.assert_allclose(_one_step(True, ids), _one_step(False, ids),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lazy_adam_skips_untouched_rows():
+    """Lazy semantics: a row touched at step 1 but not step 2 keeps its
+    step-1 value under sparse (dense Adam would keep moving it via moments)."""
+    step1 = [np.array([[1, 4]], dtype="int64")]
+    step2 = step1 + [np.array([[4, 9]], dtype="int64")]
+    w1 = _one_step(True, step1)
+    w2 = _one_step(True, step2)
+    np.testing.assert_allclose(w2[1], w1[1], rtol=0, atol=0)  # untouched
+    assert np.abs(w2[4] - w1[4]).max() > 0  # touched again: moved
+    # dense comparison: row 1 *does* move at step 2
+    d2 = _one_step(False, step2)
+    assert np.abs(d2[1] - w1[1]).max() > 0
+
+
+class TinyLM(nn.Layer):
+    def __init__(self, sparse):
+        super().__init__()
+        self.emb = nn.Embedding(V, H, sparse=sparse)
+        self.fc = nn.Linear(H, V)
+
+    def forward(self, ids):
+        return self.fc(self.emb(ids))
+
+
+def _train_step_run(sparse, n_steps=3):
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    model = TinyLM(sparse)
+    loss_fn = lambda logits, label: F.cross_entropy(  # noqa: E731
+        logits.reshape([-1, V]), label.reshape([-1]))
+    o = paddle.optimizer.Adam(0.05, parameters=model.parameters())
+    step = TrainStep(model, loss_fn, o)
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(n_steps):
+        ids = paddle.to_tensor(rng.randint(0, V, (4, 6)).astype("int64"))
+        labels = paddle.to_tensor(rng.randint(0, V, (4, 6)).astype("int64"))
+        losses.append(float(step(ids, labels)))
+    return losses, {k: np.asarray(v.numpy())
+                    for k, v in model.state_dict().items()}
+
+
+def test_train_step_sparse_first_step_matches_dense_and_learns():
+    ls, ps = _train_step_run(True, n_steps=1)
+    ld, pd = _train_step_run(False, n_steps=1)
+    assert abs(ls[0] - ld[0]) < 1e-5
+    for k in ps:
+        np.testing.assert_allclose(ps[k], pd[k], rtol=2e-4, atol=2e-5,
+                                   err_msg=k)
+    losses, _ = _train_step_run(True, n_steps=6)
+    assert losses[-1] < losses[0]
+
+
+def test_train_step_sparse_with_remat():
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    model = TinyLM(True)
+    loss_fn = lambda logits, label: F.cross_entropy(  # noqa: E731
+        logits.reshape([-1, V]), label.reshape([-1]))
+    o = paddle.optimizer.Adam(0.05, parameters=model.parameters())
+    step = TrainStep(model, loss_fn, o, remat=True)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, V, (4, 6)).astype("int64"))
+    labels = paddle.to_tensor(rng.randint(0, V, (4, 6)).astype("int64"))
+    l0 = float(step(ids, labels))
+    l1 = float(step(ids, labels))
+    assert np.isfinite(l0) and l1 < l0
+
+
+def test_sparse_grad_accumulates_across_backwards():
+    w_np = np.random.RandomState(1).randn(V, H).astype("float32")
+    w = paddle.core.tensor.Parameter(paddle.to_tensor(w_np)._data, name="w")
+    ids1 = paddle.to_tensor(np.array([[1, 2]], dtype="int64"))
+    ids2 = paddle.to_tensor(np.array([[2, 3]], dtype="int64"))
+    F.embedding(ids1, w, sparse=True).sum().backward()
+    F.embedding(ids2, w, sparse=True).sum().backward()
+    g = np.asarray(w.grad.to_dense())
+    assert np.all(g[1] == 1) and np.all(g[2] == 2) and np.all(g[3] == 1)
+
+
+def test_train_step_sparse_handles_changed_batch_shape():
+    """Partial final batches must rebuild the sparse step, not crash."""
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    model = TinyLM(True)
+    loss_fn = lambda logits, label: F.cross_entropy(  # noqa: E731
+        logits.reshape([-1, V]), label.reshape([-1]))
+    o = paddle.optimizer.Adam(0.05, parameters=model.parameters())
+    step = TrainStep(model, loss_fn, o)
+    rng = np.random.RandomState(0)
+    for shape in [(4, 6), (2, 6), (4, 6)]:
+        ids = paddle.to_tensor(rng.randint(0, V, shape).astype("int64"))
+        lbl = paddle.to_tensor(rng.randint(0, V, shape).astype("int64"))
+        assert np.isfinite(float(step(ids, lbl)))
+
+
+def test_paddle_grad_returns_row_sparse():
+    w_np = np.random.RandomState(1).randn(V, H).astype("float32")
+    w = paddle.core.tensor.Parameter(paddle.to_tensor(w_np)._data, name="w")
+    ids = _ids()
+    from paddle_tpu.autograd import grad
+    out = F.embedding(ids, w, sparse=True)
+    g = grad(out.sum(), [w])[0]
+    assert isinstance(g, RowSparseGrad)
+    dense = np.asarray(g.to_dense())
+    assert dense.sum() == pytest.approx(12 * H)
+
+
+class TiedLM(nn.Layer):
+    """Misuse case: sparse embedding weight also consumed by a tied head."""
+    def __init__(self):
+        super().__init__()
+        self.emb = nn.Embedding(V, H, sparse=True)
+
+    def forward(self, ids):
+        from paddle_tpu.tensor.linalg import matmul
+        h = self.emb(ids)
+        return matmul(h, self.emb.weight, transpose_y=True)
+
+
+def test_train_step_rejects_tied_sparse_weight():
+    from paddle_tpu.jit import TrainStep
+    paddle.seed(0)
+    model = TiedLM()
+    loss_fn = lambda logits, label: F.cross_entropy(  # noqa: E731
+        logits.reshape([-1, V]), label.reshape([-1]))
+    o = paddle.optimizer.Adam(0.05, parameters=model.parameters())
+    step = TrainStep(model, loss_fn, o)
+    ids = paddle.to_tensor(np.zeros((2, 4), dtype="int64"))
+    with pytest.raises(ValueError, match="sparse"):
+        step(ids, ids)
+
+
+def test_grad_scaler_unscales_sparse_grads():
+    from paddle_tpu import amp
+    paddle.seed(0)
+    emb = nn.Embedding(V, H, sparse=True)
+    o = paddle.optimizer.Adam(0.1, parameters=emb.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=8.0)
+    out = emb(paddle.to_tensor(np.array([[1, 2]], dtype="int64")))
+    scaler.scale(out.sum()).backward()
+    scaler.unscale_(o)
+    assert isinstance(emb.weight.grad, RowSparseGrad)
+    np.testing.assert_allclose(np.asarray(emb.weight.grad.values), 1.0)
+    assert not scaler._found_inf
+
+
+def test_clip_grad_norm_densifies_sparse():
+    from paddle_tpu.nn.clip import clip_grad_norm_
+    emb = nn.Embedding(V, H, sparse=True)
+    out = emb(paddle.to_tensor(np.array([[1, 2]], dtype="int64")))
+    out.sum().backward()
+    total = clip_grad_norm_(emb.parameters(), max_norm=1.0)
+    assert float(total) > 0
+    g = emb.weight.grad
+    assert not isinstance(g, RowSparseGrad)
+
+
+def test_gradient_accessor_densifies():
+    emb = nn.Embedding(V, H, sparse=True)
+    out = emb(paddle.to_tensor(np.array([[1, 2]], dtype="int64")))
+    out.sum().backward()
+    g = emb.weight.gradient
+    assert isinstance(g, np.ndarray) and g.shape == (V, H)
+
+
+def test_lamb_densifies_sparse_and_matches_dense():
+    """Optimizers with full-tensor norms (Lamb) must not take the lazy
+    row path — their sparse grads densify and match dense training."""
+    def run(sparse):
+        paddle.seed(0)
+        w_np = np.random.RandomState(3).randn(V, H).astype("float32")
+        emb = nn.Embedding(V, H, sparse=sparse)
+        emb.weight._set_data(paddle.to_tensor(w_np)._data)
+        o = paddle.optimizer.Lamb(0.1, parameters=emb.parameters())
+        out = emb(paddle.to_tensor(np.array([[1, 4, 4, 9]], dtype="int64")))
+        (out * out).sum().backward()
+        o.step()
+        return np.asarray(emb.weight.numpy())
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5, atol=1e-6)
