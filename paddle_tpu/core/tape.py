@@ -123,16 +123,21 @@ def _deposit(t: Tensor, raw_grad, accumulate, wanted, results):
     if t.stop_gradient:
         return
     if isinstance(raw_grad, RowSparseGrad):
-        # SelectedRows grad: stored as-is on .grad (reference keeps the
-        # sparse rep on the VarBase grad too); hooks don't apply
-        if t.grad is None or not accumulate:
-            t.grad = raw_grad
-        elif isinstance(t.grad, RowSparseGrad):
-            t.grad = t.grad + raw_grad
+        if t._hooks:
+            # hooks operate on dense Tensors: densify so registered hooks
+            # keep firing (costs the sparsity, preserves semantics)
+            raw_grad = raw_grad.to_dense()
         else:
-            t.grad = Tensor(t.grad._data + raw_grad.to_dense(),
-                            stop_gradient=True)
-        return
+            # SelectedRows grad: stored as-is on .grad (reference keeps the
+            # sparse rep on the VarBase grad too)
+            if t.grad is None or not accumulate:
+                t.grad = raw_grad
+            elif isinstance(t.grad, RowSparseGrad):
+                t.grad = t.grad + raw_grad
+            else:
+                t.grad = Tensor(t.grad._data + raw_grad.to_dense(),
+                                stop_gradient=True)
+            return
     if t._hooks:
         for hook in t._hooks:
             new = hook(wrap(raw_grad))

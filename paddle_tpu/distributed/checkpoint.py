@@ -144,6 +144,14 @@ def save_sharded(state_tree, directory: str, step: int = 0,
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices(f"paddle_tpu-ckpt-{step}")
         if pidx == 0:
+            # scrub stale shards from an earlier save with more processes
+            # BEFORE publishing the manifest, so readers without the
+            # n_processes filter can't overlay them
+            n = jax.process_count()
+            for f in os.listdir(step_dir):
+                if (f.startswith("shards-p") and f.endswith(".npz")
+                        and int(f[len("shards-p"):-len(".npz")]) >= n):
+                    os.unlink(os.path.join(step_dir, f))
             _write_atomic(os.path.join(step_dir, "manifest.json"),
                           json.dumps(manifest))
     if pidx == 0:

@@ -238,6 +238,7 @@ class TrainStep:
                         "grads would drop the other uses' gradients; use "
                         "sparse=False")
         self._compiled = None
+        self._compiled_multi = None
         self._opt_state = None
         self._remat = remat
 
@@ -332,6 +333,74 @@ class TrainStep:
     def init_opt_state(self, state):
         return {k: self.optimizer.init_state(v) for k, v in state.items()
                 if k in self._trainable}
+
+    def _build_multi(self, example_state, example_opt, example_stacked):
+        """K optimizer steps per compiled call via lax.scan over stacked
+        batches (leaves shaped (K, ...)).
+
+        The TPU-native analogue of the reference's dataset trainers running
+        the train loop inside the C++ executor (train_from_dataset,
+        framework/trainer.h): host round-trips per step become one dispatch
+        per K steps.  lr is held constant within a call (schedulers advance
+        between calls)."""
+        from ..optimizer.functional import apply_updates, decay_flags
+        opt = self.optimizer
+        trainable = self._trainable
+        decay = decay_flags(opt, trainable)
+
+        def multi(params, opt_state, step_no0, lr, rng_key, stacked):
+            def body(carry, xs):
+                params, opt_state, i = carry
+                key = jax.random.fold_in(rng_key, i)
+
+                def loss_of(train_params):
+                    full = dict(params)
+                    full.update(train_params)
+                    return self._forward_loss(full, xs, key)
+
+                train_params = {k: v for k, v in params.items()
+                                if k in trainable}
+                loss_fn = (jax.checkpoint(loss_of) if self._remat
+                           else loss_of)
+                loss, grads = jax.value_and_grad(loss_fn)(train_params)
+                new_params, new_opt = apply_updates(
+                    opt, params, grads, opt_state, lr, step_no0 + i, decay)
+                return (new_params, new_opt, i + 1), loss
+
+            (params, opt_state, _), losses = jax.lax.scan(
+                body, (params, opt_state, jnp.int32(0)), stacked)
+            return params, opt_state, losses
+
+        return jax.jit(multi, donate_argnums=(0, 1))
+
+    def run_steps(self, *stacked_batch):
+        """Run K train steps in ONE compiled call.
+
+        Each arg is a stacked batch whose leading axis K is the step count
+        (e.g. ids of shape (K, batch, seq)).  Returns the (K,) per-step loss
+        array.  Not supported together with Embedding(sparse=True)."""
+        if self._sparse:
+            raise NotImplementedError(
+                "run_steps with sparse embedding grads: use per-call steps")
+        state = state_arrays(self.model)
+        if self._opt_state is None:
+            self._opt_state = self.init_opt_state(state)
+        raw = tuple(unwrap(b) for b in stacked_batch)
+        k_steps = raw[0].shape[0]
+        if self._compiled_multi is None:
+            self._compiled_multi = self._build_multi(
+                state, self._opt_state, raw)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        step_no0 = jnp.asarray(self.optimizer._step_count + 1, jnp.int32)
+        from ..core import rng as _rng
+        rng_key = _rng.next_key()
+        new_state, self._opt_state, losses = self._compiled_multi(
+            state, self._opt_state, step_no0, lr, rng_key, raw)
+        self.optimizer._step_count += k_steps
+        sd = self.model.state_dict()
+        for k, v in new_state.items():
+            sd[k]._set_data(v)
+        return Tensor(losses)
 
     def __call__(self, *batch):
         state = state_arrays(self.model)

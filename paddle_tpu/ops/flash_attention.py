@@ -75,35 +75,75 @@ def flash_attention_bshd(q, k, v, causal=False, bias=None, q_segment_ids=None,
         return None
     if (q_segment_ids is None) != (kv_segment_ids is None):
         return None
-    try:
-        qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-        kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
-        vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
-        # reshape mask inputs so every pallas block satisfies the TPU tiling
-        # rule (last two dims divisible by (8,128) or equal to the array's):
-        # per-key vectors ride the lane axis as (B, 1, Sk), per-query ids the
-        # sublane axis as (B, Sq, 1)
-        if bias is not None:
-            bias = bias.astype(jnp.float32)[:, None, :]
-        if q_segment_ids is not None:
-            q_segment_ids = q_segment_ids.astype(jnp.int32)[:, :, None]
-        if kv_segment_ids is not None:
-            kv_segment_ids = kv_segment_ids.astype(jnp.int32)[:, None, :]
-        if dropout_seed is None:
-            dropout_seed = jnp.zeros((1,), jnp.int32)
-        out = _flash(qt, kt, vt, bias, q_segment_ids, kv_segment_ids,
-                     dropout_seed, bool(causal), float(dropout_p), h)
-        return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
-    except Exception:
-        return None
+    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
+    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
+    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    # reshape mask inputs so every pallas block satisfies the TPU tiling
+    # rule (last two dims divisible by (8,128) or equal to the array's):
+    # per-key vectors ride the lane axis as (B, 1, Sk), per-query ids the
+    # sublane axis as (B, Sq, 1)
+    if bias is not None:
+        bias = bias.astype(jnp.float32)[:, None, :]
+    if q_segment_ids is not None:
+        q_segment_ids = q_segment_ids.astype(jnp.int32)[:, :, None]
+    if kv_segment_ids is not None:
+        kv_segment_ids = kv_segment_ids.astype(jnp.int32)[:, None, :]
+    if dropout_seed is None:
+        dropout_seed = jnp.zeros((1,), jnp.int32)
+    if dropout_p > 0.0:
+        _hw_prng_available()  # resolve the bit-source before kernel trace
+    out = _flash(qt, kt, vt, bias, q_segment_ids, kv_segment_ids,
+                 dropout_seed, bool(causal), float(dropout_p), h)
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
 
 
 # ---------------------------------------------------------------------------
-# in-kernel dropout: murmur3-finalizer hash of absolute coordinates
+# in-kernel dropout.
+#
+# On TPU: the hardware PRNG, re-seeded per (seed, bh, qi, ki) block so the
+# keep mask is identical wherever the block is recomputed (fwd kernel and the
+# merged bwd kernel iterate blocks in different grid orders).
+# Under interpret=True (CPU CI): a murmur3-style hash of absolute
+# coordinates — the TPU PRNG primitives don't run in the interpreter.
 
 
-def _keep_mask(seed_ref, bh, rows, cols, dropout_p):
-    """Deterministic per-(seed, head, row, col) keep mask, tiling-independent."""
+_HW_PRNG: bool = None  # lazily probed: does this backend lower pltpu.prng_*?
+
+
+def _hw_prng_available() -> bool:
+    """Compile-probe the TPU PRNG primitives once; fall back to the hash
+    bit-source (which lowers everywhere) if they don't lower here."""
+    global _HW_PRNG
+    if _HW_PRNG is None:
+        if _INTERPRET:
+            return False
+        try:
+            def _probe_kernel(s_ref, o_ref):
+                pltpu.prng_seed(s_ref[0], jnp.int32(1))
+                o_ref[...] = pltpu.prng_random_bits((8, 128)).astype(
+                    jnp.int32)
+            out = pl.pallas_call(
+                _probe_kernel,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.int32),
+            )(jnp.zeros((1,), jnp.int32))
+            jax.block_until_ready(out)
+            _HW_PRNG = True
+        except Exception:
+            _HW_PRNG = False
+    return _HW_PRNG
+
+
+def _keep_mask(seed_ref, bh, qi, ki, blk_q, blk_k, dropout_p):
+    thresh = min(int(dropout_p * 4294967296.0), 4294967295)
+    if not _INTERPRET and _HW_PRNG:
+        # hardware seeding takes at most 2 words: pack (seed, bh) and
+        # (qi, ki) — grid coords are far below 2^15 so the pair is unique.
+        # -1640531615 == 0x9E3779B1 as int32
+        pltpu.prng_seed(seed_ref[0] + bh * jnp.int32(-1640531615),
+                        qi * jnp.int32(0x10001) + ki)
+        bits = pltpu.prng_random_bits((blk_q, blk_k))
+        return bits.astype(jnp.uint32) >= jnp.uint32(thresh)
+    rows, cols = _coords(qi, ki, blk_q, blk_k)
     x = (rows.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
          ^ cols.astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
     x = x ^ (seed_ref[0].astype(jnp.uint32)
@@ -113,7 +153,6 @@ def _keep_mask(seed_ref, bh, rows, cols, dropout_p):
     x = x ^ (x >> 15)
     x = x * jnp.uint32(0x846CA68B)
     x = x ^ (x >> 16)
-    thresh = min(int(dropout_p * 4294967296.0), 4294967295)
     return x >= jnp.uint32(thresh)
 
 
@@ -197,8 +236,7 @@ def _fwd_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
         l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         m_ref[...] = m_cur
         if dropout_p > 0.0:
-            rows, cols = _coords(qi, ki, blk_q, blk_k)
-            keep = _keep_mask(seed_ref, bh, rows, cols, dropout_p)
+            keep = _keep_mask(seed_ref, bh, qi, ki, blk_q, blk_k, dropout_p)
             p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
             p.astype(v_ref.dtype), v_ref[0],
@@ -271,65 +309,26 @@ def _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads):
 
 
 # ---------------------------------------------------------------------------
-# backward (FlashAttention-2 recompute scheme)
+# backward: ONE merged kernel (FlashAttention-2 recompute scheme).
+#
+# The score block s and p = exp(s - lse) are recomputed once per (k,q) block
+# and feed dq, dk AND dv — half the exp/mask/dropout recompute of the classic
+# two-kernel (dq grid / dkv grid) split.  dk/dv accumulate in VMEM across the
+# inner q axis; dq cannot (its output block is revisited non-consecutively on
+# TPU), so each grid step writes a per-k-block dq partial and XLA sums the
+# n_k partials afterwards — free when n_k == 1, O(n_k · |dq|) HBM otherwise,
+# still far cheaper than a second score recompute pass.
 
 
-def _bwd_dq_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
-                   blk_q, blk_k, n_k, scale, causal_off):
+def _bwd_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
+                blk_q, blk_k, n_q, scale, causal_off):
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
         next(it), next(it), next(it), next(it), next(it), next(it))
     bias_ref = next(it) if has_bias else None
     qseg_ref = next(it) if has_seg else None
     kseg_ref = next(it) if has_seg else None
-    dq_ref = next(it)
-    dq_acc = next(it)
-
-    bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-
-    @pl.when(ki == 0)
-    def _init():
-        dq_acc[...] = jnp.zeros_like(dq_acc)
-
-    def _compute():
-        s = _masked_scores(q_ref, k_ref, bias_ref, qseg_ref, kseg_ref,
-                           qi, ki, blk_q, blk_k, scale, causal, causal_off)
-        p = jnp.exp(s - lse_ref[0])
-        dpd = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if dropout_p > 0.0:
-            rows, cols = _coords(qi, ki, blk_q, blk_k)
-            keep = _keep_mask(seed_ref, bh, rows, cols, dropout_p)
-            dp = jnp.where(keep, dpd * (1.0 / (1.0 - dropout_p)), 0.0)
-        else:
-            dp = dpd
-        ds = p * (dp - delta_ref[0])
-        dq_acc[...] += jax.lax.dot(
-            ds.astype(k_ref.dtype), k_ref[0],
-            preferred_element_type=jnp.float32) * scale
-
-    if causal:
-        @pl.when(qi * blk_q + blk_q - 1 + causal_off >= ki * blk_k)
-        def _go():
-            _compute()
-    else:
-        _compute()
-
-    @pl.when(ki == n_k - 1)
-    def _finish():
-        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
-                    blk_q, blk_k, n_q, scale, causal_off):
-    it = iter(refs)
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
-        next(it), next(it), next(it), next(it), next(it), next(it))
-    bias_ref = next(it) if has_bias else None
-    qseg_ref = next(it) if has_seg else None
-    kseg_ref = next(it) if has_seg else None
-    dk_ref, dv_ref = next(it), next(it)
+    dqp_ref, dk_ref, dv_ref = next(it), next(it), next(it)
     dbias_ref = next(it) if has_bias else None
     dk_acc, dv_acc = next(it), next(it)
     db_acc = next(it) if has_bias else None
@@ -351,8 +350,7 @@ def _bwd_dkv_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
             do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         if dropout_p > 0.0:
-            rows, cols = _coords(qi, ki, blk_q, blk_k)
-            keep = _keep_mask(seed_ref, bh, rows, cols, dropout_p)
+            keep = _keep_mask(seed_ref, bh, qi, ki, blk_q, blk_k, dropout_p)
             inv = 1.0 / (1.0 - dropout_p)
             pd = jnp.where(keep, p * inv, 0.0)
             dp = jnp.where(keep, dpd * inv, 0.0)
@@ -367,11 +365,20 @@ def _bwd_dkv_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
             preferred_element_type=jnp.float32) * scale
         if has_bias:  # d(bias_k) = sum over q rows of dS (heads summed later)
             db_acc[...] += jnp.sum(ds, axis=0, keepdims=True)
+        dqp_ref[0, 0] = (jax.lax.dot(
+            ds.astype(k_ref.dtype), k_ref[0],
+            preferred_element_type=jnp.float32) * scale).astype(dqp_ref.dtype)
 
     if causal:
-        @pl.when(qi * blk_q + blk_q - 1 + causal_off >= ki * blk_k)
+        cond = qi * blk_q + blk_q - 1 + causal_off >= ki * blk_k
+
+        @pl.when(cond)
         def _go():
             _compute()
+
+        @pl.when(jnp.logical_not(cond))
+        def _zero():  # this (k,q) partial must still be defined
+            dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
     else:
         _compute()
 
@@ -395,43 +402,8 @@ def _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, do,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)  # (bh, sq, 1)
 
-    base_specs = [
-        pl.BlockSpec((1, blk_q, d), lambda b, i, j, s: (b, i, 0)),   # q
-        pl.BlockSpec((1, blk_k, d), lambda b, i, j, s: (b, j, 0)),   # k
-        pl.BlockSpec((1, blk_k, d), lambda b, i, j, s: (b, j, 0)),   # v
-        pl.BlockSpec((1, blk_q, d), lambda b, i, j, s: (b, i, 0)),   # do
-        pl.BlockSpec((1, blk_q, 1), lambda b, i, j, s: (b, i, 0)),   # lse
-        pl.BlockSpec((1, blk_q, 1), lambda b, i, j, s: (b, i, 0)),   # delta
-    ]
-    extras = ([] if bias is None else [bias]) + \
-        ([] if qseg is None else [qseg, kseg])
-    extra_specs = _mask_specs(bias is not None, qseg is not None, heads,
-                              blk_q, blk_k, q_pos=0)
-    inputs = [q, k, v, do, lse, delta] + extras
-
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, has_bias=bias is not None,
-            has_seg=qseg is not None, causal=causal, dropout_p=dropout_p,
-            blk_q=blk_q, blk_k=blk_k, n_k=n_k, scale=scale,
-            causal_off=causal_off),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(bh, n_q, n_k),
-            in_specs=base_specs + extra_specs,
-            out_specs=[
-                pl.BlockSpec((1, blk_q, d), lambda b, i, j, s: (b, i, 0)),
-            ],
-            scratch_shapes=[pltpu.VMEM((blk_q, d), jnp.float32)],
-        ),
-        out_shape=[jax.ShapeDtypeStruct((bh, sq, d), q.dtype)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
-        interpret=_INTERPRET,
-    )(seed, *inputs)[0]
-
-    # dkv grid: (bh, k block, q block) — q/do/lse/delta indexed by the inner
-    # grid axis, k/v by the outer one
+    # grid (bh, k block, q block): dk/dv owned per outer k step, dq written
+    # as per-k partials summed below
     kv_specs = [
         pl.BlockSpec((1, blk_q, d), lambda b, j, i, s: (b, i, 0)),   # q
         pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),   # k
@@ -442,10 +414,14 @@ def _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, do,
     ]
     kv_extra = _mask_specs(bias is not None, qseg is not None, heads,
                            blk_q, blk_k, q_pos=1)
+    inputs = [q, k, v, do, lse, delta] + \
+        ([] if bias is None else [bias]) + \
+        ([] if qseg is None else [qseg, kseg])
 
-    kv_outs = pl.pallas_call(
+    dqp_dtype = q.dtype if n_k == 1 else jnp.float32
+    outs = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, has_bias=bias is not None,
+            _bwd_kernel, has_bias=bias is not None,
             has_seg=qseg is not None, causal=causal, dropout_p=dropout_p,
             blk_q=blk_q, blk_k=blk_k, n_q=n_q, scale=scale,
             causal_off=causal_off),
@@ -454,6 +430,8 @@ def _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, do,
             grid=(bh, n_k, n_q),
             in_specs=kv_specs + kv_extra,
             out_specs=[
+                pl.BlockSpec((1, 1, blk_q, d),
+                             lambda b, j, i, s: (j, b, i, 0)),       # dq part
                 pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),
                 pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),
             ] + ([pl.BlockSpec((1, 1, blk_k), lambda b, j, i, s: (b, 0, j))]
@@ -465,6 +443,7 @@ def _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, do,
                  if bias is not None else []),
         ),
         out_shape=[
+            jax.ShapeDtypeStruct((n_k, bh, sq, d), dqp_dtype),
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ] + ([jax.ShapeDtypeStruct((bh, 1, sk), jnp.float32)]
@@ -473,10 +452,12 @@ def _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, do,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_INTERPRET,
     )(seed, *inputs)
-    dk, dv = kv_outs[0], kv_outs[1]
+    dqp, dk, dv = outs[0], outs[1], outs[2]
+    dq = dqp[0].astype(q.dtype) if n_k == 1 else \
+        dqp.sum(axis=0).astype(q.dtype)
     dbias = None
     if bias is not None:  # per-(batch*head) key sums -> sum heads -> (B,1,Sk)
-        dbias = kv_outs[2].reshape(bias.shape[0], heads, 1, sk).sum(axis=1)
+        dbias = outs[3].reshape(bias.shape[0], heads, 1, sk).sum(axis=1)
     return dq, dk, dv, dbias
 
 

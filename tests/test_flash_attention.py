@@ -231,3 +231,25 @@ def test_sdpa_routes_through_flash():
         assert out.shape == [2, 256, 2, 64]
     finally:
         fa.flash_attention_bshd = saved
+
+
+@pytest.mark.skipif(jax.default_backend() not in ("tpu", "axon"),
+                    reason="hardware PRNG dropout path needs a real TPU")
+def test_hw_prng_dropout_fwd_bwd_consistency_on_tpu():
+    """On-device validation of the hardware bit-source: determinism, keep
+    fraction, and fwd/bwd mask agreement (mean dv == 1 under q=k=0)."""
+    from paddle_tpu.ops import flash_attention as fa
+    key = jax.random.PRNGKey(0)
+    B, S, Hh, D = 2, 256, 4, 64
+    q0 = jnp.zeros((B, S, Hh, D), jnp.bfloat16)
+    v1 = jnp.ones((B, S, Hh, D), jnp.bfloat16)
+    seed = jnp.asarray([7], jnp.int32)
+    o1 = fa.flash_attention_bshd(q0, q0, v1, dropout_p=0.5, dropout_seed=seed)
+    o2 = fa.flash_attention_bshd(q0, q0, v1, dropout_p=0.5, dropout_seed=seed)
+    assert bool(jnp.all(o1 == o2))
+    frac = float(jnp.mean(o1.astype(jnp.float32))) / 2.0
+    assert abs(frac - 0.5) < 0.01
+    dv = jax.grad(lambda v: fa.flash_attention_bshd(
+        q0, q0, v, dropout_p=0.5,
+        dropout_seed=seed).astype(jnp.float32).sum())(v1)
+    assert abs(float(jnp.mean(dv.astype(jnp.float32))) - 1.0) < 0.01

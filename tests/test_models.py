@@ -204,3 +204,43 @@ def test_optimizer_changing_param_set():
     (a(x).sum() + b(x).sum()).backward()
     opt.step()
     assert not np.allclose(b.weight.numpy(), b_before)
+
+
+def test_run_steps_matches_per_call_steps():
+    """K steps in one compiled call == K separate step() calls."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+    from paddle_tpu.jit import TrainStep
+
+    def make():
+        paddle.seed(0)
+        cfg = models.BertConfig(vocab_size=64, hidden_size=32,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                intermediate_size=64,
+                                max_position_embeddings=16,
+                                hidden_dropout_prob=0.0,
+                                attention_probs_dropout_prob=0.0)
+        model = models.BertForPretraining(cfg)
+        crit = models.BertPretrainingCriterion()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+        return model, TrainStep(model, lambda l, n, y: crit(l, n, y), opt)
+
+    rng = np.random.RandomState(0)
+    stack_ids = rng.randint(0, 64, (3, 4, 16)).astype("int32")
+    stack_lbl = rng.randint(0, 64, (3, 4, 16)).astype("int32")
+
+    m1, s1 = make()
+    per_call = [float(s1(paddle.to_tensor(stack_ids[i]),
+                         paddle.to_tensor(stack_lbl[i]))) for i in range(3)]
+
+    m2, s2 = make()
+    multi = s2.run_steps(paddle.to_tensor(stack_ids),
+                         paddle.to_tensor(stack_lbl))
+    multi = [float(x) for x in np.asarray(multi.numpy())]
+    # identical data + zero dropout -> identical loss trajectories
+    np.testing.assert_allclose(multi, per_call, rtol=1e-5, atol=1e-6)
+    for k, v in m1.state_dict().items():
+        np.testing.assert_allclose(np.asarray(v.numpy()),
+                                   np.asarray(m2.state_dict()[k].numpy()),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
