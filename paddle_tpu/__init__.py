@@ -51,7 +51,7 @@ from . import metric  # noqa: F401
 from . import distribution  # noqa: F401
 from . import vision  # noqa: F401
 from . import hapi  # noqa: F401
-from .hapi import Model, summary  # noqa: F401
+from .hapi import Model, summary, flops  # noqa: F401
 from . import distributed  # noqa: F401
 from .framework import save, load  # noqa: F401
 from . import utils  # noqa: F401

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 from ..core import op as _op
@@ -143,31 +144,55 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled = False
+        self._jit_unscale = None  # cached by jax.jit on leaf count/shapes
 
     def scale(self, loss):
         if not self._enable:
             return loss
+        self._unscaled = False  # new loss -> new unscale cycle
         return loss * self._scale
 
     def unscale_(self, optimizer):
         if not self._enable:
             return
+        if self._unscaled:
+            # explicit unscale_ + step workflow (grad clipping): step's
+            # internal unscale_ must not divide a second time (the
+            # reference guards this via OptimizerState)
+            return
+        self._unscaled = True
         from ..core.selected_rows import RowSparseGrad
         inv = 1.0 / self._scale
-        found = False
+        # ONE fused device program + ONE host sync for the whole grad set
+        # (the reference keeps the loss-scale state machine on device,
+        # update_loss_scaling_op.cc; a per-param bool() would host-sync
+        # per tensor)
+        dense, sparse = [], []
         for p in optimizer._parameter_list or []:
             if p.grad is None:
                 continue
-            if isinstance(p.grad, RowSparseGrad):
-                vals = p.grad.values * inv
-                found = found or not bool(jnp.all(jnp.isfinite(vals)))
-                p.grad = RowSparseGrad(p.grad.rows, vals, p.grad.dense_shape)
-                continue
-            g = p.grad._data * inv
-            finite = bool(jnp.all(jnp.isfinite(g)))
-            found = found or not finite
+            (sparse if isinstance(p.grad, RowSparseGrad)
+             else dense).append(p)
+        leaves = [p.grad._data for p in dense] + \
+            [p.grad.values for p in sparse]
+        if not leaves:
+            self._found_inf = False
+            return
+        if self._jit_unscale is None:
+            def _unscale(leaves, inv):
+                out = [(g.astype(jnp.float32) * inv).astype(g.dtype)
+                       for g in leaves]
+                finite = jnp.all(jnp.stack(
+                    [jnp.all(jnp.isfinite(g)) for g in out]))
+                return out, finite
+            self._jit_unscale = jax.jit(_unscale)
+        out, finite = self._jit_unscale(leaves, jnp.float32(inv))
+        self._found_inf = not bool(finite)  # the single host sync
+        for p, g in zip(dense, out[:len(dense)]):
             p.grad._set_data(g)
-        self._found_inf = found
+        for p, v in zip(sparse, out[len(dense):]):
+            p.grad = RowSparseGrad(p.grad.rows, v, p.grad.dense_shape)
 
     def step(self, optimizer):
         if not self._enable:
@@ -177,6 +202,7 @@ class GradScaler:
         if not self._found_inf:
             optimizer.step()
         self._update()
+        self._unscaled = False
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()

@@ -20,6 +20,10 @@ from .tensor import Tensor, TapeNode, _is_tracer, is_grad_enabled
 _amp_hook: Optional[Callable] = None
 # Profiler hook: set by paddle_tpu.utils.profiler. Signature: (op_name) -> ctx.
 _profiler_hook: Optional[Callable] = None
+# FLAGS_check_nan_inf consumer (reference:
+# framework/details/nan_inf_utils_detail.cc — scan every op's outputs and
+# abort on the first non-finite value).  Toggled by utils.flags.set_flags.
+_check_nan_inf: bool = False
 
 
 def set_amp_hook(fn):
@@ -30,6 +34,27 @@ def set_amp_hook(fn):
 def set_profiler_hook(fn):
     global _profiler_hook
     _profiler_hook = fn
+
+
+def set_check_nan_inf(enabled: bool):
+    global _check_nan_inf
+    _check_nan_inf = bool(enabled)
+
+
+def _assert_finite(name: str, out):
+    """Eager-only scan of an op's float outputs for nan/inf."""
+    import jax.numpy as jnp
+    for leaf in jax.tree_util.tree_leaves(
+            out, is_leaf=lambda x: isinstance(x, Tensor)):
+        arr = leaf._data if isinstance(leaf, Tensor) else leaf
+        if _is_tracer(arr) or not hasattr(arr, "dtype"):
+            continue
+        if not _is_diff_dtype(arr):
+            continue
+        if not bool(jnp.all(jnp.isfinite(arr))):
+            raise FloatingPointError(
+                f"Operator '{name}' produced nan/inf "
+                f"(FLAGS_check_nan_inf is set)")
 
 
 def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
@@ -63,6 +88,8 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
         if not need_grad:
             a2, k2 = jax.tree_util.tree_unflatten(treedef, raw)
             out = raw_fn(*a2, **k2)
+            if _check_nan_inf:
+                _assert_finite(name, out)
             return jax.tree_util.tree_map(lambda x: Tensor(x, stop_gradient=True), out)
 
         # differentiable inputs: float/complex Tensors not marked stop_gradient
@@ -71,6 +98,8 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
         if not diff_idx:
             a2, k2 = jax.tree_util.tree_unflatten(treedef, raw)
             out = raw_fn(*a2, **k2)
+            if _check_nan_inf:
+                _assert_finite(name, out)
             return jax.tree_util.tree_map(lambda x: Tensor(x, stop_gradient=True), out)
 
         def closed(*diff_vals):
@@ -81,6 +110,8 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
             return raw_fn(*a2, **k2)
 
         out_raw, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
+        if _check_nan_inf:
+            _assert_finite(name, out_raw)
 
         out_flat, out_tree = jax.tree_util.tree_flatten(out_raw)
         out_tensors = [Tensor(x, stop_gradient=False) for x in out_flat]

@@ -303,14 +303,12 @@ def save_train_state(directory: str, params, opt_state, step: int,
                         step, extra)
 
 
-def apply_train_state(model, optimizer, restored):
-    """Write a restore_sharded result back into model/optimizer/rng/scheduler.
-    Returns (meta_dict, opt_state_tree)."""
+def restore_train_extras(optimizer, step: int, extra: dict) -> dict:
+    """Apply the non-array training state (step count, rng stream, LR
+    scheduler) from a checkpoint's extra dict.  Shared by every train-step
+    restore path.  Mutates `extra` (pops the internal keys); returns the
+    user-facing meta dict."""
     from ..core import rng as _rng
-    tree, step, extra = restored
-    sd = model.state_dict()
-    for k, v in tree["params"].items():
-        sd[k]._set_data(v)
     optimizer._step_count = step
     rng_state = extra.pop("__rng__", None)
     if rng_state is not None:
@@ -320,7 +318,27 @@ def apply_train_state(model, optimizer, restored):
         sched = getattr(optimizer, "_lr_scheduler", None)
         if sched is not None:
             sched.set_state_dict(sched_state)
-    return {"step": step, **extra}, tree["opt"]
+    return {"step": step, **extra}
+
+
+def apply_train_state(model, optimizer, restored):
+    """Write a restore_sharded result back into model/optimizer/rng/scheduler.
+    Returns (meta_dict, opt_state_tree)."""
+    tree, step, extra = restored
+    sd = model.state_dict()
+    for k, v in tree["params"].items():
+        sd[k]._set_data(v)
+    meta = restore_train_extras(optimizer, step, extra)
+    # stateless optimizers (SGD) save empty per-param dicts, which the
+    # flatten/unflatten roundtrip drops — callers merge over a fresh
+    # init_opt_state structure via merge_opt_state
+    return meta, tree.get("opt", {})
+
+
+def merge_opt_state(fresh: dict, restored: dict) -> dict:
+    """Per-param merge: restored entries win; params whose state vanished in
+    the save (empty dicts) keep the freshly initialized structure."""
+    return {k: restored.get(k, fresh[k]) for k in fresh}
 
 
 # -- checkpoint manager + auto-checkpoint -----------------------------------

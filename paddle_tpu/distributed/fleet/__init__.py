@@ -104,15 +104,30 @@ def distributed_model(model):
     return DataParallel(model)
 
 
-def distributed_train_step(model, loss_fn, optimizer,
-                           strategy=None) -> ShardedTrainStep:
+def distributed_train_step(model, loss_fn, optimizer, strategy=None):
     """Build the compiled SPMD train step for the current fleet mesh —
     the TPU-native 'minimize': where the reference rewrites programs, we
-    hand back one jitted step with sharded params/opt/batch."""
+    hand back one jitted step with sharded params/opt/batch.  The localsgd
+    strategy flag selects the divergent-replica LocalSGDTrainStep
+    (localsgd_optimizer.py equivalent)."""
     st = strategy or _strategy or DistributedStrategy()
     inner = getattr(optimizer, "_inner", optimizer)
-    return ShardedTrainStep(model, loss_fn, inner, strategy=st,
-                            mesh=get_mesh(create_default=True))
+    mesh = get_mesh(create_default=True)
+    if st.localsgd:
+        if (st.sharding or st.tensor_parallel or st.sequence_parallel
+                or st.pipeline or st.gradient_merge or st.recompute
+                or st.fp16_allreduce):
+            raise ValueError(
+                "localsgd composes with plain DP (+AMP) only — disable "
+                "sharding/tensor_parallel/sequence_parallel/pipeline/"
+                "gradient_merge/recompute/fp16_allreduce")
+        from ...parallel.localsgd import LocalSGDTrainStep
+        k = (st.localsgd_configs or {}).get("k_steps", 4)
+        return LocalSGDTrainStep(
+            model, loss_fn, inner, k_steps=k, mesh=mesh,
+            amp_level=("O1" if st.amp else None),
+            amp_dtype=st.amp_configs.dtype)
+    return ShardedTrainStep(model, loss_fn, inner, strategy=st, mesh=mesh)
 
 
 def get_strategy() -> Optional[DistributedStrategy]:

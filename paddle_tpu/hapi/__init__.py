@@ -1,3 +1,4 @@
 """paddle_tpu.hapi (reference: python/paddle/hapi/)."""
-from .model import Model, summary_fn as summary  # noqa: F401
+from .model import Model  # noqa: F401
+from .summary import summary, flops  # noqa: F401
 from . import callbacks  # noqa: F401

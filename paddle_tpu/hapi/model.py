@@ -51,16 +51,24 @@ class Model:
             loss_fn = self._loss
             self._train_step = TrainStep(
                 self.network, lambda out, lbl: loss_fn(out, lbl),
-                self._optimizer, amp_level=self._amp_level)
+                self._optimizer, amp_level=self._amp_level,
+                with_outputs=bool(self._metrics))
         batch = [unwrap(Tensor(np.asarray(x)) if isinstance(x, np.ndarray) else x)
                  for x in list(inputs) + list(labels)]
         loss = self._train_step(*batch)
         metrics_out = []
         if self._metrics:
-            with no_grad():
-                self.network.eval()
-                preds = self.network(*[Tensor(b) for b in batch[:len(inputs)]])
-                self.network.train()
+            # metrics consume the SAME forward the loss used (the reference's
+            # train_batch does too) — no second forward pass
+            outs = self._train_step.last_outputs
+            if outs is None:  # sparse-grad path: fall back to a fresh forward
+                with no_grad():
+                    self.network.eval()
+                    preds = self.network(
+                        *[Tensor(b) for b in batch[:len(inputs)]])
+                    self.network.train()
+            else:
+                preds = outs if len(outs) > 1 else outs[0]
             for m in self._metrics:
                 m.update(unwrap(m.compute(preds, Tensor(batch[-1]))))
                 metrics_out.append(m.accumulate())
@@ -225,7 +233,8 @@ class Model:
         return self.network.parameters()
 
     def summary(self, input_size=None, dtype=None):
-        return summary_fn(self.network, input_size, dtype)
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtype)
 
 
 def _as_tensor(x):
@@ -245,23 +254,3 @@ def _split_batch(batch, has_label=True):
             return list(batch[:-1]), [batch[-1]]
         return list(batch), []
     return [batch], []
-
-
-def summary_fn(net, input_size=None, dtypes=None, input=None):  # noqa: A002
-    """paddle.summary (reference: hapi/model_summary.py)."""
-    rows = []
-    total = 0
-    trainable = 0
-    for name, p in net.named_parameters():
-        n = int(np.prod(p.shape)) if p.shape else 1
-        total += n
-        if p.trainable:
-            trainable += n
-        rows.append((name, tuple(p.shape), n))
-    width = max([len(r[0]) for r in rows], default=20) + 2
-    lines = [f"{'Param':<{width}}{'Shape':<24}{'Count':>12}"]
-    lines += [f"{r[0]:<{width}}{str(r[1]):<24}{r[2]:>12,}" for r in rows]
-    lines.append(f"Total params: {total:,}")
-    lines.append(f"Trainable params: {trainable:,}")
-    print("\n".join(lines))
-    return {"total_params": total, "trainable_params": trainable}

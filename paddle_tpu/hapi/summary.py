@@ -1,0 +1,142 @@
+"""Per-layer model summary + FLOPs counting.
+
+Reference: python/paddle/hapi/model_summary.py (layer table with output
+shapes/params) and python/paddle/hapi/dynamic_flops.py:1 (per-layer flops via
+forward hooks).  Same mechanism here: forward-post hooks on leaf sublayers
+record output shapes; flops rules follow the reference's MAC accounting
+(conv: out_elems * Cin/groups * kh * kw; linear: out_elems * in_features;
+norms/activations: numel).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _numel(shape) -> int:
+    return int(np.prod(shape)) if shape else 1
+
+
+def _out_shape(out):
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    return tuple(out.shape)
+
+
+def _layer_flops(layer, inputs, out_shape) -> int:
+    name = type(layer).__name__
+    out_elems = _numel(out_shape)
+    if name == "Linear":
+        return out_elems * layer.weight.shape[0]
+    if name in ("Conv2D", "Conv1D", "Conv3D", "Conv2DTranspose"):
+        w = layer.weight.shape  # (out_c, in_c/groups, *k)
+        kernel_ops = _numel(w[1:])
+        return out_elems * kernel_ops
+    if name in ("BatchNorm", "BatchNorm1D", "BatchNorm2D", "BatchNorm3D",
+                "LayerNorm", "GroupNorm", "InstanceNorm2D", "SyncBatchNorm"):
+        return 2 * out_elems
+    if name in ("ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Softmax",
+                "LeakyReLU", "Hardswish", "Hardsigmoid", "SiLU", "Swish",
+                "AvgPool2D", "MaxPool2D", "AdaptiveAvgPool2D",
+                "AdaptiveMaxPool2D"):
+        return out_elems
+    if name == "Embedding":
+        return 0
+    return 0
+
+
+def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
+    """paddle.summary: per-layer table; returns {'total_params',
+    'trainable_params', 'total_flops'}."""
+    records = []
+    hooks = []
+
+    def make_hook(lname):
+        def hook(layer, inputs, out):
+            try:
+                oshape = _out_shape(out)
+            except Exception:
+                oshape = ()
+            n_params = sum(_numel(p.shape)
+                           for p in layer._parameters.values()
+                           if p is not None)
+            records.append((lname, type(layer).__name__, oshape, n_params,
+                            _layer_flops(layer, inputs, oshape)))
+        return hook
+
+    leaf_seen = set()
+    for lname, sub in net.named_sublayers():
+        if next(sub.children(), None) is None:  # leaves only
+            hooks.append(sub.register_forward_post_hook(make_hook(lname)))
+            leaf_seen.add(lname)
+
+    x = input
+    if x is None and input_size is None:
+        # params-only summary (no forward, so no shapes/flops)
+        for h in hooks:
+            h.remove()
+        total = sum(_numel(p.shape) for _, p in net.named_parameters())
+        trainable = sum(_numel(p.shape) for _, p in net.named_parameters()
+                        if p.trainable)
+        print(f"Total params: {total:,}\nTrainable params: {trainable:,}")
+        return {"total_params": total, "trainable_params": trainable,
+                "total_flops": 0}
+    if x is None:
+        sizes = (input_size if isinstance(input_size, (list, tuple))
+                 and isinstance(input_size[0], (list, tuple))
+                 else [input_size])
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes] * len(sizes)
+        x = [Tensor(np.zeros(s, dtype=(d or "float32"))) for s, d in
+             zip(sizes, dts)]
+    elif not isinstance(x, (list, tuple)):
+        x = [x]
+
+    was_training = net.training
+    net.eval()
+    try:
+        from ..core.tensor import no_grad
+        with no_grad():
+            net(*x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total = sum(_numel(p.shape) for _, p in net.named_parameters())
+    trainable = sum(_numel(p.shape) for _, p in net.named_parameters()
+                    if p.trainable)
+    total_flops = sum(r[4] for r in records)
+
+    w_name = max([len(f"{r[0]} ({r[1]})") for r in records], default=24) + 2
+    lines = ["-" * (w_name + 50)]
+    lines.append(f"{'Layer (type)':<{w_name}}{'Output Shape':<22}"
+                 f"{'Params':>12}{'FLOPs':>14}")
+    lines.append("-" * (w_name + 50))
+    for lname, cls, oshape, n_params, fl in records:
+        lines.append(f"{lname + ' (' + cls + ')':<{w_name}}"
+                     f"{str(list(oshape)):<22}{n_params:>12,}{fl:>14,}")
+    lines.append("-" * (w_name + 50))
+    lines.append(f"Total params: {total:,}")
+    lines.append(f"Trainable params: {trainable:,}")
+    lines.append(f"Non-trainable params: {total - trainable:,}")
+    lines.append(f"Total FLOPs (MAC-counted): {total_flops:,}")
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable,
+            "total_flops": total_flops}
+
+
+def flops(net, input_size, dtypes=None, print_detail: bool = False) -> int:
+    """paddle.flops (reference: hapi/dynamic_flops.py:flops)."""
+    import io
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        info = summary(net, input_size, dtypes)
+    if print_detail:
+        print(buf.getvalue())
+    return info["total_flops"]

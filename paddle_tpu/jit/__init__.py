@@ -178,10 +178,12 @@ def not_to_static(fn):
 # ---------------------------------------------------------------------------
 
 def forward_loss(model, loss_fn, state, batch, rng_key=None, amp_level=None,
-                 amp_dtype="bfloat16"):
+                 amp_dtype="bfloat16", return_outputs=False):
     """Shared traced forward+loss used by TrainStep / ShardedTrainStep:
     functional_call with a per-step rng root (fresh dropout masks each step)
-    and optional bf16 autocast."""
+    and optional bf16 autocast.  With return_outputs, also returns the raw
+    forward outputs (so hapi metrics reuse the training forward instead of
+    paying a second one)."""
     import contextlib
     from .. import amp as amp_mod
     from ..core import rng as _rng
@@ -191,6 +193,8 @@ def forward_loss(model, loss_fn, state, batch, rng_key=None, amp_level=None,
         label = Tensor(batch[-1])
         outs = out if isinstance(out, tuple) else (out,)
         loss = loss_fn(*[Tensor(o) for o in outs], label)
+        if return_outputs:
+            return unwrap(loss), outs
         return unwrap(loss)
 
     keyctx = (_rng.key_ctx(rng_key) if rng_key is not None
@@ -211,12 +215,18 @@ class TrainStep:
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  amp_level: Optional[str] = None, amp_dtype="bfloat16",
-                 mesh=None, batch_sharding=None, remat: bool = False):
+                 mesh=None, batch_sharding=None, remat: bool = False,
+                 with_outputs: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.amp_level = amp_level
         self.amp_dtype = amp_dtype
+        # with_outputs: the compiled step also returns the forward outputs
+        # (hapi metric reuse); ignored on the sparse-grad path, where
+        # last_outputs stays None
+        self._with_outputs = with_outputs
+        self.last_outputs = None
         self._names = list(model.state_dict().keys())
         self._trainable = {k for k, v in model.state_dict().items()
                            if getattr(v, "trainable", False)}
@@ -287,18 +297,28 @@ class TrainStep:
                     probe, {k: example_state[k] for k in sparse_names})
                 self._sparse_checked = True
 
+        with_outputs = self._with_outputs
+
         def step(params, opt_state, step_no, lr, rng_key, batch):
             def loss_of(train_params):
                 full = dict(params)
                 full.update(train_params)
-                return self._forward_loss(full, batch, rng_key)
+                return forward_loss(
+                    self.model, self.loss_fn, full, batch, rng_key,
+                    self.amp_level, self.amp_dtype,
+                    return_outputs=with_outputs)
 
             train_params = {k: v for k, v in params.items() if k in trainable}
             loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
-            loss, grads = jax.value_and_grad(loss_fn)(train_params)
+            if with_outputs:
+                (loss, outs), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(train_params)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(train_params)
+                outs = ()
             new_params, new_opt = apply_updates(
                 opt, params, grads, opt_state, lr, step_no, decay)
-            return new_params, new_opt, loss
+            return new_params, new_opt, loss, outs
 
         def step_sparse(params, opt_state, step_no, lr, rng_key, batch):
             from ..core import selected_rows as sr
@@ -325,7 +345,7 @@ class TrainStep:
                 grads[name] = (grads[name] + rsg) if name in grads else rsg
             new_params, new_opt = apply_updates(
                 opt, params, grads, opt_state, lr, step_no, decay)
-            return new_params, new_opt, loss
+            return new_params, new_opt, loss, ()
 
         return jax.jit(step_sparse if sparse_specs else step,
                        donate_argnums=(0, 1))
@@ -334,7 +354,7 @@ class TrainStep:
         return {k: self.optimizer.init_state(v) for k, v in state.items()
                 if k in self._trainable}
 
-    def _build_multi(self, example_state, example_opt, example_stacked):
+    def _build_multi(self):
         """K optimizer steps per compiled call via lax.scan over stacked
         batches (leaves shaped (K, ...)).
 
@@ -388,8 +408,7 @@ class TrainStep:
         raw = tuple(unwrap(b) for b in stacked_batch)
         k_steps = raw[0].shape[0]
         if self._compiled_multi is None:
-            self._compiled_multi = self._build_multi(
-                state, self._opt_state, raw)
+            self._compiled_multi = self._build_multi()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step_no0 = jnp.asarray(self.optimizer._step_count + 1, jnp.int32)
         from ..core import rng as _rng
@@ -424,8 +443,10 @@ class TrainStep:
         from ..core import rng as _rng
         rng_key = _rng.next_key()  # fresh per step: dropout masks differ
         raw_batch = tuple(unwrap(b) for b in batch)
-        new_state, self._opt_state, loss = self._compiled(
+        new_state, self._opt_state, loss, outs = self._compiled(
             state, self._opt_state, step_no, lr, rng_key, raw_batch)
+        self.last_outputs = (tuple(Tensor(o) for o in outs)
+                             if outs else None)
         sd = self.model.state_dict()
         for k, v in new_state.items():
             sd[k]._set_data(v)
@@ -447,8 +468,10 @@ class TrainStep:
         res = dck.restore_sharded(directory)
         if res is None:
             return None
-        meta, self._opt_state = dck.apply_train_state(
+        meta, restored_opt = dck.apply_train_state(
             self.model, self.optimizer, res)
+        fresh = self.init_opt_state(state_arrays(self.model))
+        self._opt_state = dck.merge_opt_state(fresh, restored_opt)
         return meta
 
 

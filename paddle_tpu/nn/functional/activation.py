@@ -10,9 +10,11 @@ from ...core.tensor import unwrap
 
 
 def _un(name, fn):
+    op_name = name
+
     def op(x, name=None):
-        return dispatch(name, fn, x)
-    op.__name__ = name
+        return dispatch(op_name, fn, x)
+    op.__name__ = op_name
     return op
 
 

@@ -2,3 +2,5 @@
 from . import lr  # noqa: F401
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,  # noqa: F401
                         Adagrad, RMSProp, Adadelta, Lamb, LarsMomentum, Ftrl)
+from .averaging import ExponentialMovingAverage, ModelAverage  # noqa: F401
+from .dgc import DGCMomentum  # noqa: F401

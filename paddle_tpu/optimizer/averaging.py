@@ -1,0 +1,155 @@
+"""Parameter averaging utilities: EMA and ModelAverage.
+
+Reference: python/paddle/fluid/optimizer.py:3441 (ExponentialMovingAverage)
+and :3132 (ModelAverage) — both keep device-side accumulators updated after
+each optimizer step and expose apply()/restore() to swap the averaged weights
+in for evaluation.
+
+TPU-native: accumulators are plain jax arrays updated in one fused jitted
+call per update(); under a sharded step they inherit the param shardings
+(tree ops are sharding-preserving), so no host gather ever happens.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _float_params(parameters):
+    return [p for p in parameters
+            if jnp.issubdtype(p._data.dtype, jnp.floating)]
+
+
+class ExponentialMovingAverage:
+    """shadow = decay * shadow + (1 - decay) * param, with the reference's
+    optional step-based decay ramp thres_steps: min(decay, (1+t)/(10+t))."""
+
+    def __init__(self, decay: float = 0.999, thres_steps: bool = False,
+                 parameters=None, name: Optional[str] = None):
+        if parameters is None:
+            raise ValueError("EMA needs the parameter list")
+        self._decay = float(decay)
+        self._thres = bool(thres_steps)
+        self._params = _float_params(parameters)
+        self._step = 0
+        self._shadow = [p._data for p in self._params]
+        self._backup = None
+
+        def _upd(shadow, params, decay):
+            return [decay * s.astype(jnp.float32)
+                    + (1.0 - decay) * p.astype(jnp.float32)
+                    for s, p in zip(shadow, params)]
+        self._jit_upd = jax.jit(_upd)
+
+    def update(self):
+        self._step += 1
+        d = self._decay
+        if self._thres:
+            d = min(d, (1.0 + self._step) / (10.0 + self._step))
+        self._shadow = self._jit_upd(
+            self._shadow, [p._data for p in self._params],
+            jnp.float32(d))
+
+    @contextlib.contextmanager
+    def apply(self, need_restore: bool = True):
+        """Swap averaged weights in (usable as a context manager, matching
+        the reference's apply/restore pair)."""
+        self._backup = [p._data for p in self._params]
+        for p, s in zip(self._params, self._shadow):
+            p._set_data(s.astype(p._data.dtype))
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._set_data(b)
+        self._backup = None
+
+    def state_dict(self) -> Dict[str, object]:
+        return {"step": self._step,
+                "shadow": [jax.device_get(s) for s in self._shadow]}
+
+    def set_state_dict(self, state):
+        self._step = int(state["step"])
+        self._shadow = [jnp.asarray(s) for s in state["shadow"]]
+
+
+class ModelAverage:
+    """Running sums with a sliding window (reference ModelAverage):
+    keeps sum_1 (current block), sum_2/sum_3 (older blocks) and applies
+    (sum_1+sum_2+sum_3)/num_accumulates when the window is in
+    [min_average_window, max_average_window]."""
+
+    def __init__(self, average_window_rate: float,
+                 parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000,
+                 name: Optional[str] = None):
+        if parameters is None:
+            raise ValueError("ModelAverage needs the parameter list")
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._params = _float_params(parameters)
+        z = [jnp.zeros_like(p._data, jnp.float32) for p in self._params]
+        self._sum1, self._sum2, self._sum3 = list(z), list(z), list(z)
+        self._n1 = 0      # accumulates in sum_1
+        self._n2 = 0      # accumulates in sum_2
+        self._n3 = 0      # accumulates in sum_3
+        self._backup = None
+
+        def _acc(s1, params):
+            return [s.astype(jnp.float32) + p.astype(jnp.float32)
+                    for s, p in zip(s1, params)]
+        self._jit_acc = jax.jit(_acc)
+
+    @property
+    def _window(self):
+        total = self._n1 + self._n2 + self._n3
+        return max(self._min_w, int(self._rate * total))
+
+    def step(self):
+        """Accumulate current params (call once per optimizer step)."""
+        self._sum1 = self._jit_acc(self._sum1,
+                                   [p._data for p in self._params])
+        self._n1 += 1
+        if self._n1 >= min(self._max_w, self._window):
+            # rotate blocks: sum_3 <- sum_2, sum_2 <- sum_1 (reference
+            # average_accumulates_op semantics)
+            self._sum3, self._n3 = self._sum2, self._n2
+            self._sum2, self._n2 = self._sum1, self._n1
+            self._sum1 = [jnp.zeros_like(p._data, jnp.float32)
+                          for p in self._params]
+            self._n1 = 0
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore: bool = True):
+        n = self._n1 + self._n2 + self._n3
+        if n == 0:
+            yield self
+            return
+        self._backup = [p._data for p in self._params]
+        for p, s1, s2, s3 in zip(self._params, self._sum1, self._sum2,
+                                 self._sum3):
+            avg = (s1 + s2 + s3) / n
+            p._set_data(avg.astype(p._data.dtype))
+        try:
+            yield self
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._set_data(b)
+        self._backup = None

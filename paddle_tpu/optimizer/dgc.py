@@ -1,0 +1,65 @@
+"""Deep Gradient Compression momentum optimizer.
+
+Reference: python/paddle/fluid/optimizer.py:1183 (DGCMomentumOptimizer) and
+operators/dgc_op — momentum correction + top-k gradient sparsification with
+error feedback (Lin et al., DGC).
+
+TPU-native notes: the reference's win is sending only the top-k values over
+slow interconnects; on TPU the collective itself rides ICI (and bf16 wire
+compression is ShardedTrainStep's fp16_allreduce flag), so what this class
+preserves is the ALGORITHM's semantics — sparsified velocity application with
+residual accumulation — with static shapes: the mask comes from a quantile
+threshold, not a dynamic top-k gather.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+
+class DGCMomentum(Optimizer):
+    """Momentum with top-k sparsified updates + error feedback."""
+
+    _elementwise_update = False  # quantile threshold is a full-tensor stat
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 sparsity=0.999, rampup_begin_step=0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = float(momentum)
+        self._nesterov = bool(use_nesterov)
+        if isinstance(sparsity, (list, tuple)):
+            sparsity = sparsity[-1]
+        self._sparsity = float(sparsity)
+        self._rampup_begin = int(rampup_begin_step)
+
+    def init_state(self, p):
+        return {"velocity": jnp.zeros_like(p, jnp.float32)}
+
+    def update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        u = self._momentum * state["velocity"] + g32
+        if self._nesterov:
+            applied_dense = g32 + self._momentum * u
+        else:
+            applied_dense = u
+
+        if p.ndim == 0 or p.size < 2:
+            # tiny params run plain momentum (velocity persists)
+            return (p.astype(jnp.float32) - lr * applied_dense).astype(
+                p.dtype), {"velocity": u}
+
+        # top-k selection via quantile threshold (static shapes on TPU)
+        thresh = jnp.quantile(jnp.abs(u).reshape(-1).astype(jnp.float32),
+                              self._sparsity)
+        rampup = step <= self._rampup_begin
+        mask = jnp.logical_or(jnp.abs(u) >= thresh, rampup)
+        applied = jnp.where(mask, applied_dense, 0.0)
+        # DGC phase: sent velocity is cleared (error feedback keeps the
+        # rest); ramp-up phase: plain Momentum, velocity persists
+        new_u = jnp.where(jnp.logical_and(mask, jnp.logical_not(rampup)),
+                          0.0, u)
+        return (p.astype(jnp.float32) - lr * applied).astype(p.dtype), \
+            {"velocity": new_u}

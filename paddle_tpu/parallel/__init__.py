@@ -12,3 +12,4 @@ from .strategy import (DistributedStrategy, HybridConfig,  # noqa: F401
 from .sharding import (tp_spec, param_specs, shardings_of,  # noqa: F401
                        apply_fsdp)
 from .train_step import ShardedTrainStep  # noqa: F401
+from .localsgd import LocalSGDTrainStep  # noqa: F401

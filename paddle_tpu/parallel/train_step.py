@@ -56,6 +56,16 @@ class ShardedTrainStep:
                            if getattr(v, "trainable", False)}
         fsdp = st.sharding and st.sharding_configs.stage >= 3
         self._zero12 = st.sharding and st.sharding_configs.stage in (1, 2)
+        # bf16-compressed explicit gradient allreduce: pure-DP only (the
+        # reference's fp16_allreduce likewise composes with collective DP,
+        # not sharding/TP)
+        self._fp16_allreduce = bool(st.fp16_allreduce)
+        if self._fp16_allreduce and (fsdp or st.tensor_parallel
+                                     or st.sequence_parallel or st.pipeline):
+            raise ValueError(
+                "fp16_allreduce composes with plain DP (optionally ZeRO-1/2)"
+                " only — disable sharding stage 3 / tensor_parallel /"
+                " sequence_parallel / pipeline")
         self.param_specs = shd.param_specs(
             {k: tuple(v.shape) for k, v in sd.items()}, self.mesh,
             tensor_parallel=st.tensor_parallel, fsdp=fsdp,
@@ -110,7 +120,7 @@ class ShardedTrainStep:
         avg = (self.strategy.gradient_merge_configs.avg
                if self.strategy.gradient_merge else True)
 
-        def grads_of(params, batch, rng_key):
+        def grads_of_implicit(params, batch, rng_key):
             def loss_of(tp):
                 full = dict(params)
                 full.update(tp)
@@ -118,6 +128,43 @@ class ShardedTrainStep:
             train_params = {k: v for k, v in params.items() if k in trainable}
             fn = jax.checkpoint(loss_of) if self._remat else loss_of
             return jax.value_and_grad(fn)(train_params)
+
+        def grads_of_explicit(params, batch, rng_key):
+            """Per-replica local grads via shard_map, a dtype-compressed
+            explicit pmean over dp (fp16_allreduce meta-optimizer,
+            fp16_allreduce_optimizer.py:1; bf16 is the TPU wire format).
+
+            DDP semantics like the reference's collective mode: gradients
+            are AVERAGED across replicas.  For mean-reduced losses this is
+            identical to the implicit global-loss gradient; a sum-reduced
+            loss differs by a factor of dp (exactly as it would under the
+            reference's scaled-loss + allreduce)."""
+            from jax.experimental.shard_map import shard_map
+
+            def local(params, batch):
+                key = jax.random.fold_in(rng_key,
+                                         jax.lax.axis_index("dp"))
+
+                def loss_of(tp):
+                    full = dict(params)
+                    full.update(tp)
+                    return self._forward_loss(full, batch, key)
+                tp0 = {k: v for k, v in params.items() if k in trainable}
+                fn = jax.checkpoint(loss_of) if self._remat else loss_of
+                loss, g = jax.value_and_grad(fn)(tp0)
+                g = jax.tree_util.tree_map(
+                    lambda x: jax.lax.pmean(
+                        x.astype(jnp.bfloat16), "dp").astype(jnp.float32),
+                    g)
+                return jax.lax.pmean(loss, "dp"), g
+
+            return shard_map(
+                local, mesh=self.mesh,
+                in_specs=(P(), tuple(P("dp") for _ in batch)),
+                out_specs=(P(), P()), check_rep=False)(params, batch)
+
+        grads_of = (grads_of_explicit if self._fp16_allreduce
+                    else grads_of_implicit)
 
         def step(params, opt_state, step_no, lr, rng_key, batch):
             if k_steps > 1:
@@ -220,8 +267,12 @@ class ShardedTrainStep:
                        "opt": self._ensure_opt_shardings()})
         if res is None:
             return None
-        meta, self._opt_state = dck.apply_train_state(
+        meta, restored_opt = dck.apply_train_state(
             self.model, self.optimizer, res)
+        fresh = jax.device_put(
+            self.init_opt_state(state_arrays(self.model)),
+            self._ensure_opt_shardings())
+        self._opt_state = dck.merge_opt_state(fresh, restored_opt)
         return meta
 
     # -- introspection -------------------------------------------------------

@@ -9,9 +9,11 @@ from ..core.tensor import Tensor, unwrap
 
 
 def _cmp(name, fn):
+    op_name = name
+
     def op(x, y, name=None):
-        return dispatch(name, fn, x, y)
-    op.__name__ = name
+        return dispatch(op_name, fn, x, y)
+    op.__name__ = op_name
     return op
 
 

@@ -44,10 +44,12 @@ def _binop(name, fn):
         y = _align_axis(x, y, axis) if hasattr(x, "ndim") and hasattr(y, "ndim") else y
         return fn(x, y)
 
+    op_name = name
+
     def op(x, y, axis=-1, name=None, out=None):
-        r = dispatch(name, raw, x, y, axis=axis)
+        r = dispatch(op_name, raw, x, y, axis=axis)
         return r
-    op.__name__ = name
+    op.__name__ = op_name
     return op
 
 
@@ -107,9 +109,11 @@ def multiplex(inputs, index, name=None):
 # ---- unary elementwise -----------------------------------------------------
 
 def _unop(name, fn):
+    op_name = name
+
     def op(x, name=None):
-        return dispatch(name, fn, x)
-    op.__name__ = name
+        return dispatch(op_name, fn, x)
+    op.__name__ = op_name
     return op
 
 
@@ -197,6 +201,8 @@ def increment(x, value=1.0, name=None):
 # ---- reductions ------------------------------------------------------------
 
 def _reduce(name, fn):
+    op_name = name
+
     def op(x, axis=None, keepdim=False, name=None, dtype=None):
         ax = _axis_tuple(axis)
         def raw(x):
@@ -204,8 +210,8 @@ def _reduce(name, fn):
             if dtype is not None:
                 r = r.astype(_dt.convert_dtype(dtype))
             return r
-        return dispatch(name, raw, x)
-    op.__name__ = name
+        return dispatch(op_name, raw, x)
+    op.__name__ = op_name
     return op
 
 

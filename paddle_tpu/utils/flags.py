@@ -28,6 +28,16 @@ _FLAGS: Dict[str, Any] = {
 }
 
 
+def _apply_effect(key: str, value):
+    """Push a flag's live effect into its consumer."""
+    if key == "FLAGS_use_flash_attention":
+        from ..nn.functional.attention import set_flash_attention
+        set_flash_attention(bool(value))
+    elif key == "FLAGS_check_nan_inf":
+        from ..core.op import set_check_nan_inf
+        set_check_nan_inf(bool(value))
+
+
 def _bootstrap_from_env():
     for key in list(_FLAGS):
         env = os.environ.get(key)
@@ -41,6 +51,7 @@ def _bootstrap_from_env():
                 _FLAGS[key] = float(env)
             else:
                 _FLAGS[key] = env
+            _apply_effect(key, _FLAGS[key])
 
 
 _bootstrap_from_env()
@@ -55,9 +66,7 @@ def get_flags(flags):
 def set_flags(flags: Dict[str, Any]):
     for k, v in flags.items():
         _FLAGS[k] = v
-        if k == "FLAGS_use_flash_attention":
-            from ..nn.functional.attention import set_flash_attention
-            set_flash_attention(bool(v))
+        _apply_effect(k, v)
 
 
 def get_flag(name, default=None):

