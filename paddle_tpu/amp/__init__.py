@@ -143,25 +143,45 @@ class GradScaler:
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
-        self._unscaled = False
+        self._found_inf = False  # last unscale_ result (back-compat mirror)
+        # per-optimizer cycle state (reference OptimizerState): keyed by id
+        # with a weakref identity check so a recycled id from a dropped
+        # optimizer can never inherit a stale "already unscaled" guard
+        self._opt_states = {}  # id -> dict(ref, unscaled, found_inf)
         self._jit_unscale = None  # cached by jax.jit on leaf count/shapes
+
+    def _opt_state(self, optimizer):
+        import weakref
+        # purge entries whose optimizer has been garbage-collected
+        dead = [k for k, st in self._opt_states.items() if st["ref"]() is None]
+        for k in dead:
+            del self._opt_states[k]
+        st = self._opt_states.get(id(optimizer))
+        if st is None or st["ref"]() is not optimizer:
+            st = {"ref": weakref.ref(optimizer), "unscaled": False,
+                  "found_inf": False}
+            self._opt_states[id(optimizer)] = st
+        return st
 
     def scale(self, loss):
         if not self._enable:
             return loss
-        self._unscaled = False  # new loss -> new unscale cycle
+        # NOTE: does not reset unscale guards — they are per-optimizer
+        # (cleared by that optimizer's step()), so a multi-loss interleave
+        # (scale(loss_g) between unscale_(opt_d) and step(opt_d)) cannot
+        # trigger a double division (reference: OptimizerState per optimizer)
         return loss * self._scale
 
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        if self._unscaled:
+        st = self._opt_state(optimizer)
+        if st["unscaled"]:
             # explicit unscale_ + step workflow (grad clipping): step's
             # internal unscale_ must not divide a second time (the
-            # reference guards this via OptimizerState)
+            # reference guards this per-optimizer via OptimizerState)
             return
-        self._unscaled = True
+        st["unscaled"] = True
         from ..core.selected_rows import RowSparseGrad
         inv = 1.0 / self._scale
         # ONE fused device program + ONE host sync for the whole grad set
@@ -177,7 +197,7 @@ class GradScaler:
         leaves = [p.grad._data for p in dense] + \
             [p.grad.values for p in sparse]
         if not leaves:
-            self._found_inf = False
+            self._found_inf = st["found_inf"] = False
             return
         if self._jit_unscale is None:
             def _unscale(leaves, inv):
@@ -188,7 +208,7 @@ class GradScaler:
                 return out, finite
             self._jit_unscale = jax.jit(_unscale)
         out, finite = self._jit_unscale(leaves, jnp.float32(inv))
-        self._found_inf = not bool(finite)  # the single host sync
+        self._found_inf = st["found_inf"] = not bool(finite)  # one host sync
         for p, g in zip(dense, out[:len(dense)]):
             p.grad._set_data(g)
         for p, v in zip(sparse, out[len(dense):]):
@@ -199,10 +219,14 @@ class GradScaler:
             optimizer.step()
             return
         self.unscale_(optimizer)
-        if not self._found_inf:
+        st = self._opt_state(optimizer)
+        # decide from THIS optimizer's unscale result, not whichever
+        # optimizer was unscaled last (multi-loss GAN interleave)
+        if not st["found_inf"]:
             optimizer.step()
-        self._update()
-        self._unscaled = False
+        self._update(st["found_inf"])
+        st["unscaled"] = False
+        st["found_inf"] = False
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()
@@ -212,10 +236,10 @@ class GradScaler:
     def update(self):
         pass  # folded into step, kept for API compat
 
-    def _update(self):
+    def _update(self, found_inf):
         if not self._dynamic:
             return
-        if self._found_inf:
+        if found_inf:
             self._bad_steps += 1
             self._good_steps = 0
             if self._bad_steps >= self._decr_every:

@@ -75,8 +75,16 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
         return raw_fn(*args, **kwargs)
 
     raw = [x._data if isinstance(x, Tensor) else x for x in flat]
-    if _amp_hook is not None:
-        raw = _amp_hook(name, raw, tensor_idx)
+    # NOTE: the AMP cast runs INSIDE the differentiated closure below, so the
+    # vjp of the cast maps cotangents back to each input's original dtype
+    # (bf16 activations get bf16 grads, f32 master params get f32 grads even
+    # when the op computed in bf16).  Casting before jax.vjp instead would
+    # hand the tape cotangents in the compute dtype and break accumulation
+    # against upstream nodes recorded in the storage dtype.
+    amp = _amp_hook
+
+    def apply_amp(leaves):
+        return amp(name, leaves, tensor_idx) if amp is not None else leaves
 
     need_grad = (is_grad_enabled()
                  and any(not flat[i].stop_gradient for i in tensor_idx))
@@ -86,7 +94,7 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
         if prof is not None:
             prof.__enter__()
         if not need_grad:
-            a2, k2 = jax.tree_util.tree_unflatten(treedef, raw)
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, apply_amp(raw))
             out = raw_fn(*a2, **k2)
             if _check_nan_inf:
                 _assert_finite(name, out)
@@ -96,7 +104,7 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
         diff_idx = [i for i in tensor_idx
                     if not flat[i].stop_gradient and _is_diff_dtype(raw[i])]
         if not diff_idx:
-            a2, k2 = jax.tree_util.tree_unflatten(treedef, raw)
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, apply_amp(raw))
             out = raw_fn(*a2, **k2)
             if _check_nan_inf:
                 _assert_finite(name, out)
@@ -106,7 +114,7 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
             leaves = list(raw)
             for i, v in zip(diff_idx, diff_vals):
                 leaves[i] = v
-            a2, k2 = jax.tree_util.tree_unflatten(treedef, leaves)
+            a2, k2 = jax.tree_util.tree_unflatten(treedef, apply_amp(leaves))
             return raw_fn(*a2, **k2)
 
         out_raw, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])

@@ -31,7 +31,14 @@ def _layer_flops(layer, inputs, out_shape) -> int:
     out_elems = _numel(out_shape)
     if name == "Linear":
         return out_elems * layer.weight.shape[0]
-    if name in ("Conv2D", "Conv1D", "Conv3D", "Conv2DTranspose"):
+    if name in ("Conv2DTranspose", "Conv1DTranspose", "Conv3DTranspose"):
+        # weight is (in_c, out_c/groups, *k): every input element feeds
+        # out_c/groups * prod(k) outputs -> MACs = in_elems * numel(w[1:])
+        if not inputs:
+            return 0
+        in_elems = _numel(tuple(inputs[0].shape))
+        return in_elems * _numel(layer.weight.shape[1:])
+    if name in ("Conv2D", "Conv1D", "Conv3D"):
         w = layer.weight.shape  # (out_c, in_c/groups, *k)
         kernel_ops = _numel(w[1:])
         return out_elems * kernel_ops
@@ -67,11 +74,9 @@ def summary(net, input_size=None, dtypes=None, input=None):  # noqa: A002
                             _layer_flops(layer, inputs, oshape)))
         return hook
 
-    leaf_seen = set()
     for lname, sub in net.named_sublayers():
         if next(sub.children(), None) is None:  # leaves only
             hooks.append(sub.register_forward_post_hook(make_hook(lname)))
-            leaf_seen.add(lname)
 
     x = input
     if x is None and input_size is None:

@@ -1,23 +1,36 @@
 """DataLoader.
 
-Reference: python/paddle/fluid/reader.py DataLoader (multiprocess workers +
-shared-mem mmap tensors) feeding operators/reader/buffered_reader.cc (device
-double-buffering).  TPU-native: multiprocess loading via a process pool +
-host->device prefetch pipeline (async device_put of the next batches while the
-current one computes) — the buffered_reader equivalent.
+Reference: python/paddle/fluid/reader.py:412 (DataLoader: forked worker
+processes + shared-memory tensor transfer + _DataLoaderIter reorder logic)
+feeding operators/reader/buffered_reader.cc (device double-buffering).
+
+TPU-native design:
+- num_workers > 0 forks worker PROCESSES (multiprocessing, fork context);
+  each worker materializes+collates its index batch and ships the arrays
+  through POSIX shared memory (multiprocessing.shared_memory), the analogue
+  of the reference's mmap'd _shared_memory tensors.  Results are re-ordered
+  by sequence number and the number of in-flight batches is bounded by
+  num_workers * prefetch_factor — never the whole epoch.
+- the consumer side stages batches onto the device asynchronously
+  (jax.device_put pipeline) — the buffered_reader equivalent.
+- persistent_workers keeps the pool alive across epochs; worker_init_fn
+  runs once in each worker (reference semantics).
 """
 from __future__ import annotations
 
 import itertools
+import multiprocessing as mp
 import queue
 import threading
-from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from typing import Optional
 
 import jax
 import numpy as np
 
 from ..core.tensor import Tensor
 from .dataset import BatchSampler, IterableDataset
+
+_SHM_MIN_BYTES = 1 << 14  # small arrays go through the pickle queue
 
 
 def default_collate_fn(batch):
@@ -41,6 +54,203 @@ def _fetch(dataset, indices, collate_fn):
     return collate_fn([dataset[i] for i in indices])
 
 
+# -- shared-memory encode/decode ---------------------------------------------
+
+class _ShmRef:
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name, shape, dtype):
+        self.name = name
+        self.shape = shape
+        self.dtype = dtype
+
+
+def _encode(obj, use_shm):
+    from multiprocessing import shared_memory
+    if isinstance(obj, tuple):
+        return tuple(_encode(o, use_shm) for o in obj)
+    if isinstance(obj, list):
+        return [_encode(o, use_shm) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _encode(v, use_shm) for k, v in obj.items()}
+    if (use_shm and isinstance(obj, np.ndarray)
+            and obj.nbytes >= _SHM_MIN_BYTES):
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        view = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
+        view[...] = obj
+        ref = _ShmRef(shm.name, obj.shape, str(obj.dtype))
+        shm.close()
+        # ownership transfers to the consumer (which unlinks after copying);
+        # drop this process's resource-tracker claim so its exit cleanup
+        # doesn't race a block the parent already removed
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(shm._name, "shared_memory")
+        except Exception:
+            pass
+        return ref
+    return obj
+
+
+def _decode(obj):
+    from multiprocessing import shared_memory
+    if isinstance(obj, tuple):
+        return tuple(_decode(o) for o in obj)
+    if isinstance(obj, list):
+        return [_decode(o) for o in obj]
+    if isinstance(obj, dict):
+        return {k: _decode(v) for k, v in obj.items()}
+    if isinstance(obj, _ShmRef):
+        shm = shared_memory.SharedMemory(name=obj.name)
+        try:
+            view = np.ndarray(obj.shape, np.dtype(obj.dtype), buffer=shm.buf)
+            out = np.array(view)  # own the data before releasing the block
+        finally:
+            shm.close()
+            shm.unlink()
+        return out
+    return obj
+
+
+def _worker_loop(dataset, collate_fn, task_q, result_q, worker_id,
+                 use_shm, worker_init_fn):
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        item = task_q.get()
+        if item is None:
+            break
+        epoch, seq, indices = item
+        try:
+            batch = _encode(_fetch(dataset, indices, collate_fn), use_shm)
+            result_q.put((epoch, seq, batch, None))
+        except Exception as e:  # surface worker errors to the parent
+            result_q.put((epoch, seq, None, f"{type(e).__name__}: {e}"))
+
+
+class _WorkerPool:
+    """Forked worker processes with bounded in-flight tasks + reordering."""
+
+    def __init__(self, dataset, collate_fn, num_workers, use_shm,
+                 worker_init_fn, timeout):
+        ctx = mp.get_context("fork")
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self._timeout = timeout if timeout and timeout > 0 else None
+        self._epoch = 0
+        self._procs = [
+            ctx.Process(target=_worker_loop,
+                        args=(dataset, collate_fn, self._task_q,
+                              self._result_q, wid, use_shm, worker_init_fn),
+                        daemon=True)
+            for wid in range(num_workers)]
+        for p in self._procs:
+            p.start()
+
+    def _get_result(self):
+        """Blocking result fetch that detects dead workers and honors the
+        user timeout with a meaningful error (reference: reader.py raises on
+        worker exit; torch detects OOM-killed workers the same way)."""
+        waited = 0.0
+        while True:
+            try:
+                return self._result_q.get(timeout=1.0)
+            except queue.Empty:
+                if not self.alive():
+                    raise RuntimeError(
+                        "DataLoader worker process died unexpectedly "
+                        "(killed or crashed) with a task in flight")
+                waited += 1.0
+                if self._timeout is not None and waited >= self._timeout:
+                    raise RuntimeError(
+                        f"DataLoader worker timed out after {waited:.0f}s")
+
+    def run(self, index_batches, max_in_flight):
+        """Yield collated numpy batches in order.
+
+        Every task/result carries an epoch id: stale in-flight results from
+        an abandoned or failed earlier run (persistent workers) are decoded
+        and dropped — decoding frees their shared-memory blocks and keeps
+        sequence numbers from colliding across epochs."""
+        self._epoch += 1
+        epoch = self._epoch
+        it = enumerate(index_batches)
+        pending = {}
+        next_seq = 0
+        in_flight = 0
+        exhausted = False
+        try:
+            while True:
+                while not exhausted and in_flight < max_in_flight:
+                    try:
+                        seq, idx = next(it)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    self._task_q.put((epoch, seq, list(idx)))
+                    in_flight += 1
+                if in_flight == 0:
+                    return
+                while next_seq not in pending:
+                    ep, seq, batch, err = self._get_result()
+                    if ep != epoch:
+                        if batch is not None:
+                            _decode(batch)  # free stale shm, discard
+                        continue
+                    if err is not None:
+                        raise RuntimeError(
+                            f"DataLoader worker failed: {err}")
+                    pending[seq] = batch
+                in_flight -= 1
+                yield _decode(pending.pop(next_seq))
+                next_seq += 1
+        finally:
+            # abandoned / failed epoch: free every shm block we can see now;
+            # later-arriving strays are freed by the stale-epoch branch above
+            # on the next run, or by shutdown()'s drain
+            for b in pending.values():
+                _decode(b)
+            self._drain()
+
+    def _drain(self):
+        """Decode-and-discard everything currently in the result queue
+        (frees shared-memory blocks whose ownership passed to this side)."""
+        while True:
+            try:
+                _, _, batch, _ = self._result_q.get_nowait()
+            except queue.Empty:
+                return
+            except Exception:
+                return
+            if batch is not None:
+                _decode(batch)
+
+    def shutdown(self):
+        import time as _time
+        for _ in self._procs:
+            try:
+                self._task_q.put(None)
+            except Exception:
+                pass
+        # drain WHILE joining: a worker blocked on a full result pipe can
+        # only reach its exit sentinel if this side keeps consuming (and
+        # decoding frees the shm ownership that was transferred to us)
+        deadline = _time.monotonic() + 5.0
+        procs = list(self._procs)
+        while procs and _time.monotonic() < deadline:
+            self._drain()
+            procs = [p for p in procs if p.is_alive()]
+            if procs:
+                procs[0].join(timeout=0.1)
+        for p in procs:
+            p.terminate()
+        self._procs = []
+        self._drain()  # workers have exited: anything left is ours to free
+
+    def alive(self):
+        return bool(self._procs) and all(p.is_alive() for p in self._procs)
+
+
 class DataLoader:
     """paddle.io.DataLoader — iterates device-resident batches."""
 
@@ -54,7 +264,12 @@ class DataLoader:
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
-        self.prefetch = max(2, prefetch_factor) if use_buffer_reader else 0
+        self.prefetch_factor = max(2, prefetch_factor)
+        self.prefetch = self.prefetch_factor if use_buffer_reader else 0
+        self.use_shared_memory = use_shared_memory
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.persistent_workers = persistent_workers
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -66,15 +281,53 @@ class DataLoader:
             self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
                                               batch_size=batch_size,
                                               drop_last=drop_last)
-        self._pool = None
+        self._pool: Optional[_WorkerPool] = None
+        self._pool_busy = False
+        self._pool_lock = threading.Lock()
 
     def __len__(self):
         if self._iterable_mode:
             raise TypeError("IterableDataset has no length")
         return len(self.batch_sampler)
 
+    def __del__(self):
+        try:
+            if self._pool is not None:
+                self._pool.shutdown()
+        except Exception:
+            pass
+
+    def _new_pool(self):
+        return _WorkerPool(self.dataset, self.collate_fn, self.num_workers,
+                           self.use_shared_memory, self.worker_init_fn,
+                           self.timeout)
+
+    def _acquire_pool(self):
+        """Returns (pool, owned): owned pools are shut down by the caller.
+        Persistent workers are reused across epochs, but a concurrent second
+        iterator over the same loader gets its own temporary pool (the shared
+        result queue cannot serve two epochs at once).  The check-and-mark is
+        under a lock: two threads iterating one loader must not both claim
+        the persistent pool."""
+        if not self.persistent_workers:
+            return self._new_pool(), True
+        with self._pool_lock:
+            if self._pool is not None and (self._pool_busy
+                                           or not self._pool.alive()):
+                if not self._pool_busy:
+                    self._pool.shutdown()
+                    self._pool = None
+                else:  # concurrent iteration: temporary private pool
+                    return self._new_pool(), True
+            if self._pool is None:
+                self._pool = self._new_pool()
+            self._pool_busy = True
+            return self._pool, False
+
     def _batches_numpy(self):
         if self._iterable_mode:
+            # workers for iterable datasets would need stream sharding;
+            # single-process here (the common map-style path is parallel)
             it = iter(self.dataset)
             while True:
                 chunk = list(itertools.islice(it, self.batch_size))
@@ -84,15 +337,16 @@ class DataLoader:
                     return
                 yield self.collate_fn(chunk)
         elif self.num_workers > 0:
-            # thread pool: dataset __getitem__ is typically numpy/PIL — the
-            # GIL is released in those C extensions; processes would require
-            # picklable datasets (we keep the reference's worker semantics
-            # without its shared-memory machinery).
-            with ThreadPoolExecutor(self.num_workers) as pool:
-                futures = [pool.submit(_fetch, self.dataset, idx, self.collate_fn)
-                           for idx in self.batch_sampler]
-                for fut in futures:
-                    yield fut.result()
+            pool, owned = self._acquire_pool()
+            max_in_flight = self.num_workers * self.prefetch_factor
+            try:
+                yield from pool.run(self.batch_sampler, max_in_flight)
+            finally:
+                if owned:
+                    pool.shutdown()
+                else:
+                    with self._pool_lock:
+                        self._pool_busy = False
         else:
             for idx in self.batch_sampler:
                 yield _fetch(self.dataset, idx, self.collate_fn)
@@ -112,19 +366,45 @@ class DataLoader:
 
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
+        stop = threading.Event()
+
+        def put_bounded(item):
+            # blocking put that aborts if the consumer has gone away
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
 
         def producer():
+            gen = self._batches_numpy()
             try:
-                for b in self._batches_numpy():
-                    q.put(to_device(b))  # device_put is async; enqueue ahead
+                for b in gen:
+                    put_bounded(to_device(b))  # device_put is async
+                    if stop.is_set():
+                        break
+            except BaseException as e:  # re-raised on the consumer side
+                put_bounded(e)
             finally:
-                q.put(sentinel)
+                gen.close()  # runs _batches_numpy's pool cleanup
+                put_bounded(sentinel)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
-        t.join()
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()  # consumer broke early: unblock + clean up producer
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            t.join(timeout=10)
