@@ -70,11 +70,16 @@ def main():
         apply_decay_param_fun=lambda n: "bias" not in n and "norm" not in n)
     # r2 tuning notes (v5e, flash-attention kernels live in the step):
     # - b8 no-remat remains the best operating point: b16 no-remat 257ms
-    #   (31.9k tok/s), b16 remat 320ms, vs b8 123ms (33.1k tok/s).
-    # - component profile (long in-jit scans): fwd 44ms (20.5ms of it
-    #   attention — softmax/VPU-bound; our pallas kernel at 0.86ms/layer
-    #   already beats XLA-fused 0.92ms and splash 1.55ms at this shape),
-    #   bwd ~64ms, AdamW 7ms.
+    #   (31.9k tok/s), b16 remat 320ms, vs b8 ~102ms (40.3k tok/s).
+    # - attention was the bottleneck: per-head (512,512,64) dots run at MXU
+    #   row-rate (~16 TF/s ceiling measured for ANY kernel at this shape —
+    #   bare dots, XLA naive, and jax's reference flash all land there; the
+    #   d=64 contraction fills half the 128-deep systolic array).  The fix
+    #   that got from 123ms->102ms/step: natural-layout head-folded kernels
+    #   (ops/flash_attention.py) — read (B,S,H*D) blocks directly (no HBM
+    #   transposes), amortize loads over a 4-head group per grid step, and
+    #   skip the online-softmax rescale machinery when the whole k axis fits
+    #   one block.  Measured fwd+bwd attention: 0.84 ms/layer (was ~2.5).
     # - per-jit-call tunnel overhead is ~15ms, so the bench drives K steps
     #   per compiled call via TrainStep.run_steps (the analogue of the
     #   reference's in-executor dataset train loop).

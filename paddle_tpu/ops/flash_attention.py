@@ -9,13 +9,20 @@ Design notes (see /opt/skills/guides/pallas_guide.md):
 - q/k/v stay in their input dtype (bf16 under AMP) going into the MXU dots
   with `preferred_element_type=f32` accumulation; only the softmax state is
   kept in f32.
+- **Natural layout**: the kernels read (B, S, H*D) blocks straight out of the
+  model's (batch, seq, heads, head_dim) tensors — no (B,S,H,D)->(B*H,S,D)
+  transpose through HBM on either side.  A grid step owns a GROUP of G heads
+  (G*D lanes, 128 <= G*D <= 512) and loops over them in-register: per-head
+  (s, d) matmuls at d=64 run at MXU row-rate, so amortizing every load/store
+  across a head group is worth ~1.8x over a head-per-step grid (measured on
+  v5e at BERT-large shapes).
 - The backward is the FlashAttention-2 recompute scheme: the forward saves
-  only O and the per-row logsumexp; two backward kernels recompute the score
-  blocks and produce dQ (grid over q blocks) and dK/dV (grid over k blocks).
-- Dropout is applied *inside* the kernel from a counter-based hash of the
-  absolute (head, row, col) coordinates + a seed, so the keep mask is
-  bit-identical between forward and backward regardless of block tiling, and
-  it runs under `interpret=True` on CPU (the TPU PRNG primitives do not).
+  only O and the per-row logsumexp; one merged backward kernel recomputes the
+  score blocks and produces dQ partials, dK and dV in a single pass.
+- Dropout is applied *inside* the kernel from the TPU hardware PRNG re-seeded
+  per (head, q-block, k-block), so the keep mask is bit-identical between
+  forward and backward regardless of grid order.  Under `interpret=True`
+  (CPU CI) a murmur-style hash of absolute coordinates replaces the PRNG.
 - Masking: `causal`, an additive per-key bias (B, Sk) covering padding masks,
   and q/kv segment ids (packed-sequence masking) are fused into the kernel.
 
@@ -51,6 +58,21 @@ def _block(size: int) -> int:
     return next(b for b in (512, 256, 128) if size % b == 0)
 
 
+def _head_group(h: int, d: int):
+    """Heads per grid step: largest divisor of h with 128 <= g*d <= 512,
+    preferring g*d == 256 (the measured sweet spot on v5e).  Falls back to
+    folding ALL heads into one group — a block whose lane dim equals the
+    array's full last dim is exempt from the 128-divisibility rule."""
+    # lane width g*d must be a multiple of 128 (or the array's full last
+    # dim h*d, the one exemption Mosaic grants) — h=6,d=64 must pick g=2
+    # (128 lanes), not g=3 (192 lanes, unlowerable)
+    cands = [g for g in range(1, h + 1)
+             if h % g == 0 and 128 <= g * d <= 512 and (g * d) % 128 == 0]
+    if not cands:
+        return h  # full fold: block last dim == array last dim is allowed
+    return min(cands, key=lambda g: (abs(g * d - 256), -g))
+
+
 def flash_attention_bshd(q, k, v, causal=False, bias=None, q_segment_ids=None,
                          kv_segment_ids=None, dropout_p=0.0, dropout_seed=None):
     """q/k/v: (batch, seq, heads, head_dim). Returns same layout, or None.
@@ -75,9 +97,11 @@ def flash_attention_bshd(q, k, v, causal=False, bias=None, q_segment_ids=None,
         return None
     if (q_segment_ids is None) != (kv_segment_ids is None):
         return None
-    qt = jnp.swapaxes(q, 1, 2).reshape(b * h, sq, d)
-    kt = jnp.swapaxes(k, 1, 2).reshape(b * h, sk, d)
-    vt = jnp.swapaxes(v, 1, 2).reshape(b * h, sk, d)
+    g = _head_group(h, d)
+    # natural layout: (B, S, H, D) -> (B, S, H*D) is a free reshape
+    qt = q.reshape(b, sq, h * d)
+    kt = k.reshape(b, sk, h * d)
+    vt = v.reshape(b, sk, h * d)
     # reshape mask inputs so every pallas block satisfies the TPU tiling
     # rule (last two dims divisible by (8,128) or equal to the array's):
     # per-key vectors ride the lane axis as (B, 1, Sk), per-query ids the
@@ -93,8 +117,8 @@ def flash_attention_bshd(q, k, v, causal=False, bias=None, q_segment_ids=None,
     if dropout_p > 0.0:
         _hw_prng_available()  # resolve the bit-source before kernel trace
     out = _flash(qt, kt, vt, bias, q_segment_ids, kv_segment_ids,
-                 dropout_seed, bool(causal), float(dropout_p), h)
-    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+                 dropout_seed, bool(causal), float(dropout_p), h, g)
+    return out.reshape(b, sq, h, d)
 
 
 # ---------------------------------------------------------------------------
@@ -162,21 +186,21 @@ def _coords(qi, ki, blk_q, blk_k):
     return rows, cols
 
 
-def _mask_specs(has_bias, has_seg, heads, blk_q, blk_k, q_pos):
+def _mask_specs(has_bias, has_seg, blk_q, blk_k, q_pos):
     """BlockSpecs for the optional [bias, qseg, kseg] inputs (in that order).
-    `q_pos` says which of the two non-batch grid axes (0 or 1) walks the
-    q blocks. Per-key inputs are (B, 1, Sk), per-query ones (B, Sq, 1)."""
+    `q_pos` says which of the two non-(batch/group) grid axes (0 or 1) walks
+    the q blocks. Per-key inputs are (B, 1, Sk), per-query ones (B, Sq, 1)."""
     k_pos = 1 - q_pos
 
     def spec_k(pos):
         return pl.BlockSpec(
             (1, 1, blk_k),
-            lambda b, a1, a2, s, _p=pos: (b // heads, 0, (a1, a2)[_p]))
+            lambda b, g, a1, a2, s, _p=pos: (b, 0, (a1, a2)[_p]))
 
     def spec_q(pos):
         return pl.BlockSpec(
             (1, blk_q, 1),
-            lambda b, a1, a2, s, _p=pos: (b // heads, (a1, a2)[_p], 0))
+            lambda b, g, a1, a2, s, _p=pos: (b, (a1, a2)[_p], 0))
 
     out = []
     if has_bias:
@@ -187,10 +211,10 @@ def _mask_specs(has_bias, has_seg, heads, blk_q, blk_k, q_pos):
     return out
 
 
-def _masked_scores(q_ref, k_ref, bias_ref, qseg_ref, kseg_ref, qi, ki,
+def _masked_scores(q_hd, k_hd, bias_ref, qseg_ref, kseg_ref, qi, ki,
                    blk_q, blk_k, scale, causal, causal_off):
-    """Recompute one (blk_q, blk_k) score block with all masks applied (f32)."""
-    s = jax.lax.dot_general(q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+    """One (blk_q, blk_k) score block for one head with all masks (f32)."""
+    s = jax.lax.dot_general(q_hd, k_hd, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
     if bias_ref is not None:
         s = s + bias_ref[0]  # (1, blk_k) broadcast over rows
@@ -209,67 +233,109 @@ def _masked_scores(q_ref, k_ref, bias_ref, qseg_ref, kseg_ref, qi, ki,
 
 
 def _fwd_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
-                blk_q, blk_k, n_k, scale, causal_off):
+                blk_q, blk_k, n_k, scale, causal_off, heads, hg):
     it = iter(refs)
     q_ref, k_ref, v_ref = next(it), next(it), next(it)
     bias_ref = next(it) if has_bias else None
     qseg_ref = next(it) if has_seg else None
     kseg_ref = next(it) if has_seg else None
     o_ref, lse_ref = next(it), next(it)
-    acc_ref, m_ref, l_ref = next(it), next(it), next(it)
+    if n_k > 1:
+        acc_ref, m_ref, l_ref = next(it), next(it), next(it)
 
-    bh, qi, ki = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    b, g, qi, ki = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
+                    pl.program_id(3))
+    d = q_ref.shape[-1] // hg
 
-    @pl.when(ki == 0)
-    def _init():
-        acc_ref[...] = jnp.zeros_like(acc_ref)
-        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
-        l_ref[...] = jnp.zeros_like(l_ref)
+    if n_k > 1:
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+            m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+            l_ref[...] = jnp.zeros_like(l_ref)
 
-    def _compute():
-        s = _masked_scores(q_ref, k_ref, bias_ref, qseg_ref, kseg_ref,
-                           qi, ki, blk_q, blk_k, scale, causal, causal_off)
-        m_prev = m_ref[...]
+    def _head(h):
+        sl = slice(h * d, (h + 1) * d)
+        s = _masked_scores(q_ref[0][:, sl], k_ref[0][:, sl], bias_ref,
+                           qseg_ref, kseg_ref, qi, ki, blk_q, blk_k,
+                           scale, causal, causal_off)
+        bh = b * jnp.int32(heads) + g * jnp.int32(hg) + jnp.int32(h)
+        if n_k == 1:
+            m = jnp.max(s, axis=1, keepdims=True)
+            p = jnp.exp(s - m)
+            l = jnp.maximum(jnp.sum(p, axis=1, keepdims=True), 1e-30)
+            if dropout_p > 0.0:
+                keep = _keep_mask(seed_ref, bh, qi, ki, blk_q, blk_k,
+                                  dropout_p)
+                p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
+            o = jax.lax.dot(p.astype(v_ref.dtype), v_ref[0][:, sl],
+                            preferred_element_type=jnp.float32) / l
+            return o.astype(o_ref.dtype), m + jnp.log(l)
+        # online-softmax path (multiple k blocks)
+        hsl = slice(h, h + 1)
+        m_prev = m_ref[:, hsl]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_cur)
         alpha = jnp.exp(m_prev - m_cur)
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        m_ref[...] = m_cur
+        l_ref[:, hsl] = l_ref[:, hsl] * alpha + jnp.sum(p, axis=1,
+                                                        keepdims=True)
+        m_ref[:, hsl] = m_cur
         if dropout_p > 0.0:
             keep = _keep_mask(seed_ref, bh, qi, ki, blk_q, blk_k, dropout_p)
             p = jnp.where(keep, p * (1.0 / (1.0 - dropout_p)), 0.0)
-        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot(
-            p.astype(v_ref.dtype), v_ref[0],
+        acc_ref[:, sl] = acc_ref[:, sl] * alpha + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[0][:, sl],
             preferred_element_type=jnp.float32)
+        return None, None
 
-    if causal:
+    def _compute():
+        if n_k == 1:
+            outs, lses = [], []
+            for h in range(hg):
+                o, lse = _head(h)
+                outs.append(o)
+                lses.append(lse)
+            o_ref[0] = jnp.concatenate(outs, axis=1)
+            lse_ref[0, 0] = jnp.concatenate(lses, axis=1)
+        else:
+            for h in range(hg):
+                _head(h)
+
+    if causal and n_k > 1:
         @pl.when(qi * blk_q + blk_q - 1 + causal_off >= ki * blk_k)
         def _go():
             _compute()
     else:
         _compute()
 
-    @pl.when(ki == n_k - 1)
-    def _finish():
-        l = jnp.maximum(l_ref[...], 1e-30)
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[...] + jnp.log(l)
+    if n_k > 1:
+        @pl.when(ki == n_k - 1)
+        def _finish():
+            l = jnp.maximum(l_ref[...], 1e-30)
+            d_ = q_ref.shape[-1] // hg
+            parts = [(acc_ref[:, h * d_:(h + 1) * d_] / l[:, h:h + 1])
+                     for h in range(hg)]
+            o_ref[0] = jnp.concatenate(parts, axis=1).astype(o_ref.dtype)
+            lse_ref[0, 0] = m_ref[...] + jnp.log(l)
 
 
-def _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads):
-    bh, sq, d = q.shape
+def _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads, hg):
+    b, sq, hd = q.shape
     sk = k.shape[1]
+    d = hd // heads
+    gd = hg * d
+    n_hg = heads // hg
     blk_q, blk_k = _block(sq), _block(sk)
     n_q, n_k = sq // blk_q, sk // blk_k
     scale = 1.0 / math.sqrt(d)
 
     in_specs = [
-        pl.BlockSpec((1, blk_q, d), lambda b, i, j, s: (b, i, 0)),
-        pl.BlockSpec((1, blk_k, d), lambda b, i, j, s: (b, j, 0)),
-        pl.BlockSpec((1, blk_k, d), lambda b, i, j, s: (b, j, 0)),
+        pl.BlockSpec((1, blk_q, gd), lambda b, g, i, j, s: (b, i, g)),
+        pl.BlockSpec((1, blk_k, gd), lambda b, g, i, j, s: (b, j, g)),
+        pl.BlockSpec((1, blk_k, gd), lambda b, g, i, j, s: (b, j, g)),
     ]
     inputs = [q, k, v]
-    in_specs += _mask_specs(bias is not None, qseg is not None, heads,
+    in_specs += _mask_specs(bias is not None, qseg is not None,
                             blk_q, blk_k, q_pos=0)
     if bias is not None:
         inputs.append(bias)
@@ -279,30 +345,36 @@ def _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads):
     kernel = functools.partial(
         _fwd_kernel, has_bias=bias is not None, has_seg=qseg is not None,
         causal=causal, dropout_p=dropout_p, blk_q=blk_q, blk_k=blk_k,
-        n_k=n_k, scale=scale, causal_off=sk - sq)
+        n_k=n_k, scale=scale, causal_off=sk - sq, heads=heads, hg=hg)
+
+    scratch = []
+    if n_k > 1:
+        scratch = [
+            pltpu.VMEM((blk_q, gd), jnp.float32),
+            pltpu.VMEM((blk_q, hg), jnp.float32),
+            pltpu.VMEM((blk_q, hg), jnp.float32),
+        ]
 
     o, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(bh, n_q, n_k),
+            grid=(b, n_hg, n_q, n_k),
             in_specs=in_specs,
             out_specs=[
-                pl.BlockSpec((1, blk_q, d), lambda b, i, j, s: (b, i, 0)),
-                pl.BlockSpec((1, blk_q, 1), lambda b, i, j, s: (b, i, 0)),
+                pl.BlockSpec((1, blk_q, gd), lambda b, g, i, j, s: (b, i, g)),
+                pl.BlockSpec((1, 1, blk_q, hg),
+                             lambda b, g, i, j, s: (b, g, i, 0)),
             ],
-            scratch_shapes=[
-                pltpu.VMEM((blk_q, d), jnp.float32),
-                pltpu.VMEM((blk_q, 1), jnp.float32),
-                pltpu.VMEM((blk_q, 1), jnp.float32),
-            ],
+            scratch_shapes=scratch,
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, sq, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, n_hg, sq, hg), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=_INTERPRET,
     )(seed, *inputs)
     return o, lse
@@ -321,7 +393,7 @@ def _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads):
 
 
 def _bwd_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
-                blk_q, blk_k, n_q, scale, causal_off):
+                blk_q, blk_k, n_q, scale, causal_off, heads, hg):
     it = iter(refs)
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref = (
         next(it), next(it), next(it), next(it), next(it), next(it))
@@ -333,7 +405,9 @@ def _bwd_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
     dk_acc, dv_acc = next(it), next(it)
     db_acc = next(it) if has_bias else None
 
-    bh, ki, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    b, g, ki, qi = (pl.program_id(0), pl.program_id(1), pl.program_id(2),
+                    pl.program_id(3))
+    d = q_ref.shape[-1] // hg
 
     @pl.when(qi == 0)
     def _init():
@@ -343,31 +417,42 @@ def _bwd_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
             db_acc[...] = jnp.zeros_like(db_acc)
 
     def _compute():
-        s = _masked_scores(q_ref, k_ref, bias_ref, qseg_ref, kseg_ref,
-                           qi, ki, blk_q, blk_k, scale, causal, causal_off)
-        p = jnp.exp(s - lse_ref[0])
-        dpd = jax.lax.dot_general(
-            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        if dropout_p > 0.0:
-            keep = _keep_mask(seed_ref, bh, qi, ki, blk_q, blk_k, dropout_p)
-            inv = 1.0 / (1.0 - dropout_p)
-            pd = jnp.where(keep, p * inv, 0.0)
-            dp = jnp.where(keep, dpd * inv, 0.0)
-        else:
-            pd, dp = p, dpd
-        dv_acc[...] += jax.lax.dot_general(
-            pd.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0])
-        dk_acc[...] += jax.lax.dot_general(
-            ds.astype(q_ref.dtype), q_ref[0], (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if has_bias:  # d(bias_k) = sum over q rows of dS (heads summed later)
-            db_acc[...] += jnp.sum(ds, axis=0, keepdims=True)
-        dqp_ref[0, 0] = (jax.lax.dot(
-            ds.astype(k_ref.dtype), k_ref[0],
-            preferred_element_type=jnp.float32) * scale).astype(dqp_ref.dtype)
+        dq_parts = []
+        for h in range(hg):
+            sl = slice(h * d, (h + 1) * d)
+            s = _masked_scores(q_ref[0][:, sl], k_ref[0][:, sl], bias_ref,
+                               qseg_ref, kseg_ref, qi, ki, blk_q, blk_k,
+                               scale, causal, causal_off)
+            p = jnp.exp(s - lse_ref[0, 0][:, h:h + 1])
+            dpd = jax.lax.dot_general(
+                do_ref[0][:, sl], v_ref[0][:, sl], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            if dropout_p > 0.0:
+                bh = (b * jnp.int32(heads) + g * jnp.int32(hg)
+                      + jnp.int32(h))
+                keep = _keep_mask(seed_ref, bh, qi, ki, blk_q, blk_k,
+                                  dropout_p)
+                inv = 1.0 / (1.0 - dropout_p)
+                pd = jnp.where(keep, p * inv, 0.0)
+                dp = jnp.where(keep, dpd * inv, 0.0)
+            else:
+                pd, dp = p, dpd
+            dv_acc[:, sl] += jax.lax.dot_general(
+                pd.astype(do_ref.dtype), do_ref[0][:, sl],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta_ref[0, 0][:, h:h + 1])
+            dk_acc[:, sl] += jax.lax.dot_general(
+                ds.astype(q_ref.dtype), q_ref[0][:, sl],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if has_bias:  # d(bias_k) = sum over q rows of dS (heads summed)
+                db_acc[...] += jnp.sum(ds, axis=0, keepdims=True)
+            dq_parts.append((jax.lax.dot(
+                ds.astype(k_ref.dtype), k_ref[0][:, sl],
+                preferred_element_type=jnp.float32) * scale))
+        dqp_ref[0, 0] = jnp.concatenate(dq_parts, axis=1).astype(
+            dqp_ref.dtype)
 
     if causal:
         cond = qi * blk_q + blk_q - 1 + causal_off >= ki * blk_k
@@ -387,32 +472,39 @@ def _bwd_kernel(seed_ref, *refs, has_bias, has_seg, causal, dropout_p,
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
         if has_bias:
-            dbias_ref[0] = db_acc[...]
+            dbias_ref[0, 0] = db_acc[...]
 
 
 def _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, do,
-              causal, dropout_p, heads):
-    bh, sq, d = q.shape
+              causal, dropout_p, heads, hg):
+    b, sq, hd = q.shape
     sk = k.shape[1]
+    d = hd // heads
+    gd = hg * d
+    n_hg = heads // hg
     blk_q, blk_k = _block(sq), _block(sk)
     n_q, n_k = sq // blk_q, sk // blk_k
     scale = 1.0 / math.sqrt(d)
     causal_off = sk - sq
 
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)  # (bh, sq, 1)
+    # delta[b, s, h] = sum_d do*o, laid out (B, n_hg, Sq, hg) like lse
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).reshape(
+        b, sq, heads, d).sum(-1).reshape(b, sq, n_hg, hg).transpose(
+        0, 2, 1, 3)
 
-    # grid (bh, k block, q block): dk/dv owned per outer k step, dq written
-    # as per-k partials summed below
+    # grid (b, head group, k block, q block): dk/dv owned per outer k step,
+    # dq written as per-k partials summed below
     kv_specs = [
-        pl.BlockSpec((1, blk_q, d), lambda b, j, i, s: (b, i, 0)),   # q
-        pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),   # k
-        pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),   # v
-        pl.BlockSpec((1, blk_q, d), lambda b, j, i, s: (b, i, 0)),   # do
-        pl.BlockSpec((1, blk_q, 1), lambda b, j, i, s: (b, i, 0)),   # lse
-        pl.BlockSpec((1, blk_q, 1), lambda b, j, i, s: (b, i, 0)),   # delta
+        pl.BlockSpec((1, blk_q, gd), lambda b, g, j, i, s: (b, i, g)),  # q
+        pl.BlockSpec((1, blk_k, gd), lambda b, g, j, i, s: (b, j, g)),  # k
+        pl.BlockSpec((1, blk_k, gd), lambda b, g, j, i, s: (b, j, g)),  # v
+        pl.BlockSpec((1, blk_q, gd), lambda b, g, j, i, s: (b, i, g)),  # do
+        pl.BlockSpec((1, 1, blk_q, hg),
+                     lambda b, g, j, i, s: (b, g, i, 0)),               # lse
+        pl.BlockSpec((1, 1, blk_q, hg),
+                     lambda b, g, j, i, s: (b, g, i, 0)),               # delta
     ]
-    kv_extra = _mask_specs(bias is not None, qseg is not None, heads,
+    kv_extra = _mask_specs(bias is not None, qseg is not None,
                            blk_q, blk_k, q_pos=1)
     inputs = [q, k, v, do, lse, delta] + \
         ([] if bias is None else [bias]) + \
@@ -424,40 +516,44 @@ def _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, do,
             _bwd_kernel, has_bias=bias is not None,
             has_seg=qseg is not None, causal=causal, dropout_p=dropout_p,
             blk_q=blk_q, blk_k=blk_k, n_q=n_q, scale=scale,
-            causal_off=causal_off),
+            causal_off=causal_off, heads=heads, hg=hg),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(bh, n_k, n_q),
+            grid=(b, n_hg, n_k, n_q),
             in_specs=kv_specs + kv_extra,
             out_specs=[
-                pl.BlockSpec((1, 1, blk_q, d),
-                             lambda b, j, i, s: (j, b, i, 0)),       # dq part
-                pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),
-                pl.BlockSpec((1, blk_k, d), lambda b, j, i, s: (b, j, 0)),
-            ] + ([pl.BlockSpec((1, 1, blk_k), lambda b, j, i, s: (b, 0, j))]
+                pl.BlockSpec((1, 1, blk_q, gd),
+                             lambda b, g, j, i, s: (j, b, i, g)),   # dq part
+                pl.BlockSpec((1, blk_k, gd),
+                             lambda b, g, j, i, s: (b, j, g)),
+                pl.BlockSpec((1, blk_k, gd),
+                             lambda b, g, j, i, s: (b, j, g)),
+            ] + ([pl.BlockSpec((1, 1, 1, blk_k),
+                               lambda b, g, j, i, s: (b, g, 0, j))]
                  if bias is not None else []),
             scratch_shapes=[
-                pltpu.VMEM((blk_k, d), jnp.float32),
-                pltpu.VMEM((blk_k, d), jnp.float32),
+                pltpu.VMEM((blk_k, gd), jnp.float32),
+                pltpu.VMEM((blk_k, gd), jnp.float32),
             ] + ([pltpu.VMEM((1, blk_k), jnp.float32)]
                  if bias is not None else []),
         ),
         out_shape=[
-            jax.ShapeDtypeStruct((n_k, bh, sq, d), dqp_dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
-        ] + ([jax.ShapeDtypeStruct((bh, 1, sk), jnp.float32)]
+            jax.ShapeDtypeStruct((n_k, b, sq, hd), dqp_dtype),
+            jax.ShapeDtypeStruct((b, sk, hd), k.dtype),
+            jax.ShapeDtypeStruct((b, sk, hd), v.dtype),
+        ] + ([jax.ShapeDtypeStruct((b, n_hg, 1, sk), jnp.float32)]
              if bias is not None else []),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary")),
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=_INTERPRET,
     )(seed, *inputs)
     dqp, dk, dv = outs[0], outs[1], outs[2]
     dq = dqp[0].astype(q.dtype) if n_k == 1 else \
         dqp.sum(axis=0).astype(q.dtype)
     dbias = None
-    if bias is not None:  # per-(batch*head) key sums -> sum heads -> (B,1,Sk)
-        dbias = outs[3].reshape(bias.shape[0], heads, 1, sk).sum(axis=1)
+    if bias is not None:  # per-(batch, head-group) key sums -> (B, 1, Sk)
+        dbias = outs[3].sum(axis=1)
     return dq, dk, dv, dbias
 
 
@@ -465,22 +561,23 @@ def _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, do,
 # custom_vjp glue
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9))
-def _flash(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads):
-    o, _ = _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _flash(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads, hg):
+    o, _ = _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p,
+                     heads, hg)
     return o
 
 
-def _flash_fwd(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads):
+def _flash_fwd(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads, hg):
     o, lse = _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p,
-                       heads)
+                       heads, hg)
     return o, (q, k, v, bias, qseg, kseg, seed, o, lse)
 
 
-def _flash_bwd(causal, dropout_p, heads, res, g):
+def _flash_bwd(causal, dropout_p, heads, hg, res, g):
     q, k, v, bias, qseg, kseg, seed, o, lse = res
     dq, dk, dv, dbias = _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, g,
-                                  causal, dropout_p, heads)
+                                  causal, dropout_p, heads, hg)
     dqseg = None if qseg is None else np.zeros(qseg.shape, jax.dtypes.float0)
     dkseg = None if kseg is None else np.zeros(kseg.shape, jax.dtypes.float0)
     dseed = np.zeros(seed.shape, jax.dtypes.float0)
