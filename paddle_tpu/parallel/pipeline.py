@@ -1,4 +1,4 @@
-"""Pipeline parallelism: GPipe microbatch schedule over the "pp" mesh axis.
+"""Pipeline parallelism: GPipe and 1F1B schedules over the "pp" mesh axis.
 
 Reference: PipelineOptimizer splits the program by device_guard annotations,
 inserts send_v2/recv_v2 p2p ops, and runs a fwd-all-then-bwd-all microbatch
@@ -6,11 +6,29 @@ loop in C++ SectionWorker (python/paddle/fluid/optimizer.py:3693,3713-3731;
 paddle/fluid/framework/section_worker.cc:44,61-110).
 
 TPU-native: no program splitting.  Identical transformer blocks are stacked
-on a leading axis sharded P("pp"); the GPipe tick loop is a `lax.fori_loop`
-whose stage→stage handoff is `lax.ppermute` over ICI, all inside one
-`shard_map` under `jit`.  Because ppermute/psum are differentiable,
-`jax.grad` of the pipelined forward IS the backward pipeline — the reference's
-hand-built SectionWorker bwd pass falls out of autodiff.
+on a leading axis sharded P("pp"); the tick loop is a `lax.fori_loop` whose
+stage→stage handoff is `lax.ppermute` over ICI, all inside one `shard_map`
+under `jit`.
+
+Two schedules:
+- "gpipe" (default): fwd-all-then-bwd-all.  Because ppermute/psum are
+  differentiable, `jax.grad` of the pipelined forward IS the backward
+  pipeline — the reference's hand-built SectionWorker bwd falls out of
+  autodiff.  The head/loss runs AFTER the loop over all microbatches at
+  once: collected outputs are `psum_scatter`ed across pp so every rank
+  head-computes only n_micro/n_stages microbatches (a p-fold dedup vs
+  broadcasting; falls back to broadcast when pp doesn't divide n_micro).
+- "1f1b": one-forward-one-backward (the schedule the reference's
+  interleaved SectionWorker family targets).  Hand-scheduled combined
+  ticks: tick t runs fwd of microbatch (t - stage), seeds the head vjp on
+  the stage that just finished, and runs bwd of microbatch
+  (t - 2(p-1) + stage) by RECOMPUTING the stage from a stashed input
+  activation (FlashAttention-style recompute-bwd, `jax.vjp` per stage per
+  tick).  PER-LAYER activations in flight are bounded by the stash (2p-1
+  microbatch inputs) instead of growing with n_micro like
+  autodiff-of-GPipe.  (The embedded inputs, their cotangent buffer and
+  the parameter-grad accumulators still scale with n_micro/model size —
+  the bound covers the dominant per-stage trajectory term only.)
 
 Layout: model blocks must be structurally identical (true for GPTBlock /
 BertLayer).  n_layers = n_stages * layers_per_stage; leaf shapes go from
@@ -78,7 +96,10 @@ class PipelinedTrainStep:
     def __init__(self, model: Layer, optimizer, mesh: Mesh,
                  block_re: str, block_module: Layer,
                  embed_fn: Callable, head_loss_fn: Callable,
-                 n_micro: int = 4, remat: bool = True):
+                 n_micro: int = 4, remat: bool = True,
+                 schedule: str = "gpipe"):
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pipeline schedule {schedule!r}")
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -88,6 +109,7 @@ class PipelinedTrainStep:
         self.head_loss_fn = head_loss_fn
         self.n_micro = n_micro
         self.remat = remat
+        self.schedule = schedule
         self.n_stages = mesh.shape["pp"]
         self.dp = mesh.shape.get("dp", 1)
         self._compiled = None
@@ -125,6 +147,20 @@ class PipelinedTrainStep:
                               Tensor(h), training=True)
         return out
 
+    def _run_stage(self, params, h, key, lps):
+        """One stage's lps-layer scan (shared by both schedules — the rng
+        fold and remat policy MUST be identical between them)."""
+        from ..core import rng as _rng
+
+        def layer(h, xs):
+            p, i = xs
+            with _rng.key_ctx(jax.random.fold_in(key, i)):
+                out = self._block_apply(p, h)
+            return unwrap(out), None
+        body = jax.checkpoint(layer) if self.remat else layer
+        h, _ = lax.scan(body, h, (params, jnp.arange(lps)))
+        return h
+
     # -- pipelined loss ------------------------------------------------------
     def _pipeline_loss(self, staged, rest, ids, labels, rng_key, lps):
         """Runs INSIDE shard_map: staged leaves arrive as (1, lps, ...) —
@@ -137,14 +173,7 @@ class PipelinedTrainStep:
         stage = lax.axis_index("pp")
 
         def run_stage(h, key):
-            def layer(h, xs):
-                p, i = xs
-                with _rng.key_ctx(jax.random.fold_in(key, i)):
-                    out = self._block_apply(p, h)
-                return unwrap(out), None
-            body = jax.checkpoint(layer) if self.remat else layer
-            h, _ = lax.scan(body, h, (staged, jnp.arange(lps)))
-            return h
+            return self._run_stage(staged, h, key, lps)
 
         with _rng.key_ctx(jax.random.fold_in(rng_key, 2 ** 20)):
             embedded = self.embed_fn(rest, ids)  # (n_micro, mb, s, h)
@@ -183,16 +212,153 @@ class PipelinedTrainStep:
 
         buf, outs = lax.fori_loop(0, T, tick, (buf, outs),
                                   unroll=False)
-        # broadcast last stage's collected outputs to every pp rank, then
-        # compute the head+loss once, vectorized over all microbatches
-        outs = lax.psum(
-            jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs)),
-            "pp")
-        flat_h = outs.reshape((-1,) + outs.shape[2:])
-        flat_l = labels.reshape((-1,) + labels.shape[2:])
-        with _rng.key_ctx(jax.random.fold_in(rng_key, 2 ** 20 + 1)):
-            loss = self.head_loss_fn(rest, flat_h, flat_l)
+        masked = jnp.where(stage == n_stages - 1, outs, jnp.zeros_like(outs))
+        if n_micro % n_stages == 0:
+            # scatter the collected outputs across pp: every rank runs the
+            # head+loss on n_micro/p microbatches instead of all of them
+            # (the r1 weakness: head compute was replicated on every rank)
+            shard = lax.psum_scatter(masked, "pp", scatter_dimension=0,
+                                     tiled=True)
+            mpp = n_micro // n_stages
+            lbl = lax.dynamic_slice_in_dim(labels, stage * mpp, mpp, axis=0)
+            flat_h = shard.reshape((-1,) + shard.shape[2:])
+            flat_l = lbl.reshape((-1,) + lbl.shape[2:])
+            with _rng.key_ctx(jax.random.fold_in(rng_key, 2 ** 20 + 1)):
+                loss = self.head_loss_fn(rest, flat_h, flat_l)
+            loss = lax.psum(loss, "pp") / n_stages
+        else:  # fallback: broadcast and compute everywhere
+            outs = lax.psum(masked, "pp")
+            flat_h = outs.reshape((-1,) + outs.shape[2:])
+            flat_l = labels.reshape((-1,) + labels.shape[2:])
+            with _rng.key_ctx(jax.random.fold_in(rng_key, 2 ** 20 + 1)):
+                loss = self.head_loss_fn(rest, flat_h, flat_l)
         return lax.pmean(loss, "dp")
+
+    # -- 1F1B: hand-scheduled fwd/bwd interleave with recompute backward ----
+    def _pipeline_1f1b(self, staged, rest, ids, labels, rng_key, lps):
+        """Runs INSIDE shard_map.  Returns (loss, g_staged, g_rest) — the
+        backward is hand-built (jax.vjp per stage per tick over a stashed
+        input activation), so in-flight activation memory is bounded by the
+        2p-1 stash slots instead of the whole fwd trajectory."""
+        from ..core import rng as _rng
+        staged = {k: v[0] for k, v in staged.items()}  # drop pp block dim
+        m = self.n_micro
+        p = self.n_stages
+        stage = lax.axis_index("pp")
+        is_last = stage == p - 1
+        is_first = stage == 0
+        fwd_perm = [(i, (i + 1) % p) for i in range(p)]
+        bwd_perm = [(i, (i - 1) % p) for i in range(p)]
+
+        def run_stage(params, h, key):
+            return self._run_stage(params, h, key, lps)
+
+        def head_vjp(h, lbl, key):
+            def fn(r, hh):
+                with _rng.key_ctx(key):
+                    return self.head_loss_fn(r, hh, lbl)
+            loss, pull = jax.vjp(fn, rest, h)
+            d_rest, dh = pull(jnp.ones((), loss.dtype) / m)
+            return loss, d_rest, dh
+
+        with _rng.key_ctx(jax.random.fold_in(rng_key, 2 ** 20)):
+            embedded, embed_pull = jax.vjp(
+                lambda r: self.embed_fn(r, ids), rest)
+        mb_shape = embedded.shape[1:]
+        axes = tuple(self.mesh.axis_names)
+
+        def vary(x):
+            return lax.pcast(x, axes, to="varying")
+
+        n_slots = 2 * p - 1
+        zeros_g_staged = jax.tree_util.tree_map(
+            lambda v: vary(jnp.zeros_like(v, dtype=jnp.float32)), staged)
+        zeros_g_rest = jax.tree_util.tree_map(
+            lambda v: vary(jnp.zeros_like(v, dtype=jnp.float32)), rest)
+        carry0 = dict(
+            fwd_buf=vary(jnp.zeros(mb_shape, embedded.dtype)),
+            bwd_buf=vary(jnp.zeros(mb_shape, jnp.float32)),
+            stash=vary(jnp.zeros((n_slots,) + mb_shape, embedded.dtype)),
+            d_emb=vary(jnp.zeros(embedded.shape, jnp.float32)),
+            g_staged=zeros_g_staged,
+            g_rest=zeros_g_rest,
+            loss=vary(jnp.zeros((), jnp.float32)),
+        )
+        T = m + 2 * (p - 1)
+
+        def stage_key(j):
+            return jax.random.fold_in(rng_key, j * p + stage)
+
+        def tick(t, c):
+            # ---- forward: stage s runs microbatch f = t - s ----
+            f = t - stage
+            f_ok = jnp.logical_and(f >= 0, f < m)
+            f_c = jnp.clip(f, 0, m - 1)
+            inj = lax.dynamic_index_in_dim(embedded, jnp.clip(t, 0, m - 1),
+                                           axis=0, keepdims=False)
+            h_in = jnp.where(is_first, inj, c["fwd_buf"])
+            # stash the input activation for the recompute backward
+            slot_f = f_c % n_slots
+            old = lax.dynamic_index_in_dim(c["stash"], slot_f, axis=0,
+                                           keepdims=False)
+            stash = lax.dynamic_update_index_in_dim(
+                c["stash"], jnp.where(f_ok, h_in, old), slot_f, axis=0)
+            h_out = run_stage(staged, h_in, stage_key(f_c))
+            # ---- head: the mb that just LEFT the last stage seeds its bwd
+            fl = t - (p - 1)
+            fl_ok = jnp.logical_and(fl >= 0, fl < m)
+            fl_c = jnp.clip(fl, 0, m - 1)
+            lbl = lax.dynamic_index_in_dim(labels, fl_c, axis=0,
+                                           keepdims=False)
+            hkey = jax.random.fold_in(rng_key, 2 ** 20 + 1 + fl_c)
+            loss_f, d_rest_f, dh_f = head_vjp(h_out, lbl, hkey)
+            take_head = jnp.logical_and(is_last, fl_ok)
+            loss = c["loss"] + jnp.where(take_head, loss_f / m, 0.0)
+            g_rest = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(take_head, d, 0.0),
+                c["g_rest"], d_rest_f)
+            # ---- backward: stage s runs microbatch j = t - 2(p-1) + s ----
+            j = t - 2 * (p - 1) + stage
+            j_ok = jnp.logical_and(j >= 0, j < m)
+            j_c = jnp.clip(j, 0, m - 1)
+            h_in_b = lax.dynamic_index_in_dim(stash, j_c % n_slots, axis=0,
+                                              keepdims=False)
+            dh_in = jnp.where(is_last, dh_f, c["bwd_buf"])
+            _, stage_pull = jax.vjp(
+                lambda pr, hh: run_stage(pr, hh, stage_key(j_c)),
+                staged, h_in_b)
+            d_params, dh_prev = stage_pull(dh_in.astype(h_out.dtype))
+            g_staged = jax.tree_util.tree_map(
+                lambda a, d: a + jnp.where(j_ok, d, 0.0),
+                c["g_staged"], d_params)
+            # stage 0's input cotangent is the embedding grad for mb j
+            old_de = lax.dynamic_index_in_dim(c["d_emb"], j_c, axis=0,
+                                              keepdims=False)
+            dep = jnp.where(jnp.logical_and(is_first, j_ok),
+                            dh_prev.astype(jnp.float32), old_de)
+            d_emb = lax.dynamic_update_index_in_dim(c["d_emb"], dep, j_c,
+                                                    axis=0)
+            # ---- handoffs ----
+            return dict(
+                fwd_buf=lax.ppermute(h_out, "pp", fwd_perm),
+                bwd_buf=lax.ppermute(dh_prev.astype(jnp.float32), "pp",
+                                     bwd_perm),
+                stash=stash, d_emb=d_emb, g_staged=g_staged, g_rest=g_rest,
+                loss=loss)
+
+        c = lax.fori_loop(0, T, tick, carry0, unroll=False)
+        # embedding pullback (valid d_emb only on stage 0; zeros elsewhere)
+        (g_rest_embed,) = embed_pull(c["d_emb"].astype(embedded.dtype))
+        g_rest = jax.tree_util.tree_map(
+            lambda a, b: a + b.astype(jnp.float32), c["g_rest"], g_rest_embed)
+        # reduce: rest grads live partly on stage 0 (embed) and stage p-1
+        # (head) -> psum over pp; all grads dp-averaged
+        g_rest = jax.tree_util.tree_map(
+            lambda g: lax.pmean(lax.psum(g, "pp"), "dp"), g_rest)
+        g_staged = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, "dp"), c["g_staged"])
+        loss = lax.pmean(lax.psum(c["loss"], "pp"), "dp")
+        return loss, g_staged, g_rest
 
     # -- compiled step -------------------------------------------------------
     def _build(self, staged_sh, rest_sh, lps):
@@ -217,6 +383,21 @@ class PipelinedTrainStep:
                 check_vma=False)
             return fn(staged, rest, ids, labels, rng_key)
 
+        def loss_and_grads_1f1b(staged, rest, ids, labels, rng_key):
+            def body(s, r, i, l, k):
+                loss, g_staged, g_rest = self._pipeline_1f1b(
+                    s, r, i, l, k, lps)
+                # re-add the pp block dim shard_map expects for P("pp") outs
+                g_staged = jax.tree_util.tree_map(lambda g: g[None], g_staged)
+                return loss, g_staged, g_rest
+            fn = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(staged_spec, rest_spec,
+                          P(None, "dp"), P(None, "dp"), P()),
+                out_specs=(P(), staged_spec, rest_spec),
+                check_vma=False)
+            return fn(staged, rest, ids, labels, rng_key)
+
         from ..optimizer.functional import apply_updates, decay_flags
         # staged keys are block-relative suffixes ("qkv.bias"), which still
         # carry the bias/norm markers apply_decay_param_fun filters on
@@ -229,8 +410,17 @@ class PipelinedTrainStep:
             mb = b // n_micro
             ids_m = ids.reshape((n_micro, mb) + ids.shape[1:])
             lbl_m = labels.reshape((n_micro, mb) + labels.shape[1:])
-            loss, (g_staged, g_rest) = jax.value_and_grad(
-                loss_fn, argnums=(0, 1))(staged, rest, ids_m, lbl_m, rng_key)
+            if self.schedule == "1f1b":
+                loss, g_staged, g_rest = loss_and_grads_1f1b(
+                    staged, rest, ids_m, lbl_m, rng_key)
+                g_staged = jax.tree_util.tree_map(
+                    lambda g, v: g.astype(v.dtype), g_staged, staged)
+                g_rest = jax.tree_util.tree_map(
+                    lambda g, v: g.astype(v.dtype), g_rest, rest)
+            else:
+                loss, (g_staged, g_rest) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1))(staged, rest, ids_m, lbl_m,
+                                             rng_key)
             opt_staged, opt_rest = opt_state
             g_staged = {k: v for k, v in g_staged.items()
                         if self._staged_trainable.get(k, True)}
@@ -295,7 +485,8 @@ class PipelinedTrainStep:
             t._set_data(jnp.asarray(jax.device_get(arr)))
 
 
-def gpt_pipeline_step(model, optimizer, mesh, n_micro=4, remat=True):
+def gpt_pipeline_step(model, optimizer, mesh, n_micro=4, remat=True,
+                      schedule="gpipe"):
     """Wire a models.GPTForPretraining into PipelinedTrainStep."""
     from ..models.gpt import GPTBlock
     from ..nn import functional as F
@@ -337,4 +528,4 @@ def gpt_pipeline_step(model, optimizer, mesh, n_micro=4, remat=True):
         block_re=r"gpt\.blocks\.(\d+)\.(.*)",
         block_module=block,
         embed_fn=embed_fn, head_loss_fn=head_loss_fn,
-        n_micro=n_micro, remat=remat)
+        n_micro=n_micro, remat=remat, schedule=schedule)
