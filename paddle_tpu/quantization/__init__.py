@@ -1,0 +1,321 @@
+"""Quantization: QAT fake-quant training + post-training quantization.
+
+Reference: the slim quantization subsystem —
+python/paddle/fluid/contrib/slim/quantization/imperative/qat.py:53
+(ImperativeQuantAware: replace Linear/Conv2D with fake-quant wrappers),
+post_training_quantization.py:1 (PTQ: sample activation ranges with
+observers, then bake int8 weights), quantization_pass.py:1 (the
+fake_quantize/dequantize op family: abs_max, channel_wise_abs_max,
+moving_average_abs_max).
+
+TPU-native design: fake-quant is a pure jnp transform trained through a
+straight-through estimator (`x + stop_grad(qdq(x) - x)`) so QAT runs
+through XLA like any other op; activation observers are abs-max reductions
+held as Layer buffers; a converted model stores int8 weight arrays plus
+per-channel f32 scales and dequantizes at the matmul input, where XLA
+fuses the rescale into the dot — int8 halves/quarters the HBM weight
+footprint (the TPU win); the MXU still computes in bf16/f32.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+from ..nn.layer_base import Layer
+from ..nn.layer.common import Linear
+from ..nn.layer.conv import Conv2D
+from ..nn import functional as F
+
+__all__ = [
+    "fake_quantize_dequantize_abs_max",
+    "fake_quantize_dequantize_channel_wise_abs_max",
+    "QuantedLinear", "QuantedConv2D",
+    "ImperativeQuantAware", "PostTrainingQuantization",
+    "Int8Linear", "Int8Conv2D",
+]
+
+
+def _qmax(bits: int) -> float:
+    return float(2 ** (bits - 1) - 1)
+
+
+def _qdq(x, scale, qmax):
+    """Quantize-dequantize a Tensor given a (broadcastable) scale."""
+    s = scale / qmax
+    return (x / s).round().clip(-qmax, qmax) * s
+
+
+def fake_quantize_dequantize_abs_max(x, bits=8):
+    """Per-tensor abs-max fake quant with STE (reference:
+    quantization_pass.py fake_quantize_dequantize_abs_max)."""
+    qmax = _qmax(bits)
+    scale = x.abs().max().clip(min=1e-8).detach()
+    return x + (_qdq(x, scale, qmax) - x).detach()
+
+
+def fake_quantize_dequantize_channel_wise_abs_max(w, quant_axis=0, bits=8):
+    """Per-channel abs-max fake quant with STE (reference:
+    quantization_pass.py channel_wise_abs_max)."""
+    qmax = _qmax(bits)
+    axes = tuple(i for i in range(len(w.shape)) if i != quant_axis)
+    scale = w.abs().max(axis=axes, keepdim=True).clip(min=1e-8).detach()
+    return w + (_qdq(w, scale, qmax) - w).detach()
+
+
+def _qdq_with_scale(x, scale_value, bits):
+    """Fake quant with an EXTERNAL scalar scale (moving-average path).
+    A never-observed scale (== 0, e.g. eval before any training step) is an
+    identity — quantizing against the epsilon floor would saturate every
+    activation to ~1e-8 garbage."""
+    raw = unwrap(scale_value)
+    qmax = _qmax(bits)
+    scale = Tensor(jnp.maximum(raw, 1e-8), stop_gradient=True)
+    q = _qdq(x, scale, qmax)
+    observed = Tensor(jnp.asarray(raw > 0), stop_gradient=True)
+    from ..tensor.search import where
+    return x + (where(observed, q, x) - x).detach()
+
+
+class _QuantedBase(Layer):
+    """Shared QAT machinery: channel-wise weight fake quant + a
+    moving-average abs-max activation observer buffer."""
+
+    def _init_quant(self, weight_bits, activation_bits, moving_rate,
+                    weight_quantize_type):
+        self._weight_bits = weight_bits
+        self._activation_bits = activation_bits
+        self._moving_rate = moving_rate
+        self._wq_type = weight_quantize_type
+        self.register_buffer("act_scale",
+                             Tensor(jnp.zeros((), jnp.float32),
+                                    stop_gradient=True))
+
+    def _quant_weight(self, w, channel_axis):
+        if self._wq_type == "abs_max":  # per-tensor scale
+            return fake_quantize_dequantize_abs_max(w, self._weight_bits)
+        return fake_quantize_dequantize_channel_wise_abs_max(
+            w, quant_axis=channel_axis, bits=self._weight_bits)
+
+    def _observe_and_quant_act(self, x):
+        """Update the moving-average abs-max (training) and fake-quant x.
+        Buffer updates are eager-side effects — QAT is a dygraph training
+        flow (reference: ImperativeQuantAware is imperative-only too)."""
+        if self.training:
+            cur = jnp.max(jnp.abs(unwrap(x))).astype(jnp.float32)
+            r = self._moving_rate
+            state = unwrap(self.act_scale)
+            accum = jnp.where(state > 0, state * r + cur * (1 - r), cur)
+            # buffer registry update (plain attr assignment would shadow the
+            # buffer and leave state_dict stale)
+            self._buffers["act_scale"] = Tensor(accum, stop_gradient=True)
+        return _qdq_with_scale(x, unwrap(self.act_scale),
+                               self._activation_bits)
+
+
+class QuantedLinear(_QuantedBase):
+    """QAT wrapper around Linear (reference: imperative/quant_layers.py
+    QuantizedLinear).  Shares the wrapped layer's Parameters, so existing
+    optimizers keep updating the same tensors."""
+
+    def __init__(self, layer: Linear, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max"):
+        super().__init__()
+        self._layer = layer
+        self._init_quant(weight_bits, activation_bits, moving_rate,
+                         weight_quantize_type)
+
+    def forward(self, x):
+        x = self._observe_and_quant_act(x)
+        # Linear weight is (in, out): the output channel is axis 1
+        w = self._quant_weight(self._layer.weight, channel_axis=1)
+        return F.linear(x, w, self._layer.bias)
+
+
+class QuantedConv2D(_QuantedBase):
+    """QAT wrapper around Conv2D (reference: imperative/quant_layers.py
+    QuantizedConv2D)."""
+
+    def __init__(self, layer: Conv2D, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9, weight_quantize_type="channel_wise_abs_max"):
+        super().__init__()
+        self._layer = layer
+        self._init_quant(weight_bits, activation_bits, moving_rate,
+                         weight_quantize_type)
+
+    def forward(self, x):
+        x = self._observe_and_quant_act(x)
+        lay = self._layer
+        w = self._quant_weight(lay.weight, channel_axis=0)
+        return F.conv2d(x, w, lay.bias, lay.stride, lay.padding,
+                        lay.dilation, lay.groups, lay.data_format)
+
+
+def _replace_layers(model: Layer, factory):
+    """Walk the layer tree and swap quantizable leaves via factory(child)
+    -> replacement | None.  Goes through setattr: Layer.__setattr__ caches
+    sublayers in the instance __dict__ too, and a bare _sub_layers update
+    would leave attribute-style models (`self.fc = Linear(...)`) silently
+    executing the original fp32 layer."""
+    for name, child in list(model._sub_layers.items()):
+        repl = factory(child)
+        if repl is not None:
+            setattr(model, name, repl)
+        else:
+            _replace_layers(child, factory)
+    return model
+
+
+class ImperativeQuantAware:
+    """Dygraph QAT driver (reference: imperative/qat.py:53).
+
+    quantize(model) swaps every Linear/Conv2D for its fake-quant wrapper
+    in place; train as usual; save_quantized_model exports through
+    jit.save with the qdq ops baked into the traced program."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 moving_rate=0.9, quantizable_layer_type=None):
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
+            raise ValueError(weight_quantize_type)
+        if activation_quantize_type != "moving_average_abs_max":
+            raise ValueError(activation_quantize_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self._wq_type = weight_quantize_type
+        self._types = tuple(quantizable_layer_type or (Linear, Conv2D))
+
+    def quantize(self, model: Layer) -> Layer:
+        def factory(child):
+            if isinstance(child, Linear) and Linear in self._types:
+                return QuantedLinear(child, self._wbits, self._abits,
+                                     self._rate, self._wq_type)
+            if isinstance(child, Conv2D) and Conv2D in self._types:
+                return QuantedConv2D(child, self._wbits, self._abits,
+                                     self._rate, self._wq_type)
+            return None
+        return _replace_layers(model, factory)
+
+    def save_quantized_model(self, layer, path, input_spec=None):
+        from .. import jit
+        layer.eval()
+        jit.save(layer, path, input_spec=input_spec)
+
+
+# ---------------------------------------------------------------------------
+# post-training quantization
+
+
+class Int8Linear(Layer):
+    """Converted Linear: int8 weight + per-out-channel scale, dequantized
+    at the input of the dot (XLA fuses the rescale into the matmul)."""
+
+    def __init__(self, layer: Linear, bits=8, act_scale=None, act_bits=8):
+        super().__init__()
+        if bits > 8:
+            raise ValueError(
+                f"int8 storage holds at most 8-bit weights, got bits={bits}")
+        qmax = _qmax(bits)
+        w = unwrap(layer.weight)
+        scale = jnp.maximum(jnp.max(jnp.abs(w), axis=0, keepdims=True), 1e-8)
+        self.register_buffer("w_int8", Tensor(
+            jnp.clip(jnp.round(w / scale * qmax), -qmax, qmax).astype(
+                jnp.int8), stop_gradient=True))
+        self.register_buffer("w_scale", Tensor(
+            (scale / qmax).astype(jnp.float32), stop_gradient=True))
+        self.bias = layer.bias
+        self._act_scale = act_scale
+        self._act_bits = act_bits
+
+    def forward(self, x):
+        if self._act_scale is not None:  # static activation quant
+            x = _qdq_with_scale(x, self._act_scale, self._act_bits)
+        w = Tensor(unwrap(self.w_int8).astype(jnp.float32)
+                   * unwrap(self.w_scale), stop_gradient=True)
+        return F.linear(x, w, self.bias)
+
+
+class Int8Conv2D(Layer):
+    """Converted Conv2D: int8 weight + per-out-channel scale."""
+
+    def __init__(self, layer: Conv2D, bits=8, act_scale=None, act_bits=8):
+        super().__init__()
+        if bits > 8:
+            raise ValueError(
+                f"int8 storage holds at most 8-bit weights, got bits={bits}")
+        qmax = _qmax(bits)
+        w = unwrap(layer.weight)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(w), axis=(1, 2, 3), keepdims=True), 1e-8)
+        self.register_buffer("w_int8", Tensor(
+            jnp.clip(jnp.round(w / scale * qmax), -qmax, qmax).astype(
+                jnp.int8), stop_gradient=True))
+        self.register_buffer("w_scale", Tensor(
+            (scale / qmax).astype(jnp.float32), stop_gradient=True))
+        self.bias = layer.bias
+        self._cfg = (layer.stride, layer.padding, layer.dilation,
+                     layer.groups, layer.data_format)
+        self._act_scale = act_scale
+        self._act_bits = act_bits
+
+    def forward(self, x):
+        if self._act_scale is not None:
+            x = _qdq_with_scale(x, self._act_scale, self._act_bits)
+        w = Tensor(unwrap(self.w_int8).astype(jnp.float32)
+                   * unwrap(self.w_scale), stop_gradient=True)
+        stride, padding, dilation, groups, fmt = self._cfg
+        return F.conv2d(x, w, self.bias, stride, padding, dilation, groups,
+                        fmt)
+
+
+class PostTrainingQuantization:
+    """PTQ driver (reference: post_training_quantization.py:1).
+
+    1) observers = ptq.prepare(model)  — installs abs-max input observers
+    2) run calibration batches through the model (eval mode)
+    3) q_model = ptq.convert(model)    — int8 weights + static act scales
+    """
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 quantize_activations=True):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._quant_act = quantize_activations
+        self._observed = {}
+        self._hooks = []
+
+    def prepare(self, model: Layer) -> Layer:
+        self._observed.clear()
+
+        def make_hook(key):
+            def hook(layer, inputs):
+                if inputs and isinstance(inputs[0], Tensor):
+                    cur = float(jnp.max(jnp.abs(unwrap(inputs[0]))))
+                    prev = self._observed.get(key, 0.0)
+                    self._observed[key] = max(prev, cur)
+                return None
+            return hook
+
+        for name, sub in model.named_sublayers():
+            if isinstance(sub, (Linear, Conv2D)):
+                self._hooks.append(
+                    sub.register_forward_pre_hook(make_hook(id(sub))))
+        return model
+
+    def convert(self, model: Layer) -> Layer:
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+
+        def factory(child):
+            act = None
+            if self._quant_act and id(child) in self._observed:
+                act = jnp.float32(self._observed[id(child)])
+            if isinstance(child, Linear):
+                return Int8Linear(child, self._wbits, act, self._abits)
+            if isinstance(child, Conv2D):
+                return Int8Conv2D(child, self._wbits, act, self._abits)
+            return None
+        return _replace_layers(model, factory)

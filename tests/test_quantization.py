@@ -1,0 +1,206 @@
+"""Quantization: QAT fake-quant + PTQ int8 conversion.
+
+Reference test strategy: slim's test_imperative_qat.py trains a small conv
+net with ImperativeQuantAware and checks the quantized model tracks fp32
+accuracy; test_post_training_quantization_* calibrate then compare."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.quantization import (
+    fake_quantize_dequantize_abs_max,
+    fake_quantize_dequantize_channel_wise_abs_max,
+    ImperativeQuantAware, PostTrainingQuantization, Int8Linear,
+    QuantedLinear, QuantedConv2D)
+
+
+def test_fake_qdq_values_on_grid_and_ste_grad():
+    x = paddle.to_tensor(np.linspace(-2, 2, 31).astype("float32"))
+    x.stop_gradient = False
+    y = fake_quantize_dequantize_abs_max(x, bits=8)
+    # quantized values live on the 8-bit grid scaled by absmax
+    step = 2.0 / 127
+    np.testing.assert_allclose(y.numpy() / step,
+                               np.round(y.numpy() / step), atol=1e-5)
+    np.testing.assert_allclose(y.numpy(), x.numpy(), atol=step)
+    # STE: gradient is identity
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.ones(31, "float32"),
+                               atol=1e-6)
+
+
+def test_channel_wise_scales():
+    w = paddle.to_tensor(
+        (np.random.RandomState(0).randn(4, 8) *
+         np.array([0.1, 1.0, 10.0, 100.0])[:, None]).astype("float32"))
+    q = fake_quantize_dequantize_channel_wise_abs_max(w, quant_axis=0)
+    # each row keeps ~8-bit relative resolution despite 1000x range spread
+    rel = np.abs(q.numpy() - w.numpy()) / np.abs(w.numpy()).max(1, keepdims=True)
+    assert rel.max() < 1.0 / 127
+
+
+def _blob_data(n=512, d=16, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    teacher = rng.randn(d, classes).astype("float32")
+    x = rng.randn(n, d).astype("float32")
+    y = (x @ teacher).argmax(1).astype("int64")
+    return x, y
+
+
+def _mlp(d=16, classes=4):
+    return paddle.nn.Sequential(
+        paddle.nn.Linear(d, 32), paddle.nn.ReLU(),
+        paddle.nn.Linear(32, classes))
+
+
+def _train(model, x, y, steps=60, lr=5e-2):
+    opt = paddle.optimizer.Adam(learning_rate=lr,
+                                parameters=model.parameters())
+    crit = paddle.nn.CrossEntropyLoss()
+    xt, yt = paddle.to_tensor(x), paddle.to_tensor(y)
+    for _ in range(steps):
+        loss = crit(model(xt), yt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(loss)
+
+
+def _acc(model, x, y):
+    model.eval()
+    logits = model(paddle.to_tensor(x)).numpy()
+    return float((logits.argmax(1) == y).mean())
+
+
+def test_qat_trains_and_tracks_fp32_accuracy():
+    x, y = _blob_data()
+    paddle.seed(0)
+    model = _mlp()
+    _train(model, x, y, steps=40)
+    fp32_acc = _acc(model, x, y)
+
+    qat = ImperativeQuantAware()
+    qmodel = qat.quantize(model)
+    assert isinstance(qmodel[0], QuantedLinear)  # swapped in place
+    qmodel.train()
+    _train(qmodel, x, y, steps=30)  # finetune with fake quant in the graph
+    q_acc = _acc(qmodel, x, y)
+    assert q_acc >= fp32_acc - 0.01, (fp32_acc, q_acc)
+    # observers populated
+    assert float(qmodel[0].act_scale.numpy()) > 0
+
+
+def test_qat_save_quantized_model_roundtrip(tmp_path):
+    x, y = _blob_data(n=64)
+    paddle.seed(1)
+    model = _mlp()
+    qat = ImperativeQuantAware()
+    qmodel = qat.quantize(model)
+    qmodel.train()
+    _train(qmodel, x, y, steps=5)
+    qmodel.eval()
+    ref = qmodel(paddle.to_tensor(x)).numpy()
+    path = str(tmp_path / "qat_model")
+    qat.save_quantized_model(
+        qmodel, path,
+        input_spec=[paddle.static.InputSpec([64, 16], "float32")])
+    loaded = paddle.jit.load(path)
+    out = loaded(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_ptq_lenet_within_one_percent():
+    """PTQ'd conv net must stay within 1% of the fp32 accuracy
+    (VERDICT r1 'done' bar for quantization)."""
+    from paddle_tpu.vision.models import LeNet
+    rng = np.random.RandomState(0)
+    n, classes = 256, 10
+    # synthetic "digits": per-class template + noise — learnable to high
+    # accuracy fast, giving confident margins like any real PTQ candidate
+    # (near-tie logits would make the test measure argmax coin flips)
+    templates = rng.rand(classes, 1, 28, 28).astype("float32")
+    y = rng.randint(0, classes, n).astype("int64")
+    x = (templates[y] + 0.3 * rng.randn(n, 1, 28, 28)).astype("float32")
+    paddle.seed(2)
+    model = LeNet(num_classes=classes)
+    _train(model, x, y, steps=30, lr=3e-3)
+    fp32_acc = _acc(model, x, y)
+    assert fp32_acc > 0.9  # sanity: the target is learnable
+
+    ptq = PostTrainingQuantization()
+    ptq.prepare(model)
+    model.eval()
+    for i in range(0, n, 64):  # calibration passes feed the observers
+        model(paddle.to_tensor(x[i:i + 64]))
+    qmodel = ptq.convert(model)
+    q_acc = _acc(qmodel, x, y)
+    assert q_acc >= fp32_acc - 0.01, (fp32_acc, q_acc)
+    # weights really are int8
+    found = [b for _, b in qmodel.named_buffers() if
+             b.numpy().dtype == np.int8]
+    assert found, "no int8 weight buffers after convert"
+
+
+def test_quantize_attribute_style_model():
+    """Models whose forward resolves sublayers as attributes (`self.fc(x)`)
+    must actually execute the quantized wrapper, not a stale __dict__
+    reference to the fp32 layer."""
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(8, 4)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    paddle.seed(5)
+    net = Net()
+    qnet = ImperativeQuantAware().quantize(net)
+    assert isinstance(qnet.fc, QuantedLinear)  # attribute view swapped too
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype("float32"))
+    qnet.train()
+    out = qnet(x)
+    assert float(qnet.fc.act_scale.numpy()) > 0  # observer actually ran
+
+
+def test_qat_eval_before_any_training_is_identity():
+    """Unobserved activation scale must behave as identity, not saturate
+    everything to the epsilon floor."""
+    paddle.seed(6)
+    lin = paddle.nn.Linear(8, 4)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 8)
+                         .astype("float32"))
+    ref = lin(x).numpy()
+    q = QuantedLinear(lin)
+    q.eval()
+    out = q(x).numpy()  # weight qdq only; activations untouched
+    assert np.abs(out - ref).max() < 0.05 * np.abs(ref).max() + 1e-3
+
+
+def test_ptq_rejects_wide_bits():
+    with pytest.raises(ValueError):
+        Int8Linear(paddle.nn.Linear(4, 4), bits=16)
+
+
+def test_qat_per_tensor_weight_quant_option():
+    paddle.seed(7)
+    model = _mlp()
+    q = ImperativeQuantAware(weight_quantize_type="abs_max").quantize(model)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 16)
+                         .astype("float32"))
+    q.train()
+    assert np.isfinite(q(x).numpy()).all()
+
+
+def test_ptq_int8_linear_numerics():
+    paddle.seed(3)
+    lin = paddle.nn.Linear(8, 4)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(5, 8)
+                         .astype("float32"))
+    ref = lin(x).numpy()
+    q = Int8Linear(lin)
+    out = q(x).numpy()
+    # per-channel int8 weight quant: ~1/127 relative error budget
+    assert np.abs(out - ref).max() < 0.05 * np.abs(ref).max() + 1e-3
