@@ -1,5 +1,9 @@
 """paddle_tpu.nn.functional (reference: python/paddle/nn/functional/)."""
 from .activation import *  # noqa: F401,F403
+from .sequence import (sequence_pad, sequence_unpad, sequence_pool,  # noqa: F401
+                       sequence_softmax, sequence_reverse, sequence_concat,
+                       sequence_enumerate, sequence_expand_as,
+                       sequence_first_step, sequence_last_step)
 from .attention import (scaled_dot_product_attention, sequence_mask,  # noqa: F401
                         set_flash_attention)
 from .common import *  # noqa: F401,F403

@@ -1,0 +1,238 @@
+"""Sequence (ragged) ops — the TPU-native answer to LoDTensor.
+
+Reference: paddle/fluid/framework/lod_tensor.h:114 (LoD = per-sequence
+offset table over a packed buffer) and operators/sequence_ops/ (49 kernels
+walking those offsets).  On TPU, dynamic per-row extents are hostile to
+XLA's static-shape compilation, so the ragged representation is
+(padded dense tensor, lengths vector) — every op below is a masked dense
+computation.  Ops are jit-friendly given a static `maxlen`; with
+maxlen=None the time extent is read from the data (one host sync, eager
+only).  sequence_unpad is inherently host-side (data-dependent output
+shape).
+
+  reference LoDTensor op          here
+  sequence_pad / unpad            pack <-> padded converters
+  sequence_mask                   nn.functional.sequence_mask
+  sequence_pool (6 modes)         sequence_pool — masked reductions
+  sequence_softmax                sequence_softmax — masked softmax
+  sequence_reverse                sequence_reverse — prefix flip gather
+  sequence_concat                 sequence_concat — per-row concat
+  sequence_enumerate              sequence_enumerate — sliding windows
+  sequence_expand_as              sequence_expand_as — row broadcast
+
+For packed-sequence training (many short sequences per row, the LoD
+batching trick), `paddle_tpu.text.pack_sequences` emits segment ids that
+flow through the flash-attention kernel's q/kv_segment_ids masking.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.op import dispatch
+from ...core.tensor import Tensor, unwrap
+
+__all__ = [
+    "sequence_pad", "sequence_unpad", "sequence_pool", "sequence_softmax",
+    "sequence_reverse", "sequence_concat", "sequence_enumerate",
+    "sequence_expand_as", "sequence_first_step", "sequence_last_step",
+]
+
+# finite stand-in for -inf: exp(x - max) underflows to exactly 0 for
+# masked entries, but (unlike -inf) an all-masked row stays NaN-free in
+# both the forward softmax and its vjp
+_MASKED = -1e30
+
+
+def _lengths(lengths):
+    return unwrap(lengths).astype(jnp.int32)
+
+
+def _time_mask(lv, maxlen, ndim):
+    """(B, T, 1...) bool mask of valid positions for an (B, T, ...) value
+    with `ndim` dims — the single source of the mask shape logic."""
+    m = jnp.arange(maxlen)[None, :] < lv[:, None]
+    return m.reshape(m.shape + (1,) * (ndim - 2))
+
+
+def sequence_pad(x, lengths, maxlen=None, pad_value=0.0, name=None):
+    """Packed (total, ...) + lengths (B,) -> padded (B, maxlen, ...).
+
+    Reference: sequence_pad_op (LoD -> padded)."""
+    lv = _lengths(lengths)
+    if maxlen is None:
+        maxlen = int(jax.device_get(jnp.max(lv)))
+
+    def raw(x, lv):
+        offsets = jnp.cumsum(lv) - lv                      # (B,)
+        t = jnp.arange(maxlen)                             # (T,)
+        idx = jnp.clip(offsets[:, None] + t[None, :], 0, x.shape[0] - 1)
+        out = x[idx]                                       # (B, T, ...)
+        return jnp.where(_time_mask(lv, maxlen, out.ndim), out,
+                         jnp.asarray(pad_value, out.dtype))
+    return dispatch("sequence_pad", raw, x, Tensor(lv, stop_gradient=True))
+
+
+def sequence_unpad(x, lengths, name=None):
+    """Padded (B, T, ...) + lengths -> packed (total, ...).
+
+    The output extent sum(lengths) is data-dependent, so this op runs
+    host-side (eager only) — the LoD direction of sequence_pad_op."""
+    lv = np.asarray(jax.device_get(_lengths(lengths)))
+    rows = np.repeat(np.arange(len(lv)), lv)
+    cols = np.concatenate([np.arange(n) for n in lv]) if len(lv) else \
+        np.zeros((0,), np.int64)
+
+    def raw(x):
+        return x[jnp.asarray(rows), jnp.asarray(cols)]
+    return dispatch("sequence_unpad", raw, x)
+
+
+def sequence_pool(x, lengths, pool_type="average", pad_value=0.0, name=None):
+    """Masked pooling over the time axis (B, T, ...) -> (B, ...).
+
+    Empty sequences (length 0) yield pad_value in every mode (reference:
+    sequence_pool_op pad_value attribute)."""
+    pool_type = pool_type.lower()
+    lv = _lengths(lengths)
+
+    def raw(x, lv):
+        mask = _time_mask(lv, x.shape[1], x.ndim)
+        n = jnp.maximum(lv, 1).reshape((-1,) + (1,) * (x.ndim - 2))
+        empty = (lv == 0).reshape((-1,) + (1,) * (x.ndim - 2))
+        pad = jnp.asarray(pad_value, x.dtype)
+        if pool_type == "sum":
+            out = jnp.where(mask, x, 0).sum(1)
+        elif pool_type == "average":
+            out = jnp.where(mask, x, 0).sum(1) / n
+        elif pool_type == "sqrt":
+            out = jnp.where(mask, x, 0).sum(1) / jnp.sqrt(
+                n.astype(x.dtype))
+        elif pool_type == "max":
+            out = jnp.where(mask, x, _MASKED).max(1)
+        elif pool_type == "min":
+            out = jnp.where(mask, x, -_MASKED).min(1)
+        elif pool_type == "first":
+            out = x[:, 0]
+        elif pool_type == "last":
+            idx = jnp.maximum(lv - 1, 0)
+            out = jnp.take_along_axis(
+                x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+            )[:, 0]
+        else:
+            raise ValueError(f"unknown pool_type {pool_type!r}")
+        return jnp.where(empty, pad, out)
+    return dispatch("sequence_pool", raw, x, Tensor(lv, stop_gradient=True))
+
+
+def sequence_first_step(x, lengths=None, name=None):
+    if lengths is None:
+        lengths = jnp.full((unwrap(x).shape[0],), unwrap(x).shape[1])
+    return sequence_pool(x, lengths, "first")
+
+
+def sequence_last_step(x, lengths, name=None):
+    return sequence_pool(x, lengths, "last")
+
+
+def sequence_softmax(x, lengths, name=None):
+    """Masked softmax over the time axis (reference: sequence_softmax_op).
+    Empty rows output 0 with finite (zero) gradients — the masking uses a
+    large-negative sentinel rather than -inf to keep the softmax vjp
+    NaN-free."""
+    lv = _lengths(lengths)
+
+    def raw(x, lv):
+        mask = _time_mask(lv, x.shape[1], x.ndim)
+        s = jnp.where(mask, x, _MASKED)
+        p = jax.nn.softmax(s.astype(jnp.float32), axis=1)
+        return jnp.where(mask, p, 0).astype(x.dtype)
+    return dispatch("sequence_softmax", raw, x,
+                    Tensor(lv, stop_gradient=True))
+
+
+def sequence_reverse(x, lengths, name=None):
+    """Reverse each row's valid prefix, keep padding in place
+    (reference: sequence_reverse_op)."""
+    lv = _lengths(lengths)
+
+    def raw(x, lv):
+        t = jnp.arange(x.shape[1])
+        rev = lv[:, None] - 1 - t[None, :]
+        idx = jnp.where(t[None, :] < lv[:, None], rev, t[None, :])
+        return jnp.take_along_axis(
+            x, idx.reshape(idx.shape + (1,) * (x.ndim - 2)), axis=1)
+    return dispatch("sequence_reverse", raw, x,
+                    Tensor(lv, stop_gradient=True))
+
+
+def sequence_concat(xs, lengths_list, maxlen=None, name=None):
+    """Per-row concatenation of ragged sequences
+    (reference: sequence_concat_op).  Returns (padded, lengths)."""
+    lvs = [_lengths(l) for l in lengths_list]
+    total = sum(lvs)
+    if maxlen is None:
+        maxlen = int(jax.device_get(jnp.max(total)))
+
+    def raw(*args):
+        n = len(args) // 2
+        xs, lvs = args[:n], args[n:]
+        b = xs[0].shape[0]
+        t = jnp.arange(maxlen)
+        out = jnp.zeros((b, maxlen) + xs[0].shape[2:], xs[0].dtype)
+        start = jnp.zeros((b,), jnp.int32)
+        for xi, li in zip(xs, lvs):
+            # place xi's valid prefix at offset `start` in each row
+            src_t = t[None, :] - start[:, None]            # (B, T)
+            valid = jnp.logical_and(src_t >= 0, src_t < li[:, None])
+            src = jnp.clip(src_t, 0, xi.shape[1] - 1)
+            gathered = jnp.take_along_axis(
+                xi, src.reshape(src.shape + (1,) * (xi.ndim - 2)), axis=1)
+            vshape = valid.shape + (1,) * (xi.ndim - 2)
+            out = jnp.where(valid.reshape(vshape), gathered, out)
+            start = start + li
+        return out
+    parts = list(xs) + [Tensor(l, stop_gradient=True) for l in lvs]
+    return dispatch("sequence_concat", raw, *parts), \
+        Tensor(total, stop_gradient=True)
+
+
+def sequence_enumerate(x, win_size, lengths=None, pad_value=0, name=None):
+    """Sliding windows over ids: (B, T) -> (B, T, win_size); windows read
+    past a row's length (or the array end) as pad_value
+    (reference: sequence_enumerate_op is LoD-aware the same way)."""
+    lv = None if lengths is None else _lengths(lengths)
+
+    def raw(x, *opt):
+        t = jnp.arange(x.shape[1])[:, None] + jnp.arange(win_size)[None, :]
+        if opt:
+            end = opt[0][:, None, None]                    # (B, 1, 1)
+            valid = t[None, :, :] < end
+        else:
+            valid = (t < x.shape[1])[None]
+        tc = jnp.clip(t, 0, x.shape[1] - 1)
+        out = x[:, tc]                                     # (B, T, W)
+        return jnp.where(valid, out, jnp.asarray(pad_value, x.dtype))
+    if lv is None:
+        return dispatch("sequence_enumerate", raw, x)
+    return dispatch("sequence_enumerate", raw, x,
+                    Tensor(lv, stop_gradient=True))
+
+
+def sequence_expand_as(x, lengths, maxlen=None, name=None):
+    """Broadcast each row vector x[b] across its sequence positions:
+    (B, ...) + lengths -> (B, maxlen, ...).  Dense analogue of
+    sequence_expand_as_op: result[b, t] = x[b] for t < lengths[b], else 0.
+    Pass maxlen for a jit-traceable call."""
+    lv = _lengths(lengths)
+    if maxlen is None:
+        maxlen = int(jax.device_get(jnp.max(lv))) if lv.size else 0
+
+    def raw(x, lv):
+        mask = _time_mask(lv, maxlen, x.ndim + 1)
+        tiled = jnp.broadcast_to(x[:, None], (x.shape[0], maxlen)
+                                 + x.shape[1:])
+        return jnp.where(mask, tiled, 0)
+    return dispatch("sequence_expand_as", raw, x,
+                    Tensor(lv, stop_gradient=True))

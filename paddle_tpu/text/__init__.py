@@ -15,8 +15,11 @@ import numpy as np
 
 from ..io.dataset import Dataset
 
+from .packing import pack_sequences, BucketByLengthBatchSampler  # noqa: F401
+
 __all__ = ["FakeTextDataset", "Imdb", "Imikolov", "UCIHousing",
-           "ViterbiDecoder", "viterbi_decode"]
+           "ViterbiDecoder", "viterbi_decode", "pack_sequences",
+           "BucketByLengthBatchSampler"]
 
 
 class FakeTextDataset(Dataset):

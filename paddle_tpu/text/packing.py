@@ -1,0 +1,135 @@
+"""Packed-sequence batching — the TPU-native LoD batching story.
+
+Reference: LoDTensor batches many variable-length sequences as one packed
+buffer + offset table (paddle/fluid/framework/lod_tensor.h:114).  On TPU
+the same density win comes from packing several sequences into each fixed
+row and masking attention with SEGMENT IDS, which the pallas flash kernel
+applies in-kernel (ops/flash_attention.py q/kv_segment_ids) — no (S, S)
+mask tensor is ever materialized.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["pack_sequences", "BucketByLengthBatchSampler"]
+
+
+def pack_sequences(seqs: Sequence[np.ndarray], row_len: int,
+                   pad_id: int = 0, truncate: bool = False
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Greedy first-fit packing of 1-D token sequences into fixed rows.
+
+    A sequence longer than row_len raises unless truncate=True (silent
+    token loss would misalign labels derived from the original sequence).
+
+    Returns (tokens, segment_ids, positions), each (rows, row_len) int32:
+    - tokens: packed ids, pad_id in the slack
+    - segment_ids: 1-based id per packed sequence, 0 on padding — feed to
+      flash attention's q/kv_segment_ids so tokens attend only within
+      their own sequence (padding id 0 never matches a real segment...
+      except other padding; give each row's padding its own unique id 0
+      and mask pad positions out of the loss instead)
+    - positions: position within each original sequence (0 on padding) —
+      the position-embedding index for packed rows
+    """
+    rows: List[List[np.ndarray]] = []
+    space: List[int] = []
+    for s in seqs:
+        s = np.asarray(s)
+        if s.ndim != 1:
+            raise ValueError("pack_sequences packs 1-D token sequences")
+        if len(s) > row_len:
+            if not truncate:
+                raise ValueError(
+                    f"sequence of length {len(s)} exceeds row_len "
+                    f"{row_len}; pass truncate=True to clip it")
+            s = s[:row_len]
+        placed = False
+        for i, free in enumerate(space):
+            if len(s) <= free:
+                rows[i].append(s)
+                space[i] -= len(s)
+                placed = True
+                break
+        if not placed:
+            rows.append([s])
+            space.append(row_len - len(s))
+
+    n = len(rows)
+    tokens = np.full((n, row_len), pad_id, np.int32)
+    segs = np.zeros((n, row_len), np.int32)
+    pos = np.zeros((n, row_len), np.int32)
+    for i, row in enumerate(rows):
+        off = 0
+        for j, s in enumerate(row):
+            tokens[i, off:off + len(s)] = s
+            segs[i, off:off + len(s)] = j + 1
+            pos[i, off:off + len(s)] = np.arange(len(s))
+            off += len(s)
+    return tokens, segs, pos
+
+
+class BucketByLengthBatchSampler:
+    """Batch sampler grouping examples of similar length to minimize pad
+    waste (reference: the LoD batching path + fluid.layers batch-by-size
+    readers; torch's BucketBatchSampler is the common analogue).
+
+    lengths: per-example sequence lengths.
+    bucket_boundaries: ascending cut points; example with length L goes to
+    the first bucket with L <= boundary (overflow bucket at the end).
+    """
+
+    def __init__(self, lengths, bucket_boundaries, batch_size,
+                 shuffle=False, drop_last=False, seed=0):
+        self.lengths = np.asarray(lengths)
+        self.boundaries = sorted(bucket_boundaries)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.RandomState(seed)
+
+    def _bucket_of(self, n):
+        for i, b in enumerate(self.boundaries):
+            if n <= b:
+                return i
+        return len(self.boundaries)
+
+    def _batches(self):
+        buckets: List[List[int]] = [[] for _ in
+                                    range(len(self.boundaries) + 1)]
+        order = np.arange(len(self.lengths))
+        if self.shuffle:
+            self._rng.shuffle(order)
+        out = []
+        for idx in order:
+            b = buckets[self._bucket_of(self.lengths[idx])]
+            b.append(int(idx))
+            if len(b) == self.batch_size:
+                out.append(list(b))
+                b.clear()
+        for b in buckets:
+            if b and not self.drop_last:
+                out.append(list(b))
+        if self.shuffle:
+            self._rng.shuffle(out)
+        return out
+
+    def __iter__(self):
+        return iter(self._batches())
+
+    def __len__(self):
+        # count WITHOUT touching the RNG: bucket membership is a pure
+        # function of lengths, so the batch count doesn't depend on the
+        # shuffle order (len() advancing the RNG would make epoch order
+        # depend on how many times a progress bar called len())
+        counts = [0] * (len(self.boundaries) + 1)
+        for n in self.lengths:
+            counts[self._bucket_of(n)] += 1
+        total = 0
+        for c in counts:
+            total += c // self.batch_size
+            if c % self.batch_size and not self.drop_last:
+                total += 1
+        return total

@@ -1,0 +1,249 @@
+"""Sequence/ragged toolkit (the LoD answer) — VERDICT r1 missing #8.
+
+Reference: operators/sequence_ops/* semantics checked against numpy
+references; packed-sequence masking checked against per-sequence attention
+through the flash kernel (interpret mode)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.text import pack_sequences, BucketByLengthBatchSampler
+
+
+def _ragged(seed=0, b=4, tmax=6, h=3):
+    rng = np.random.RandomState(seed)
+    lengths = rng.randint(1, tmax + 1, b).astype("int64")
+    x = rng.randn(b, tmax, h).astype("float32")
+    return x, lengths
+
+
+def test_sequence_pad_unpad_roundtrip():
+    x, lengths = _ragged()
+    padded = paddle.to_tensor(x)
+    packed = F.sequence_unpad(padded, paddle.to_tensor(lengths))
+    assert packed.shape[0] == int(lengths.sum())
+    repad = F.sequence_pad(packed, paddle.to_tensor(lengths),
+                           maxlen=x.shape[1])
+    mask = np.arange(x.shape[1])[None] < lengths[:, None]
+    np.testing.assert_allclose(repad.numpy()[mask], x[mask])
+    assert (repad.numpy()[~mask] == 0).all()
+
+
+def test_sequence_pool_modes():
+    x, lengths = _ragged(1)
+    lt = paddle.to_tensor(lengths)
+    xt = paddle.to_tensor(x)
+    for mode in ("sum", "average", "sqrt", "max", "min", "last", "first"):
+        got = F.sequence_pool(xt, lt, mode).numpy()
+        for b, n in enumerate(lengths):
+            seg = x[b, :n]
+            ref = {"sum": seg.sum(0), "average": seg.mean(0),
+                   "sqrt": seg.sum(0) / np.sqrt(n), "max": seg.max(0),
+                   "min": seg.min(0), "last": seg[-1], "first": seg[0]}[mode]
+            np.testing.assert_allclose(got[b], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_softmax_and_reverse():
+    x, lengths = _ragged(2)
+    lt = paddle.to_tensor(lengths)
+    sm = F.sequence_softmax(paddle.to_tensor(x), lt).numpy()
+    rv = F.sequence_reverse(paddle.to_tensor(x), lt).numpy()
+    for b, n in enumerate(lengths):
+        ref = np.exp(x[b, :n] - x[b, :n].max(0))
+        np.testing.assert_allclose(sm[b, :n], ref / ref.sum(0), rtol=1e-4,
+                                   atol=1e-5)
+        assert (sm[b, n:] == 0).all()
+        np.testing.assert_allclose(rv[b, :n], x[b, :n][::-1])
+        np.testing.assert_allclose(rv[b, n:], x[b, n:])  # padding untouched
+
+
+def test_sequence_softmax_grad_masked():
+    x, lengths = _ragged(3)
+    xt = paddle.to_tensor(x)
+    xt.stop_gradient = False
+    out = F.sequence_softmax(xt, paddle.to_tensor(lengths))
+    out.sum().backward()
+    g = xt.grad.numpy()
+    for b, n in enumerate(lengths):
+        assert np.abs(g[b, n:]).max() == 0  # no grad leaks into padding
+
+
+def test_sequence_concat_and_enumerate_and_expand():
+    x1, l1 = _ragged(4, tmax=4)
+    x2, l2 = _ragged(5, tmax=5)
+    out, lens = F.sequence_concat(
+        [paddle.to_tensor(x1), paddle.to_tensor(x2)],
+        [paddle.to_tensor(l1), paddle.to_tensor(l2)])
+    on = out.numpy()
+    for b in range(x1.shape[0]):
+        ref = np.concatenate([x1[b, :l1[b]], x2[b, :l2[b]]])
+        np.testing.assert_allclose(on[b, :l1[b] + l2[b]], ref, rtol=1e-6)
+    np.testing.assert_array_equal(lens.numpy(), l1 + l2)
+
+    ids = paddle.to_tensor(np.arange(12).reshape(2, 6).astype("int32"))
+    win = F.sequence_enumerate(ids, 3, pad_value=-1).numpy()
+    assert win.shape == (2, 6, 3)
+    np.testing.assert_array_equal(win[0, 0], [0, 1, 2])
+    np.testing.assert_array_equal(win[0, 5], [5, -1, -1])
+
+    vec = paddle.to_tensor(np.arange(8).reshape(4, 2).astype("float32"))
+    exp = F.sequence_expand_as(vec, paddle.to_tensor(
+        np.array([2, 1, 3, 2], "int64"))).numpy()
+    assert exp.shape == (4, 3, 2)
+    np.testing.assert_allclose(exp[2, 2], vec.numpy()[2])
+    assert (exp[1, 1:] == 0).all()
+
+
+def test_pack_sequences_and_segment_attention_parity():
+    """Packed rows + segment ids through the flash kernel must equal each
+    sequence attended separately — the LoD packing story end-to-end."""
+    from paddle_tpu.ops import flash_attention as fa
+    fa._INTERPRET = True
+    try:
+        rng = np.random.RandomState(0)
+        row_len, d, h = 128, 64, 1
+        seq_lens = [50, 40, 30, 60, 128, 20]
+        seqs = [np.arange(n) for n in seq_lens]
+        tokens, segs, pos = pack_sequences(seqs, row_len)
+        assert tokens.shape[1] == row_len
+        # every sequence fully placed, position ids restart per segment
+        assert sum(segs.max(1)) >= 1
+        total = sum(min(n, row_len) for n in seq_lens)
+        assert int((segs > 0).sum()) == total
+
+        # attention parity on one packed row with 2 segments
+        a, b = 48, 64
+        q = rng.randn(1, row_len, h, d).astype("float32")
+        seg = np.zeros((1, row_len), "int32")
+        seg[0, :a] = 1
+        seg[0, a:a + b] = 2
+        st = jnp.asarray(seg)
+        packed = fa.flash_attention_bshd(
+            jnp.asarray(q), jnp.asarray(q), jnp.asarray(q),
+            q_segment_ids=st, kv_segment_ids=st)
+        naive = []
+        for s, e in ((0, a), (a, a + b)):
+            qs = q[:, s:e]
+            sc = np.einsum("bshd,bthd->bhst", qs, qs) / np.sqrt(d)
+            p = jax.nn.softmax(jnp.asarray(sc), -1)
+            naive.append(np.einsum("bhst,bthd->bshd", np.asarray(p),
+                                   qs))
+        np.testing.assert_allclose(np.asarray(packed)[:, :a],
+                                   naive[0], rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(packed)[:, a:a + b],
+                                   naive[1], rtol=2e-4, atol=2e-4)
+    finally:
+        fa._INTERPRET = False
+
+
+def test_empty_sequences_are_safe():
+    """Length-0 rows: pool modes yield pad_value, softmax grads stay
+    finite (the jnp.where -inf NaN-grad trap)."""
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 4, 2)
+                         .astype("float32"))
+    lengths = paddle.to_tensor(np.array([0, 2, 4], "int64"))
+    for mode in ("max", "min", "average", "first", "last"):
+        out = F.sequence_pool(x, lengths, mode, pad_value=7.0).numpy()
+        np.testing.assert_allclose(out[0], 7.0)
+        assert np.isfinite(out).all()
+    xg = paddle.to_tensor(np.random.RandomState(1).randn(3, 4, 2)
+                          .astype("float32"))
+    xg.stop_gradient = False
+    F.sequence_softmax(xg, lengths).sum().backward()
+    assert np.isfinite(xg.grad.numpy()).all()
+    assert np.abs(xg.grad.numpy()[0]).max() == 0  # empty row: zero grad
+
+
+def test_sequence_enumerate_respects_lengths():
+    ids = paddle.to_tensor(np.arange(12).reshape(2, 6).astype("int32"))
+    lengths = paddle.to_tensor(np.array([3, 6], "int64"))
+    win = F.sequence_enumerate(ids, 2, lengths=lengths, pad_value=-1).numpy()
+    np.testing.assert_array_equal(win[0, 2], [2, -1])  # past length 3
+    np.testing.assert_array_equal(win[0, 3], [-1, -1])
+    np.testing.assert_array_equal(win[1, 4], [10, 11])
+
+
+def test_pack_sequences_rejects_overlong():
+    with pytest.raises(ValueError, match="row_len"):
+        pack_sequences([np.arange(200)], 128)
+    toks, _, _ = pack_sequences([np.arange(200)], 128, truncate=True)
+    assert toks.shape == (1, 128)
+
+
+def test_bucket_sampler_len_does_not_consume_rng():
+    lengths = list(np.random.RandomState(0).randint(1, 100, 37))
+    a = BucketByLengthBatchSampler(lengths, [32, 64], 4, shuffle=True,
+                                   seed=9)
+    b = BucketByLengthBatchSampler(lengths, [32, 64], 4, shuffle=True,
+                                   seed=9)
+    len(a); len(a); len(a)  # must not advance the RNG
+    assert list(a) == list(b)
+    assert len(a) == len(list(b))
+
+
+def test_bucket_sampler_groups_by_length():
+    lengths = [5, 100, 7, 90, 6, 95, 8, 85]
+    bs = BucketByLengthBatchSampler(lengths, bucket_boundaries=[16],
+                                    batch_size=2)
+    batches = list(bs)
+    assert len(bs) == len(batches)
+    for batch in batches:
+        ls = [lengths[i] for i in batch]
+        assert max(ls) <= 16 or min(ls) > 16  # no mixed buckets
+
+
+def test_varlen_bert_trains_with_masked_flash_attention():
+    """VERDICT r1 'done' bar: a variable-length BERT batch trains THROUGH
+    the flash kernel with a padding mask (bias path) and dropout."""
+    from paddle_tpu import models
+    from paddle_tpu.ops import flash_attention as fa
+    fa._INTERPRET = True
+    calls = {"n": 0}
+    orig = fa.flash_attention_bshd
+
+    def spy(*a, **kw):
+        out = orig(*a, **kw)
+        if out is not None:
+            calls["n"] += 1
+        return out
+
+    fa.flash_attention_bshd = spy
+    try:
+        paddle.seed(0)
+        cfg = models.BertConfig(
+            vocab_size=128, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=1, intermediate_size=128,
+            max_position_embeddings=128,
+            hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.1)
+        model = models.BertForPretraining(cfg)
+        crit = models.BertPretrainingCriterion()
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        b, s = 2, 128
+        lengths = np.array([80, 128], "int64")
+        ids = rng.randint(0, 128, (b, s)).astype("int32")
+        labels = rng.randint(0, 128, (b, s)).astype("int32")
+        # mask the loss AND attention beyond each length
+        labels_m = labels.copy()
+        for i, n in enumerate(lengths):
+            labels_m[i, n:] = -100
+        attn_mask = F.sequence_mask(paddle.to_tensor(lengths), maxlen=s,
+                                    dtype="int64")
+        losses = []
+        for _ in range(3):
+            logits, nsp = model(paddle.to_tensor(ids),
+                                attention_mask=attn_mask)
+            loss = crit(logits, nsp, paddle.to_tensor(labels_m))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert calls["n"] >= 6  # 2 layers x 3 steps through the kernel
+        assert losses[-1] < losses[0]
+    finally:
+        fa.flash_attention_bshd = orig
+        fa._INTERPRET = False
