@@ -57,6 +57,8 @@ from .framework import save, load  # noqa: F401
 from . import utils  # noqa: F401
 from . import ops  # noqa: F401
 from . import quantization  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import text  # noqa: F401
 
 disable_static = lambda *a, **k: None  # noqa: E731  (always "dygraph")
 enable_static = lambda *a, **k: None  # noqa: E731

@@ -27,6 +27,7 @@ def apply_updates(optimizer, params: dict, grads: dict, opt_state: dict,
     from ..core.selected_rows import RowSparseGrad
     from .sparse import lazy_row_update
     wd = getattr(optimizer, "_wd", 0.0)
+    wd_l1 = getattr(optimizer, "_wd_mode", "l2") == "l1"
     dwd = getattr(optimizer, "_decoupled_wd", 0.0)
     new_params = dict(params)
     new_opt = dict(opt_state)
@@ -46,7 +47,7 @@ def apply_updates(optimizer, params: dict, grads: dict, opt_state: dict,
         db = decay.get(k, True)
         m = (lr_mults or {}).get(k, 1.0)
         if wd and db and is_float:
-            g = g + wd * p
+            g = g + wd * (jnp.sign(p) if wd_l1 else p)
         np_, ns = optimizer.update_one(p, g, opt_state[k], lr * m, step_no)
         if dwd and db and is_float:
             np_ = (np_.astype(jnp.float32)

@@ -39,6 +39,9 @@ class Optimizer:
         self._name = name
         # weight_decay: float -> L2 coefficient added to grads (paddle
         # regularizer semantics); AdamW overrides with decoupled decay.
+        # An L1Decay object switches the penalty to coeff * sign(w)
+        # (reference: regularizer.py append_regularization_ops).
+        self._wd_mode = "l2"
         if weight_decay is None:
             self._wd = 0.0
         elif isinstance(weight_decay, (int, float)):
@@ -46,6 +49,7 @@ class Optimizer:
         else:  # L1Decay/L2Decay object
             self._wd = float(getattr(weight_decay, "_coeff",
                                      getattr(weight_decay, "coeff", 0.0)))
+            self._wd_mode = getattr(weight_decay, "mode", "l2") or "l2"
         self._step_count = 0
         self._states: Dict[int, dict] = {}
         # jitted tree-update closures keyed by (n_params, lr_mults, decay_bits)
@@ -139,18 +143,28 @@ class Optimizer:
         lr_mults = tuple(float(p.optimize_attr.get("learning_rate", 1.0))
                          for p in params)
         decay_bits = tuple(self._decay_applies(p.name) for p in params)
+        # per-param ParamAttr(regularizer=...) overrides the optimizer-level
+        # decay (reference: append_regularization_ops picks the param's own
+        # regularizer first)
+        per_wd = tuple(
+            (float(getattr(r, "_coeff", 0.0)),
+             getattr(r, "mode", "l2") == "l1")
+            if (r := getattr(p, "regularizer", None)) is not None else None
+            for p in params)
 
-        cache_key = (len(params), lr_mults, decay_bits)
+        cache_key = (len(params), lr_mults, decay_bits, per_wd)
         jit_update = self._jit_cache.get(cache_key)
         if jit_update is None:
             wd, dwd = self._wd, self._decoupled_wd
+            wd_l1 = self._wd_mode == "l1"
             def _tree_update(p_raw, g_raw, states, lr, step):
                 outs, new_states = [], []
-                for p, g, s, m, db in zip(p_raw, g_raw, states, lr_mults,
-                                          decay_bits):
+                for p, g, s, m, db, pw in zip(p_raw, g_raw, states,
+                                              lr_mults, decay_bits, per_wd):
                     is_float = jnp.issubdtype(p.dtype, jnp.floating)
-                    if wd and db and is_float:
-                        g = g + wd * p
+                    w_coeff, w_l1 = (wd, wd_l1) if pw is None else pw
+                    if w_coeff and db and is_float:
+                        g = g + w_coeff * (jnp.sign(p) if w_l1 else p)
                     np_, ns = self.update_one(p, g, s, lr * m, step)
                     if dwd and db and is_float:
                         np_ = (np_.astype(jnp.float32)

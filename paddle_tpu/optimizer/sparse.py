@@ -57,9 +57,11 @@ def lazy_row_update(optimizer, p, grad: RowSparseGrad, state, lr, step_no,
         lambda s: s[safe] if _row_leaf(s, height) else s, state)
 
     wd = getattr(optimizer, "_wd", 0.0)
+    wd_l1 = getattr(optimizer, "_wd_mode", "l2") == "l1"
     dwd = getattr(optimizer, "_decoupled_wd", 0.0)
     if wd and decay_flag:
-        g = g + wd * p_rows.astype(jnp.float32)
+        pr = p_rows.astype(jnp.float32)
+        g = g + wd * (jnp.sign(pr) if wd_l1 else pr)
     new_rows, ns_rows = optimizer.update_one(p_rows, g, state_rows,
                                              lr * lr_mult, step_no)
     if dwd and decay_flag:

@@ -1,0 +1,40 @@
+"""paddle.regularizer — L1/L2 weight-decay regularizers.
+
+Reference: python/paddle/regularizer.py (L1Decay/L2Decay) and
+fluid/regularizer.py append_regularization_ops: the regularizer adds its
+penalty gradient (coeff * sign(w) for L1, coeff * w for L2) to each
+trainable parameter's gradient before the optimizer update.  Here the
+optimizer consumes the object directly (`weight_decay=L2Decay(1e-4)`) and
+folds the penalty into its fused jitted update — no separate regularizer
+op pass.  On the dygraph optimizer path, a per-parameter regularizer set
+via ParamAttr overrides the optimizer-level one (reference semantics);
+the functional apply_updates path (sharded train steps) applies the
+optimizer-level decay uniformly.
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class _Decay:
+    mode: str = ""
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(_Decay):
+    """Lasso: penalty grad = coeff * sign(w)."""
+    mode = "l1"
+
+
+class L2Decay(_Decay):
+    """Ridge: penalty grad = coeff * w."""
+    mode = "l2"

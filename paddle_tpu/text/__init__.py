@@ -16,10 +16,12 @@ import numpy as np
 from ..io.dataset import Dataset
 
 from .packing import pack_sequences, BucketByLengthBatchSampler  # noqa: F401
+from .datasets import Conll05st, WMT14, WMT16, Movielens  # noqa: F401
 
 __all__ = ["FakeTextDataset", "Imdb", "Imikolov", "UCIHousing",
            "ViterbiDecoder", "viterbi_decode", "pack_sequences",
-           "BucketByLengthBatchSampler"]
+           "BucketByLengthBatchSampler", "Conll05st", "WMT14", "WMT16",
+           "Movielens"]
 
 
 class FakeTextDataset(Dataset):

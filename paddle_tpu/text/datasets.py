@@ -1,0 +1,335 @@
+"""Text dataset readers: Conll05st, WMT14, WMT16, Movielens.
+
+Reference: python/paddle/text/datasets/{conll05,wmt14,wmt16,movielens}.py —
+same archive formats and per-item shapes, local-files-only (this
+environment has zero egress, so `data_file` paths are required; there is
+no download path).
+"""
+from __future__ import annotations
+
+import gzip
+import re
+import tarfile
+import zipfile
+from collections import defaultdict
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Conll05st", "WMT14", "WMT16", "Movielens"]
+
+_UNK_IDX = 2  # wmt convention: <s>=0, <e>=1, <unk>=2
+_START, _END, _UNK = "<s>", "<e>", "<unk>"
+
+
+def _lines(fileobj):
+    for line in fileobj:
+        yield line.decode("utf-8", "ignore") if isinstance(line, bytes) \
+            else line
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split (reference: conll05.py — the public data
+    is the WSJ test section; items are the 9-column feature tuple the
+    reference emits: words, 5 predicate-context columns, predicate, mark,
+    label ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="test"):
+        for arg, nm in ((data_file, "data_file"),
+                        (word_dict_file, "word_dict_file"),
+                        (verb_dict_file, "verb_dict_file"),
+                        (target_dict_file, "target_dict_file")):
+            if arg is None:
+                raise ValueError(f"Conll05st requires {nm} (no downloads)")
+        self.word_dict = self._read_dict(word_dict_file)
+        self.predicate_dict = self._read_dict(verb_dict_file)
+        self.label_dict = self._read_label_dict(target_dict_file)
+        self._load(data_file)
+
+    @staticmethod
+    def _read_dict(path):
+        with open(path) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _read_label_dict(path):
+        tags = set()
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line[:2] in ("B-", "I-"):
+                    tags.add(line[2:])
+        d = {}
+        for tag in sorted(tags):  # sorted: id mapping must be stable
+            d[f"B-{tag}"] = len(d)  # across processes (hash-seed-free)
+            d[f"I-{tag}"] = len(d)
+        d["O"] = len(d)
+        return d
+
+    def _load(self, data_file):
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with gzip.GzipFile(fileobj=wf) as words, \
+                    gzip.GzipFile(fileobj=pf) as props:
+                sent, cols = [], []
+                for wline, pline in zip(_lines(words), _lines(props)):
+                    w = wline.strip()
+                    p = pline.strip().split()
+                    if not p:  # sentence boundary
+                        self._emit(sent, cols)
+                        sent, cols = [], []
+                    else:
+                        sent.append(w)
+                        cols.append(p)
+                self._emit(sent, cols)
+
+    def _emit(self, sent, cols):
+        if not cols:
+            return
+        ncol = len(cols[0])
+        verbs = [row[0] for row in cols if row[0] != "-"]
+        for ci in range(1, ncol):
+            tags, cur, inside = [], "O", False
+            for row in cols:
+                tok = row[ci]
+                if tok == "*":
+                    tags.append(f"I-{cur}" if inside else "O")
+                elif tok == "*)":
+                    tags.append(f"I-{cur}")
+                    inside = False
+                elif "(" in tok:
+                    cur = tok[1:tok.find("*")]
+                    tags.append(f"B-{cur}")
+                    inside = ")" not in tok
+                else:
+                    raise RuntimeError(f"unexpected props token {tok!r}")
+            self.sentences.append(list(sent))
+            self.predicates.append(verbs[ci - 1])
+            self.labels.append(tags)
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def __getitem__(self, idx):
+        sent = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sent)
+        v = labels.index("B-V")
+        mark = np.zeros(n, np.int64)
+        ctx = {}
+        for off, name in ((-2, "n2"), (-1, "n1"), (0, "c0"), (1, "p1"),
+                          (2, "p2")):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[name] = sent[j]
+            else:
+                ctx[name] = "bos" if off < 0 else "eos"
+        wd = self.word_dict
+        word_idx = np.array([wd.get(w, _UNK_IDX) for w in sent], np.int64)
+
+        def rep(word):
+            return np.full(n, wd.get(word, _UNK_IDX), np.int64)
+        pred = np.full(n, self.predicate_dict.get(self.predicates[idx],
+                                                  _UNK_IDX), np.int64)
+        lab = np.array([self.label_dict[t] for t in labels], np.int64)
+        return (word_idx, rep(ctx["n2"]), rep(ctx["n1"]), rep(ctx["c0"]),
+                rep(ctx["p1"]), rep(ctx["p2"]), pred, mark, lab)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+
+class WMT14(Dataset):
+    """WMT'14 en->fr (reference: wmt14.py — tar with src.dict/trg.dict and
+    {mode}/{mode} tab-separated parallel text; items are
+    (src_ids, trg_ids, trg_ids_next))."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1):
+        if data_file is None:
+            raise ValueError("WMT14 requires data_file (no downloads)")
+        if mode not in ("train", "test", "gen"):
+            raise ValueError(mode)
+        assert dict_size > 0, "dict_size should be a positive number"
+        self.dict_size = dict_size
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            self.src_dict = self._dict_from(tf, "src.dict")
+            self.trg_dict = self._dict_from(tf, "trg.dict")
+            data_names = [m.name for m in tf.getmembers()
+                          if m.name.endswith(f"{mode}/{mode}")]
+            for name in data_names:
+                for line in _lines(tf.extractfile(name)):
+                    parts = line.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, _UNK_IDX)
+                           for w in [_START] + parts[0].split() + [_END]]
+                    trg = [self.trg_dict.get(w, _UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.src_ids.append(src)
+                    self.trg_ids.append([self.trg_dict[_START]] + trg)
+                    self.trg_ids_next.append(trg + [self.trg_dict[_END]])
+
+    def _dict_from(self, tf, suffix):
+        names = [m.name for m in tf.getmembers() if m.name.endswith(suffix)]
+        assert len(names) == 1, f"expected one {suffix} in archive"
+        d = {}
+        for i, line in enumerate(_lines(tf.extractfile(names[0]))):
+            if i >= self.dict_size:
+                break
+            d[line.strip()] = i
+        for i, w in enumerate((_START, _END, _UNK)):
+            d[w] = i
+        return d
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+
+class WMT16(Dataset):
+    """WMT'16 en<->de (reference: wmt16.py — tar with wmt16/{train,val,
+    test} tab-separated text; vocab built from the train split on first
+    use; items are (src_ids, trg_ids, trg_ids_next))."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en"):
+        if data_file is None:
+            raise ValueError("WMT16 requires data_file (no downloads)")
+        if mode not in ("train", "test", "val"):
+            raise ValueError(mode)
+        assert src_dict_size > 0 and trg_dict_size > 0
+        self.lang = lang
+        self.data_file = data_file
+        # one pass over the train split counts BOTH columns' vocabularies
+        # (per-dict scans would decompress the archive twice more)
+        self.src_dict, self.trg_dict = self._build_dicts(
+            src_dict_size, trg_dict_size, lang)
+        start, end, unk = (self.src_dict[_START], self.src_dict[_END],
+                           self.src_dict[_UNK])
+        src_col = 0 if lang == "en" else 1
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for line in _lines(tf.extractfile(f"wmt16/{mode}")):
+                parts = line.strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [start] + [self.src_dict.get(w, unk)
+                                 for w in parts[src_col].split()] + [end]
+                trg = [self.trg_dict.get(w, unk)
+                       for w in parts[1 - src_col].split()]
+                self.src_ids.append(src)
+                self.trg_ids.append([start] + trg)
+                self.trg_ids_next.append(trg + [end])
+
+    def _build_dicts(self, src_size, trg_size, lang):
+        src_col = 0 if lang == "en" else 1
+        freqs = (defaultdict(int), defaultdict(int))
+        with tarfile.open(self.data_file, "r:*") as tf:
+            for line in _lines(tf.extractfile("wmt16/train")):
+                parts = line.strip().split("\t")
+                if len(parts) == 2:
+                    for col in (0, 1):
+                        for w in parts[col].split():
+                            freqs[col][w] += 1
+
+        def build(freq, size):
+            words = sorted(freq, key=lambda w: (-freq[w], w))
+            d = {w: i for i, w in enumerate((_START, _END, _UNK))}
+            for w in words[:max(size - 3, 0)]:
+                d[w] = len(d)
+            return d
+        return (build(freqs[src_col], src_size),
+                build(freqs[1 - src_col], trg_size))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def get_dict(self, lang="en", reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference: movielens.py — ml-1m zip with
+    movies.dat/users.dat/ratings.dat '::'-separated; items are
+    (user_id, gender, age, job, movie_id, category_ids, title_ids,
+    rating))."""
+
+    _TITLE_RE = re.compile(r"^(.*)\((\d+)\)$")
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0):
+        if data_file is None:
+            raise ValueError("Movielens requires data_file (no downloads)")
+        if mode not in ("train", "test"):
+            raise ValueError(mode)
+        self.categories_dict = {}
+        self.movie_title_dict = {}
+        movies, users = {}, {}
+        with zipfile.ZipFile(data_file) as z:
+            with z.open("ml-1m/movies.dat") as f:
+                for line in _lines(f):
+                    mid, title, cats = line.strip().split("::")
+                    m = self._TITLE_RE.match(title)
+                    title_words = (m.group(1) if m else title).lower().split()
+                    for c in cats.split("|"):
+                        self.categories_dict.setdefault(
+                            c, len(self.categories_dict))
+                    for w in title_words:
+                        self.movie_title_dict.setdefault(
+                            w, len(self.movie_title_dict))
+                    movies[int(mid)] = (
+                        [self.categories_dict[c] for c in cats.split("|")],
+                        [self.movie_title_dict[w] for w in title_words])
+            with z.open("ml-1m/users.dat") as f:
+                for line in _lines(f):
+                    uid, gender, age, job, _ = line.strip().split("::")
+                    users[int(uid)] = (0 if gender == "M" else 1, int(age),
+                                       int(job))
+            rng = np.random.RandomState(rand_seed)
+            self.data = []
+            with z.open("ml-1m/ratings.dat") as f:
+                for line in _lines(f):
+                    uid, mid, rating, _ = line.strip().split("::")
+                    uid, mid = int(uid), int(mid)
+                    if mid not in movies or uid not in users:
+                        continue
+                    is_test = rng.rand() < test_ratio
+                    if (mode == "test") != is_test:
+                        continue
+                    g, a, j = users[uid]
+                    cats, title = movies[mid]
+                    self.data.append((uid, g, a, j, mid, cats, title,
+                                      float(rating)))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        uid, g, a, j, mid, cats, title, rating = self.data[idx]
+        return (np.array(uid), np.array(g), np.array(a), np.array(j),
+                np.array(mid), np.array(cats), np.array(title),
+                np.array(rating, np.float32))

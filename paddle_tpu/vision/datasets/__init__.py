@@ -9,6 +9,7 @@ and benchmarks, `FakeData` generates deterministic synthetic samples.
 from __future__ import annotations
 
 import gzip
+import io
 import os
 import pickle
 import struct
@@ -193,3 +194,98 @@ class DatasetFolder(Dataset):
 
 
 ImageFolder = DatasetFolder
+
+
+def _decode_image(raw: bytes, to_rgb=True):
+    import io as _io
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError("decoding image archives requires PIL") from e
+    img = Image.open(_io.BytesIO(raw))
+    return np.asarray(img.convert("RGB") if to_rgb else img)
+
+
+class _ForkSafeTar:
+    """Tar handle reopened per process: DataLoader forks workers, and a
+    file descriptor inherited across fork shares its offset — concurrent
+    extractfile reads would interleave seeks and corrupt the bytes."""
+
+    def __init__(self, path):
+        self._path = path
+        self._pid = os.getpid()
+        self._tf = tarfile.open(path)
+        self.members = {m.name: m for m in self._tf.getmembers()}
+
+    def read(self, name) -> bytes:
+        if os.getpid() != self._pid:
+            self._tf = tarfile.open(self._path)
+            self._pid = os.getpid()
+        return self._tf.extractfile(self.members[name]).read()
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference: vision/datasets/voc2012.py —
+    VOCtrainval tar; items are (image HWC uint8, label HW uint8))."""
+
+    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _IMG = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _LBL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    _FLAG = {"train": "trainval", "test": "train", "valid": "val"}
+
+    def __init__(self, data_file=None, mode="train", transform=None):
+        if data_file is None:
+            raise ValueError("VOC2012 requires data_file (no downloads)")
+        if mode.lower() not in self._FLAG:
+            raise ValueError(mode)
+        self.transform = transform
+        self._tar = _ForkSafeTar(data_file)
+        names = io.BytesIO(self._tar.read(
+            self._SET.format(self._FLAG[mode.lower()])))
+        self.keys = [ln.decode("utf-8").strip() for ln in names
+                     if ln.strip()]
+
+    def __len__(self):
+        return len(self.keys)
+
+    def __getitem__(self, idx):
+        key = self.keys[idx]
+        img = _decode_image(self._tar.read(self._IMG.format(key)))
+        # palette PNG: keep the raw class indices, not RGB
+        lbl = _decode_image(self._tar.read(self._LBL.format(key)),
+                            to_rgb=False)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, lbl.astype(np.uint8)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference: vision/datasets/flowers.py —
+    102flowers tgz + imagelabels.mat + setid.mat; items are
+    (image, label))."""
+
+    _FLAG = {"train": "tstid", "test": "trnid", "valid": "valid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None):
+        for arg, nm in ((data_file, "data_file"), (label_file, "label_file"),
+                        (setid_file, "setid_file")):
+            if arg is None:
+                raise ValueError(f"Flowers requires {nm} (no downloads)")
+        if mode.lower() not in self._FLAG:
+            raise ValueError(mode)
+        import scipy.io as scio
+        self.transform = transform
+        self._tar = _ForkSafeTar(data_file)
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[self._FLAG[mode.lower()]][0]
+
+    def __len__(self):
+        return len(self.indexes)
+
+    def __getitem__(self, idx):
+        index = int(self.indexes[idx])
+        img = _decode_image(self._tar.read("jpg/image_%05d.jpg" % index))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.array([self.labels[index - 1]], np.int64)

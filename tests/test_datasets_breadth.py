@@ -1,0 +1,231 @@
+"""Dataset breadth (Conll05st/WMT14/WMT16/Movielens/VOC2012/Flowers) and
+paddle.regularizer — VERDICT r1 missing #9.
+
+Each test builds a tiny synthetic archive in the reference's exact layout
+and checks parsing + item shapes (reference test strategy: the dataset
+unit tests feed golden mini-fixtures)."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import Conll05st, WMT14, WMT16, Movielens
+
+
+def _add_bytes(tf, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+def test_conll05st(tmp_path):
+    words = "The\ncat\nsat\n\nDogs\nbark\n\n".encode()
+    # props: one predicate column; '-' rows for non-predicates
+    props = ("-\t(A0*\nsit\t*)\n-\t(V*)\n\n"
+             "-\t(V*)\nbark\t*\n\n").encode()
+    # NOTE the props format is token-per-line columns; build precisely:
+    props = (b"-\t(A0*\n" b"sit\t*)\n" b"-\t(V*)\n" b"\n"
+             b"bark\t(V*)\n" b"-\t*\n" b"\n")
+    data = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(data, "w:gz") as tf:
+        _add_bytes(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                   gzip.compress(words))
+        _add_bytes(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                   gzip.compress(props))
+    wd = tmp_path / "wordDict.txt"
+    wd.write_text("The\ncat\nsat\nDogs\nbark\n")
+    vd = tmp_path / "verbDict.txt"
+    vd.write_text("sit\nbark\n")
+    td = tmp_path / "targetDict.txt"
+    td.write_text("B-A0\nI-A0\nB-V\nI-V\nO\n")
+    ds = Conll05st(data_file=str(data), word_dict_file=str(wd),
+                   verb_dict_file=str(vd), target_dict_file=str(td))
+    assert len(ds) == 2
+    item = ds[0]
+    assert len(item) == 9  # words + 5 ctx + predicate + mark + labels
+    n = len(item[0])
+    assert all(len(a) == n for a in item)
+    w, p, l = ds.get_dict()
+    assert "O" in l and all(t in l for t in ("B-A0", "I-A0", "B-V", "I-V"))
+
+
+def _wmt_pairs():
+    return [("hello world", "bonjour monde"),
+            ("good day", "bonne journee"),
+            ("the cat", "le chat")]
+
+
+def test_wmt14(tmp_path):
+    data = tmp_path / "wmt14.tgz"
+    vocab_src = "\n".join(["<s>", "<e>", "<unk>", "hello", "world", "good",
+                           "day", "the", "cat"]).encode()
+    vocab_trg = "\n".join(["<s>", "<e>", "<unk>", "bonjour", "monde",
+                           "bonne", "journee", "le", "chat"]).encode()
+    body = "\n".join(f"{s}\t{t}" for s, t in _wmt_pairs()).encode()
+    with tarfile.open(data, "w:gz") as tf:
+        _add_bytes(tf, "wmt14/src.dict", vocab_src)
+        _add_bytes(tf, "wmt14/trg.dict", vocab_trg)
+        _add_bytes(tf, "wmt14/train/train", body)
+    ds = WMT14(data_file=str(data), mode="train", dict_size=30)
+    assert len(ds) == 3
+    src, trg, nxt = ds[0]
+    assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+    assert trg[0] == ds.trg_dict["<s>"] and nxt[-1] == ds.trg_dict["<e>"]
+    np.testing.assert_array_equal(trg[1:], nxt[:-1])
+    fwd, _ = ds.get_dict()
+    rev_s, _ = ds.get_dict(reverse=True)
+    assert rev_s[fwd["hello"]] == "hello"
+
+
+def test_wmt16(tmp_path):
+    data = tmp_path / "wmt16.tar.gz"
+    body = "\n".join(f"{s}\t{t}" for s, t in _wmt_pairs()).encode()
+    with tarfile.open(data, "w:gz") as tf:
+        _add_bytes(tf, "wmt16/train", body)
+        _add_bytes(tf, "wmt16/val", body[:20])
+        _add_bytes(tf, "wmt16/test", body)
+    ds = WMT16(data_file=str(data), mode="train", src_dict_size=20,
+               trg_dict_size=20, lang="en")
+    assert len(ds) == 3
+    src, trg, nxt = ds[1]
+    assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+    np.testing.assert_array_equal(trg[1:], nxt[:-1])
+    # de->en direction swaps columns
+    ds_de = WMT16(data_file=str(data), mode="train", src_dict_size=20,
+                  trg_dict_size=20, lang="de")
+    assert len(ds_de) == 3
+    assert "bonjour" in ds_de.src_dict and "hello" in ds_de.trg_dict
+
+
+def test_movielens(tmp_path):
+    data = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(data, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Children's\n"
+                   "2::Jumanji (1995)::Adventure\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::F::1::10::48067\n2::M::56::16::70072\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::978300760\n1::2::3::978302109\n"
+                   "2::1::4::978301968\n")
+    tr = Movielens(data_file=str(data), mode="train", test_ratio=0.0)
+    assert len(tr) == 3
+    uid, g, a, j, mid, cats, title, rating = tr[0]
+    assert rating.dtype == np.float32
+    assert title.ndim == 1 and cats.ndim == 1
+    te = Movielens(data_file=str(data), mode="test", test_ratio=1.0)
+    assert len(te) == 3
+
+
+def _png_bytes(arr, mode="RGB"):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr, mode).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _jpg_bytes(arr):
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr, "RGB").save(buf, "JPEG")
+    return buf.getvalue()
+
+
+def test_voc2012(tmp_path):
+    from paddle_tpu.vision.datasets import VOC2012
+    rng = np.random.RandomState(0)
+    data = tmp_path / "voc.tar"
+    with tarfile.open(data, "w") as tf:
+        keys = ["2007_000001", "2007_000002"]
+        _add_bytes(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/"
+                   "trainval.txt", "\n".join(keys).encode() + b"\n")
+        _add_bytes(tf, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                   keys[0].encode() + b"\n")
+        for k in keys:
+            img = rng.randint(0, 255, (8, 8, 3), "uint8")
+            lbl = rng.randint(0, 21, (8, 8), "uint8")
+            _add_bytes(tf, f"VOCdevkit/VOC2012/JPEGImages/{k}.jpg",
+                       _jpg_bytes(img))
+            _add_bytes(tf, f"VOCdevkit/VOC2012/SegmentationClass/{k}.png",
+                       _png_bytes(lbl, "L"))
+    ds = VOC2012(data_file=str(data), mode="train")
+    assert len(ds) == 2
+    img, lbl = ds[0]
+    assert img.shape == (8, 8, 3) and lbl.shape == (8, 8)
+    assert lbl.dtype == np.uint8
+    assert len(VOC2012(data_file=str(data), mode="valid")) == 1
+
+
+def test_flowers(tmp_path):
+    import scipy.io as scio
+    from paddle_tpu.vision.datasets import Flowers
+    rng = np.random.RandomState(0)
+    data = tmp_path / "102flowers.tgz"
+    n = 4
+    with tarfile.open(data, "w:gz") as tf:
+        for i in range(1, n + 1):
+            img = rng.randint(0, 255, (8, 8, 3), "uint8")
+            _add_bytes(tf, "jpg/image_%05d.jpg" % i, _jpg_bytes(img))
+    labels = tmp_path / "imagelabels.mat"
+    scio.savemat(labels, {"labels": np.arange(1, n + 1)[None]})
+    setid = tmp_path / "setid.mat"
+    scio.savemat(setid, {"tstid": np.array([[1, 2, 3]]),
+                         "trnid": np.array([[4]]),
+                         "valid": np.array([[2]])})
+    tr = Flowers(data_file=str(data), label_file=str(labels),
+                 setid_file=str(setid), mode="train")
+    assert len(tr) == 3
+    img, lab = tr[0]
+    assert img.shape == (8, 8, 3) and lab.shape == (1,)
+    assert len(Flowers(data_file=str(data), label_file=str(labels),
+                       setid_file=str(setid), mode="test")) == 1
+
+
+def test_regularizer_l2_matches_float_and_l1_sign():
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+    x = paddle.ones([2, 2])
+
+    def one_step(wd):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(2, 2)
+        w0 = lin.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters(),
+                                   weight_decay=wd)
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        return w0, lin.weight.numpy()
+
+    w0a, wa = one_step(0.5)
+    w0b, wb = one_step(L2Decay(0.5))
+    np.testing.assert_allclose(wa, wb, rtol=1e-6)
+
+    w0c, wc = one_step(L1Decay(0.5))
+    # grad of sum = x^T 1 = [2,2] per out; manual: g + 0.5*sign(w)
+    g = np.full((2, 2), 2.0, "float32")
+    ref = w0c - 0.1 * (g + 0.5 * np.sign(w0c))
+    np.testing.assert_allclose(wc, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_param_attr_regularizer_overrides_optimizer_decay():
+    from paddle_tpu.regularizer import L2Decay
+    x = paddle.ones([2, 2])
+    paddle.seed(0)
+    lin = paddle.nn.Linear(2, 2, weight_attr=paddle.nn.ParamAttr(
+        regularizer=L2Decay(0.0)))  # per-param: NO decay on the weight
+    w0 = lin.weight.numpy().copy()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=lin.parameters(),
+                               weight_decay=0.5)  # global decay
+    lin(x).sum().backward()
+    opt.step()
+    g = np.full((2, 2), 2.0, "float32")
+    ref = w0 - 0.1 * g  # decay suppressed by the per-param override
+    np.testing.assert_allclose(lin.weight.numpy(), ref, rtol=1e-5,
+                               atol=1e-6)
