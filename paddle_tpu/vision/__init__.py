@@ -1,2 +1,2 @@
 """paddle_tpu.vision (reference: python/paddle/vision/)."""
-from . import models, datasets, transforms  # noqa: F401
+from . import models, datasets, transforms, ops  # noqa: F401

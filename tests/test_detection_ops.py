@@ -1,0 +1,229 @@
+"""Detection op family (paddle.vision.ops) — VERDICT §2.1 gap
+(reference: paddle/fluid/operators/detection/, 66 kernels).  Each op is
+checked against an independent numpy reference."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def _boxes(seed=0, n=12, size=100.0):
+    rng = np.random.RandomState(seed)
+    x1 = rng.rand(n) * size * 0.8
+    y1 = rng.rand(n) * size * 0.8
+    w = rng.rand(n) * size * 0.3 + 2
+    h = rng.rand(n) * size * 0.3 + 2
+    return np.stack([x1, y1, x1 + w, y1 + h], -1).astype("float32")
+
+
+def _iou_np(a, b):
+    x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+    aa = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    ab = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return inter / np.maximum(aa[:, None] + ab[None, :] - inter, 1e-10)
+
+
+def test_iou_similarity():
+    a, b = _boxes(0), _boxes(1, n=7)
+    got = ops.iou_similarity(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(got.numpy(), _iou_np(a, b), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_nms_matches_greedy_reference():
+    boxes = _boxes(2, n=20)
+    scores = np.random.RandomState(3).rand(20).astype("float32")
+    kept = ops.nms(paddle.to_tensor(boxes), 0.4,
+                   paddle.to_tensor(scores)).numpy()
+    # greedy numpy reference
+    order = np.argsort(-scores)
+    iou = _iou_np(boxes, boxes)
+    ref = []
+    for i in order:
+        if all(iou[i, j] <= 0.4 for j in ref):
+            ref.append(i)
+    np.testing.assert_array_equal(kept, ref)
+
+
+def test_nms_per_category_no_cross_suppression():
+    box = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], "float32")
+    scores = np.array([0.9, 0.8], "float32")
+    cats = np.array([0, 1], "int32")
+    kept = ops.nms(paddle.to_tensor(box), 0.3, paddle.to_tensor(scores),
+                   category_idxs=paddle.to_tensor(cats),
+                   categories=[0, 1]).numpy()
+    assert len(kept) == 2  # different categories: both survive
+
+
+def test_multiclass_nms():
+    boxes = _boxes(4, n=10)
+    scores = np.random.RandomState(5).rand(3, 10).astype("float32")
+    out, count = ops.multiclass_nms(paddle.to_tensor(boxes),
+                                    paddle.to_tensor(scores),
+                                    score_threshold=0.2, nms_top_k=5,
+                                    keep_top_k=8, nms_threshold=0.4)
+    o = out.numpy()
+    assert o.shape == (8, 6)
+    assert count <= 8
+    valid = o[:count]
+    assert (valid[:, 1][:-1] >= valid[:, 1][1:]).all()  # sorted by score
+    assert (o[count:] == -1).all()
+
+
+def test_box_coder_roundtrip():
+    priors = _boxes(6, n=5)
+    var = np.full((5, 4), 0.1, "float32")
+    targets = _boxes(7, n=5)
+    enc = ops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                        paddle.to_tensor(targets),
+                        code_type="encode_center_size").numpy()
+    assert enc.shape == (5, 5, 4)
+    # decode the diagonal (each target against its own prior)
+    diag = np.stack([enc[i, i] for i in range(5)])[:, None, :]
+    dec = ops.box_coder(paddle.to_tensor(priors), paddle.to_tensor(var),
+                        paddle.to_tensor(diag),
+                        code_type="decode_center_size", axis=0).numpy()
+    np.testing.assert_allclose(dec[:, 0], targets, rtol=1e-4, atol=1e-3)
+
+
+def test_yolo_box_shapes_and_thresh():
+    rng = np.random.RandomState(0)
+    n, an, cls, h, w = 2, 3, 4, 5, 5
+    x = rng.randn(n, an * (5 + cls), h, w).astype("float32")
+    img = np.array([[320, 320], [480, 640]], "int32")
+    boxes, scores = ops.yolo_box(paddle.to_tensor(x), paddle.to_tensor(img),
+                                 anchors=[10, 13, 16, 30, 33, 23],
+                                 class_num=cls, conf_thresh=0.5,
+                                 downsample_ratio=32)
+    assert boxes.shape == [n, an * h * w, 4]
+    assert scores.shape == [n, an * h * w, cls]
+    b = boxes.numpy()
+    assert (b[0] <= 320).all() and (b[0] >= 0).all()  # clipped to image
+    # score zeroing: where all 4 coords are zero the conf was sub-threshold
+    s = scores.numpy()
+    zero_rows = (np.abs(b).sum(-1) == 0)
+    assert (s[zero_rows] == 0).all()
+
+
+def test_prior_box_and_anchor_generator():
+    feat = paddle.zeros([1, 8, 4, 4])
+    image = paddle.zeros([1, 3, 32, 32])
+    boxes, var = ops.prior_box(feat, image, min_sizes=[8.0],
+                               aspect_ratios=[1.0, 2.0], flip=True,
+                               clip=True)
+    assert boxes.shape == [4, 4, 3, 4]  # H, W, priors(ar 1.0 + 2.0 + flip 0.5), 4
+    bn = boxes.numpy()
+    assert bn.min() >= 0 and bn.max() <= 1
+    # cell (0,0) prior 0 is centered at offset*step/img = 4/32
+    c = (bn[0, 0, 0, 0] + bn[0, 0, 0, 2]) / 2
+    np.testing.assert_allclose(c, 4 / 32, atol=1e-6)
+
+    anchors, avar = ops.anchor_generator(feat, anchor_sizes=[32, 64],
+                                         aspect_ratios=[0.5, 1.0],
+                                         variances=[0.1, 0.1, 0.2, 0.2],
+                                         stride=[8.0, 8.0])
+    assert anchors.shape == [4, 4, 4, 4]
+    an = anchors.numpy()
+    # anchor areas match the requested sizes
+    a0 = an[0, 0, 0]
+    area = (a0[2] - a0[0]) * (a0[3] - a0[1])
+    np.testing.assert_allclose(area, 32 * 32, rtol=1e-4)
+
+
+def test_box_clip():
+    boxes = np.array([[-5, -5, 50, 50], [10, 10, 200, 300]], "float32")
+    out = ops.box_clip(paddle.to_tensor(boxes),
+                       paddle.to_tensor(np.array([100, 80], "float32")))
+    np.testing.assert_allclose(out.numpy(),
+                               [[0, 0, 50, 50], [10, 10, 79, 99]])
+
+
+def test_distribute_fpn_proposals():
+    rois = np.array([[0, 0, 10, 10],      # small -> low level
+                     [0, 0, 224, 224],    # refer scale -> refer level
+                     [0, 0, 500, 500]], "float32")
+    outs, restore = ops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224)
+    sizes = [o.shape[0] for o in outs]
+    assert sum(sizes) == 3
+    assert outs[0].shape[0] == 1  # the small one at level 2
+    # restore maps original order to concatenated output rows
+    cat = np.concatenate([o.numpy() for o in outs if o.shape[0]])
+    np.testing.assert_allclose(cat[restore.numpy()], rois)
+
+
+def test_roi_align_uniform_image():
+    """On a constant image every interior RoI must return that constant."""
+    x = np.full((1, 2, 16, 16), 3.5, "float32")
+    boxes = np.array([[2, 2, 10, 10], [0, 0, 15, 15]], "float32")
+    out = ops.roi_align(paddle.to_tensor(x), paddle.to_tensor(boxes),
+                        output_size=4, spatial_scale=1.0, aligned=True)
+    assert out.shape == [2, 2, 4, 4]
+    np.testing.assert_allclose(out.numpy(), 3.5, rtol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    import jax.numpy as jnp
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 1, 8, 8).astype("float32"))
+    x.stop_gradient = False
+    boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], "float32"))
+    out = ops.roi_align(x, boxes, output_size=2)
+    out.sum().backward()
+    g = x.grad.numpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_box_clip_honors_scale():
+    boxes = np.array([[0, 0, 500, 700]], "float32")
+    out = ops.box_clip(paddle.to_tensor(boxes),
+                       paddle.to_tensor(np.array([800, 600, 2.0],
+                                                 "float32")))
+    # clipped to round(800/2) x round(600/2) = 400 x 300 original image
+    np.testing.assert_allclose(out.numpy(), [[0, 0, 299, 399]])
+
+
+def test_multiclass_nms_candidate_preselection():
+    """nms_top_k limits CANDIDATES before NMS (reference order), so a
+    suppression inside the top-k must not pull in lower-ranked boxes."""
+    boxes = np.array([[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                      [50, 50, 60, 60], [80, 80, 90, 90]], "float32")
+    scores = np.array([[0.9, 0.85, 0.3, 0.2]], "float32")
+    out, count = ops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.1, nms_top_k=2, keep_top_k=4, nms_threshold=0.5)
+    # top-2 candidates are the two overlapping boxes; one suppressed ->
+    # exactly 1 detection (boxes 2/3 were never candidates)
+    assert count == 1
+    np.testing.assert_allclose(out.numpy()[0, 2:], boxes[0])
+
+
+def test_distribute_fpn_proposals_rois_num():
+    rois = np.array([[0, 0, 10, 10], [0, 0, 500, 500],
+                     [0, 0, 12, 12]], "float32")
+    outs, restore, per_level = ops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224, rois_num=paddle.to_tensor(
+            np.array([2, 1], "int32")))
+    assert len(per_level) == 4
+    total = np.stack([p.numpy() for p in per_level]).sum(0)
+    np.testing.assert_array_equal(total, [2, 1])  # counts preserved
+
+
+def test_roi_pool_max_semantics():
+    x = np.zeros((1, 1, 8, 8), "float32")
+    x[0, 0, 2, 2] = 5.0
+    x[0, 0, 5, 5] = 7.0
+    out = ops.roi_pool(paddle.to_tensor(x),
+                       paddle.to_tensor(np.array([[0, 0, 7, 7]],
+                                                 "float32")),
+                       output_size=2)
+    o = out.numpy()[0, 0]
+    assert o[0, 0] == 5.0 and o[1, 1] == 7.0
+    assert o[0, 1] == 0.0 and o[1, 0] == 0.0
