@@ -59,6 +59,7 @@ from . import ops  # noqa: F401
 from . import quantization  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import text  # noqa: F401
+from . import inference  # noqa: F401
 
 disable_static = lambda *a, **k: None  # noqa: E731  (always "dygraph")
 enable_static = lambda *a, **k: None  # noqa: E731

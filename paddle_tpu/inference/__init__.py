@@ -1,0 +1,204 @@
+"""paddle.inference — Config / Predictor deployment API.
+
+Reference: paddle/fluid/inference/api/paddle_inference_api.h
+(AnalysisConfig + AnalysisPredictor + ZeroCopyTensor): configure a saved
+model, create a predictor, feed named input handles, run, read named
+output handles.
+
+TPU-native: the "engine" is the exported StableHLO program saved by
+`paddle.jit.save` — deserialized once and executed by the JAX runtime.
+The reference's pass/optimization knobs (ir_optim, memory_optim, mkldnn,
+TensorRT) are accepted for API compatibility and recorded, but they are
+subsumed by XLA compilation: there is no separate pass pipeline to
+toggle.  `enable_profile` wires the paddle_tpu profiler around `run()`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]
+
+
+class Config:
+    """AnalysisConfig equivalent (reference: paddle_inference_api.h)."""
+
+    def __init__(self, model_path: Optional[str] = None,
+                 params_path: Optional[str] = None):
+        # paddle convention: prog file "model.pdmodel" + "model.pdiparams";
+        # here one artifact prefix covers both (jit.save layout)
+        self._prefix = None
+        if model_path is not None:
+            self.set_model(model_path, params_path)
+        self._ir_optim = True
+        self._memory_optim = False
+        self._profile = False
+        self._device = "tpu"
+        self._threads = 1
+
+    # -- model ----------------------------------------------------------------
+    def set_model(self, model_path: str, params_path: Optional[str] = None):
+        for suffix in (".pdmodel", ".pdiparams", ".pdiparams.npz"):
+            if model_path.endswith(suffix):
+                model_path = model_path[:-len(suffix)]
+                break
+        if params_path is not None:
+            # jit.save artifacts keep program+weights under one prefix; a
+            # divergent params location cannot be honored — fail loudly
+            # instead of silently loading from a path the user never gave
+            expect = model_path + ".pdiparams"
+            stripped = params_path[:-4] if params_path.endswith(".npz") \
+                else params_path
+            if stripped != expect:
+                raise ValueError(
+                    f"params_path {params_path!r} disagrees with the "
+                    f"artifact prefix {model_path!r} (expected "
+                    f"{expect}[.npz]); paddle_tpu artifacts store weights "
+                    "next to the program")
+        self._prefix = model_path
+
+    def model_dir(self):
+        return self._prefix
+
+    # -- device ---------------------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "gpu"  # recorded; execution uses the JAX backend
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_xpu(self, *a, **k):
+        self._device = "xpu"
+
+    def use_gpu(self):
+        return self._device == "gpu"
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._threads = int(n)
+
+    # -- optimization knobs (XLA-subsumed, recorded for compat) --------------
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = bool(flag)
+
+    def memory_optim_enabled(self):
+        return self._memory_optim
+
+    def enable_tensorrt_engine(self, *a, **k):
+        pass  # XLA is the backend; accepted for API compat
+
+    def enable_mkldnn(self):
+        pass
+
+    def switch_use_feed_fetch_ops(self, flag=False):
+        pass
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    # -- profiling ------------------------------------------------------------
+    def enable_profile(self):
+        self._profile = True
+
+    def summary(self) -> str:
+        return (f"Config(model={self._prefix!r}, device={self._device}, "
+                f"ir_optim={self._ir_optim}, "
+                f"memory_optim={self._memory_optim})")
+
+
+class PredictorTensor:
+    """ZeroCopyTensor equivalent: a named input/output slot."""
+
+    def __init__(self, name: str, shape=None, dtype=None):
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self._dtype = dtype
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        arr = np.asarray(arr)
+        if self._dtype is not None:
+            arr = arr.astype(self._dtype, copy=False)
+        self._value = arr
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"tensor {self.name!r} has no value yet "
+                               "(run() first)")
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        self._shape = tuple(shape)
+
+    def shape(self):
+        if self._value is not None:
+            return list(self._value.shape)
+        return list(self._shape) if self._shape else []
+
+
+class Predictor:
+    """AnalysisPredictor equivalent over a jit.save artifact."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+        if config.model_dir() is None:
+            raise ValueError("Config has no model path (set_model)")
+        self._config = config
+        self._layer = jit_load(config.model_dir())
+        specs = (self._layer._meta or {}).get("input_spec") or []
+        if not specs:
+            raise RuntimeError(
+                "artifact has no input_spec metadata; re-export it with "
+                "paddle.jit.save(..., input_spec=[...])")
+        self._inputs: Dict[str, PredictorTensor] = {
+            f"x{i}": PredictorTensor(f"x{i}", shape, dtype)
+            for i, (shape, dtype) in enumerate(specs)}
+        self._outputs: Dict[str, PredictorTensor] = {}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name: str) -> PredictorTensor:
+        return self._inputs[name]
+
+    def run(self) -> bool:
+        args = []
+        for name, t in self._inputs.items():
+            if t._value is None:
+                raise RuntimeError(f"input {name!r} not set")
+            args.append(t._value)
+        prof = None
+        if self._config._profile:
+            from ..utils import profiler
+            prof = profiler.RecordEvent("predictor_run")
+            prof.__enter__()
+        try:
+            out = self._layer(*args)
+        finally:
+            if prof is not None:
+                prof.__exit__(None, None, None)
+        leaves = jax.tree_util.tree_leaves(out)
+        self._outputs = {}
+        for i, leaf in enumerate(leaves):
+            t = PredictorTensor(f"out{i}")
+            t.copy_from_cpu(np.asarray(
+                leaf.numpy() if hasattr(leaf, "numpy") else leaf))
+            self._outputs[t.name] = t
+        return True
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs)
+
+    def get_output_handle(self, name: str) -> PredictorTensor:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

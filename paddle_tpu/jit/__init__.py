@@ -479,13 +479,34 @@ class TrainStep:
 # save / load (inference model): AOT export via jax.export + weights pickle
 # ---------------------------------------------------------------------------
 
+def _relevant_op_versions(layer):
+    """Version entries for op families this layer tree actually exercises
+    (reference: op_version_registry records versions per op IN the saved
+    program; embedding the full registry would make unrelated version
+    bumps reject artifacts that never use the bumped op)."""
+    from ..utils import op_version
+    relevant = {"exported_program"}
+    for _, sub in getattr(layer, "named_sublayers", lambda: [])():
+        name = type(sub).__name__
+        if name in ("MultiHeadAttention", "TransformerEncoderLayer",
+                    "TransformerDecoderLayer", "BertLayer", "GPTBlock",
+                    "ErnieLayer"):
+            relevant |= {"flash_attention", "scaled_dot_product_attention"}
+        if name.startswith("Quanted") or name.startswith("Int8"):
+            relevant.add("fake_quantize")
+    snap = op_version.snapshot()
+    return {k: v for k, v in snap.items() if k in relevant}
+
+
 def save(layer, path, input_spec=None, **config):
     """paddle.jit.save — serialize compiled fn (StableHLO via jax.export) +
     weights (reference: save_inference_model, io.py:1198)."""
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     state = {k: np.asarray(v) for k, v in state_arrays(layer).items()}
     np.savez(path + ".pdiparams.npz", **state)
-    meta = {"class": type(layer).__name__, "input_spec": None}
+    from ..utils import op_version
+    meta = {"class": type(layer).__name__, "input_spec": None,
+            "op_versions": _relevant_op_versions(layer)}
     if input_spec is not None:
         layer.eval()
         specs = [s.to_shape_dtype() if isinstance(s, InputSpec) else
@@ -511,9 +532,10 @@ def save(layer, path, input_spec=None, **config):
 class TranslatedLayer:
     """Loaded inference artifact (reference: TranslatedLayer / AnalysisPredictor)."""
 
-    def __init__(self, exported, state):
+    def __init__(self, exported, state, meta=None):
         self._exported = exported
         self._state = state
+        self._meta = meta or {}
 
     def __call__(self, *args):
         raw = tuple(unwrap(a) for a in args)
@@ -530,6 +552,9 @@ class TranslatedLayer:
 def load(path, **config):
     with open(path + ".pdmeta", "rb") as f:
         meta = pickle.load(f)
+    from ..utils import op_version
+    op_version.check_compat(meta.get("op_versions"),
+                            strict=config.get("strict_op_versions", False))
     data = np.load(path + ".pdiparams.npz")
     state = {k: jnp.asarray(data[k]) for k in data.files}
     model_file = path + ".pdmodel"
@@ -537,7 +562,7 @@ def load(path, **config):
         from jax import export as jax_export
         with open(model_file, "rb") as f:
             exported = jax_export.deserialize(f.read())
-        return TranslatedLayer(exported, state)
+        return TranslatedLayer(exported, state, meta)
     raise FileNotFoundError(
         f"{model_file} not found — layer was saved without input_spec; "
         "load weights via paddle_tpu.load instead")

@@ -1,0 +1,93 @@
+"""Inference Config/Predictor API + op-version artifact compatibility.
+
+Reference: paddle_inference_api.h AnalysisConfig/AnalysisPredictor tests;
+op_version_registry.h compat checks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.utils import op_version
+
+
+def _saved_model(tmp_path):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 4))
+    net.eval()
+    path = str(tmp_path / "infer_model")
+    paddle.jit.save(net, path, input_spec=[
+        paddle.static.InputSpec([4, 8], "float32")])
+    return net, path
+
+
+def test_predictor_end_to_end(tmp_path):
+    net, path = _saved_model(tmp_path)
+    cfg = Config(path + ".pdmodel")
+    cfg.enable_memory_optim()
+    cfg.switch_ir_optim(True)
+    pred = create_predictor(cfg)
+    assert pred.get_input_names() == ["x0"]
+    x = np.random.RandomState(0).randn(4, 8).astype("float32")
+    h = pred.get_input_handle("x0")
+    assert h.shape() == [4, 8]
+    h.copy_from_cpu(x)
+    assert pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    ref = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_profile_and_errors(tmp_path):
+    _, path = _saved_model(tmp_path)
+    cfg = Config()
+    with pytest.raises(ValueError):
+        create_predictor(cfg)
+    cfg.set_model(path)
+    cfg.enable_profile()
+    pred = create_predictor(cfg)
+    with pytest.raises(RuntimeError, match="not set"):
+        pred.run()
+    with pytest.raises(RuntimeError, match="no value"):
+        pred.get_input_handle("x0").copy_to_cpu()
+
+
+def test_op_version_registry_basics():
+    assert op_version.get_op_version("flash_attention") >= 2
+    snap = op_version.snapshot()
+    assert "exported_program" in snap
+    with pytest.raises(ValueError):  # downgrade forbidden
+        op_version.register_op_version("flash_attention", 1)
+
+
+def test_op_version_artifact_compat(tmp_path):
+    _, path = _saved_model(tmp_path)
+    # saved metadata carries the snapshot
+    import pickle
+    with open(path + ".pdmeta", "rb") as f:
+        meta = pickle.load(f)
+    assert meta["op_versions"]["exported_program"] == 1
+
+    # a NEWER artifact than the runtime must refuse to load
+    meta["op_versions"]["flash_attention"] = 99
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+    with pytest.raises(op_version.OpVersionError):
+        paddle.jit.load(path)
+
+    # unknown op warns (default) / errors (strict)
+    meta["op_versions"] = {"op_from_the_future": 1}
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+    with pytest.warns(UserWarning):
+        paddle.jit.load(path)
+    with pytest.raises(op_version.OpVersionError):
+        paddle.jit.load(path, strict_op_versions=True)
+
+    # older artifact (subset of ops, lower versions) loads fine
+    meta["op_versions"] = {"exported_program": 1}
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+    loaded = paddle.jit.load(path)
+    x = np.zeros((4, 8), "float32")
+    assert loaded(paddle.to_tensor(x)).shape == [4, 4]
