@@ -1,0 +1,256 @@
+"""Dataset engine: InMemoryDataset / QueueDataset.
+
+Reference: paddle/fluid/framework/data_set.h:40-111 (DatasetImpl:
+LoadIntoMemory over many files x many threads, LocalShuffle,
+GlobalShuffle across trainers, ReleaseMemory, memory-size queries) and
+python/paddle/distributed/fleet/dataset/dataset.py (the 2.0 facade:
+init/set_filelist/load_into_memory/global_shuffle).
+
+TPU-native redesign:
+- LoadIntoMemory parses with the native C++ threaded datafeed
+  (native/src/datafeed.cc) when it builds, falling back to a Python
+  parser of the same `label<TAB>f1 f2 ...` text format.
+- GlobalShuffle needs no parameter-server scatter: every rank derives the
+  SAME seeded permutation of the global sample set and then iterates only
+  its rank's strided shard — the outcome (each sample visited once
+  per epoch by exactly one trainer, order globally random) matches the
+  reference's PS-mediated shuffle without any cross-host traffic.
+- QueueDataset streams batches straight off the native feed (single
+  pass, nothing held in memory) — data_set.h's non-memory mode.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["InMemoryDataset", "QueueDataset"]
+
+
+def _parse_text_py(path: str, dim: int):
+    feats, labels = [], []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().replace(",", " ").split()
+            if len(parts) != dim + 1:
+                continue
+            try:
+                labels.append(int(parts[0]))
+                feats.append([float(v) for v in parts[1:]])
+            except ValueError:
+                continue
+    return (np.asarray(feats, np.float32).reshape(-1, dim),
+            np.asarray(labels, np.int64))
+
+
+def _parse_binary_py(path: str, dim: int):
+    """Fixed records of int64 label + dim float32 (the format
+    native.write_binary_slot_file emits)."""
+    rec = np.dtype([("label", "<i8"), ("feat", "<f4", (dim,))])
+    data = np.fromfile(path, dtype=rec)
+    return (np.ascontiguousarray(data["feat"], np.float32),
+            np.ascontiguousarray(data["label"], np.int64))
+
+
+def _parse_file_py(path: str, dim: int, binary: bool):
+    return (_parse_binary_py if binary else _parse_text_py)(path, dim)
+
+
+class _DatasetBase:
+    def __init__(self):
+        self._filelist: List[str] = []
+        self._batch_size = 1
+        self._thread_num = 1
+        self._dim: Optional[int] = None
+        self._binary = False
+        self._drop_last = False
+
+    # -- fleet-style configuration (reference: dataset.py init/set_*) --------
+    def init(self, batch_size=1, thread_num=1, feature_dim=None,
+             use_var=None, binary=False, drop_last=False, **kw):
+        self._batch_size = int(batch_size)
+        self._thread_num = int(thread_num)
+        if feature_dim is not None:
+            self._dim = int(feature_dim)
+        self._binary = bool(binary)
+        self._drop_last = bool(drop_last)
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def get_filelist(self):
+        return list(self._filelist)
+
+    def set_batch_size(self, batch_size: int):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num: int):
+        self._thread_num = int(thread_num)
+
+    def set_feature_dim(self, dim: int):
+        self._dim = int(dim)
+
+    def _require_dim(self):
+        if self._dim is None:
+            raise ValueError(
+                "feature_dim not set: call init(feature_dim=...) or "
+                "set_feature_dim() (records are label + dim floats)")
+
+
+class InMemoryDataset(_DatasetBase):
+    """Load-then-shuffle dataset (reference: data_set.h InMemoryDataset).
+
+    Flow: init -> set_filelist -> load_into_memory -> [local|global]_shuffle
+    -> iterate batches (of this trainer's shard after a global shuffle).
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._feats: Optional[np.ndarray] = None
+        self._labels: Optional[np.ndarray] = None
+        self._order: Optional[np.ndarray] = None
+        self._sharded = False
+        self._epoch_seed = 0
+
+    # -- loading -------------------------------------------------------------
+    def load_into_memory(self):
+        """Parse every file with n_threads native readers (reference:
+        DatasetImpl::LoadIntoMemory's thread-per-channel parse)."""
+        self._require_dim()
+        if not self._filelist:
+            raise ValueError("set_filelist before load_into_memory")
+        from ..native import TextSlotDataFeed, available
+        feats, labels = [], []
+        if available():
+            feed = TextSlotDataFeed(
+                self._filelist, batch_size=4096, dim=self._dim,
+                n_threads=self._thread_num, binary=self._binary)
+            for f, l in feed:
+                feats.append(f)
+                labels.append(l)
+        else:  # pure-Python fallback (same text/binary formats)
+            for path in self._filelist:
+                f, l = _parse_file_py(path, self._dim, self._binary)
+                feats.append(f)
+                labels.append(l)
+        self._feats = (np.concatenate(feats) if feats else
+                       np.zeros((0, self._dim), np.float32))
+        self._labels = (np.concatenate(labels) if labels else
+                        np.zeros((0,), np.int64))
+        self._order = np.arange(len(self._labels))
+        self._sharded = False
+
+    def preload_into_memory(self):
+        self.load_into_memory()
+
+    def wait_preload_done(self):
+        pass
+
+    # -- shuffling -----------------------------------------------------------
+    def local_shuffle(self, seed: Optional[int] = None):
+        """Shuffle this trainer's in-memory samples only."""
+        self._check_loaded()
+        rng = np.random.RandomState(self._next_seed(seed))
+        rng.shuffle(self._order)
+
+    def global_shuffle(self, fleet=None, thread_num: Optional[int] = None,
+                       seed: Optional[int] = None):
+        """Globally shuffle + shard across trainers.  Every rank computes
+        the identical seeded permutation and keeps its strided slice, so
+        the union over ranks is exactly one globally-shuffled epoch (the
+        reference ships samples through the PS to achieve the same).
+
+        The permutation is applied over a CONTENT-CANONICAL ordering, not
+        load order: multithreaded native loading interleaves batches
+        nondeterministically per process, and a permutation of raw
+        positions would then pick different samples per rank.  Sorting
+        rows lexicographically first makes every rank agree (duplicate
+        rows are interchangeable by construction)."""
+        self._check_loaded()
+        canon = np.lexsort(
+            tuple(self._feats[:, d] for d in range(self._feats.shape[1]))
+            + (self._labels,))
+        rng = np.random.RandomState(self._next_seed(seed))
+        perm = rng.permutation(len(self._labels))
+        rank, nranks = self._rank_info(fleet)
+        self._order = canon[perm][rank::nranks]
+        self._sharded = True
+
+    def _next_seed(self, seed):
+        if seed is not None:
+            return int(seed)
+        self._epoch_seed += 1
+        return self._epoch_seed
+
+    @staticmethod
+    def _rank_info(fleet):
+        if fleet is not None and hasattr(fleet, "worker_index"):
+            return int(fleet.worker_index()), max(
+                1, int(fleet.worker_num()))
+        from .env import get_rank, get_world_size
+        return get_rank(), max(1, get_world_size())
+
+    # -- memory management ----------------------------------------------------
+    def release_memory(self):
+        self._feats = self._labels = self._order = None
+
+    def get_memory_data_size(self) -> int:
+        return 0 if self._labels is None else int(len(self._labels))
+
+    def get_shuffle_data_size(self) -> int:
+        return 0 if self._order is None else int(len(self._order))
+
+    def _check_loaded(self):
+        if self._feats is None:
+            raise RuntimeError("load_into_memory first")
+
+    # -- iteration ------------------------------------------------------------
+    def __iter__(self):
+        self._check_loaded()
+        bs = self._batch_size
+        order = self._order
+        for i in range(0, len(order), bs):
+            idx = order[i:i + bs]
+            if len(idx) < bs and self._drop_last:
+                return
+            yield self._feats[idx], self._labels[idx]
+
+    def __len__(self):
+        n = self.get_shuffle_data_size()
+        if self._drop_last:
+            return n // self._batch_size
+        return (n + self._batch_size - 1) // self._batch_size
+
+
+class QueueDataset(_DatasetBase):
+    """Streaming single-pass dataset (reference: data_set.h QueueDataset):
+    batches come straight off the native threaded feed, nothing is held
+    in memory; each iteration re-opens the files."""
+
+    def __iter__(self):
+        self._require_dim()
+        if not self._filelist:
+            raise ValueError("set_filelist before iterating")
+        from ..native import TextSlotDataFeed, available
+        if available():
+            feed = TextSlotDataFeed(
+                self._filelist, batch_size=self._batch_size, dim=self._dim,
+                n_threads=self._thread_num, binary=self._binary,
+                drop_last=self._drop_last)
+            yield from feed
+            return
+        # python fallback: parse one file at a time, carrying only the
+        # partial-batch remainder across files (memory stays ~one file)
+        rem_f = np.zeros((0, self._dim), np.float32)
+        rem_l = np.zeros((0,), np.int64)
+        for path in self._filelist:
+            f, l = _parse_file_py(path, self._dim, self._binary)
+            f = np.concatenate([rem_f, f])
+            l = np.concatenate([rem_l, l])
+            full = (len(l) // self._batch_size) * self._batch_size
+            for i in range(0, full, self._batch_size):
+                yield (f[i:i + self._batch_size],
+                       l[i:i + self._batch_size])
+            rem_f, rem_l = f[full:], l[full:]
+        if len(rem_l) and not self._drop_last:
+            yield rem_f, rem_l

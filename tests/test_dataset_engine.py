@@ -1,0 +1,181 @@
+"""Dataset engine: InMemoryDataset / QueueDataset.
+
+Reference: framework/data_set.h (LoadIntoMemory over many files x many
+threads, Local/GlobalShuffle, memory-size queries, streaming mode)."""
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import InMemoryDataset, QueueDataset
+
+
+def _write_files(tmp_path, n_files=4, rows_per_file=25, dim=6):
+    rng = np.random.RandomState(0)
+    paths, all_labels = [], []
+    for fi in range(n_files):
+        p = tmp_path / f"part-{fi:05d}.txt"
+        with open(p, "w") as f:
+            for r in range(rows_per_file):
+                label = fi * rows_per_file + r  # unique id as label
+                feats = rng.rand(dim)
+                f.write(f"{label}\t" + " ".join(f"{v:.6f}" for v in feats)
+                        + "\n")
+        paths.append(str(p))
+        all_labels.extend(range(fi * rows_per_file,
+                                (fi + 1) * rows_per_file))
+    return paths, all_labels, dim
+
+
+def test_load_into_memory_and_iterate(tmp_path):
+    paths, all_labels, dim = _write_files(tmp_path)
+    ds = InMemoryDataset()
+    ds.init(batch_size=10, thread_num=3, feature_dim=dim)
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 100
+    seen = []
+    for feats, labels in ds:
+        assert feats.shape[1] == dim
+        seen.extend(labels.tolist())
+    assert sorted(seen) == all_labels  # every row loaded exactly once
+
+
+def test_local_shuffle_changes_order_keeps_set(tmp_path):
+    paths, all_labels, dim = _write_files(tmp_path)
+    ds = InMemoryDataset()
+    ds.init(batch_size=100, feature_dim=dim)
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    before = next(iter(ds))[1].tolist()
+    ds.local_shuffle(seed=7)
+    after = next(iter(ds))[1].tolist()
+    assert before != after and sorted(before) == sorted(after)
+    # features follow their labels through the shuffle
+    feats, labels = next(iter(ds))
+    ds2 = InMemoryDataset()
+    ds2.init(batch_size=100, feature_dim=dim)
+    ds2.set_filelist(paths)
+    ds2.load_into_memory()
+    f0, l0 = next(iter(ds2))
+    lut = {l: f for l, f in zip(l0.tolist(), f0)}
+    for l, f in zip(labels.tolist(), feats):
+        np.testing.assert_allclose(f, lut[l])
+
+
+def test_global_shuffle_partitions_across_ranks(tmp_path):
+    """Sharded union across simulated ranks == one globally shuffled
+    epoch, disjoint per rank (the PS-shuffle outcome)."""
+    paths, all_labels, dim = _write_files(tmp_path)
+
+    class FakeFleet:
+        def __init__(self, idx, num):
+            self._i, self._n = idx, num
+
+        def worker_index(self):
+            return self._i
+
+        def worker_num(self):
+            return self._n
+
+    shards = []
+    for rank in range(4):
+        ds = InMemoryDataset()
+        ds.init(batch_size=100, feature_dim=dim)
+        ds.set_filelist(paths)
+        ds.load_into_memory()
+        ds.global_shuffle(fleet=FakeFleet(rank, 4), seed=13)
+        got = []
+        for _, labels in ds:
+            got.extend(labels.tolist())
+        shards.append(got)
+        assert ds.get_shuffle_data_size() == 25
+    union = sum(shards, [])
+    assert sorted(union) == all_labels          # exact partition
+    assert all(len(set(s)) == 25 for s in shards)
+    flat_first = [s[0] for s in shards]
+    assert flat_first != sorted(flat_first)     # actually shuffled
+
+
+def test_release_and_errors(tmp_path):
+    paths, _, dim = _write_files(tmp_path, n_files=1)
+    ds = InMemoryDataset()
+    ds.init(batch_size=4, feature_dim=dim)
+    ds.set_filelist(paths)
+    with pytest.raises(RuntimeError):
+        ds.local_shuffle()
+    ds.load_into_memory()
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+    ds2 = InMemoryDataset()
+    ds2.set_filelist(paths)
+    with pytest.raises(ValueError, match="feature_dim"):
+        ds2.load_into_memory()
+
+
+def test_global_shuffle_partition_survives_threaded_load_order(tmp_path):
+    """Ranks loading with DIFFERENT in-memory orders (thread interleaving)
+    must still produce an exact partition — the canonical-sort guard."""
+    paths, all_labels, dim = _write_files(tmp_path)
+
+    class FakeFleet:
+        def __init__(self, idx, num):
+            self._i, self._n = idx, num
+
+        def worker_index(self):
+            return self._i
+
+        def worker_num(self):
+            return self._n
+
+    shards = []
+    for rank in range(2):
+        ds = InMemoryDataset()
+        ds.init(batch_size=100, feature_dim=dim, thread_num=3)
+        ds.set_filelist(paths)
+        ds.load_into_memory()
+        # simulate a rank-specific thread interleaving of the load
+        scram = np.random.RandomState(100 + rank).permutation(
+            len(ds._labels))
+        ds._feats = ds._feats[scram]
+        ds._labels = ds._labels[scram]
+        ds.global_shuffle(fleet=FakeFleet(rank, 2), seed=21)
+        shards.append([l for _, ls in ds for l in ls.tolist()])
+    assert sorted(shards[0] + shards[1]) == all_labels
+    assert not (set(shards[0]) & set(shards[1]))
+
+
+def test_binary_python_fallback(tmp_path, monkeypatch):
+    """With the native lib unavailable, binary=True files must still load
+    (fixed int64+float32 records), not silently parse to zero rows."""
+    import paddle_tpu.native as native
+    from paddle_tpu.distributed import dataset as ds_mod
+    rng = np.random.RandomState(0)
+    feats = rng.rand(30, 5).astype("float32")
+    labels = np.arange(30, dtype="int64")
+    path = str(tmp_path / "part.bin")
+    native.write_binary_slot_file(path, feats, labels)
+    monkeypatch.setattr(native, "available", lambda: False)
+    ds = InMemoryDataset()
+    ds.init(batch_size=8, feature_dim=5, binary=True)
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 30
+    got_f, got_l = next(iter(ds))
+    np.testing.assert_allclose(got_f, feats[:8])
+    np.testing.assert_array_equal(got_l, labels[:8])
+
+
+def test_queue_dataset_streams_all_rows(tmp_path):
+    paths, all_labels, dim = _write_files(tmp_path)
+    ds = QueueDataset()
+    ds.init(batch_size=7, thread_num=2, feature_dim=dim)
+    ds.set_filelist(paths)
+    seen = []
+    for feats, labels in ds:
+        assert feats.shape[0] == labels.shape[0] <= 7
+        seen.extend(labels.tolist())
+    assert sorted(seen) == all_labels
+    # second pass re-streams (files reopened)
+    again = [l for _, ls in ds for l in ls.tolist()]
+    assert sorted(again) == all_labels
