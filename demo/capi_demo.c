@@ -1,0 +1,70 @@
+/* Standalone C inference demo — reference: paddle/fluid/train/demo
+ * (standalone binary linking the C++ runtime) and inference/capi usage.
+ *
+ * Embeds the paddle_tpu runtime through the C ABI in
+ * paddle_tpu/native/src/capi.cc.  Build (see tests/test_capi.py):
+ *   g++ -O2 demo/capi_demo.c paddle_tpu/native/src/capi.cc \
+ *       $(python3-config --includes) $(python3-config --ldflags --embed) \
+ *       -o capi_demo
+ * Run:  PYTHONPATH=/path/to/repo ./capi_demo <model_prefix>
+ */
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+extern int PD_Init(void);
+extern void PD_Finalize(void);
+extern void* PD_CreatePredictor(const char* model_prefix);
+extern int PD_PredictorRun(void* h, const float* in, const int64_t* shape,
+                           int ndim, float* out, int64_t cap,
+                           int64_t* out_shape, int* out_ndim);
+extern void PD_DeletePredictor(void* h);
+extern const char* PD_GetLastError(void);
+#ifdef __cplusplus
+}
+#endif
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s <model_prefix>\n", argv[0]);
+    return 2;
+  }
+  if (PD_Init() != 0) {
+    fprintf(stderr, "init failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  void* pred = PD_CreatePredictor(argv[1]);
+  if (pred == NULL) {
+    fprintf(stderr, "create failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  /* fixed demo input: 2x4 ramp */
+  int64_t shape[2] = {2, 4};
+  float input[8];
+  int i;
+  for (i = 0; i < 8; ++i) input[i] = (float)i * 0.1f;
+
+  float out[4096];
+  int64_t out_shape[8];
+  int out_ndim = 0;
+  if (PD_PredictorRun(pred, input, shape, 2, out, 4096, out_shape,
+                      &out_ndim) != 0) {
+    fprintf(stderr, "run failed: %s\n", PD_GetLastError());
+    return 1;
+  }
+  int64_t total = 1;
+  printf("out_shape=");
+  for (i = 0; i < out_ndim; ++i) {
+    printf("%lld%s", (long long)out_shape[i], i + 1 < out_ndim ? "x" : "");
+    total *= out_shape[i];
+  }
+  double checksum = 0.0;
+  for (i = 0; i < total; ++i) checksum += out[i];
+  printf(" checksum=%.6f\n", checksum);
+  PD_DeletePredictor(pred);
+  PD_Finalize();
+  return 0;
+}

@@ -18,10 +18,16 @@ _warnings.filterwarnings(
 from .core.tensor import (Tensor, Parameter, no_grad, enable_grad,  # noqa: F401
                           is_grad_enabled, set_grad_enabled)
 from .core.device import (CPUPlace, CUDAPlace, TPUPlace, XPUPlace,  # noqa: F401
-                          set_device, get_device, device_count,
-                          is_compiled_with_cuda, is_compiled_with_tpu)
+                          CUDAPinnedPlace, set_device, get_device,
+                          device_count, is_compiled_with_cuda,
+                          is_compiled_with_tpu, is_compiled_with_xpu,
+                          get_cudnn_version)
 from .core.dtype import set_default_dtype, get_default_dtype  # noqa: F401
 from .core.rng import seed, get_rng_state, set_rng_state  # noqa: F401
+# accelerator rng-state aliases (paddle.get/set_cuda_rng_state): one PRNG
+# stream serves every backend in the jax design
+from .core.rng import (get_rng_state as get_cuda_rng_state,  # noqa: F401
+                       set_rng_state as set_cuda_rng_state)
 from .core.tape import grad  # noqa: F401
 
 # dtype name aliases (paddle.float32 etc.)
@@ -60,6 +66,28 @@ from . import quantization  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import text  # noqa: F401
 from . import inference  # noqa: F401
+from . import onnx  # noqa: F401
+from .nn.layer_base import ParamAttr  # noqa: F401
+from .distributed.parallel_layer import DataParallel  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions — numpy print options drive Tensor repr."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
 
 disable_static = lambda *a, **k: None  # noqa: E731  (always "dygraph")
 enable_static = lambda *a, **k: None  # noqa: E731

@@ -1,0 +1,123 @@
+"""C inference API build + ctypes bindings.
+
+Reference: paddle/fluid/inference/capi/ (C ABI over AnalysisPredictor, used
+from Go/R/C deployments) and paddle/fluid/train/demo/ (standalone binary
+embedding the runtime).  The C surface lives in native/src/capi.cc; this
+module builds it (needs libpython, via python3-config) and exposes a ctypes
+client used by the tests — external C programs link the same .so directly.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..native import NativeBuildError
+
+_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SRC = os.path.join(_DIR, "native", "src", "capi.cc")
+_LIB = os.path.join(_DIR, "native", "libpdtpu_capi.so")
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def embed_flags() -> Tuple[list, list]:
+    """(include flags, link flags) for embedding CPython."""
+    inc = ["-I" + sysconfig.get_paths()["include"]]
+    ver = sysconfig.get_config_var("LDVERSION") or sysconfig.get_config_var(
+        "VERSION")
+    libdir = sysconfig.get_config_var("LIBDIR") or ""
+    link = [f"-L{libdir}", f"-lpython{ver}"]
+    return inc, link
+
+
+def build() -> str:
+    inc, link = embed_flags()
+    cmd = (["g++", "-O2", "-std=c++17", "-shared", "-fPIC", _SRC,
+            "-o", _LIB] + inc + link)
+    proc = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"capi build failed:\n{proc.stderr[-2000:]}")
+    return _LIB
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            build()
+        lib = ctypes.CDLL(_LIB, mode=ctypes.RTLD_GLOBAL)
+        lib.PD_Init.restype = ctypes.c_int
+        lib.PD_CreatePredictor.restype = ctypes.c_void_p
+        lib.PD_CreatePredictor.argtypes = [ctypes.c_char_p]
+        lib.PD_PredictorRun.restype = ctypes.c_int
+        lib.PD_PredictorRun.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int)]
+        lib.PD_DeletePredictor.argtypes = [ctypes.c_void_p]
+        lib.PD_GetLastError.restype = ctypes.c_char_p
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        load_library()
+        return True
+    except NativeBuildError:
+        return False
+
+
+class CPredictor:
+    """ctypes client over the C ABI (what a Go/R binding would wrap)."""
+
+    def __init__(self, model_prefix: str):
+        self._lib = load_library()
+        self._lib.PD_Init()
+        self._h = self._lib.PD_CreatePredictor(model_prefix.encode())
+        if not self._h:
+            raise RuntimeError(
+                f"PD_CreatePredictor: "
+                f"{self._lib.PD_GetLastError().decode()}")
+
+    def run(self, arr: np.ndarray,
+            out_capacity: int = 1 << 22) -> np.ndarray:
+        arr = np.ascontiguousarray(arr, np.float32)
+        shape = (ctypes.c_int64 * arr.ndim)(*arr.shape)
+        out = np.empty((out_capacity,), np.float32)
+        out_shape = (ctypes.c_int64 * 8)()
+        out_ndim = ctypes.c_int()
+        rc = self._lib.PD_PredictorRun(
+            self._h, arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            shape, arr.ndim,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out_capacity, out_shape, ctypes.byref(out_ndim))
+        if rc != 0:
+            raise RuntimeError(
+                f"PD_PredictorRun: {self._lib.PD_GetLastError().decode()}")
+        dims = tuple(out_shape[i] for i in range(out_ndim.value))
+        n = int(np.prod(dims)) if dims else 1
+        return out[:n].reshape(dims).copy()
+
+    def close(self):
+        if self._h:
+            self._lib.PD_DeletePredictor(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -112,3 +112,18 @@ def is_compiled_with_cuda() -> bool:
 
 def is_compiled_with_tpu() -> bool:
     return any(d.platform in ("tpu", "axon") for d in jax.devices())
+
+
+def is_compiled_with_xpu() -> bool:
+    """Paddle-API compat: Baidu-Kunlun XPU — never present here."""
+    return False
+
+
+def get_cudnn_version():
+    """Paddle-API compat: no cuDNN in the XLA/TPU stack."""
+    return None
+
+
+# paddle exposes CUDAPinnedPlace for pinned host staging buffers; host
+# memory management is XLA's job here, so it aliases the host place.
+CUDAPinnedPlace = CPUPlace

@@ -57,6 +57,32 @@ def _assert_finite(name: str, out):
                 f"(FLAGS_check_nan_inf is set)")
 
 
+def _harmonize_placement(raw):
+    """PrepareData equivalent (reference operator.cc:1258): when an eager op
+    mixes multi-device (mesh-sharded) arrays with arrays committed to a
+    single device — e.g. DataParallel-sharded activations vs a host-loaded
+    label — move the single-device ones onto the mesh (replicated) so the
+    op compiles instead of raising an incompatible-devices error."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh_sh = None
+    for x in raw:
+        if (isinstance(x, jax.Array) and not _is_tracer(x)
+                and isinstance(x.sharding, NamedSharding)
+                and len(x.sharding.device_set) > 1):
+            mesh_sh = x.sharding
+            break
+    if mesh_sh is None:
+        return raw
+    repl = NamedSharding(mesh_sh.mesh, PartitionSpec())
+    out = list(raw)
+    for i, x in enumerate(out):
+        if (isinstance(x, jax.Array) and not _is_tracer(x)
+                and len(x.sharding.device_set) == 1
+                and x.sharding.device_set != mesh_sh.device_set):
+            out[i] = jax.device_put(x, repl)
+    return out
+
+
 def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
     """Run `raw_fn` over args where Tensor leaves are unwrapped.
 
@@ -74,7 +100,8 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
     if not tensor_idx:
         return raw_fn(*args, **kwargs)
 
-    raw = [x._data if isinstance(x, Tensor) else x for x in flat]
+    raw = _harmonize_placement(
+        [x._data if isinstance(x, Tensor) else x for x in flat])
     # NOTE: the AMP cast runs INSIDE the differentiated closure below, so the
     # vjp of the cast maps cotangents back to each input's original dtype
     # (bf16 activations get bf16 grads, f32 master params get f32 grads even

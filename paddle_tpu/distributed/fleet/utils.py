@@ -1,0 +1,49 @@
+"""fleet.utils — filesystem clients + small helpers.
+
+Reference: python/paddle/distributed/fleet/utils/fs.py (LocalFS/HDFSClient)
+and utils/__init__.py (UtilBase: all_reduce/barrier over trainers + fs).
+The clients themselves live in paddle_tpu.io.fs; this module is the
+fleet-facing surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...io.fs import (LocalFS, HDFSClient, get_fs, ExecuteError,  # noqa: F401
+                      FSFileExistsError, FSFileNotExistsError, FSTimeOut)
+from .. import collective as _collective
+
+__all__ = ["LocalFS", "HDFSClient", "get_fs", "UtilBase",
+           "ExecuteError", "FSFileExistsError", "FSFileNotExistsError",
+           "FSTimeOut"]
+
+
+class UtilBase:
+    """Cross-trainer helpers (reference util_factory.py UtilBase)."""
+
+    def __init__(self, fs=None):
+        self._fs = fs or LocalFS()
+
+    def set_file_system(self, fs):
+        self._fs = fs
+
+    def get_file_shard(self, files):
+        """Split a file list across trainers (util_factory.py
+        get_file_shard): trainer i takes files[i::n]."""
+        from ..env import get_rank, get_world_size
+        n = max(1, get_world_size())
+        return list(files)[get_rank() % n::n]
+
+    def all_reduce(self, input, mode="sum"):
+        import paddle_tpu as paddle
+        t = paddle.to_tensor(np.asarray(input))
+        _collective.all_reduce(t, op=mode)
+        return np.asarray(t.numpy())
+
+    def barrier(self):
+        _collective.barrier()
+
+    def print_on_rank(self, message, rank_id=0):
+        from ..env import get_rank
+        if get_rank() == rank_id:
+            print(message)

@@ -1,38 +1,111 @@
-"""DataParallel — dygraph DDP wrapper.
+"""DataParallel — dygraph (eager) DDP wrapper.
 
 Reference: python/paddle/fluid/dygraph/parallel.py:289 (DataParallel wraps a
-Layer; imperative::Reducer buckets grads and all-reduces them on comm
-streams, imperative/reducer.h:116).
+Layer; imperative::Reducer buckets grads and ncclAllReduces them on comm
+streams, imperative/reducer.h:116, reducer.cc MarkVarReady hooks).
 
-TPU-native: there are no per-rank processes to reduce across in the
-single-controller model — the batch axis of a jitted step is sharded over
-the "dp" mesh axis and XLA emits the gradient reduction (see
-parallel.ShardedTrainStep).  This wrapper keeps API parity for eager code:
-it forwards to the inner layer, and `scale_loss`/`apply_collective_grads`
-are the identity (world of one per controller).  Multi-process eager DDP
-(jax.distributed + pmap-style) is intentionally not the perf path.
+TPU-native: there are no rank processes and no comm streams.  The wrapper
+makes *eager* code data-parallel by sharding every batch input over the
+"dp" axis of a device mesh (`jax.device_put` with a NamedSharding).  From
+there JAX's eager per-op compilation propagates the sharding: activations
+stay batch-sharded, and each parameter-grad op in the tape's vjp closures
+contracts over the sharded batch axis, so **XLA inserts the all-reduce
+inside the grad op itself** — the Reducer's bucketed ncclAllReduce becomes
+compiler-scheduled ICI collectives, overlapped per-op instead of hooked at
+MarkVarReady.
+
+`scale_loss` is the identity (the loss is already the mean over the global
+batch — the reference divides by nranks only because each rank computes a
+local mean and the allreduce sums).  `apply_collective_grads` re-replicates
+any grad whose sharding is not already fully replicated, in fused groups of
+`comm_buffer_size` MB (the Reducer's bucket size knob).
 """
 from __future__ import annotations
 
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
+from ..parallel.mesh import create_mesh, get_mesh
+
+
+def _dp_size(mesh) -> int:
+    return mesh.shape.get("dp", 1)
 
 
 class DataParallel(Layer):
     def __init__(self, layers, strategy=None, comm_buffer_size=25,
                  last_comm_buffer_size=1, find_unused_parameters=False,
-                 group=None):
+                 group=None, mesh=None):
         super().__init__()
         self._layers = layers
         self.find_unused_parameters = find_unused_parameters
+        self.comm_buffer_size = comm_buffer_size
+        m = mesh or get_mesh()
+        if m is None and len(jax.devices()) > 1:
+            m = create_mesh({"dp": len(jax.devices())})
+        self._mesh = m
+        self._batch_sharding = (
+            NamedSharding(m, P("dp")) if m is not None and _dp_size(m) > 1
+            else None)
+
+    def _shard_arg(self, x):
+        """Shard dim-0 of batch-like args over dp; pass others through."""
+        if self._batch_sharding is None:
+            return x
+        dp = _dp_size(self._mesh)
+        if isinstance(x, Tensor):
+            if x.ndim >= 1 and x.shape[0] % dp == 0:
+                data = jax.device_put(x._data, self._batch_sharding)
+                return Tensor(data, stop_gradient=x.stop_gradient)
+            return x
+        if isinstance(x, jax.Array) and x.ndim >= 1 and x.shape[0] % dp == 0:
+            return jax.device_put(x, self._batch_sharding)
+        return x
 
     def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_arg(a) for a in inputs)
+        kwargs = {k: self._shard_arg(v) for k, v in kwargs.items()}
         return self._layers(*inputs, **kwargs)
 
     def scale_loss(self, loss):
+        # identity: loss is the global-batch mean already (see module doc)
         return loss
 
     def apply_collective_grads(self):
-        pass
+        """Re-replicate non-replicated grads in comm_buffer_size-MB groups
+        (the Reducer bucket knob, reducer.h:41)."""
+        if self._mesh is None:
+            return
+        replicated = NamedSharding(self._mesh, P())
+        bucket, bucket_bytes = [], 0
+        cap = max(1, int(self.comm_buffer_size)) * (1 << 20)
+
+        def flush():
+            nonlocal bucket, bucket_bytes
+            if not bucket:
+                return
+            moved = jax.device_put([p.grad._data for p in bucket],
+                                   [replicated] * len(bucket))
+            for p, g in zip(bucket, moved):
+                p.grad = Tensor(g, stop_gradient=True)
+            bucket, bucket_bytes = [], 0
+
+        for p in self._layers.parameters():
+            g = getattr(p, "grad", None)
+            if g is None or not isinstance(g, Tensor):
+                continue
+            sh = getattr(g._data, "sharding", None)
+            if sh is None or sh.is_fully_replicated:
+                continue
+            bucket.append(p)
+            bucket_bytes += g._data.nbytes
+            if bucket_bytes >= cap:
+                flush()
+        flush()
 
     # delegate everything stateful to the wrapped layer
     def state_dict(self, *a, **k):

@@ -1,7 +1,7 @@
 """paddle.framework equivalents: save/load (filled out in utils/checkpoint)."""
-def save(obj, path, protocol=4):
+def save(obj, path, protocol=4, **kwargs):
     from .utils.checkpoint import save as _save
-    return _save(obj, path, protocol)
+    return _save(obj, path, protocol, **kwargs)
 
 def load(path, **kwargs):
     from .utils.checkpoint import load as _load

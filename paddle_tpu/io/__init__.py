@@ -5,3 +5,5 @@ from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
                       WeightedRandomSampler, BatchSampler,
                       DistributedBatchSampler)
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .fs import (LocalFS, HDFSClient, get_fs, ExecuteError,  # noqa: F401
+                 FSFileExistsError, FSFileNotExistsError, FSTimeOut)

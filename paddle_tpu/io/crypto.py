@@ -1,0 +1,149 @@
+"""Model-artifact encryption (AES-CTR, native).
+
+Reference: framework/io/crypto/cipher.h (Cipher/CipherFactory),
+aes_cipher.cc (cryptopp AES), pybind/crypto.cc (python surface).  Here the
+block cipher is a self-contained C++ AES (native/src/crypto.cc) driven over
+ctypes; CTR mode makes encrypt/decrypt one code path.  Wire format:
+  magic 'PDTC' | 1-byte version | 16-byte IV | ciphertext
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..native import load_module, NativeBuildError
+
+__all__ = ["AESCipher", "CipherFactory", "CipherUtils"]
+
+_MAGIC = b"PDTC"
+_VERSION = 1
+
+
+def _lib():
+    lib = load_module("crypto")
+    if lib.pdtpu_aes_ctr_crypt.argtypes is None:
+        lib.pdtpu_aes_ctr_crypt.restype = ctypes.c_int
+        lib.pdtpu_aes_ctr_crypt.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_longlong]
+        lib.pdtpu_aes_encrypt_block.restype = ctypes.c_int
+        lib.pdtpu_aes_encrypt_block.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint8)]
+    return lib
+
+
+def _ctr_crypt(key: bytes, iv: bytes, data: bytes) -> bytes:
+    buf = np.frombuffer(data, np.uint8).copy()
+    if buf.size:
+        rc = _lib().pdtpu_aes_ctr_crypt(
+            key, len(key), iv,
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            buf.size)
+        if rc != 0:
+            raise ValueError(f"bad AES key length {len(key)} "
+                             "(expect 16/24/32 bytes)")
+    return buf.tobytes()
+
+
+def encrypt_block(key: bytes, block16: bytes) -> bytes:
+    """Single-block AES encrypt — used by known-answer tests."""
+    out = np.zeros(16, np.uint8)
+    rc = _lib().pdtpu_aes_encrypt_block(
+        key, len(key), block16,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)))
+    if rc != 0:
+        raise ValueError("bad AES key length")
+    return out.tobytes()
+
+
+class AESCipher:
+    """AES-CTR cipher with the reference Cipher interface (cipher.h:24)."""
+
+    def __init__(self, key_size: int = 16):
+        if key_size not in (16, 24, 32):
+            raise ValueError("key_size must be 16/24/32 bytes")
+        self._key_size = key_size
+
+    def _check_key(self, key: bytes):
+        if len(key) not in (16, 24, 32):
+            raise ValueError(f"AES key must be 16/24/32 bytes, got {len(key)}")
+
+    def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
+        self._check_key(key)
+        iv = os.urandom(16)
+        return (_MAGIC + bytes([_VERSION]) + iv
+                + _ctr_crypt(key, iv, plaintext))
+
+    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+        self._check_key(key)
+        head = len(_MAGIC) + 1 + 16
+        if (len(ciphertext) < head
+                or ciphertext[:len(_MAGIC)] != _MAGIC
+                or ciphertext[len(_MAGIC)] != _VERSION):
+            raise ValueError("not a paddle_tpu encrypted artifact")
+        iv = ciphertext[len(_MAGIC) + 1:head]
+        return _ctr_crypt(key, iv, ciphertext[head:])
+
+    def encrypt_to_file(self, plaintext: bytes, key: bytes, filename: str):
+        d = os.path.dirname(filename)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(filename, "wb") as f:
+            f.write(self.encrypt(plaintext, key))
+
+    def decrypt_from_file(self, key: bytes, filename: str) -> bytes:
+        with open(filename, "rb") as f:
+            return self.decrypt(f.read(), key)
+
+
+class CipherFactory:
+    """CipherFactory.create_cipher (cipher.h:45).  The reference picks the
+    implementation from a config file; only AES-CTR exists here."""
+
+    @staticmethod
+    def create_cipher(config_file: Optional[str] = None) -> AESCipher:
+        key_size = 16
+        if config_file and os.path.exists(config_file):
+            with open(config_file) as f:
+                for line in f:
+                    k, _, v = line.partition(":")
+                    if k.strip() == "cipher_key_size":
+                        key_size = int(v.strip()) // 8
+        return AESCipher(key_size=key_size)
+
+
+class CipherUtils:
+    """Key helpers (cipher_utils.h: GenKey/GenKeyToFile/ReadKeyFromFile)."""
+
+    @staticmethod
+    def gen_key(length_bits: int = 128) -> bytes:
+        if length_bits not in (128, 192, 256):
+            raise ValueError("key length must be 128/192/256 bits")
+        return os.urandom(length_bits // 8)
+
+    @staticmethod
+    def gen_key_to_file(length_bits: int, filename: str) -> bytes:
+        key = CipherUtils.gen_key(length_bits)
+        d = os.path.dirname(filename)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(filename, "wb") as f:
+            f.write(key)
+        return key
+
+    @staticmethod
+    def read_key_from_file(filename: str) -> bytes:
+        with open(filename, "rb") as f:
+            return f.read()
+
+
+def available() -> bool:
+    try:
+        _lib()
+        return True
+    except NativeBuildError:
+        return False

@@ -5,12 +5,17 @@ processes + shared-memory tensor transfer + _DataLoaderIter reorder logic)
 feeding operators/reader/buffered_reader.cc (device double-buffering).
 
 TPU-native design:
-- num_workers > 0 forks worker PROCESSES (multiprocessing, fork context);
-  each worker materializes+collates its index batch and ships the arrays
-  through POSIX shared memory (multiprocessing.shared_memory), the analogue
-  of the reference's mmap'd _shared_memory tensors.  Results are re-ordered
-  by sequence number and the number of in-flight batches is bounded by
-  num_workers * prefetch_factor — never the whole epoch.
+- num_workers > 0 starts worker PROCESSES; each worker materializes+collates
+  its index batch and ships the arrays through POSIX shared memory
+  (multiprocessing.shared_memory), the analogue of the reference's mmap'd
+  _shared_memory tensors.  Results are re-ordered by sequence number and the
+  number of in-flight batches is bounded by num_workers * prefetch_factor —
+  never the whole epoch.
+- start method: "fork" matches the reference and is cheapest, but forking a
+  process that already carries live XLA/jax runtime threads can deadlock
+  the child on an inherited lock.  So when the parent is multi-threaded the
+  pool defaults to "forkserver" (workers import only numpy + the user's
+  dataset module — see io/_worker.py); `multiprocessing_context=` overrides.
 - the consumer side stages batches onto the device asynchronously
   (jax.device_put pipeline) — the buffered_reader equivalent.
 - persistent_workers keeps the pool alive across epochs; worker_init_fn
@@ -20,8 +25,10 @@ from __future__ import annotations
 
 import itertools
 import multiprocessing as mp
+import pickle
 import queue
 import threading
+import warnings
 from typing import Optional
 
 import jax
@@ -29,123 +36,66 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from .dataset import BatchSampler, IterableDataset
-
-_SHM_MIN_BYTES = 1 << 14  # small arrays go through the pickle queue
-
-
-def default_collate_fn(batch):
-    """Stack samples into batched numpy arrays (reference: reader.py default_collate)."""
-    sample = batch[0]
-    if isinstance(sample, (list, tuple)):
-        return tuple(default_collate_fn([b[i] for b in batch])
-                     for i in range(len(sample)))
-    if isinstance(sample, dict):
-        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
-    if isinstance(sample, Tensor):
-        return np.stack([np.asarray(b._data) for b in batch])
-    if isinstance(sample, np.ndarray):
-        return np.stack(batch)
-    if isinstance(sample, (int, float, np.integer, np.floating)):
-        return np.asarray(batch)
-    return batch
+from ._worker import (default_collate_fn, fetch as _fetch,  # noqa: F401
+                      decode as _decode, worker_loop as _worker_loop)
 
 
-def _fetch(dataset, indices, collate_fn):
-    return collate_fn([dataset[i] for i in indices])
-
-
-# -- shared-memory encode/decode ---------------------------------------------
-
-class _ShmRef:
-    __slots__ = ("name", "shape", "dtype")
-
-    def __init__(self, name, shape, dtype):
-        self.name = name
-        self.shape = shape
-        self.dtype = dtype
-
-
-def _encode(obj, use_shm):
-    from multiprocessing import shared_memory
-    if isinstance(obj, tuple):
-        return tuple(_encode(o, use_shm) for o in obj)
-    if isinstance(obj, list):
-        return [_encode(o, use_shm) for o in obj]
-    if isinstance(obj, dict):
-        return {k: _encode(v, use_shm) for k, v in obj.items()}
-    if (use_shm and isinstance(obj, np.ndarray)
-            and obj.nbytes >= _SHM_MIN_BYTES):
-        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
-        view = np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)
-        view[...] = obj
-        ref = _ShmRef(shm.name, obj.shape, str(obj.dtype))
-        shm.close()
-        # ownership transfers to the consumer (which unlinks after copying);
-        # drop this process's resource-tracker claim so its exit cleanup
-        # doesn't race a block the parent already removed
-        try:
-            from multiprocessing import resource_tracker
-            resource_tracker.unregister(shm._name, "shared_memory")
-        except Exception:
-            pass
-        return ref
-    return obj
-
-
-def _decode(obj):
-    from multiprocessing import shared_memory
-    if isinstance(obj, tuple):
-        return tuple(_decode(o) for o in obj)
-    if isinstance(obj, list):
-        return [_decode(o) for o in obj]
-    if isinstance(obj, dict):
-        return {k: _decode(v) for k, v in obj.items()}
-    if isinstance(obj, _ShmRef):
-        shm = shared_memory.SharedMemory(name=obj.name)
-        try:
-            view = np.ndarray(obj.shape, np.dtype(obj.dtype), buffer=shm.buf)
-            out = np.array(view)  # own the data before releasing the block
-        finally:
-            shm.close()
-            shm.unlink()
-        return out
-    return obj
-
-
-def _worker_loop(dataset, collate_fn, task_q, result_q, worker_id,
-                 use_shm, worker_init_fn):
-    if worker_init_fn is not None:
-        worker_init_fn(worker_id)
-    while True:
-        item = task_q.get()
-        if item is None:
-            break
-        epoch, seq, indices = item
-        try:
-            batch = _encode(_fetch(dataset, indices, collate_fn), use_shm)
-            result_q.put((epoch, seq, batch, None))
-        except Exception as e:  # surface worker errors to the parent
-            result_q.put((epoch, seq, None, f"{type(e).__name__}: {e}"))
+def _default_mp_context() -> str:
+    """"fork" when single-threaded (cheap, reference behavior); "forkserver"
+    once runtime threads exist — forking a jax/XLA-threaded parent can
+    deadlock the child on an inherited lock."""
+    if threading.active_count() > 1:
+        return "forkserver"
+    return "fork"
 
 
 class _WorkerPool:
-    """Forked worker processes with bounded in-flight tasks + reordering."""
+    """Worker processes with bounded in-flight tasks + reordering."""
 
     def __init__(self, dataset, collate_fn, num_workers, use_shm,
-                 worker_init_fn, timeout):
-        ctx = mp.get_context("fork")
-        self._task_q = ctx.Queue()
-        self._result_q = ctx.Queue()
+                 worker_init_fn, timeout, mp_context=None):
+        if mp_context is None or isinstance(mp_context, str):
+            method = mp_context or _default_mp_context()
+        else:
+            method = mp_context.get_start_method()
         self._timeout = timeout if timeout and timeout > 0 else None
         self._epoch = 0
+        try:
+            self._start(mp.get_context(method), dataset, collate_fn,
+                        num_workers, use_shm, worker_init_fn)
+        except (AttributeError, TypeError, pickle.PicklingError) as e:
+            if method == "fork" or mp_context is not None:
+                raise
+            # forkserver/spawn needs picklable dataset/collate/init_fn;
+            # locally-defined ones force the fork path (reference behavior,
+            # at the cost of fork-with-threads deadlock risk)
+            warnings.warn(
+                f"DataLoader falling back to fork workers: {e} "
+                "(make dataset/collate_fn/worker_init_fn module-level "
+                "picklables to use the thread-safe forkserver start method)",
+                RuntimeWarning)
+            self._start(mp.get_context("fork"), dataset, collate_fn,
+                        num_workers, use_shm, worker_init_fn)
+
+    def _start(self, ctx, dataset, collate_fn, num_workers, use_shm,
+               worker_init_fn):
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
         self._procs = [
             ctx.Process(target=_worker_loop,
                         args=(dataset, collate_fn, self._task_q,
                               self._result_q, wid, use_shm, worker_init_fn),
                         daemon=True)
             for wid in range(num_workers)]
-        for p in self._procs:
-            p.start()
+        try:
+            for p in self._procs:
+                p.start()
+        except Exception:
+            for p in self._procs:
+                if p.is_alive():
+                    p.terminate()
+            self._procs = []
+            raise
 
     def _get_result(self):
         """Blocking result fetch that detects dead workers and honors the
@@ -259,7 +209,7 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, multiprocessing_context=None):
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn
@@ -270,6 +220,7 @@ class DataLoader:
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.persistent_workers = persistent_workers
+        self.multiprocessing_context = multiprocessing_context
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -300,7 +251,8 @@ class DataLoader:
     def _new_pool(self):
         return _WorkerPool(self.dataset, self.collate_fn, self.num_workers,
                            self.use_shared_memory, self.worker_init_fn,
-                           self.timeout)
+                           self.timeout,
+                           mp_context=self.multiprocessing_context)
 
     def _acquire_pool(self):
         """Returns (pool, owned): owned pools are shut down by the caller.

@@ -27,6 +27,35 @@ class NativeBuildError(RuntimeError):
     pass
 
 
+_modules = {}
+
+
+def load_module(name: str) -> ctypes.CDLL:
+    """Build (g++, cached by mtime) and dlopen src/<name>.cc as
+    libpdtpu_<name>.so.  Generic loader for the native runtime pieces
+    (datafeed keeps its original bespoke path below)."""
+    with _lock:
+        if name in _modules:
+            return _modules[name]
+        src = os.path.join(_DIR, "src", f"{name}.cc")
+        lib_path = os.path.join(_DIR, f"libpdtpu_{name}.so")
+        if (not os.path.exists(lib_path)
+                or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+            cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                   "-pthread", src, "-o", lib_path]
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=240)
+            except (OSError, subprocess.TimeoutExpired) as e:
+                raise NativeBuildError(f"g++ failed: {e}") from e
+            if proc.returncode != 0:
+                raise NativeBuildError(
+                    f"native {name} build failed:\n{proc.stderr[-2000:]}")
+        lib = ctypes.CDLL(lib_path)
+        _modules[name] = lib
+        return lib
+
+
 def _build() -> str:
     cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
            _SRC, "-o", _LIB]

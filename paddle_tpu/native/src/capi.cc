@@ -1,0 +1,201 @@
+// C inference API — reference: paddle/fluid/inference/capi/ (pd_config.cc,
+// pd_predictor.cc wrap AnalysisPredictor behind a C ABI for non-C++
+// deployments) and paddle/fluid/train/demo (standalone binary embedding the
+// runtime).
+//
+// TPU-native: the runtime is the Python/JAX world, so the C ABI embeds
+// CPython and drives paddle_tpu.inference.{Config,create_predictor} over a
+// jit.save artifact.  Data crosses as raw float32 buffers wrapped in
+// memoryviews (np.frombuffer) — no numpy C headers needed.
+//
+// Build:  g++ -O2 -std=c++17 -shared -fPIC capi.cc -o libpdtpu_capi.so \
+//             $(python3-config --includes) $(python3-config --ldflags --embed)
+// A C consumer links the same way (see tests/capi_demo.c).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+std::string g_last_error;
+
+void capture_py_error(const char* where) {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = std::string(where) + ": ";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      g_last_error += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+bool g_we_initialized = false;
+
+struct Predictor {
+  PyObject* pred;  // paddle_tpu.inference.Predictor
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+// Initialize the embedded interpreter (no-op when already inside Python).
+int PD_Init() {
+  if (Py_IsInitialized()) return 0;
+  Py_InitializeEx(0);
+  if (!Py_IsInitialized()) {
+    g_last_error = "Py_InitializeEx failed";
+    return 1;
+  }
+  g_we_initialized = true;
+  PyEval_SaveThread();  // release the GIL for PyGILState_Ensure below
+  return 0;
+}
+
+void PD_Finalize() {
+  if (g_we_initialized && Py_IsInitialized()) {
+    PyGILState_Ensure();
+    Py_Finalize();
+    g_we_initialized = false;
+  }
+}
+
+void* PD_CreatePredictor(const char* model_prefix) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  void* result = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.inference");
+  if (mod == nullptr) {
+    capture_py_error("import paddle_tpu.inference");
+  } else {
+    PyObject* pred = PyObject_CallMethod(
+        mod, "create_predictor", "(N)",
+        PyObject_CallMethod(mod, "Config", "(s)", model_prefix));
+    if (pred == nullptr) {
+      capture_py_error("create_predictor");
+    } else {
+      Predictor* h = new Predictor{pred};
+      result = h;
+    }
+    Py_DECREF(mod);
+  }
+  PyGILState_Release(gil);
+  return result;
+}
+
+// Run with one float32 input -> first float32 output.
+// out_shape must hold >= 8 dims; returns 0 on success.
+int PD_PredictorRun(void* handle, const float* input, const int64_t* shape,
+                    int ndim, float* output, int64_t out_capacity,
+                    int64_t* out_shape, int* out_ndim) {
+  if (handle == nullptr) {
+    g_last_error = "null predictor";
+    return 1;
+  }
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 1;
+  PyObject *np = nullptr, *arr = nullptr, *names = nullptr, *in_h = nullptr,
+           *run = nullptr, *onames = nullptr, *out_h = nullptr,
+           *out_arr = nullptr, *flat = nullptr;
+  do {
+    int64_t n = 1;
+    for (int i = 0; i < ndim; ++i) n *= shape[i];
+    np = PyImport_ImportModule("numpy");
+    if (np == nullptr) { capture_py_error("import numpy"); break; }
+    PyObject* mv = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<float*>(input)),
+        n * sizeof(float), PyBUF_READ);
+    arr = PyObject_CallMethod(np, "frombuffer", "(Ns)", mv, "float32");
+    if (arr == nullptr) { capture_py_error("np.frombuffer"); break; }
+    PyObject* shp = PyTuple_New(ndim);
+    for (int i = 0; i < ndim; ++i)
+      PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+    PyObject* reshaped = PyObject_CallMethod(arr, "reshape", "(N)", shp);
+    if (reshaped == nullptr) { capture_py_error("reshape"); break; }
+    Py_DECREF(arr);
+    arr = reshaped;
+
+    names = PyObject_CallMethod(h->pred, "get_input_names", nullptr);
+    if (names == nullptr) { capture_py_error("get_input_names"); break; }
+    PyObject* name0 = PyList_GetItem(names, 0);  // borrowed
+    in_h = PyObject_CallMethod(h->pred, "get_input_handle", "(O)", name0);
+    if (in_h == nullptr) { capture_py_error("get_input_handle"); break; }
+    PyObject* ok = PyObject_CallMethod(in_h, "copy_from_cpu", "(O)", arr);
+    if (ok == nullptr) { capture_py_error("copy_from_cpu"); break; }
+    Py_DECREF(ok);
+
+    run = PyObject_CallMethod(h->pred, "run", nullptr);
+    if (run == nullptr) { capture_py_error("run"); break; }
+
+    onames = PyObject_CallMethod(h->pred, "get_output_names", nullptr);
+    if (onames == nullptr || PyList_Size(onames) == 0) {
+      capture_py_error("get_output_names");
+      break;
+    }
+    out_h = PyObject_CallMethod(h->pred, "get_output_handle", "(O)",
+                                PyList_GetItem(onames, 0));
+    if (out_h == nullptr) { capture_py_error("get_output_handle"); break; }
+    out_arr = PyObject_CallMethod(out_h, "copy_to_cpu", nullptr);
+    if (out_arr == nullptr) { capture_py_error("copy_to_cpu"); break; }
+
+    // shape out
+    PyObject* oshape = PyObject_GetAttrString(out_arr, "shape");
+    if (oshape == nullptr) { capture_py_error("out.shape"); break; }
+    int on = static_cast<int>(PyTuple_Size(oshape));
+    if (on > 8) on = 8;
+    *out_ndim = on;
+    int64_t total = 1;
+    for (int i = 0; i < on; ++i) {
+      out_shape[i] = PyLong_AsLongLong(PyTuple_GetItem(oshape, i));
+      total *= out_shape[i];
+    }
+    Py_DECREF(oshape);
+    if (total > out_capacity) {
+      g_last_error = "output buffer too small";
+      break;
+    }
+    // copy data: np.ascontiguousarray(out, 'float32').tobytes()
+    flat = PyObject_CallMethod(np, "ascontiguousarray", "(Os)", out_arr,
+                               "float32");
+    if (flat == nullptr) { capture_py_error("ascontiguousarray"); break; }
+    PyObject* bytes = PyObject_CallMethod(flat, "tobytes", nullptr);
+    if (bytes == nullptr) { capture_py_error("tobytes"); break; }
+    std::memcpy(output, PyBytes_AsString(bytes), total * sizeof(float));
+    Py_DECREF(bytes);
+    rc = 0;
+  } while (false);
+  Py_XDECREF(np);
+  Py_XDECREF(arr);
+  Py_XDECREF(names);
+  Py_XDECREF(in_h);
+  Py_XDECREF(run);
+  Py_XDECREF(onames);
+  Py_XDECREF(out_h);
+  Py_XDECREF(out_arr);
+  Py_XDECREF(flat);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void PD_DeletePredictor(void* handle) {
+  if (handle == nullptr) return;
+  Predictor* h = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->pred);
+  PyGILState_Release(gil);
+  delete h;
+}
+
+}  // extern "C"

@@ -1,0 +1,138 @@
+// AES-CTR stream cipher for encrypted model artifacts.
+//
+// reference: paddle/fluid/framework/io/crypto/aes_cipher.cc (cryptopp-backed
+// AES for save_inference_model encryption).  This is a self-contained
+// FIPS-197 AES (128/192/256) with CTR mode — no third-party crypto library
+// in the image, and CTR keeps encrypt == decrypt (one entry point).
+//
+// exported C ABI (ctypes):
+//   int pdtpu_aes_ctr_crypt(const uint8_t* key, int key_len,
+//                           const uint8_t iv[16],
+//                           uint8_t* buf, long long len);
+// returns 0 on success, nonzero on bad key length.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+const uint8_t SBOX[256] = {
+    0x63, 0x7c, 0x77, 0x7b, 0xf2, 0x6b, 0x6f, 0xc5, 0x30, 0x01, 0x67, 0x2b,
+    0xfe, 0xd7, 0xab, 0x76, 0xca, 0x82, 0xc9, 0x7d, 0xfa, 0x59, 0x47, 0xf0,
+    0xad, 0xd4, 0xa2, 0xaf, 0x9c, 0xa4, 0x72, 0xc0, 0xb7, 0xfd, 0x93, 0x26,
+    0x36, 0x3f, 0xf7, 0xcc, 0x34, 0xa5, 0xe5, 0xf1, 0x71, 0xd8, 0x31, 0x15,
+    0x04, 0xc7, 0x23, 0xc3, 0x18, 0x96, 0x05, 0x9a, 0x07, 0x12, 0x80, 0xe2,
+    0xeb, 0x27, 0xb2, 0x75, 0x09, 0x83, 0x2c, 0x1a, 0x1b, 0x6e, 0x5a, 0xa0,
+    0x52, 0x3b, 0xd6, 0xb3, 0x29, 0xe3, 0x2f, 0x84, 0x53, 0xd1, 0x00, 0xed,
+    0x20, 0xfc, 0xb1, 0x5b, 0x6a, 0xcb, 0xbe, 0x39, 0x4a, 0x4c, 0x58, 0xcf,
+    0xd0, 0xef, 0xaa, 0xfb, 0x43, 0x4d, 0x33, 0x85, 0x45, 0xf9, 0x02, 0x7f,
+    0x50, 0x3c, 0x9f, 0xa8, 0x51, 0xa3, 0x40, 0x8f, 0x92, 0x9d, 0x38, 0xf5,
+    0xbc, 0xb6, 0xda, 0x21, 0x10, 0xff, 0xf3, 0xd2, 0xcd, 0x0c, 0x13, 0xec,
+    0x5f, 0x97, 0x44, 0x17, 0xc4, 0xa7, 0x7e, 0x3d, 0x64, 0x5d, 0x19, 0x73,
+    0x60, 0x81, 0x4f, 0xdc, 0x22, 0x2a, 0x90, 0x88, 0x46, 0xee, 0xb8, 0x14,
+    0xde, 0x5e, 0x0b, 0xdb, 0xe0, 0x32, 0x3a, 0x0a, 0x49, 0x06, 0x24, 0x5c,
+    0xc2, 0xd3, 0xac, 0x62, 0x91, 0x95, 0xe4, 0x79, 0xe7, 0xc8, 0x37, 0x6d,
+    0x8d, 0xd5, 0x4e, 0xa9, 0x6c, 0x56, 0xf4, 0xea, 0x65, 0x7a, 0xae, 0x08,
+    0xba, 0x78, 0x25, 0x2e, 0x1c, 0xa6, 0xb4, 0xc6, 0xe8, 0xdd, 0x74, 0x1f,
+    0x4b, 0xbd, 0x8b, 0x8a, 0x70, 0x3e, 0xb5, 0x66, 0x48, 0x03, 0xf6, 0x0e,
+    0x61, 0x35, 0x57, 0xb9, 0x86, 0xc1, 0x1d, 0x9e, 0xe1, 0xf8, 0x98, 0x11,
+    0x69, 0xd9, 0x8e, 0x94, 0x9b, 0x1e, 0x87, 0xe9, 0xce, 0x55, 0x28, 0xdf,
+    0x8c, 0xa1, 0x89, 0x0d, 0xbf, 0xe6, 0x42, 0x68, 0x41, 0x99, 0x2d, 0x0f,
+    0xb0, 0x54, 0xbb, 0x16};
+
+inline uint8_t xtime(uint8_t x) {
+  return static_cast<uint8_t>((x << 1) ^ ((x >> 7) * 0x1b));
+}
+
+struct AES {
+  int nr;                 // rounds: 10/12/14
+  uint8_t rk[15][4][4];   // round keys as state matrices (column-major)
+
+  // key schedule (FIPS-197 §5.2)
+  bool init(const uint8_t* key, int key_len) {
+    int nk = key_len / 4;  // words
+    if (key_len != 16 && key_len != 24 && key_len != 32) return false;
+    nr = nk + 6;
+    uint8_t w[60][4];
+    for (int i = 0; i < nk; ++i)
+      for (int j = 0; j < 4; ++j) w[i][j] = key[4 * i + j];
+    uint8_t rcon = 1;
+    for (int i = nk; i < 4 * (nr + 1); ++i) {
+      uint8_t t[4];
+      std::memcpy(t, w[i - 1], 4);
+      if (i % nk == 0) {
+        uint8_t tmp = t[0];  // RotWord + SubWord + Rcon
+        t[0] = static_cast<uint8_t>(SBOX[t[1]] ^ rcon);
+        t[1] = SBOX[t[2]];
+        t[2] = SBOX[t[3]];
+        t[3] = SBOX[tmp];
+        rcon = xtime(rcon);
+      } else if (nk > 6 && i % nk == 4) {
+        for (int j = 0; j < 4; ++j) t[j] = SBOX[t[j]];
+      }
+      for (int j = 0; j < 4; ++j) w[i][j] = w[i - nk][j] ^ t[j];
+    }
+    for (int r = 0; r <= nr; ++r)
+      for (int c = 0; c < 4; ++c)
+        for (int j = 0; j < 4; ++j) rk[r][j][c] = w[4 * r + c][j];
+    return true;
+  }
+
+  void encrypt_block(const uint8_t in[16], uint8_t out[16]) const {
+    uint8_t s[4][4];
+    for (int c = 0; c < 4; ++c)
+      for (int r = 0; r < 4; ++r) s[r][c] = in[4 * c + r] ^ rk[0][r][c];
+    for (int round = 1; round < nr; ++round) {
+      uint8_t t[4][4];
+      // SubBytes + ShiftRows
+      for (int r = 0; r < 4; ++r)
+        for (int c = 0; c < 4; ++c) t[r][c] = SBOX[s[r][(c + r) & 3]];
+      // MixColumns + AddRoundKey
+      for (int c = 0; c < 4; ++c) {
+        uint8_t a0 = t[0][c], a1 = t[1][c], a2 = t[2][c], a3 = t[3][c];
+        uint8_t x = static_cast<uint8_t>(a0 ^ a1 ^ a2 ^ a3);
+        s[0][c] = static_cast<uint8_t>(a0 ^ x ^ xtime(a0 ^ a1) ^ rk[round][0][c]);
+        s[1][c] = static_cast<uint8_t>(a1 ^ x ^ xtime(a1 ^ a2) ^ rk[round][1][c]);
+        s[2][c] = static_cast<uint8_t>(a2 ^ x ^ xtime(a2 ^ a3) ^ rk[round][2][c]);
+        s[3][c] = static_cast<uint8_t>(a3 ^ x ^ xtime(a3 ^ a0) ^ rk[round][3][c]);
+      }
+    }
+    // final round: no MixColumns
+    for (int r = 0; r < 4; ++r)
+      for (int c = 0; c < 4; ++c)
+        out[4 * c + r] =
+            static_cast<uint8_t>(SBOX[s[r][(c + r) & 3]] ^ rk[nr][r][c]);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+int pdtpu_aes_ctr_crypt(const uint8_t* key, int key_len, const uint8_t* iv,
+                        uint8_t* buf, long long len) {
+  AES aes;
+  if (!aes.init(key, key_len)) return 1;
+  uint8_t ctr[16], ks[16];
+  std::memcpy(ctr, iv, 16);
+  for (long long off = 0; off < len; off += 16) {
+    aes.encrypt_block(ctr, ks);
+    long long n = len - off < 16 ? len - off : 16;
+    for (long long i = 0; i < n; ++i) buf[off + i] ^= ks[i];
+    for (int i = 15; i >= 0; --i)  // big-endian counter increment
+      if (++ctr[i] != 0) break;
+  }
+  return 0;
+}
+
+// single-block ECB encrypt, exposed for known-answer tests against the
+// FIPS-197 vectors from Python
+int pdtpu_aes_encrypt_block(const uint8_t* key, int key_len,
+                            const uint8_t* in, uint8_t* out) {
+  AES aes;
+  if (!aes.init(key, key_len)) return 1;
+  aes.encrypt_block(in, out);
+  return 0;
+}
+
+}  // extern "C"

@@ -38,3 +38,4 @@ from .layer.rnn import (RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN,  # n
 from .layer.transformer import (MultiHeadAttention, TransformerEncoderLayer,  # noqa: F401
                                 TransformerEncoder, TransformerDecoderLayer,
                                 TransformerDecoder, Transformer)
+from .layer.moe import MoELayer  # noqa: F401

@@ -13,3 +13,4 @@ from .loss import *  # noqa: F401,F403
 from .norm import (batch_norm, layer_norm, instance_norm, group_norm,  # noqa: F401
                    local_response_norm, normalize, rms_norm)
 from .pooling import *  # noqa: F401,F403
+from .moe import moe_ffn  # noqa: F401

@@ -1,0 +1,87 @@
+"""Mixture-of-Experts dispatch/combine (GShard-style, capacity-based).
+
+Beyond-reference capability: the reference has no MoE (SURVEY.md §2.3
+"Expert parallel: no").  TPU-native design: dense one-hot dispatch/combine
+einsums with static shapes — under jit with the expert dim of the weights
+sharded P("ep", ...) and tokens sharded P("dp"), GSPMD lowers the dispatch
+einsum to the all-to-all the reference would have hand-written, and the
+per-expert FFN einsum runs fully expert-parallel on the MXU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import defop
+
+_ACTS = {
+    "gelu": jax.nn.gelu,
+    "relu": jax.nn.relu,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+}
+
+
+def _raw_moe_ffn(x, gate_w, w1, b1, w2, b2, top_k=2, capacity_factor=1.25,
+                 activation="gelu"):
+    """Returns (y, aux_loss).
+
+    x: (..., d_model); gate_w: (d_model, E); w1: (E, d_model, d_hidden);
+    b1: (E, d_hidden); w2: (E, d_hidden, d_model); b2: (E, d_model).
+    Top-k routing with per-expert capacity C = ceil(k*T/E * factor); tokens
+    over capacity are dropped (standard Switch/GShard semantics).  aux_loss
+    is the Switch load-balance loss E * Σ_e fraction_e · prob_mass_e.
+    """
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    xt = x.reshape(-1, d)
+    T = xt.shape[0]
+    E = gate_w.shape[-1]
+    act = _ACTS[activation]
+
+    logits = (xt @ gate_w.astype(xt.dtype)).astype(jnp.float32)
+    gates = jax.nn.softmax(logits, axis=-1)                        # (T, E)
+    cap = max(1, int(math.ceil(top_k * T / E * capacity_factor)))
+
+    # iterative top-k: argmax, mask out, repeat (k is tiny and static)
+    rem = gates
+    masks, probs = [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(rem, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=gates.dtype)              # (T, E)
+        masks.append(m)
+        probs.append(jnp.sum(gates * m, axis=-1))                  # (T,)
+        rem = rem * (1.0 - m)
+    denom = sum(probs) + 1e-9
+
+    # capacity assignment in token order; later k-choices queue behind all
+    # earlier choices of the same expert
+    combine = jnp.zeros((T, E, cap), gates.dtype)
+    offset = jnp.zeros((E,), jnp.int32)
+    for m, p in zip(masks, probs):
+        mi = m.astype(jnp.int32)
+        pos_in_e = jnp.cumsum(mi, axis=0) - mi + offset[None, :]   # (T, E)
+        within = (pos_in_e < cap).astype(gates.dtype) * m
+        pos = jnp.sum(pos_in_e * mi, axis=-1)                      # (T,)
+        slot = jax.nn.one_hot(pos, cap, dtype=gates.dtype)         # (T, cap)
+        combine = combine + ((p / denom)[:, None, None]
+                             * within[:, :, None] * slot[:, None, :])
+        offset = offset + jnp.sum(mi, axis=0)
+
+    dispatch = (combine > 0).astype(xt.dtype)                      # (T,E,cap)
+    ein = jnp.einsum("tec,td->ecd", dispatch, xt)
+    h = act(jnp.einsum("ecd,edf->ecf", ein, w1.astype(ein.dtype))
+            + b1[:, None, :].astype(ein.dtype))
+    out_e = (jnp.einsum("ecf,efd->ecd", h, w2.astype(h.dtype))
+             + b2[:, None, :].astype(h.dtype))
+    y = jnp.einsum("tec,ecd->td", combine.astype(out_e.dtype), out_e)
+
+    density = jnp.mean(masks[0], axis=0)          # fraction routed (top-1)
+    density_proxy = jnp.mean(gates, axis=0)       # mean router prob
+    aux = jnp.sum(density * density_proxy) * E
+    return y.reshape(orig_shape), aux.astype(jnp.float32)
+
+
+moe_ffn = defop("moe_ffn")(_raw_moe_ffn)

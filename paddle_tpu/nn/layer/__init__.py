@@ -1,1 +1,1 @@
-from . import container, common, activation, conv, norm, pooling, loss, rnn, transformer  # noqa: F401
+from . import container, common, activation, conv, norm, pooling, loss, rnn, transformer, moe  # noqa: F401

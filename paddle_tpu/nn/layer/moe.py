@@ -1,0 +1,56 @@
+"""MoELayer — mixture-of-experts FFN block.
+
+Beyond-reference: the reference snapshot has no MoE/expert parallelism
+(SURVEY.md §2.3).  Expert weights carry a leading expert dim so
+parallel.sharding.ep_spec can shard them P("ep", ...) when
+DistributedStrategy.expert_parallel is on; the gate stays replicated.
+
+Usage:
+    moe = nn.MoELayer(d_model=256, d_hidden=1024, num_experts=8, top_k=2)
+    y = moe(x)                       # x: (B, S, d_model)
+    loss = task_loss + 0.01 * moe.aux_loss
+"""
+from __future__ import annotations
+
+from ..layer_base import Layer
+from .. import initializer as I
+from ..functional.moe import moe_ffn
+
+
+class MoELayer(Layer):
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 activation: str = "gelu", weight_attr=None, name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        init = I.Normal(std=0.02)
+        self.gate_weight = self.create_parameter(
+            (d_model, num_experts), attr=weight_attr,
+            default_initializer=init)
+        self.experts_w1 = self.create_parameter(
+            (num_experts, d_model, d_hidden), default_initializer=init)
+        self.experts_b1 = self.create_parameter(
+            (num_experts, d_hidden), is_bias=True)
+        self.experts_w2 = self.create_parameter(
+            (num_experts, d_hidden, d_model), default_initializer=init)
+        self.experts_b2 = self.create_parameter(
+            (num_experts, d_model), is_bias=True)
+        self.aux_loss = None
+
+    def forward(self, x):
+        y, aux = moe_ffn(x, self.gate_weight, self.experts_w1,
+                         self.experts_b1, self.experts_w2, self.experts_b2,
+                         top_k=self.top_k,
+                         capacity_factor=self.capacity_factor,
+                         activation=self.activation)
+        self.aux_loss = aux
+        return y
+
+    def extra_repr(self):
+        return (f"d_model={self.d_model}, d_hidden={self.d_hidden}, "
+                f"num_experts={self.num_experts}, top_k={self.top_k}")

@@ -1,0 +1,60 @@
+"""paddle.onnx.export equivalent.
+
+Reference: python/paddle/onnx/export.py — a thin shim that delegates to the
+external `paddle2onnx` package and errors when it is absent.  Same stance
+here: the framework's own interchange format is StableHLO (`paddle.jit.save`
+→ .pdmodel, the XLA-world ONNX analogue), which this function always
+produces; emitting an actual .onnx protobuf additionally requires the
+external `onnx` package at runtime.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export `layer` for interchange.
+
+    Always writes the StableHLO artifact (`{path}.pdmodel` + weights) via
+    paddle.jit.save; converts to `{path}.onnx` when the `onnx` package is
+    importable, else raises ImportError after the StableHLO artifact is
+    written (mirroring the reference's hard paddle2onnx dependency,
+    python/paddle/onnx/export.py:1).
+    """
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec (static shapes)")
+    from .. import jit as pjit
+    base = path[:-5] if path.endswith(".onnx") else path
+    pjit.save(layer, base, input_spec=input_spec)
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "onnx.export wrote the StableHLO artifact "
+            f"({base}.pdmodel) but the `onnx` package is required to emit "
+            "a .onnx protobuf — pip install onnx (reference parity: "
+            "paddle.onnx.export requires paddle2onnx)") from e
+    # With onnx available, wrap the StableHLO bytes in a single custom-op
+    # ONNX graph so downstream tooling can carry the artifact.
+    import numpy as np
+    import onnx.helper as oh
+    meta_inputs = []
+    for i, s in enumerate(input_spec):
+        shape = tuple(getattr(s, "shape", s[0]))
+        dtype = getattr(s, "dtype", None) or s[1]
+        meta_inputs.append(oh.make_tensor_value_info(
+            f"x{i}", oh.np_dtype_to_tensor_dtype(np.dtype(dtype)),
+            list(shape)))
+    with open(base + ".pdmodel", "rb") as f:
+        payload = f.read()
+    node = oh.make_node("StableHLO", [vi.name for vi in meta_inputs],
+                        ["out"], domain="ai.paddle_tpu",
+                        module=payload)
+    graph = oh.make_graph([node], "paddle_tpu", meta_inputs, [])
+    model = oh.make_model(graph, opset_imports=[
+        oh.make_opsetid("", opset_version),
+        oh.make_opsetid("ai.paddle_tpu", 1)])
+    onnx.save(model, base + ".onnx")
+    return base + ".onnx"

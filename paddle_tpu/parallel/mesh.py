@@ -5,9 +5,9 @@ instead of bootstrapping per-ring communicators over TCP
 `jax.sharding.Mesh` names the parallelism axes and XLA inserts/schedules all
 collectives over ICI/DCN.
 
-Canonical axis names: "dp" (data), "pp" (pipeline stages), "tp" (tensor /
-intra-layer model), "sp" (sequence / context).  A mesh axis of size 1 simply
-disables that parallelism dimension.
+Canonical axis names: "dp" (data), "pp" (pipeline stages), "ep" (experts /
+MoE), "tp" (tensor / intra-layer model), "sp" (sequence / context).  A mesh
+axis of size 1 simply disables that parallelism dimension.
 """
 from __future__ import annotations
 
@@ -17,7 +17,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "pp", "tp", "sp")
+AXES = ("dp", "pp", "ep", "tp", "sp")
 
 _GLOBAL_MESH: Optional[Mesh] = None
 

@@ -32,6 +32,16 @@ _COL_B = re.compile(
 _ROW_W = re.compile(
     r"(\bout\b|proj|ffn_out|linear2|out_proj)\.weight$")
 _EMB_W = re.compile(r"(word|position|token_type|task_type)_embeddings\.weight$")
+# MoELayer expert weights: leading dim is the expert axis (nn/layer/moe.py
+# names them experts_w1/b1/w2/b2; gate stays replicated)
+_EXPERT = re.compile(r"experts?_(w1|b1|w2|b2)$|\.experts\.")
+
+
+def ep_spec(name: str, shape) -> Optional[P]:
+    """Expert-parallel PartitionSpec: shard the leading (expert) dim."""
+    if _EXPERT.search(name) and len(shape) >= 1:
+        return P(*(("ep",) + (None,) * (len(shape) - 1)))
+    return None
 
 
 def tp_spec(name: str, shape) -> Optional[P]:
@@ -76,13 +86,18 @@ def apply_fsdp(spec: Optional[P], shape, mesh: Mesh, axis: str = "dp"
 
 def param_specs(names_shapes: Dict[str, tuple], mesh: Mesh,
                 tensor_parallel: bool = False, fsdp: bool = False,
-                custom_rule: Optional[Callable] = None) -> Dict[str, P]:
+                custom_rule: Optional[Callable] = None,
+                expert_parallel: bool = False) -> Dict[str, P]:
     """Resolve a PartitionSpec per parameter name."""
     specs = {}
     for name, shape in names_shapes.items():
         spec = None
         if custom_rule is not None:
             spec = custom_rule(name, shape)
+        if spec is None and expert_parallel and mesh.shape.get("ep", 1) > 1:
+            spec = ep_spec(name, shape)
+            if spec is not None and not _divisible(shape[0], mesh, "ep"):
+                spec = None
         if spec is None and tensor_parallel and mesh.shape.get("tp", 1) > 1:
             spec = tp_spec(name, shape)
             # tp spec only valid if the sharded dim divides

@@ -17,6 +17,7 @@ class HybridConfig:
     pp_degree: int = 1
     mp_degree: int = 1       # tensor/model parallel ("tp" axis)
     sp_degree: int = 1       # sequence/context parallel ("sp" axis)
+    ep_degree: int = 1       # expert parallel ("ep" axis, MoE)
 
 
 @dataclass
@@ -62,6 +63,7 @@ class DistributedStrategy:
     hybrid_configs: HybridConfig = field(default_factory=HybridConfig)
     tensor_parallel: bool = False
     sequence_parallel: bool = False
+    expert_parallel: bool = False
     localsgd: bool = False
     localsgd_configs: Optional[dict] = None
     lars: bool = False
@@ -78,12 +80,15 @@ class DistributedStrategy:
         pp = h.pp_degree if self.pipeline else 1
         tp = h.mp_degree if self.tensor_parallel else 1
         sp = h.sp_degree if self.sequence_parallel else 1
-        fixed = pp * tp * sp
+        ep = h.ep_degree if self.expert_parallel else 1
+        fixed = pp * tp * sp * ep
         if n_devices % fixed:
             raise ValueError(
-                f"pp*tp*sp={fixed} does not divide device count {n_devices}")
+                f"pp*ep*tp*sp={fixed} does not divide device count "
+                f"{n_devices}")
         dp = h.dp_degree if h.dp_degree > 0 else n_devices // fixed
         if dp * fixed > n_devices:
             raise ValueError(
-                f"dp*pp*tp*sp={dp * fixed} exceeds device count {n_devices}")
-        return {"dp": dp, "pp": pp, "tp": tp, "sp": sp}
+                f"dp*pp*ep*tp*sp={dp * fixed} exceeds device count "
+                f"{n_devices}")
+        return {"dp": dp, "pp": pp, "ep": ep, "tp": tp, "sp": sp}

@@ -69,7 +69,8 @@ class ShardedTrainStep:
         self.param_specs = shd.param_specs(
             {k: tuple(v.shape) for k, v in sd.items()}, self.mesh,
             tensor_parallel=st.tensor_parallel, fsdp=fsdp,
-            custom_rule=st.sharding_rule)
+            custom_rule=st.sharding_rule,
+            expert_parallel=st.expert_parallel)
         self.param_shardings = shd.shardings_of(self.param_specs, self.mesh)
         # batch elements shard over dp on axis 0 (+ sp on seq axis 1 when
         # sequence parallel)

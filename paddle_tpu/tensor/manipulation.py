@@ -437,3 +437,21 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):  # noqa: 
         inside = (x >= lo) & (x < hi)
         return jnp.where(inside, x - lo, ignore_value)
     return dispatch("shard_index", raw, input)
+
+
+def reverse(x, axis, name=None):
+    """paddle.reverse (reference reverse_op.cc) — alias of flip."""
+    return flip(x, axis)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """paddle.crop (reference crop_tensor_op.cc): static slice of size
+    `shape` starting at `offsets` (defaults: full-size / zeros)."""
+    def raw(x):
+        shp = list(shape) if shape is not None else list(x.shape)
+        shp = [x.shape[i] if s in (-1, None) else int(s)
+               for i, s in enumerate(shp)]
+        off = [int(o) for o in offsets] if offsets is not None \
+            else [0] * x.ndim
+        return jax.lax.dynamic_slice(x, off, shp)
+    return dispatch("crop", raw, x)

@@ -381,3 +381,16 @@ def renorm(x, p, axis, max_norm):
         factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
         return x * factor
     return dispatch("renorm", raw, x)
+
+
+def add_n(inputs, name=None):
+    """Element-wise sum of a list of tensors (reference sum_op.cc —
+    paddle.add_n, also the grad-accumulation primitive)."""
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    def raw(*xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+    return dispatch("add_n", raw, *inputs)

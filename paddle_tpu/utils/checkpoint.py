@@ -42,19 +42,32 @@ def _to_tensor_tree(obj):
     return obj
 
 
-def save(obj, path, protocol=4):
-    """paddle.save: state_dict / nested structure -> file."""
+def save(obj, path, protocol=4, encrypt_key=None):
+    """paddle.save: state_dict / nested structure -> file.
+
+    encrypt_key: optional AES key (16/24/32 bytes) — artifact is written
+    through the native cipher (reference framework/io/crypto/)."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    payload = pickle.dumps(_to_numpy_tree(obj), protocol=protocol)
+    if encrypt_key is not None:
+        from ..io.crypto import AESCipher
+        AESCipher().encrypt_to_file(payload, encrypt_key, path)
+        return
     with open(path, "wb") as f:
-        pickle.dump(_to_numpy_tree(obj), f, protocol=protocol)
+        f.write(payload)
 
 
-def load(path, return_numpy=False, **kwargs):
+def load(path, return_numpy=False, encrypt_key=None, **kwargs):
     """paddle.load."""
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
+    if encrypt_key is not None:
+        from ..io.crypto import AESCipher
+        payload = AESCipher().decrypt_from_file(encrypt_key, path)
+        obj = pickle.loads(payload)
+    else:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
     if return_numpy:
         return obj
     return _to_tensor_tree(obj)

@@ -91,3 +91,30 @@ def test_op_version_artifact_compat(tmp_path):
     loaded = paddle.jit.load(path)
     x = np.zeros((4, 8), "float32")
     assert loaded(paddle.to_tensor(x)).shape == [4, 4]
+
+
+def test_onnx_export_gated(tmp_path):
+    """paddle.onnx.export (reference python/paddle/onnx/export.py): always
+    writes the StableHLO artifact; .onnx emission needs the external onnx
+    package and raises a clear ImportError without it."""
+    import os
+    import pytest
+    m = paddle.nn.Linear(4, 2)
+    base = str(tmp_path / "m")
+    try:
+        import onnx  # noqa: F401
+        has_onnx = True
+    except ImportError:
+        has_onnx = False
+    if has_onnx:
+        out = paddle.onnx.export(
+            m, base, input_spec=[paddle.static.InputSpec([1, 4], "float32")])
+        assert os.path.exists(out)
+    else:
+        with pytest.raises(ImportError, match="StableHLO artifact"):
+            paddle.onnx.export(
+                m, base,
+                input_spec=[paddle.static.InputSpec([1, 4], "float32")])
+    assert os.path.exists(base + ".pdmodel")
+    with pytest.raises(ValueError):
+        paddle.onnx.export(m, base)

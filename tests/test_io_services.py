@@ -1,0 +1,125 @@
+"""Filesystem clients + AES crypto (reference framework/io/fs.cc,
+framework/io/crypto/, fleet/utils/fs.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io.fs import (LocalFS, HDFSClient, get_fs, ExecuteError,
+                              FSFileExistsError, FSFileNotExistsError)
+
+
+class TestLocalFS:
+    def test_roundtrip(self, tmp_path):
+        fs = LocalFS()
+        root = str(tmp_path / "a")
+        fs.mkdirs(root)
+        assert fs.is_dir(root) and fs.is_exist(root)
+        f = os.path.join(root, "x.txt")
+        fs.touch(f)
+        assert fs.is_file(f)
+        fs.touch(f, exist_ok=True)
+        with pytest.raises(FSFileExistsError):
+            fs.touch(f, exist_ok=False)
+        fs.mkdirs(os.path.join(root, "sub"))
+        dirs, files = fs.ls_dir(root)
+        assert dirs == ["sub"] and files == ["x.txt"]
+        assert fs.list_dirs(root) == ["sub"]
+        assert not fs.need_upload_download()
+
+    def test_mv_delete(self, tmp_path):
+        fs = LocalFS()
+        src = str(tmp_path / "src.bin")
+        dst = str(tmp_path / "dst.bin")
+        with open(src, "wb") as f:
+            f.write(b"hello")
+        fs.mv(src, dst)
+        assert not fs.is_exist(src) and fs.is_file(dst)
+        with pytest.raises(FSFileNotExistsError):
+            fs.mv(str(tmp_path / "nope"), dst, test_exists=True)
+        open(src, "wb").close()
+        with pytest.raises(FSFileExistsError):
+            fs.mv(src, dst, overwrite=False, test_exists=True)
+        fs.mv(src, dst, overwrite=True)
+        fs.delete(dst)
+        assert not fs.is_exist(dst)
+        fs.delete(dst)  # idempotent
+
+    def test_get_fs_scheme(self):
+        assert isinstance(get_fs("/tmp/x"), LocalFS)
+        if os.path.exists("/usr/bin/hadoop"):
+            assert isinstance(get_fs("hdfs://x"), HDFSClient)
+        else:
+            with pytest.raises(ExecuteError):
+                get_fs("hdfs://x")
+
+    def test_hdfs_gated(self):
+        with pytest.raises(ExecuteError):
+            HDFSClient(hadoop_home="/nonexistent-hadoop")
+
+
+class TestFleetUtils:
+    def test_utilbase(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import UtilBase
+        u = UtilBase()
+        files = [f"f{i}" for i in range(10)]
+        shard = u.get_file_shard(files)
+        assert set(shard) <= set(files) and shard
+        out = u.all_reduce(np.arange(4.0), mode="sum")
+        np.testing.assert_allclose(out, np.arange(4.0))  # world of one
+        u.barrier()
+
+
+native_crypto = pytest.importorskip("paddle_tpu.io.crypto")
+if not native_crypto.available():  # pragma: no cover - g++ always in image
+    pytest.skip("native crypto unavailable", allow_module_level=True)
+
+
+class TestCrypto:
+    def test_fips197_known_answer(self):
+        # FIPS-197 appendix C.1: AES-128
+        key = bytes(range(16))
+        pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+        ct = native_crypto.encrypt_block(key, pt)
+        assert ct.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+        # appendix C.3: AES-256
+        key256 = bytes(range(32))
+        ct256 = native_crypto.encrypt_block(key256, pt)
+        assert ct256.hex() == "8ea2b7ca516745bfeafc49904b496089"
+
+    def test_roundtrip(self):
+        from paddle_tpu.io.crypto import AESCipher, CipherUtils
+        c = AESCipher()
+        key = CipherUtils.gen_key(128)
+        msg = os.urandom(1000) + b"tail"  # non-multiple of block size
+        enc = c.encrypt(msg, key)
+        assert enc != msg and len(enc) == len(msg) + 21
+        assert c.decrypt(enc, key) == msg
+        wrong = CipherUtils.gen_key(128)
+        assert c.decrypt(enc, wrong) != msg
+
+    def test_file_roundtrip(self, tmp_path):
+        from paddle_tpu.io.crypto import AESCipher, CipherUtils
+        c = AESCipher()
+        key = CipherUtils.gen_key_to_file(256, str(tmp_path / "k"))
+        assert CipherUtils.read_key_from_file(str(tmp_path / "k")) == key
+        c.encrypt_to_file(b"secret-weights", key, str(tmp_path / "m.enc"))
+        assert c.decrypt_from_file(key, str(tmp_path / "m.enc")) \
+            == b"secret-weights"
+        with pytest.raises(ValueError):
+            c.decrypt(b"garbage-not-encrypted-data", key)
+
+    def test_encrypted_save_load(self, tmp_path):
+        from paddle_tpu.io.crypto import CipherUtils
+        key = CipherUtils.gen_key(128)
+        state = {"w": paddle.to_tensor(np.arange(6.0).reshape(2, 3))}
+        p = str(tmp_path / "model.pdparams.enc")
+        paddle.save(state, p, encrypt_key=key)
+        # on-disk bytes must not be a plain pickle
+        with open(p, "rb") as f:
+            raw = f.read()
+        assert raw[:4] == b"PDTC"
+        back = paddle.load(p, encrypt_key=key)
+        np.testing.assert_allclose(np.asarray(back["w"].numpy()),
+                                   np.arange(6.0).reshape(2, 3))
