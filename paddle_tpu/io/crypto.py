@@ -3,12 +3,18 @@
 Reference: framework/io/crypto/cipher.h (Cipher/CipherFactory),
 aes_cipher.cc (cryptopp AES), pybind/crypto.cc (python surface).  Here the
 block cipher is a self-contained C++ AES (native/src/crypto.cc) driven over
-ctypes; CTR mode makes encrypt/decrypt one code path.  Wire format:
-  magic 'PDTC' | 1-byte version | 16-byte IV | ciphertext
+ctypes; CTR mode makes encrypt/decrypt one code path.  Wire format (v2):
+  magic 'PDTC' | 1-byte version | 16-byte IV | ciphertext | 32-byte HMAC
+The HMAC-SHA256 (keyed off a derived mac key) covers version|IV|ciphertext
+and is verified BEFORE decryption — CTR is malleable and the payload often
+feeds pickle, so tampering must fail closed.  v1 artifacts (no tag, parity
+with the reference's unauthenticated cipher) still load.
 """
 from __future__ import annotations
 
 import ctypes
+import hashlib
+import hmac as _hmac
 import os
 from typing import Optional
 
@@ -19,7 +25,13 @@ from ..native import load_module, NativeBuildError
 __all__ = ["AESCipher", "CipherFactory", "CipherUtils"]
 
 _MAGIC = b"PDTC"
-_VERSION = 1
+_VERSION = 2
+_TAG_LEN = 32
+
+
+def _mac_key(key: bytes) -> bytes:
+    # Domain-separate the MAC key from the cipher key.
+    return hashlib.sha256(b"pdtpu-artifact-mac:" + key).digest()
 
 
 def _lib():
@@ -75,18 +87,33 @@ class AESCipher:
     def encrypt(self, plaintext: bytes, key: bytes) -> bytes:
         self._check_key(key)
         iv = os.urandom(16)
-        return (_MAGIC + bytes([_VERSION]) + iv
-                + _ctr_crypt(key, iv, plaintext))
+        body = bytes([_VERSION]) + iv + _ctr_crypt(key, iv, plaintext)
+        tag = _hmac.new(_mac_key(key), body, hashlib.sha256).digest()
+        return _MAGIC + body + tag
 
     def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
         self._check_key(key)
         head = len(_MAGIC) + 1 + 16
         if (len(ciphertext) < head
-                or ciphertext[:len(_MAGIC)] != _MAGIC
-                or ciphertext[len(_MAGIC)] != _VERSION):
+                or ciphertext[:len(_MAGIC)] != _MAGIC):
             raise ValueError("not a paddle_tpu encrypted artifact")
-        iv = ciphertext[len(_MAGIC) + 1:head]
-        return _ctr_crypt(key, iv, ciphertext[head:])
+        version = ciphertext[len(_MAGIC)]
+        if version == 2:
+            if len(ciphertext) < head + _TAG_LEN:
+                raise ValueError("truncated encrypted artifact")
+            body, tag = ciphertext[len(_MAGIC):-_TAG_LEN], \
+                ciphertext[-_TAG_LEN:]
+            want = _hmac.new(_mac_key(key), body, hashlib.sha256).digest()
+            if not _hmac.compare_digest(tag, want):
+                raise ValueError(
+                    "encrypted artifact failed integrity check "
+                    "(wrong key or tampered file)")
+            iv = ciphertext[len(_MAGIC) + 1:head]
+            return _ctr_crypt(key, iv, ciphertext[head:-_TAG_LEN])
+        elif version == 1:  # legacy unauthenticated format
+            iv = ciphertext[len(_MAGIC) + 1:head]
+            return _ctr_crypt(key, iv, ciphertext[head:])
+        raise ValueError(f"unknown encrypted-artifact version {version}")
 
     def encrypt_to_file(self, plaintext: bytes, key: bytes, filename: str):
         d = os.path.dirname(filename)

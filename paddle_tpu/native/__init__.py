@@ -39,8 +39,7 @@ def load_module(name: str) -> ctypes.CDLL:
             return _modules[name]
         src = os.path.join(_DIR, "src", f"{name}.cc")
         lib_path = os.path.join(_DIR, f"libpdtpu_{name}.so")
-        if (not os.path.exists(lib_path)
-                or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+        def build():
             cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
                    "-pthread", src, "-o", lib_path]
             try:
@@ -51,7 +50,19 @@ def load_module(name: str) -> ctypes.CDLL:
             if proc.returncode != 0:
                 raise NativeBuildError(
                     f"native {name} build failed:\n{proc.stderr[-2000:]}")
-        lib = ctypes.CDLL(lib_path)
+
+        if (not os.path.exists(lib_path)
+                or os.path.getmtime(lib_path) < os.path.getmtime(src)):
+            build()
+        try:
+            lib = ctypes.CDLL(lib_path)
+        except OSError:
+            # e.g. an ABI-mismatched binary from another host: rebuild once.
+            build()
+            try:
+                lib = ctypes.CDLL(lib_path)
+            except OSError as e:
+                raise NativeBuildError(f"dlopen {lib_path} failed: {e}") from e
         _modules[name] = lib
         return lib
 
@@ -79,7 +90,14 @@ def load_library() -> ctypes.CDLL:
         if (not os.path.exists(_LIB)
                 or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
             _build()
-        lib = ctypes.CDLL(_LIB)
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _build()
+            try:
+                lib = ctypes.CDLL(_LIB)
+            except OSError as e:
+                raise NativeBuildError(f"dlopen {_LIB} failed: {e}") from e
         lib.pdtpu_feed_create.restype = ctypes.c_void_p
         lib.pdtpu_feed_create.argtypes = [
             ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,

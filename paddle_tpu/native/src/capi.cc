@@ -79,14 +79,18 @@ void* PD_CreatePredictor(const char* model_prefix) {
   if (mod == nullptr) {
     capture_py_error("import paddle_tpu.inference");
   } else {
-    PyObject* pred = PyObject_CallMethod(
-        mod, "create_predictor", "(N)",
-        PyObject_CallMethod(mod, "Config", "(s)", model_prefix));
-    if (pred == nullptr) {
-      capture_py_error("create_predictor");
+    PyObject* cfg = PyObject_CallMethod(mod, "Config", "(s)", model_prefix);
+    if (cfg == nullptr) {
+      capture_py_error("Config");
     } else {
-      Predictor* h = new Predictor{pred};
-      result = h;
+      PyObject* pred =
+          PyObject_CallMethod(mod, "create_predictor", "(N)", cfg);
+      if (pred == nullptr) {
+        capture_py_error("create_predictor");
+      } else {
+        Predictor* h = new Predictor{pred};
+        result = h;
+      }
     }
     Py_DECREF(mod);
   }
@@ -117,7 +121,9 @@ int PD_PredictorRun(void* handle, const float* input, const int64_t* shape,
     PyObject* mv = PyMemoryView_FromMemory(
         reinterpret_cast<char*>(const_cast<float*>(input)),
         n * sizeof(float), PyBUF_READ);
-    arr = PyObject_CallMethod(np, "frombuffer", "(Ns)", mv, "float32");
+    if (mv == nullptr) { capture_py_error("memoryview"); break; }
+    arr = PyObject_CallMethod(np, "frombuffer", "(Os)", mv, "float32");
+    Py_DECREF(mv);
     if (arr == nullptr) { capture_py_error("np.frombuffer"); break; }
     PyObject* shp = PyTuple_New(ndim);
     for (int i = 0; i < ndim; ++i)

@@ -448,10 +448,17 @@ def crop(x, shape=None, offsets=None, name=None):
     """paddle.crop (reference crop_tensor_op.cc): static slice of size
     `shape` starting at `offsets` (defaults: full-size / zeros)."""
     def raw(x):
-        shp = list(shape) if shape is not None else list(x.shape)
-        shp = [x.shape[i] if s in (-1, None) else int(s)
-               for i, s in enumerate(shp)]
         off = [int(o) for o in offsets] if offsets is not None \
             else [0] * x.ndim
+        shp = list(shape) if shape is not None else list(x.shape)
+        # -1/None means "everything from the offset to the end of the axis"
+        # (crop_tensor doc Case 2: shape=[2,2,-1], offsets=[0,0,1] -> [2,2,3]).
+        shp = [x.shape[i] - off[i] if s in (-1, None) else int(s)
+               for i, s in enumerate(shp)]
+        for i, (o, s) in enumerate(zip(off, shp)):
+            if o + s > x.shape[i]:
+                raise ValueError(
+                    f"crop: offsets[{i}]+shape[{i}] = {o + s} exceeds input "
+                    f"dim {x.shape[i]}")
         return jax.lax.dynamic_slice(x, off, shp)
     return dispatch("crop", raw, x)

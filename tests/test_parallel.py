@@ -26,7 +26,7 @@ def test_create_mesh_axes():
 def test_strategy_mesh_axes():
     st = parallel.DistributedStrategy(tensor_parallel=True)
     st.hybrid_configs.mp_degree = 4
-    assert st.mesh_axes(8) == {"dp": 2, "pp": 1, "tp": 4, "sp": 1}
+    assert st.mesh_axes(8) == {"dp": 2, "pp": 1, "ep": 1, "tp": 4, "sp": 1}
     st2 = parallel.DistributedStrategy()
     assert st2.mesh_axes(8)["dp"] == 8
 
