@@ -95,3 +95,4 @@ enable_static = lambda *a, **k: None  # noqa: E731
 
 def in_dynamic_mode():
     return True
+from . import generation  # noqa: F401,E402

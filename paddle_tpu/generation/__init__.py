@@ -1,0 +1,242 @@
+"""Text generation: greedy / top-k / top-p sampling and beam search.
+
+Reference surface: fluid's BeamSearchDecoder/dynamic_decode
+(python/paddle/fluid/layers/rnn.py:1) backed by the beam_search op
+(paddle/fluid/operators/math/beam_search.cc:1) — a host-stepped loop over
+growing LoD beam state.  TPU-native redesign: the WHOLE decode loop is one
+compiled XLA program — `lax.scan` over a fixed token budget with a
+preallocated kv cache written via dynamic_update_slice; beams live as a
+(batch*beam) leading axis and hypothesis reordering is a gather.  The
+RNN-cell-shaped `BeamSearchDecoder` API lives in paddle_tpu.nn.decode.
+
+Model protocol: `model.gen_fixed_cache(batch, max_len)` returns per-layer
+(kbuf, vbuf) raw-array buffers; `model.forward_fixed(ids, caches, pos)`
+returns (logits, new_caches) with the chunk written at [pos, pos+s).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+
+__all__ = ["generate", "apply_top_k", "apply_top_p"]
+
+_NEG = -1e9
+
+
+def apply_top_k(logits, k):
+    """Mask all but the k largest logits per row to -inf."""
+    if k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, _NEG, logits)
+
+
+def apply_top_p(logits, p):
+    """Nucleus filtering: keep the smallest prefix of the sorted
+    distribution whose cumulative probability exceeds p."""
+    if p >= 1.0:
+        return logits
+    sort = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sort, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep entries where the cumulative mass BEFORE them is < p; the top
+    # token always survives (p=0 must mean greedy, not uniform)
+    keep = (cum - probs) < p
+    keep = keep.at[..., 0].set(True)
+    cutoff = jnp.min(jnp.where(keep, sort, jnp.inf), axis=-1, keepdims=True)
+    return jnp.where(logits < cutoff, _NEG, logits)
+
+
+def _process_logits(logits, temperature, top_k, top_p, greedy):
+    if greedy:
+        return logits
+    if temperature not in (None, 1.0):
+        logits = logits / jnp.float32(temperature)
+    if top_k:
+        logits = apply_top_k(logits, int(top_k))
+    if top_p is not None and top_p < 1.0:
+        logits = apply_top_p(logits, float(top_p))
+    return logits
+
+
+def beam_step(logp, scores, finished, keep_token):
+    """One beam-search selection step over raw arrays (shared by the jitted
+    generate() loop and nn.decode.BeamSearchDecoder).
+
+    logp: (B, K, V) per-beam next-token log-probs; scores: (B, K) running
+    totals; finished: (B, K) bool.  Finished beams may only extend with
+    `keep_token` at zero added cost.  Returns (new_scores, token, parent,
+    flat_src, parent_finished) where flat_src are (B*K,) gather indices for
+    reordering any per-hypothesis state (kv caches, cell states).
+    """
+    b, k, vocab = logp.shape
+    fin_row = jnp.full((vocab,), _NEG, jnp.float32).at[keep_token].set(0.0)
+    logp = jnp.where(finished[:, :, None], fin_row[None, None], logp)
+    cand = scores[:, :, None] + logp
+    new_scores, top_ix = jax.lax.top_k(cand.reshape(b, k * vocab), k)
+    parent = top_ix // vocab
+    token = (top_ix % vocab).astype(jnp.int32)
+    parent_finished = jnp.take_along_axis(finished, parent, axis=1)
+    flat_src = (jnp.arange(b)[:, None] * k + parent).reshape(-1)
+    return new_scores, token, parent, flat_src, parent_finished
+
+
+def _model_fns(model):
+    from ..jit import functional_call, state_arrays
+
+    def apply_fixed(state, ids, caches, pos):
+        return functional_call(model, state, ids, caches, pos,
+                               training=False, method="forward_fixed")
+    return state_arrays(model), apply_fixed
+
+
+def generate(model, input_ids, max_length=None, max_new_tokens=None,
+             decode_strategy: str = "greedy_search", temperature=1.0,
+             top_k=0, top_p=1.0, num_beams=1, length_penalty=0.0,
+             eos_token_id: Optional[int] = None, pad_token_id: int = 0,
+             bos_token_id=None, seed=None):
+    """Decode continuations of `input_ids` (B, S).
+
+    Returns (ids, scores): ids (B, max_new) generated tokens (pad after
+    eos), scores (B,) the sequence log-prob of the emitted tokens (for
+    sampling, under the tempered/filtered distribution they were drawn
+    from).  decode_strategy: "greedy_search" | "sampling" | "beam_search".
+    The full loop (prefill + scan over steps) runs as compiled XLA.
+    """
+    if decode_strategy not in ("greedy_search", "sampling", "beam_search"):
+        raise ValueError(
+            f"unknown decode_strategy {decode_strategy!r}: expected "
+            "'greedy_search', 'sampling' or 'beam_search'")
+    ids = unwrap(input_ids)
+    if ids.ndim == 1:
+        ids = ids[None]
+    ids = ids.astype(jnp.int32)
+    b, prompt_len = ids.shape
+    if max_new_tokens is None:
+        if max_length is None:
+            raise ValueError("pass max_new_tokens or max_length")
+        max_new_tokens = int(max_length) - prompt_len
+    max_new_tokens = int(max_new_tokens)
+    if max_new_tokens <= 0:
+        raise ValueError("nothing to generate")
+    total = prompt_len + max_new_tokens
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+
+    state, apply_fixed = _model_fns(model)
+    strategy = decode_strategy
+    if strategy == "beam_search":
+        out, scores = _beam_search(
+            state, apply_fixed, model, ids, max_new_tokens, total,
+            int(num_beams), eos, int(pad_token_id), float(length_penalty))
+    else:
+        greedy = strategy == "greedy_search"
+        if seed is not None:
+            key = jax.random.PRNGKey(int(seed))
+        else:
+            from ..core import rng as _rng
+            key = _rng.next_key()  # advances with paddle.seed's stream
+        out, scores = _sample_loop(
+            state, apply_fixed, model, ids, max_new_tokens, total, greedy,
+            temperature, top_k, top_p, eos, int(pad_token_id), key)
+    return Tensor(out), Tensor(scores)
+
+
+def _sample_loop(state, apply_fixed, model, ids, max_new, total, greedy,
+                 temperature, top_k, top_p, eos, pad, key):
+    b = ids.shape[0]
+    caches = model.gen_fixed_cache(b, total)
+
+    def run(state, ids, caches, key):
+        logits, caches = apply_fixed(state, ids, caches, 0)  # prefill
+        last = logits[:, -1, :].astype(jnp.float32)
+        prompt_len = ids.shape[1]
+
+        def body(carry, _):
+            tok, caches, pos, key, finished, score, last = carry
+            proc = _process_logits(last, temperature, top_k, top_p, greedy)
+            key, sub = jax.random.split(key)
+            if greedy:
+                nxt = jnp.argmax(proc, axis=-1).astype(jnp.int32)
+            else:
+                nxt = jax.random.categorical(sub, proc).astype(jnp.int32)
+            logp = jax.nn.log_softmax(proc, axis=-1)
+            step_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+            score = score + jnp.where(finished, 0.0, step_lp)
+            nxt = jnp.where(finished, pad, nxt)
+            finished = finished | (nxt == eos)
+            logits, caches = apply_fixed(state, nxt[:, None], caches, pos)
+            nlast = logits[:, -1, :].astype(jnp.float32)
+            return (nxt, caches, pos + 1, key, finished, score,
+                    nlast), nxt
+
+        init = (jnp.zeros((b,), jnp.int32), caches,
+                jnp.int32(prompt_len), key,
+                jnp.zeros((b,), bool), jnp.zeros((b,), jnp.float32), last)
+        carry, toks = jax.lax.scan(body, init, None, length=max_new)
+        return toks.T, carry[5]
+
+    return jax.jit(run)(state, ids, caches, key)
+
+
+def _beam_search(state, apply_fixed, model, ids, max_new, total, k, eos,
+                 pad, length_penalty):
+    """Batched beam search: hypotheses as a (B*K) leading axis; beam
+    reordering is a gather on tokens + kv buffers (the XLA replacement for
+    the reference's beam_search op LoD bookkeeping)."""
+    b, prompt_len = ids.shape
+    caches = model.gen_fixed_cache(b * k, total)
+
+    def run(state, ids, caches):
+        v_ids = jnp.repeat(ids, k, axis=0)  # (B*K, S)
+        logits, caches = apply_fixed(state, v_ids, caches, 0)
+        last = logits[:, -1, :].astype(jnp.float32)
+        vocab = last.shape[-1]
+        # beam 0 active, others -inf so step 1 picks distinct continuations
+        scores = jnp.tile(jnp.array([0.0] + [_NEG] * (k - 1),
+                                    jnp.float32), (b, 1))
+        finished = jnp.zeros((b, k), bool)
+        tok_buf = jnp.full((b, k, max_new), pad, jnp.int32)
+
+        def body(carry, step):
+            caches, scores, finished, tok_buf, last = carry
+            logp = jax.nn.log_softmax(last, axis=-1).reshape(b, k, vocab)
+            scores, tok, src_beam, flat_src, finished = beam_step(
+                logp, scores, finished, keep_token=pad)
+            tok_buf = jnp.take_along_axis(
+                tok_buf, src_beam[:, :, None], axis=1)
+            tok = jnp.where(finished, pad, tok)
+            tok_buf = jax.lax.dynamic_update_index_in_dim(
+                tok_buf, tok, step, axis=2)
+            finished = finished | (tok == eos)
+
+            caches = jax.tree_util.tree_map(
+                lambda buf: jnp.take(buf, flat_src, axis=0), caches)
+            logits, caches = apply_fixed(
+                state, tok.reshape(-1)[:, None], caches,
+                prompt_len + step)
+            last = logits[:, -1, :].astype(jnp.float32)
+            return (caches, scores, finished, tok_buf, last), None
+
+        (caches, scores, finished, tok_buf, last), _ = jax.lax.scan(
+            body, (caches, scores, finished, tok_buf, last),
+            jnp.arange(max_new))
+
+        if length_penalty:
+            lens = jnp.sum((tok_buf != pad).astype(jnp.float32), axis=-1)
+            lens = jnp.maximum(lens, 1.0)
+            norm = jnp.power((5.0 + lens) / 6.0, length_penalty)
+            ranked = scores / norm
+        else:
+            ranked = scores
+        best = jnp.argmax(ranked, axis=1)  # (B,)
+        out = jnp.take_along_axis(
+            tok_buf, best[:, None, None], axis=1)[:, 0]
+        sc = jnp.take_along_axis(ranked, best[:, None], axis=1)[:, 0]
+        return out, sc
+
+    return jax.jit(run)(state, ids, caches)

@@ -36,11 +36,14 @@ def state_arrays(layer: Layer) -> Dict[str, Any]:
 
 
 def functional_call(layer: Layer, state: Dict[str, Any], *args,
-                    training: Optional[bool] = None, **kwargs):
+                    training: Optional[bool] = None, method: str = None,
+                    **kwargs):
     """Run layer.forward with `state` (name -> raw array) swapped in.
 
     Works under jit tracing: swapping happens at trace time only.  Tape is
     disabled so the pure-functional jax.grad path is used for autodiff.
+    `method` selects an alternative entry point (e.g. a fixed-cache decode
+    forward) instead of __call__.
     """
     sd = layer.state_dict()
     originals = {k: t._data for k, t in sd.items()}
@@ -53,8 +56,9 @@ def functional_call(layer: Layer, state: Dict[str, Any], *args,
         for k, t in sd.items():
             if k in state:
                 t._data = state[k]
+        entry = getattr(layer, method) if method else layer
         with no_grad():
-            out = layer(*_wrap_args(args), **kwargs)
+            out = entry(*_wrap_args(args), **kwargs)
         return jax.tree_util.tree_map(
             lambda x: x._data if isinstance(x, Tensor) else x, out,
             is_leaf=lambda x: isinstance(x, Tensor))

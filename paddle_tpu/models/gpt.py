@@ -104,6 +104,40 @@ class GPTBlock(Layer):
         x = x + self.dropout(h)
         return x if cache is None else (x, new_cache)
 
+    def attend_fixed(self, x, kbuf, vbuf, pos):
+        """Decode attention against a FIXED-size kv buffer (B, T, H, D),
+        writing this chunk's k/v at [pos, pos+s).  Static shapes keep the
+        whole generate loop one compiled XLA program (no per-length retrace —
+        the TPU-native replacement for the reference's growing LoD beam
+        state, fluid/layers/rnn.py dynamic_decode)."""
+        import jax
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor, unwrap
+        pos = unwrap(pos)
+        b, s = x.shape[0], x.shape[1]
+        qkv = self.qkv(x).reshape([b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        kbuf = jax.lax.dynamic_update_slice(
+            kbuf, unwrap(k).astype(kbuf.dtype), (0, pos, 0, 0))
+        vbuf = jax.lax.dynamic_update_slice(
+            vbuf, unwrap(v).astype(vbuf.dtype), (0, pos, 0, 0))
+        # query i (absolute pos+i) may attend buffer slots <= pos+i
+        t = kbuf.shape[1]
+        key_idx = jnp.arange(t)[None, :]
+        q_idx = pos + jnp.arange(s)[:, None]
+        mask = jnp.where(key_idx <= q_idx, 0.0, -1e30)[None, None]
+        ctx = F.scaled_dot_product_attention(
+            q, Tensor(kbuf.astype(unwrap(q).dtype)),
+            Tensor(vbuf.astype(unwrap(q).dtype)), attn_mask=Tensor(mask),
+            dropout_p=0.0, training=False)
+        return self.proj(ctx.reshape([b, s, self.hidden_size])), kbuf, vbuf
+
+    def forward_fixed(self, x, kbuf, vbuf, pos):
+        a, kbuf, vbuf = self.attend_fixed(self.ln1(x), kbuf, vbuf, pos)
+        x = x + a
+        h = self.ffn_out(getattr(F, self.act)(self.ffn_in(self.ln2(x))))
+        return x + h, kbuf, vbuf
+
 
 class GPTModel(Layer):
     def __init__(self, cfg: GPTConfig = None, **kw):
@@ -153,6 +187,35 @@ class GPTModel(Layer):
                  zeros([batch_size, 0, cfg.num_attention_heads, hd]))
                 for _ in range(cfg.num_hidden_layers)]
 
+    def gen_fixed_cache(self, batch_size, max_length, dtype=None):
+        """Preallocated (k, v) buffers per layer for the jitted decode loop:
+        each (B, max_length, H, D) raw jax arrays."""
+        import jax.numpy as jnp
+        cfg = self.config
+        hd = cfg.hidden_size // cfg.num_attention_heads
+        dt = dtype or jnp.float32
+        shape = (batch_size, max_length, cfg.num_attention_heads, hd)
+        return [(jnp.zeros(shape, dt), jnp.zeros(shape, dt))
+                for _ in range(cfg.num_hidden_layers)]
+
+    def forward_fixed(self, input_ids, caches, pos):
+        """Fixed-cache forward: caches is [(kbuf, vbuf)] raw arrays, pos the
+        write offset (traced scalar ok).  Returns (h, new_caches)."""
+        import jax.numpy as jnp
+        from ..core.tensor import Tensor, unwrap
+        ids = unwrap(input_ids)
+        s = ids.shape[-1]
+        position_ids = Tensor(jnp.broadcast_to(
+            unwrap(pos) + jnp.arange(s, dtype=jnp.int32), ids.shape))
+        h = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids))
+        new_caches = []
+        for i, blk in enumerate(self.blocks):
+            kbuf, vbuf = caches[i]
+            h, kbuf, vbuf = blk.forward_fixed(h, kbuf, vbuf, pos)
+            new_caches.append((kbuf, vbuf))
+        return self.ln_f(h), new_caches
+
 
 class GPTForPretraining(Layer):
     """Causal-LM pretraining head (tied embedding weights)."""
@@ -167,6 +230,22 @@ class GPTForPretraining(Layer):
         h = out[0] if isinstance(out, tuple) else out
         logits = matmul(h, self.gpt.word_embeddings.weight, transpose_y=True)
         return logits if cache is None else (logits, out[1])
+
+    # --- generation protocol (paddle_tpu.generation.generate) ---
+    def gen_fixed_cache(self, batch_size, max_length, dtype=None):
+        return self.gpt.gen_fixed_cache(batch_size, max_length, dtype)
+
+    def forward_fixed(self, input_ids, caches, pos):
+        from ..tensor.linalg import matmul
+        h, caches = self.gpt.forward_fixed(input_ids, caches, pos)
+        logits = matmul(h, self.gpt.word_embeddings.weight, transpose_y=True)
+        return logits, caches
+
+    def generate(self, input_ids, **kwargs):
+        """Greedy / sampling / beam-search decoding over the jitted
+        fixed-cache decode loop — see paddle_tpu.generation.generate."""
+        from ..generation import generate
+        return generate(self, input_ids, **kwargs)
 
 
 class GPTPretrainingCriterion(Layer):

@@ -39,3 +39,5 @@ from .layer.transformer import (MultiHeadAttention, TransformerEncoderLayer,  # 
                                 TransformerEncoder, TransformerDecoderLayer,
                                 TransformerDecoder, Transformer)
 from .layer.moe import MoELayer  # noqa: F401
+from .decode import (Decoder, BeamSearchDecoder, dynamic_decode,  # noqa: F401
+                     gather_tree)

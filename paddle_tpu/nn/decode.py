@@ -1,0 +1,202 @@
+"""RNN-cell-shaped decoding API: Decoder / BeamSearchDecoder / dynamic_decode.
+
+Reference: python/paddle/fluid/layers/rnn.py:1 (Decoder, BeamSearchDecoder,
+dynamic_decode) backed by operators/math/beam_search.cc:1 and the gather_tree
+op.  The reference steps the decoder from Python over LoD beam state; here
+beams are a dense (batch*beam) leading axis, hypothesis reordering is a
+gather, and backtracking (`gather_tree`) is a reversed lax.scan.  The loop
+itself is host-stepped like the reference (dynamic early exit when every beam
+finishes) — the fully-jitted fixed-budget path for production decoding is
+paddle_tpu.generation.generate.
+"""
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+
+_NEG = -1e9
+
+
+def gather_tree(ids, parents):
+    """Backtrack beam parents into full sequences.
+
+    ids/parents: (max_time, batch, beam) int arrays (the stacked per-step
+    predicted_ids / parent_ids of a beam search).  Returns the same shape
+    with each beam's ancestry resolved (reference: gather_tree op,
+    paddle/fluid/operators/gather_tree_op.cc).
+    """
+    idv, pav = unwrap(ids), unwrap(parents)
+    t = idv.shape[0]
+    batch_ix = jnp.arange(idv.shape[1])[:, None]
+
+    def body(carry, xs):
+        beam_ix = carry  # (batch, beam): which beam each output lane tracks
+        step_ids, step_parents = xs
+        toks = step_ids[batch_ix, beam_ix]
+        beam_ix = step_parents[batch_ix, beam_ix]
+        return beam_ix, toks
+
+    init = jnp.broadcast_to(jnp.arange(idv.shape[2]), idv.shape[1:])
+    _, toks = jax.lax.scan(body, init, (idv, pav), reverse=True)
+    return Tensor(toks)
+
+
+class Decoder:
+    """Abstract decoder interface (reference fluid/layers/rnn.py Decoder):
+    initialize() -> (initial_inputs, initial_states, initial_finished)
+    step(time, inputs, states, **kwargs) ->
+        (outputs, next_states, next_inputs, finished)
+    finalize(outputs, final_states, sequence_lengths) -> (outputs, states)
+    """
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return False
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search wrapper over a single-step `cell` (reference
+    BeamSearchDecoder, fluid/layers/rnn.py).
+
+    cell: callable (inputs, states) -> (cell_out, next_states) — an
+      RNNCellBase or any Layer with that contract.
+    output_fn: maps cell_out -> (B*K, vocab) logits (e.g. the projection
+      layer); defaults to identity.
+    embedding_fn: maps token ids -> cell inputs; defaults to identity.
+    """
+
+    OutputWrapper = collections.namedtuple(
+        "OutputWrapper", ("scores", "predicted_ids", "parent_ids"))
+    StateWrapper = collections.namedtuple(
+        "StateWrapper", ("cell_states", "log_probs", "finished", "lengths"))
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """(B, ...) -> (B*K, ...) by repeating each batch entry K times
+        (reference BeamSearchDecoder.tile_beam_merge_with_batch)."""
+        v = unwrap(x)
+        return Tensor(jnp.repeat(v, beam_size, axis=0))
+
+    def _merge(self, x):
+        v = unwrap(x)
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split(self, v):
+        return v.reshape((-1, self.beam_size) + v.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        k = self.beam_size
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.repeat(unwrap(s), k, axis=0), initial_cell_states,
+            is_leaf=lambda s: isinstance(s, Tensor))
+        some_leaf = jax.tree_util.tree_leaves(states)[0]
+        bk = some_leaf.shape[0]
+        b = bk // k
+        log_probs = jnp.tile(
+            jnp.array([0.0] + [_NEG] * (k - 1), jnp.float32), (b, 1))
+        finished = jnp.zeros((b, k), bool)
+        lengths = jnp.zeros((b, k), jnp.int32)
+        init_inputs = jnp.full((bk,), self.start_token, jnp.int32)
+        if self.embedding_fn is not None:
+            init_inputs = self.embedding_fn(Tensor(init_inputs))
+        else:
+            init_inputs = Tensor(init_inputs)
+        return init_inputs, self.StateWrapper(states, log_probs, finished,
+                                              lengths), Tensor(finished)
+
+    def step(self, time, inputs, states, **kwargs):
+        from ..generation import beam_step
+        cell_out, next_cell = self.cell(inputs, states.cell_states, **kwargs)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        logits = unwrap(cell_out).astype(jnp.float32)  # (B*K, V)
+        k = self.beam_size
+        vocab = logits.shape[-1]
+        b = logits.shape[0] // k
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(b, k, vocab)
+        # finished beams extend only with end_token at zero added cost
+        top_sc, token, parent, flat_parent, finished = beam_step(
+            logp, states.log_probs, states.finished,
+            keep_token=self.end_token)
+        lengths = jnp.take_along_axis(states.lengths, parent, axis=1)
+        lengths = jnp.where(finished, lengths, lengths + 1)
+        finished = finished | (token == self.end_token)
+        next_cell = jax.tree_util.tree_map(
+            lambda s: Tensor(jnp.take(unwrap(s), flat_parent, axis=0)),
+            next_cell, is_leaf=lambda s: isinstance(s, Tensor))
+
+        outputs = self.OutputWrapper(Tensor(top_sc), Tensor(token),
+                                     Tensor(parent))
+        next_states = self.StateWrapper(next_cell, top_sc, finished, lengths)
+        next_inputs = Tensor(token.reshape(-1))
+        if self.embedding_fn is not None:
+            next_inputs = self.embedding_fn(next_inputs)
+        return outputs, next_states, next_inputs, Tensor(finished)
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        """Stacked per-step outputs -> backtracked (T, B, K) sequences."""
+        predicted_ids = gather_tree(outputs.predicted_ids,
+                                    outputs.parent_ids)
+        return predicted_ids, final_states
+
+    @property
+    def tracks_own_finished(self):
+        return True
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Step `decoder` until every lane finishes or max_step_num is hit
+    (reference fluid/layers/rnn.py dynamic_decode).  Host-stepped with a
+    device-side finished flag checked once per step."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    time = 0
+    while True:
+        if max_step_num is not None and time >= max_step_num:
+            break
+        outputs, states, inputs, finished = decoder.step(
+            time, inputs, states, **kwargs)
+        step_outputs.append(outputs)
+        time += 1
+        if bool(np.all(np.asarray(unwrap(finished)))):
+            break
+
+    stacked = jax.tree_util.tree_map(
+        lambda *xs: Tensor(jnp.stack([unwrap(x) for x in xs], axis=0)),
+        *step_outputs, is_leaf=lambda x: isinstance(x, Tensor))
+    seq_lens = getattr(states, "lengths", None)
+    final_outputs, final_states = decoder.finalize(stacked, states, seq_lens)
+    if not output_time_major:
+        final_outputs = jax.tree_util.tree_map(
+            lambda x: Tensor(jnp.swapaxes(unwrap(x), 0, 1)), final_outputs,
+            is_leaf=lambda x: isinstance(x, Tensor))
+    if return_length:
+        return final_outputs, final_states, seq_lens
+    return final_outputs, final_states

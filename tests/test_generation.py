@@ -1,0 +1,221 @@
+"""Generation: jitted greedy/sampling/beam decode (paddle_tpu.generation)
+and the reference-shaped BeamSearchDecoder/dynamic_decode/gather_tree API
+(reference: fluid/layers/rnn.py:1, operators/math/beam_search.cc:1 — here
+cross-checked against numpy oracles and the eager no-cache forward)."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import models, nn
+from paddle_tpu.core.tensor import Tensor, unwrap
+
+
+def tiny_gpt(vocab=13, hidden=16, layers=2, heads=2, max_pos=64):
+    cfg = models.GPTConfig(vocab_size=vocab, hidden_size=hidden,
+                           num_hidden_layers=layers,
+                           num_attention_heads=heads,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0,
+                           max_position_embeddings=max_pos)
+    paddle.seed(7)
+    m = models.GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def eager_logits(model, ids_np):
+    """Full no-cache forward -> last-position logits (the oracle path)."""
+    out = model(paddle.to_tensor(ids_np.astype("int32")))
+    return np.asarray(unwrap(out))[:, -1, :].astype(np.float64)
+
+
+def test_gather_tree_matches_numpy():
+    rng = np.random.RandomState(0)
+    t, b, k = 5, 2, 3
+    ids = rng.randint(0, 9, (t, b, k))
+    parents = rng.randint(0, k, (t, b, k))
+    got = np.asarray(unwrap(nn.gather_tree(
+        paddle.to_tensor(ids), paddle.to_tensor(parents))))
+
+    # backtrack oracle: lane ki follows parents from the last step back
+    want = np.zeros_like(ids)
+    for bi in range(b):
+        for ki in range(k):
+            beam = ki
+            for ti in reversed(range(t)):
+                want[ti, bi, ki] = ids[ti, bi, beam]
+                beam = parents[ti, bi, beam]
+    assert (got == want).all()
+
+
+def test_generate_greedy_matches_eager_argmax():
+    model = tiny_gpt()
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(0, 13, (2, 4))
+    max_new = 6
+
+    out, scores = model.generate(paddle.to_tensor(prompt.astype("int32")),
+                                 max_new_tokens=max_new)
+    got = np.asarray(unwrap(out))
+
+    seq = prompt.copy()
+    want = []
+    for _ in range(max_new):
+        nxt = eager_logits(model, seq).argmax(-1)
+        want.append(nxt)
+        seq = np.concatenate([seq, nxt[:, None]], axis=1)
+    want = np.stack(want, axis=1)
+    assert (got == want).all(), (got, want)
+    assert np.asarray(unwrap(scores)).shape == (2,)
+
+
+def test_generate_eos_padding_and_score():
+    model = tiny_gpt()
+    prompt = np.array([[1, 2, 3]], dtype="int32")
+    # pick eos = the greedy first token so generation finishes immediately
+    first = int(eager_logits(model, prompt).argmax(-1)[0])
+    out, scores = model.generate(paddle.to_tensor(prompt),
+                                 max_new_tokens=5, eos_token_id=first,
+                                 pad_token_id=0)
+    got = np.asarray(unwrap(out))[0]
+    assert got[0] == first and (got[1:] == 0).all()
+
+
+def test_generate_topk1_matches_greedy_and_seeded_sampling_reproducible():
+    model = tiny_gpt()
+    prompt = np.array([[3, 1], [2, 5]], dtype="int32")
+    g, _ = model.generate(paddle.to_tensor(prompt), max_new_tokens=5)
+    s1, _ = model.generate(paddle.to_tensor(prompt), max_new_tokens=5,
+                           decode_strategy="sampling", top_k=1, seed=0)
+    assert (np.asarray(unwrap(g)) == np.asarray(unwrap(s1))).all()
+    a, _ = model.generate(paddle.to_tensor(prompt), max_new_tokens=5,
+                          decode_strategy="sampling", top_k=4, seed=3)
+    b, _ = model.generate(paddle.to_tensor(prompt), max_new_tokens=5,
+                          decode_strategy="sampling", top_k=4, seed=3)
+    assert (np.asarray(unwrap(a)) == np.asarray(unwrap(b))).all()
+
+
+def test_top_p_filter_keeps_nucleus():
+    from paddle_tpu.generation import apply_top_p
+    logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]]))
+    out = np.asarray(apply_top_p(logits, 0.7))
+    assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
+    assert out[0, 2] <= -1e8 and out[0, 3] <= -1e8
+
+
+def _numpy_beam(model, prompt, k, max_new, eos, pad):
+    """Beam-search oracle over the eager no-cache forward."""
+    b = prompt.shape[0]
+    beams = [[prompt[i].tolist() for _ in range(k)] for i in range(b)]
+    scores = np.tile(np.array([0.0] + [-1e9] * (k - 1)), (b, 1))
+    finished = np.zeros((b, k), bool)
+    toks_out = [[[] for _ in range(k)] for _ in range(b)]
+    for _ in range(max_new):
+        flat = np.array([beams[i][j] for i in range(b) for j in range(k)])
+        logits = eager_logits(model, flat)
+        logp = logits - np.log(np.exp(
+            logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
+            - logits.max(-1, keepdims=True)
+        logp = logp.reshape(b, k, -1)
+        v = logp.shape[-1]
+        fin_row = np.full((v,), -1e9); fin_row[pad] = 0.0
+        logp = np.where(finished[:, :, None], fin_row[None, None], logp)
+        cand = scores[:, :, None] + logp
+        new_beams, new_out = [], []
+        for i in range(b):
+            order = np.argsort(-cand[i].reshape(-1), kind="stable")[:k]
+            par, tok = order // v, order % v
+            scores[i] = cand[i].reshape(-1)[order]
+            nb, no = [], []
+            nf = []
+            for j in range(k):
+                p, t = int(par[j]), int(tok[j])
+                was_fin = finished[i, p]
+                t_eff = pad if was_fin else t
+                nb.append(beams[i][p] + [t_eff])
+                no.append(toks_out[i][p] + [t_eff])
+                nf.append(bool(was_fin or t_eff == eos))
+            new_beams.append(nb); new_out.append(no)
+            finished[i] = nf
+        beams, toks_out = new_beams, new_out
+    best = scores.argmax(1)
+    return np.array([toks_out[i][best[i]] for i in range(b)]), \
+        scores[np.arange(b), best]
+
+
+def test_generate_beam_matches_numpy_oracle():
+    model = tiny_gpt()
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(0, 13, (2, 3)).astype("int32")
+    out, sc = model.generate(paddle.to_tensor(prompt), max_new_tokens=5,
+                             decode_strategy="beam_search", num_beams=3,
+                             eos_token_id=12, pad_token_id=0)
+    want, want_sc = _numpy_beam(model, prompt, 3, 5, eos=12, pad=0)
+    assert (np.asarray(unwrap(out)) == want).all(), \
+        (np.asarray(unwrap(out)), want)
+    assert np.allclose(np.asarray(unwrap(sc)), want_sc, atol=1e-3)
+
+
+def test_beam_decoder_dynamic_decode_gru():
+    """BeamSearchDecoder over a GRU cell + embedding + projection, checked
+    against a numpy beam oracle that drives the same cell eagerly."""
+    hidden, vocab, k = 8, 7, 3
+    paddle.seed(11)
+    cell = nn.GRUCell(hidden, hidden)
+    emb = nn.Embedding(vocab, hidden)
+    proj = nn.Linear(hidden, vocab)
+    dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=2, beam_size=k,
+                               embedding_fn=emb, output_fn=proj)
+    b = 2
+    rng = np.random.RandomState(3)
+    h0 = paddle.to_tensor(rng.randn(b, hidden).astype("float32"))
+    outs, final_states = nn.dynamic_decode(dec, inits=h0, max_step_num=4)
+    ids = np.asarray(unwrap(outs))  # (B, T, K) after batch-major swap
+    assert ids.shape[0] == b and ids.shape[2] == k
+
+    # oracle: greedy-beam over the same cell called eagerly
+    def step_cell(tok, h):
+        x = emb(paddle.to_tensor(tok.astype("int32")))
+        out, nh = cell(x, paddle.to_tensor(h.astype("float32")))
+        logits = proj(out)
+        return np.asarray(unwrap(logits)).astype(np.float64), \
+            np.asarray(unwrap(nh))
+
+    h = np.repeat(np.asarray(unwrap(h0)), k, axis=0)
+    scores = np.tile(np.array([0.0] + [-1e9] * (k - 1)), (b, 1))
+    finished = np.zeros((b, k), bool)
+    tok = np.full((b * k,), 1)
+    seqs = [[[] for _ in range(k)] for _ in range(b)]
+    for _ in range(4):
+        logits, h = step_cell(tok, h)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        logp = logp.reshape(b, k, vocab)
+        fin_row = np.full((vocab,), -1e9); fin_row[2] = 0.0
+        logp = np.where(finished[:, :, None], fin_row[None, None], logp)
+        cand = scores[:, :, None] + logp
+        new_h = np.empty_like(h.reshape(b, k, hidden))
+        ntok = np.empty((b, k), int)
+        for i in range(b):
+            order = np.argsort(-cand[i].reshape(-1), kind="stable")[:k]
+            par, t = order // vocab, order % vocab
+            scores[i] = cand[i].reshape(-1)[order]
+            nf, ns = [], []
+            for j in range(k):
+                p = int(par[j])
+                ns.append(seqs[i][p] + [int(t[j])])
+                nf.append(bool(finished[i, p] or t[j] == 2))
+                new_h[i, j] = h.reshape(b, k, hidden)[i, p]
+                ntok[i, j] = int(t[j])
+            seqs[i] = ns
+            finished[i] = nf
+        h = new_h.reshape(b * k, hidden)
+        tok = ntok.reshape(-1)
+        if finished.all():
+            break
+    t_got = ids.shape[1]
+    for i in range(b):
+        for j in range(k):
+            assert ids[i, :, j].tolist() == seqs[i][j][:t_got], \
+                (i, j, ids[i, :, j], seqs[i][j])
