@@ -227,3 +227,257 @@ def test_roi_pool_max_semantics():
     o = out.numpy()[0, 0]
     assert o[0, 0] == 5.0 and o[1, 1] == 7.0
     assert o[0, 1] == 0.0 and o[1, 0] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# round-3 additions: deform_conv2d / yolo_loss / generate_proposals
+
+
+def test_deform_conv2d_zero_offset_equals_conv2d():
+    """With zero offsets and unit mask, deformable conv IS a regular conv."""
+    import paddle_tpu.nn.functional as F
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4, 7, 7).astype("float32")
+    wgt = rng.randn(6, 4, 3, 3).astype("float32")
+    off = np.zeros((2, 2 * 9, 7, 7), "float32")
+    msk = np.ones((2, 9, 7, 7), "float32")
+    got = ops.deform_conv2d(
+        paddle.to_tensor(x), paddle.to_tensor(off), paddle.to_tensor(wgt),
+        padding=1, mask=paddle.to_tensor(msk))
+    want = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(wgt), padding=1)
+    np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deform_conv2d_mask_scales_output():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 5, 5).astype("float32")
+    wgt = rng.randn(3, 2, 3, 3).astype("float32")
+    off = np.zeros((1, 18, 5, 5), "float32")
+    half = np.full((1, 9, 5, 5), 0.5, "float32")
+    full = np.ones((1, 9, 5, 5), "float32")
+    a = ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                          paddle.to_tensor(wgt), padding=1,
+                          mask=paddle.to_tensor(half))
+    b = ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                          paddle.to_tensor(wgt), padding=1,
+                          mask=paddle.to_tensor(full))
+    np.testing.assert_allclose(a.numpy(), 0.5 * b.numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_deform_conv2d_grads_numeric():
+    """Numeric-vs-analytic grads for x, offset, weight, mask (OpTest
+    harness; offsets non-integer so bilinear corners are differentiable)."""
+    from op_test import check_grad
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    wgt = rng.randn(2, 2, 3, 3).astype("float32") * 0.5
+    off = (rng.rand(1, 18, 4, 4).astype("float32") - 0.5) * 0.6 + 0.25
+    msk = rng.rand(1, 9, 4, 4).astype("float32") * 0.8 + 0.1
+
+    def fn(xv, ov, wv, mv):
+        return ops.deform_conv2d(xv, ov, wv, padding=1, mask=mv)
+    check_grad(fn, [x, off, wgt, msk], atol=5e-2, rtol=5e-2, delta=1e-3)
+
+
+def test_deform_conv2d_layer():
+    layer = ops.DeformConv2D(4, 8, 3, padding=1)
+    x = paddle.to_tensor(np.random.randn(2, 4, 6, 6).astype("float32"))
+    off = paddle.to_tensor(np.zeros((2, 18, 6, 6), "float32"))
+    out = layer(x, off)
+    assert list(out.shape) == [2, 8, 6, 6]
+
+
+def _np_yolo_loss(xv, gtb, gtl, gts, anchors, anchor_mask, class_num,
+                  ignore_thresh, downsample, use_label_smooth=True,
+                  scale_x_y=1.0):
+    """Independent numpy reference implementing the documented yolov3_loss
+    semantics (loops, no vectorization)."""
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    def sce(logit, label):
+        return max(logit, 0) - logit * label + np.log1p(np.exp(-abs(logit)))
+
+    n, c, h, w = xv.shape
+    mask_num = len(anchor_mask)
+    an = np.asarray(anchors, np.float64).reshape(-1, 2)
+    x5 = xv.reshape(n, mask_num, 5 + class_num, h, w).astype(np.float64)
+    input_w, input_h = downsample * w, downsample * h
+    losses = np.zeros(n)
+    for i in range(n):
+        # objectness targets/weights
+        tobj = np.zeros((mask_num, h, w))
+        wobj = np.ones((mask_num, h, w))
+        # ignore negatives with high IoU vs any gt
+        for m in range(mask_num):
+            for gj in range(h):
+                for gi in range(w):
+                    px = (sig(x5[i, m, 0, gj, gi]) * scale_x_y
+                          - 0.5 * (scale_x_y - 1) + gi) / w
+                    py = (sig(x5[i, m, 1, gj, gi]) * scale_x_y
+                          - 0.5 * (scale_x_y - 1) + gj) / h
+                    pw = np.exp(x5[i, m, 2, gj, gi]) * an[anchor_mask[m], 0] / input_w
+                    ph = np.exp(x5[i, m, 3, gj, gi]) * an[anchor_mask[m], 1] / input_h
+                    best = 0.0
+                    for b in range(gtb.shape[1]):
+                        gx, gy, gw, gh = gtb[i, b]
+                        if gw <= 0 or gh <= 0:
+                            continue
+                        ix1 = max(px - pw / 2, gx - gw / 2)
+                        iy1 = max(py - ph / 2, gy - gh / 2)
+                        ix2 = min(px + pw / 2, gx + gw / 2)
+                        iy2 = min(py + ph / 2, gy + gh / 2)
+                        inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+                        u = pw * ph + gw * gh - inter
+                        best = max(best, inter / max(u, 1e-10))
+                    if best > ignore_thresh:
+                        wobj[m, gj, gi] = 0.0
+        for b in range(gtb.shape[1]):
+            gx, gy, gw, gh = gtb[i, b]
+            if gw <= 0 or gh <= 0:
+                continue
+            gwp, ghp = gw * input_w, gh * input_h
+            ious = []
+            for a in range(len(an)):
+                inter = min(gwp, an[a, 0]) * min(ghp, an[a, 1])
+                u = gwp * ghp + an[a, 0] * an[a, 1] - inter
+                ious.append(inter / max(u, 1e-10))
+            best_an = int(np.argmax(ious))
+            if best_an not in anchor_mask:
+                continue
+            m = anchor_mask.index(best_an)
+            gi, gj = int(gx * w), int(gy * h)
+            gi, gj = min(gi, w - 1), min(gj, h - 1)
+            tx, ty = gx * w - gi, gy * h - gj
+            tw = np.log(gwp / an[best_an, 0])
+            th = np.log(ghp / an[best_an, 1])
+            scale = 2.0 - gw * gh
+            s = gts[i, b]
+            losses[i] += (sce(x5[i, m, 0, gj, gi], tx)
+                          + sce(x5[i, m, 1, gj, gi], ty)
+                          + abs(x5[i, m, 2, gj, gi] - tw)
+                          + abs(x5[i, m, 3, gj, gi] - th)) * scale * s
+            if use_label_smooth and class_num > 1:
+                pos, neg = 1.0 - 1.0 / class_num, 1.0 / class_num
+            else:
+                pos, neg = 1.0, 0.0
+            for cc in range(class_num):
+                lbl = pos if cc == gtl[i, b] else neg
+                losses[i] += sce(x5[i, m, 5 + cc, gj, gi], lbl) * s
+            tobj[m, gj, gi] = s
+            wobj[m, gj, gi] = 1.0
+        for m in range(mask_num):
+            for gj in range(h):
+                for gi in range(w):
+                    losses[i] += sce(x5[i, m, 4, gj, gi],
+                                     tobj[m, gj, gi]) * wobj[m, gj, gi]
+    return losses
+
+
+@pytest.mark.parametrize("anchor_mask", [[1, 2], [2, 3]])
+def test_yolo_loss_matches_numpy_reference(anchor_mask):
+    """[1, 2]: best anchors fall OUTSIDE the mask (pure-negative objectness
+    path); [2, 3]: gts assign positives (box/class/obj-positive path)."""
+    rng = np.random.RandomState(3)
+    n, h, w, class_num = 2, 4, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23, 30, 61]
+    xv = rng.randn(n, len(anchor_mask) * (5 + class_num), h, w).astype(
+        "float32") * 0.5
+    gtb = np.zeros((n, 3, 4), "float32")
+    gtb[:, :2] = rng.rand(n, 2, 4).astype("float32") * 0.5 + 0.2
+    gtl = rng.randint(0, class_num, (n, 3)).astype("int32")
+    gts = rng.rand(n, 3).astype("float32")
+    got = ops.yolo_loss(paddle.to_tensor(xv), paddle.to_tensor(gtb),
+                        paddle.to_tensor(gtl), anchors, anchor_mask,
+                        class_num, ignore_thresh=0.5, downsample_ratio=32,
+                        gt_score=paddle.to_tensor(gts))
+    want = _np_yolo_loss(xv, gtb, gtl, gts, anchors, anchor_mask,
+                         class_num, 0.5, 32)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-4)
+    assert (got.numpy() > 0).all()
+
+
+def test_yolo_loss_grad_flows():
+    """yolo_loss is trainable: tape grad exists and matches numeric grad."""
+    from op_test import check_grad
+    rng = np.random.RandomState(4)
+    n, h, w, class_num = 1, 2, 2, 2
+    anchors = [10, 13, 16, 30]
+    anchor_mask = [0, 1]
+    xv = rng.randn(n, 2 * (5 + class_num), h, w).astype("float32") * 0.3
+    gtb = np.array([[[0.4, 0.4, 0.3, 0.35], [0.7, 0.6, 0.2, 0.2]]],
+                   "float32")
+    gtl = np.array([[1, 0]], "int32")
+
+    def fn(x):
+        return ops.yolo_loss(x, paddle.to_tensor(gtb),
+                             paddle.to_tensor(gtl), anchors, anchor_mask,
+                             class_num, ignore_thresh=0.7,
+                             downsample_ratio=32)
+    check_grad(fn, [xv], atol=5e-2, rtol=5e-2, delta=5e-4)
+
+
+def test_generate_proposals_matches_numpy():
+    rng = np.random.RandomState(5)
+    n, a, h, w = 1, 3, 4, 4
+    scores = rng.rand(n, a, h, w).astype("float32")
+    deltas = rng.randn(n, 4 * a, h, w).astype("float32") * 0.2
+    img = np.array([[64.0, 64.0]], "float32")
+    anchors = np.zeros((h, w, a, 4), "float32")
+    for i in range(h):
+        for j in range(w):
+            for k in range(a):
+                cx, cy = j * 16 + 8, i * 16 + 8
+                sz = 8 * (k + 1)
+                anchors[i, j, k] = [cx - sz, cy - sz, cx + sz, cy + sz]
+    var = np.full((h, w, a, 4), 1.0, "float32")
+    rois, probs, num = ops.generate_proposals(
+        paddle.to_tensor(scores), paddle.to_tensor(deltas),
+        paddle.to_tensor(img), paddle.to_tensor(anchors),
+        paddle.to_tensor(var), pre_nms_top_n=20, post_nms_top_n=10,
+        nms_thresh=0.6, min_size=4.0, return_rois_num=True)
+    rn, pn = rois.numpy(), probs.numpy()
+    assert rn.shape[0] == pn.shape[0] == int(num.numpy()[0])
+    assert rn.shape[0] >= 1 and rn.shape[0] <= 10
+
+    # full numpy reference: decode -> clip -> filter -> greedy NMS
+    flat_s = scores[0].transpose(1, 2, 0).reshape(-1).astype(np.float64)
+    flat_d = deltas[0].reshape(a, 4, h, w).transpose(2, 3, 0, 1) \
+        .reshape(-1, 4).astype(np.float64)
+    flat_a = anchors.reshape(-1, 4).astype(np.float64)
+    order = np.argsort(-flat_s, kind="stable")[:20]
+    cand = []
+    for idx in order:
+        ax1, ay1, ax2, ay2 = flat_a[idx]
+        aw, ah = ax2 - ax1, ay2 - ay1
+        acx, acy = ax1 + aw / 2, ay1 + ah / 2
+        dx, dy, dw, dh = flat_d[idx]
+        cx, cy = dx * aw + acx, dy * ah + acy
+        bw = np.exp(min(dw, np.log(1000 / 16))) * aw
+        bh = np.exp(min(dh, np.log(1000 / 16))) * ah
+        box = [np.clip(cx - bw / 2, 0, 64), np.clip(cy - bh / 2, 0, 64),
+               np.clip(cx + bw / 2, 0, 64), np.clip(cy + bh / 2, 0, 64)]
+        if box[2] - box[0] >= 4.0 and box[3] - box[1] >= 4.0:
+            cand.append((flat_s[idx], box))
+    kept = []
+    for s, b in cand:  # already score-descending
+        ok = True
+        for _, kb in kept:
+            ix1, iy1 = max(b[0], kb[0]), max(b[1], kb[1])
+            ix2, iy2 = min(b[2], kb[2]), min(b[3], kb[3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            ua = ((b[2] - b[0]) * (b[3] - b[1])
+                  + (kb[2] - kb[0]) * (kb[3] - kb[1]) - inter)
+            if inter / max(ua, 1e-10) > 0.6:
+                ok = False
+                break
+        if ok:
+            kept.append((s, b))
+    kept = kept[:10]
+    want_boxes = np.array([b for _, b in kept], np.float64)
+    want_scores = np.array([s for s, _ in kept], np.float64)
+    assert rn.shape[0] == len(kept)
+    np.testing.assert_allclose(rn, want_boxes, rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(pn[:, 0], want_scores, rtol=1e-5, atol=1e-6)
