@@ -1,17 +1,23 @@
 """Benchmark: BERT-large pretraining MFU on one chip (BASELINE.md config #3
-flagship; north star = 45% MFU on TPU v5e).
+flagship; north star = 45% MFU on TPU v5e) plus secondary BASELINE configs
+(ResNet-50 jit #2, GPT-2-medium #5 single-chip; pipeline GPipe-vs-1F1B ratio
+on the 8-virtual-device CPU mesh).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"} —
+the driver parses the flagship fields; extra configs ride in `detail`.
+Set BENCH_EXTRA=0 to measure only the flagship.
 
-Runs the fused TrainStep (forward+backward+AdamW in a single donated XLA
-program) with bf16 AMP + remat, seq 512 — the reference's equivalent path is
-Fleet AMP+Recompute meta-optimizers over the BERT program.
-On non-TPU backends a tiny config keeps the harness runnable (the number is
-then only a smoke signal).
+A100 comparison note: BASELINE.json's second north star ("tokens/sec/chip
+within 5% of Paddle's own A100 run") is unverifiable — the reference repo
+publishes no benchmark numbers (BASELINE.md:3-9) and the driver supplies no
+A100 figure; `detail.a100_comparison` records that explicitly.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -44,17 +50,30 @@ def bert_train_flops(batch, seq, cfg) -> float:
     return float(dense + attn)
 
 
-def main():
-    import jax
+def gpt_train_flops(batch, seq, cfg) -> float:
+    """Causal LM: same accounting, attention halved by the causal mask."""
+    h, L, V = cfg.hidden_size, cfg.num_hidden_layers, cfg.vocab_size
+    i = cfg.intermediate_size
+    params_dense = L * (4 * h * h + 2 * h * i) + V * h
+    tokens = batch * seq
+    return float(6 * params_dense * tokens
+                 + 6 * L * batch * seq * seq * h)
+
+
+# ResNet-50 224x224 forward ~4.09 GFLOPs/image (standard published count);
+# fwd+bwd ~3x forward.
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
+
+
+def measure_bert(on_tpu):
     import paddle_tpu as paddle
     from paddle_tpu import models
     from paddle_tpu.jit import TrainStep
 
-    on_tpu = jax.default_backend() in ("tpu",)
     if on_tpu:
         cfg = models.bert_large_config(vocab_size=30528,
                                        max_position_embeddings=512)
-        batch, seq, iters, warmup = 8, 512, 20, 3
+        batch, seq, iters, warmup = 8, 512, 6, 2
     else:
         cfg = models.BertConfig(vocab_size=1024, hidden_size=128,
                                 num_hidden_layers=2, num_attention_heads=8,
@@ -68,27 +87,34 @@ def main():
     opt = paddle.optimizer.AdamW(
         learning_rate=1e-4, parameters=model.parameters(),
         apply_decay_param_fun=lambda n: "bias" not in n and "norm" not in n)
-    # r2 tuning notes (v5e, flash-attention kernels live in the step):
-    # - b8 no-remat remains the best operating point: b16 no-remat 257ms
-    #   (31.9k tok/s), b16 remat 320ms, vs b8 ~102ms (40.3k tok/s).
-    # - attention was the bottleneck: per-head (512,512,64) dots run at MXU
-    #   row-rate (~16 TF/s ceiling measured for ANY kernel at this shape —
-    #   bare dots, XLA naive, and jax's reference flash all land there; the
-    #   d=64 contraction fills half the 128-deep systolic array).  The fix
-    #   that got from 123ms->102ms/step: natural-layout head-folded kernels
-    #   (ops/flash_attention.py) — read (B,S,H*D) blocks directly (no HBM
-    #   transposes), amortize loads over a 4-head group per grid step, and
-    #   skip the online-softmax rescale machinery when the whole k axis fits
-    #   one block.  Measured fwd+bwd attention: 0.84 ms/layer (was ~2.5).
-    # - per-jit-call tunnel overhead is ~15ms, so the bench drives K steps
-    #   per compiled call via TrainStep.run_steps (the analogue of the
-    #   reference's in-executor dataset train loop).
+    # r3 profiling notes (component timings, v5e, serialized solo probes —
+    # two concurrent tunnel benchmarks cross-contaminate wall clocks):
+    # - step decomposition at b8 s512: fwd 41 ms / fwd+bwd 102 / +AdamW 113
+    #   (fused run_steps step: 102).  AdamW ~11 ms is pure HBM (28 B/param
+    #   x 333 M).  MLM head + CE only ~4 ms; encoder fwd 35 ms vs a
+    #   measured pure-matmul chain rate of ~128 TF/s (65% of peak) for
+    #   these (4096,1024)x(1024,{1024..4096}) shapes — the dense path is
+    #   near its practical shape ceiling, not mis-scheduled.
+    # - embedding backward was the hidden cost: XLA lowers grad-of-take to
+    #   a serialized row-scatter (~16 ms standalone).  Fix: custom_vjp
+    #   one_hot(ids)^T @ g matmul (nn/functional/common.py _take_rows).
+    # - dropout RNG: threefry burns VPU int ops (16 ms standalone for one
+    #   step's masks).  Fix: rbg (TPU hardware generator) — ~5 ms/step.
+    #   b8 102 -> 96.8 ms = 46.2% MFU with both fixes.
+    # - b16 stays worse than b8 (fwd+bwd 219 ms = 2.15x b8): mildly
+    #   super-linear everywhere (activation-stash HBM pressure), so b8
+    #   remains the operating point; k_per_call 5 vs 20 makes no
+    #   difference (no measurable per-call tunnel overhead in-loop).
+    # r2 tuning notes (flash kernels): b8 no-remat beats b16; per-head
+    #   (512,512,64) dots are MXU-row-rate-bound (~16 TF/s) for ANY kernel;
+    #   the natural-layout head-folded pallas pair (ops/flash_attention.py)
+    #   runs fwd+bwd attention at 0.84 ms/layer (was ~2.5).
     step = TrainStep(model, lambda logits, nsp, label: crit(
         logits, nsp, label), opt, amp_level="O1", amp_dtype="bfloat16",
         remat=False)
 
     rng = np.random.RandomState(0)
-    k_per_call = 5 if on_tpu else 2
+    k_per_call = 20 if on_tpu else 2
     ids = paddle.to_tensor(rng.randint(
         0, cfg.vocab_size, (k_per_call, batch, seq)).astype("int32"))
     labels = paddle.to_tensor(rng.randint(
@@ -109,19 +135,194 @@ def main():
     flops = bert_train_flops(batch, seq, cfg)
     peak = detect_peak_tflops() * 1e12
     mfu = flops / dt / peak * 100.0
-    tokens_per_sec = batch * seq / dt
+    return {
+        "mfu": mfu,
+        "tokens_per_sec_per_chip": round(batch * seq / dt, 1),
+        "step_ms": round(dt * 1e3, 2),
+        "config": "bert-large-512" if on_tpu else "bert-tiny-cpu",
+        "loss": final_loss,
+    }
+
+
+def measure_resnet50(on_tpu):
+    """BASELINE config #2: ResNet-50, jit/static path, single device."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision import models as vmodels
+
+    paddle.seed(0)
+    if on_tpu:
+        batch, hw, iters, warmup = 64, 224, 5, 2
+        model = vmodels.resnet50()
+    else:
+        batch, hw, iters, warmup = 4, 32, 2, 1
+        model = vmodels.resnet18()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    step = TrainStep(model, lambda logits, label: F.cross_entropy(
+        logits, label), opt, amp_level="O1", amp_dtype="bfloat16")
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(batch, 3, hw, hw).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 1000, (batch,)).astype("int64"))
+    for _ in range(warmup):
+        loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    sps = batch / dt
+    mfu = (RESNET50_TRAIN_FLOPS_PER_IMG * sps
+           / (detect_peak_tflops() * 1e12) * 100.0) if on_tpu else None
+    return {"samples_per_sec_per_chip": round(sps, 1),
+            "step_ms": round(dt * 1e3, 2),
+            "mfu": round(mfu, 2) if mfu is not None else None,
+            "config": f"resnet50-b{batch}-{hw}" if on_tpu
+            else f"resnet18-cpu-smoke-b{batch}"}
+
+
+def measure_gpt2(on_tpu):
+    """BASELINE config #5's model (GPT-2 medium) single-chip; the
+    pipeline+recompute leg is exercised on the virtual mesh (see
+    pipeline_ratio) since one chip hosts no pp axis."""
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+    from paddle_tpu.jit import TrainStep
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = models.gpt2_medium_config()
+        batch, seq, iters, warmup = 4, 1024, 5, 2
+    else:
+        cfg = models.GPTConfig(vocab_size=512, hidden_size=64,
+                               num_hidden_layers=2, num_attention_heads=4,
+                               max_position_embeddings=128)
+        batch, seq, iters, warmup = 2, 64, 2, 1
+    model = models.GPTForPretraining(cfg)
+    crit = models.GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    step = TrainStep(model, lambda logits, label: crit(logits, label),
+                     opt, amp_level="O1", amp_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(
+        0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    labels = paddle.to_tensor(rng.randint(
+        0, cfg.vocab_size, (batch, seq)).astype("int32"))
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    mfu = (gpt_train_flops(batch, seq, cfg) / dt
+           / (detect_peak_tflops() * 1e12) * 100.0) if on_tpu else None
+    return {"tokens_per_sec_per_chip": round(batch * seq / dt, 1),
+            "step_ms": round(dt * 1e3, 2),
+            "mfu": round(mfu, 2) if mfu is not None else None,
+            "config": "gpt2-medium-1024" if on_tpu else "gpt2-tiny-cpu"}
+
+
+_PIPE_RATIO_SCRIPT = r"""
+import os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags
+                               + " --xla_force_host_platform_device_count=8").strip()
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu import models, parallel
+from paddle_tpu.parallel.pipeline import gpt_pipeline_step
+
+def timed(schedule):
+    paddle.seed(0)
+    cfg = models.GPTConfig(vocab_size=256, hidden_size=64,
+                           num_hidden_layers=8, num_attention_heads=4,
+                           max_position_embeddings=64,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0)
+    model = models.GPTForPretraining(cfg)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                                 parameters=model.parameters())
+    mesh = parallel.create_mesh({"pp": 4, "dp": 2})
+    step = gpt_pipeline_step(model, opt, mesh, n_micro=8, remat=True,
+                             schedule=schedule)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 256, (16, 64)).astype("int32"))
+    lab = paddle.to_tensor(rng.randint(0, 256, (16, 64)).astype("int32"))
+    loss = step(ids, lab); float(loss)
+    t0 = time.perf_counter()
+    for _ in range(4):
+        loss = step(ids, lab)
+    float(loss)
+    return (time.perf_counter() - t0) / 4
+
+g = timed("gpipe")
+f = timed("1f1b")
+print(f"RATIO {g:.6f} {f:.6f}")
+"""
+
+
+def measure_pipeline_ratio():
+    """GPipe vs 1F1B steady-state step time on the 8-virtual-device CPU
+    mesh (the BASELINE #5 pipeline leg, minus real chips)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run([sys.executable, "-c", _PIPE_RATIO_SCRIPT],
+                          capture_output=True, text=True, timeout=900,
+                          env=env, cwd=os.path.dirname(
+                              os.path.abspath(__file__)))
+    for line in proc.stdout.splitlines():
+        if line.startswith("RATIO"):
+            _, g, f = line.split()
+            return {"gpipe_step_s": round(float(g), 4),
+                    "onef1b_step_s": round(float(f), 4),
+                    "onef1b_over_gpipe": round(float(f) / float(g), 4),
+                    "mesh": "pp4 x dp2 (8 virtual cpu devices)"}
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
+def main():
+    import jax
+    # TPU HW RNG for dropout masks: XLA's threefry lowering burns VPU int
+    # ops (~16 ms for one step's worth of masks measured standalone);
+    # rbg uses the on-chip generator.  Bench-scoped: tests keep threefry
+    # for cross-platform determinism.
+    jax.config.update("jax_default_prng_impl", "rbg")
+
+    on_tpu = jax.default_backend() in ("tpu",)
+    bert = measure_bert(on_tpu)
+
+    detail = dict(bert)
+    mfu = detail.pop("mfu")
+    detail["a100_comparison"] = (
+        "no published A100 tokens/sec figure exists (reference repo has no "
+        "in-tree benchmarks; driver supplies none) — unverifiable")
+    if os.environ.get("BENCH_EXTRA", "1") != "0":
+        for name, fn in (("resnet50", lambda: measure_resnet50(on_tpu)),
+                         ("gpt2_medium", lambda: measure_gpt2(on_tpu)),
+                         ("pipeline", measure_pipeline_ratio)):
+            try:
+                detail[name] = fn()
+            except Exception as e:  # secondary configs never kill the line
+                detail[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
 
     print(json.dumps({
         "metric": "bert_mfu" if on_tpu else "bert_mfu_cpu_smoke",
         "value": round(mfu, 2),
         "unit": "%",
         "vs_baseline": round(mfu / 45.0, 4),
-        "detail": {
-            "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
-            "step_ms": round(dt * 1e3, 2),
-            "config": "bert-large-512" if on_tpu else "bert-tiny-cpu",
-            "loss": final_loss,
-        },
+        "detail": detail,
     }))
 
 

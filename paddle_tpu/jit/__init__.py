@@ -513,20 +513,57 @@ def save(layer, path, input_spec=None, **config):
             "op_versions": _relevant_op_versions(layer)}
     if input_spec is not None:
         layer.eval()
-        specs = [s.to_shape_dtype() if isinstance(s, InputSpec) else
-                 jax.ShapeDtypeStruct(tuple(s.shape), s.dtype) for s in input_spec]
+        from jax import export as jax_export
+
+        # -1/None dims export as SYMBOLIC dimensions, named by AXIS
+        # POSITION ("b" for dim 0, "d<j>" otherwise) and shared across
+        # inputs — so (ids, mask) specs of (-1, -1) agree on batch AND
+        # seq_len, the common paddle Program -1 pattern.  Inputs whose
+        # same-position dynamic dims are genuinely independent would
+        # over-constrain; pass concrete sizes for those.
+        scope = jax_export.SymbolicScope()
+
+        def to_sds(s):
+            if not isinstance(s, InputSpec):
+                return jax.ShapeDtypeStruct(tuple(s.shape), s.dtype)
+            if all(d != -1 for d in s.shape):
+                return s.to_shape_dtype()
+            names = ",".join(
+                ("b" if j == 0 else f"d{j}") if d == -1 else str(d)
+                for j, d in enumerate(s.shape))
+            sym = jax_export.symbolic_shape(names, scope=scope)
+            return jax.ShapeDtypeStruct(sym, s.dtype)
+
+        specs = [to_sds(s) for s in input_spec]
 
         def pure(state, *args):
             return functional_call(layer, state, *args, training=False)
 
+        state_sds = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+                     for k, v in state.items()}
         try:
-            from jax import export as jax_export
-            exported = jax_export.export(jax.jit(pure))(
-                {k: jax.ShapeDtypeStruct(v.shape, v.dtype) for k, v in state.items()},
-                *specs)
+            try:
+                exported = jax_export.export(jax.jit(pure))(state_sds, *specs)
+            except Exception as sym_err:
+                # model not shape-polymorphic: fall back to the concrete
+                # export (every -1 becomes 1) rather than producing no
+                # artifact — but say so, loudly and in the metadata
+                import warnings
+                warnings.warn(
+                    "jit.save: symbolic-shape export failed "
+                    f"({type(sym_err).__name__}); falling back to CONCRETE "
+                    "shapes — the saved model only accepts the exact "
+                    "fallback shapes (every -1 dim = 1)")
+                meta["export_fallback"] = f"concrete: {sym_err}"[:500]
+                specs = [s.to_shape_dtype() if isinstance(s, InputSpec)
+                         else s for s in input_spec]
+                exported = jax_export.export(jax.jit(pure))(state_sds, *specs)
             with open(path + ".pdmodel", "wb") as f:
                 f.write(exported.serialize())
-            meta["input_spec"] = [(tuple(s.shape), str(np.dtype(s.dtype))) for s in specs]
+            meta["input_spec"] = [
+                (tuple(int(d) if isinstance(d, int) else -1
+                       for d in s.shape), str(np.dtype(s.dtype)))
+                for s in specs]
         except Exception as e:  # export unsupported on some backends
             meta["export_error"] = str(e)
     with open(path + ".pdmeta", "wb") as f:

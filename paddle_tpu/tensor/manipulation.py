@@ -18,7 +18,16 @@ def _ints(seq):
         seq = seq.tolist()
     if isinstance(seq, (int, np.integer)):
         return int(seq)
-    return [int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s) for s in seq]
+
+    def one(s):
+        v = unwrap(s) if isinstance(s, Tensor) else s
+        try:
+            return int(v)
+        except Exception:
+            # symbolic export dimension (_DimExpr) or traced value: pass
+            # through — jnp handles both in shape positions
+            return v
+    return [one(s) for s in seq]
 
 
 def cast(x, dtype):

@@ -1,0 +1,232 @@
+"""Book-tier end-to-end convergence suite.
+
+Reference: python/paddle/fluid/tests/book/ (test_recognize_digits.py:1,
+test_fit_a_line.py, test_word2vec.py, test_machine_translation.py) — each
+trains a model to an ABSOLUTE metric threshold, then round-trips through
+save/load-inference and checks the served output.  Zero-egress stand-in
+data: deterministic synthetic datasets with enough structure that the model
+must genuinely learn (class prototypes + noise for digits, a linear ground
+truth for fit_a_line, an n-gram language for word2vec, string reversal for
+the seq2seq translation task).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+pytestmark = pytest.mark.slow
+
+
+# ---------------------------------------------------------------------------
+# synthetic data
+
+
+def synth_digits(n, rng, noise=0.35):
+    """10 fixed 28x28 prototypes + gaussian noise -> (x, y)."""
+    protos = np.stack([np.outer(
+        np.sin(np.linspace(0, (c + 2) * np.pi / 3, 28)),
+        np.cos(np.linspace(0, (c % 5 + 1) * np.pi, 28)))
+        for c in range(10)]).astype("float32")
+    y = rng.randint(0, 10, n)
+    x = protos[y] + rng.randn(n, 28, 28).astype("float32") * noise
+    return x[:, None], y.astype("int64")
+
+
+# ---------------------------------------------------------------------------
+# 1. recognize_digits (reference book test_recognize_digits.py:1)
+
+
+def test_book_recognize_digits_lenet(tmp_path):
+    from paddle_tpu.vision.models import LeNet
+    rng = np.random.RandomState(0)
+    paddle.seed(0)
+    model = LeNet(num_classes=10)
+    opt = paddle.optimizer.Adam(learning_rate=2e-3,
+                                parameters=model.parameters())
+    xtr, ytr = synth_digits(1024, rng)
+    xte, yte = synth_digits(256, rng)
+    for epoch in range(3):
+        perm = rng.permutation(len(xtr))
+        for i in range(0, len(xtr), 64):
+            idx = perm[i:i + 64]
+            logits = model(paddle.to_tensor(xtr[idx]))
+            loss = F.cross_entropy(logits, paddle.to_tensor(ytr[idx]))
+            loss.backward(); opt.step(); opt.clear_grad()
+    model.eval()
+    pred = model(paddle.to_tensor(xte)).numpy().argmax(-1)
+    acc = (pred == yte).mean()
+    assert acc >= 0.9, f"LeNet accuracy {acc} below book threshold"
+
+    # save/load-inference round trip (the book tests' second half)
+    path = os.path.join(tmp_path, "digits")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.static.InputSpec([None, 1, 28, 28])])
+    served = paddle.jit.load(path)
+    out = served(paddle.to_tensor(xte[:8]))
+    np.testing.assert_allclose(out.numpy(),
+                               model(paddle.to_tensor(xte[:8])).numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# 2. fit_a_line (reference book test_fit_a_line.py)
+
+
+def test_book_fit_a_line(tmp_path):
+    rng = np.random.RandomState(1)
+    paddle.seed(1)
+    w_true = rng.randn(13).astype("float32")
+    x = rng.randn(512, 13).astype("float32")
+    y = x @ w_true + 0.7 + rng.randn(512).astype("float32") * 0.05
+
+    model = nn.Linear(13, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    for step in range(200):
+        i = (step * 64) % 448
+        xb = paddle.to_tensor(x[i:i + 64])
+        yb = paddle.to_tensor(y[i:i + 64, None])
+        loss = F.mse_loss(model(xb), yb)
+        loss.backward(); opt.step(); opt.clear_grad()
+    final = float(F.mse_loss(model(paddle.to_tensor(x)),
+                             paddle.to_tensor(y[:, None])))
+    assert final < 0.02, f"fit_a_line cost {final} above book threshold"
+
+    path = os.path.join(tmp_path, "line")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.static.InputSpec([None, 13])])
+    served = paddle.jit.load(path)
+    np.testing.assert_allclose(served(paddle.to_tensor(x[:4])).numpy(),
+                               model(paddle.to_tensor(x[:4])).numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# 3. word2vec (reference book test_word2vec.py: n-gram LM over embeddings)
+
+
+class NGram(nn.Layer):
+    def __init__(self, vocab, emb=32, hid=64, n=4):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, emb)
+        self.fc1 = nn.Linear(emb * n, hid)
+        self.fc2 = nn.Linear(hid, vocab)
+
+    def forward(self, ctx):  # (B, n)
+        e = self.emb(ctx)
+        b = e.shape[0]
+        h = F.tanh(self.fc1(e.reshape([b, -1])))
+        return self.fc2(h)
+
+
+def test_book_word2vec(tmp_path):
+    # deterministic markov "language": word (i) is followed by one of
+    # {2i, 2i+1} mod V — an n-gram model must drive cost well below log(V)
+    V, n = 50, 4
+    rng = np.random.RandomState(2)
+    paddle.seed(2)
+    seq = [0]
+    for _ in range(4000):
+        seq.append((2 * seq[-1] + rng.randint(2)) % V)
+    seq = np.asarray(seq)
+    ctxs = np.stack([seq[i:i + n] for i in range(len(seq) - n)])
+    nxts = seq[n:]
+
+    model = NGram(V, n=n)
+    opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                parameters=model.parameters())
+    losses = []
+    for step in range(300):
+        i = (step * 128) % (len(ctxs) - 128)
+        loss = F.cross_entropy(
+            model(paddle.to_tensor(ctxs[i:i + 128].astype("int64"))),
+            paddle.to_tensor(nxts[i:i + 128].astype("int64")))
+        loss.backward(); opt.step(); opt.clear_grad()
+        losses.append(float(loss))
+    # ideal cost is one bit (two successors); book threshold: well under
+    # the log(V) ~ 3.9 uniform baseline
+    assert losses[-1] < 1.5, f"word2vec cost {losses[-1]} above threshold"
+
+    path = os.path.join(tmp_path, "w2v")
+    paddle.jit.save(model, path,
+                    input_spec=[paddle.static.InputSpec([None, n], "int64")])
+    served = paddle.jit.load(path)
+    np.testing.assert_allclose(
+        served(paddle.to_tensor(ctxs[:4].astype("int64"))).numpy(),
+        model(paddle.to_tensor(ctxs[:4].astype("int64"))).numpy(),
+        rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# 4. machine translation: seq2seq + BeamSearchDecoder decode
+#    (reference book test_machine_translation.py: encoder-decoder with
+#    beam search over operators/math/beam_search.cc)
+
+
+class Seq2Seq(nn.Layer):
+    def __init__(self, vocab, hid=64):
+        super().__init__()
+        self.src_emb = nn.Embedding(vocab, hid)
+        self.tgt_emb = nn.Embedding(vocab, hid)
+        self.encoder = nn.GRU(hid, hid)
+        self.cell = nn.GRUCell(hid, hid)
+        self.proj = nn.Linear(hid, vocab)
+
+    def encode(self, src):
+        _, h = self.encoder(self.src_emb(src))
+        return h[0]  # (B, hid)
+
+    def forward(self, src, tgt_in):
+        h = self.encode(src)
+        outs = []
+        for t in range(tgt_in.shape[1]):
+            o, h = self.cell(self.tgt_emb(tgt_in[:, t]), h)
+            outs.append(self.proj(o))
+        import paddle_tpu.tensor as T
+        return T.stack(outs, axis=1)
+
+
+def test_book_machine_translation_beam_decode():
+    """Train tiny seq2seq to reverse digit strings, then decode with
+    BeamSearchDecoder/dynamic_decode and check exact-match translations."""
+    V, L = 12, 5          # tokens 3..11 payload; 0 pad / 1 bos / 2 eos
+    rng = np.random.RandomState(3)
+    paddle.seed(3)
+
+    def sample_batch(b):
+        src = rng.randint(3, V, (b, L))
+        tgt = src[:, ::-1]
+        tgt_in = np.concatenate([np.full((b, 1), 1), tgt], 1)
+        tgt_out = np.concatenate([tgt, np.full((b, 1), 2)], 1)
+        return (src.astype("int64"), tgt_in.astype("int64"),
+                tgt_out.astype("int64"))
+
+    model = Seq2Seq(V)
+    opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                parameters=model.parameters())
+    from paddle_tpu.jit import TrainStep
+    step_fn = TrainStep(
+        model, lambda logits, label: F.cross_entropy(
+            logits.reshape([-1, V]), label.reshape([-1])), opt)
+    for step in range(900):
+        src, tin, tout = sample_batch(32)
+        step_fn(paddle.to_tensor(src), paddle.to_tensor(tin),
+                paddle.to_tensor(tout))
+
+    model.eval()
+    src, _, _ = sample_batch(16)
+    h0 = model.encode(paddle.to_tensor(src))
+    dec = nn.BeamSearchDecoder(model.cell, start_token=1, end_token=2,
+                               beam_size=3, embedding_fn=model.tgt_emb,
+                               output_fn=model.proj)
+    outs, _ = nn.dynamic_decode(dec, inits=h0, max_step_num=L + 1)
+    best = outs.numpy()[:, :, 0]  # (B, T) best beam
+    want = src[:, ::-1]
+    match = sum(
+        1 for i in range(16)
+        if best[i, :L].tolist() == want[i].tolist()) / 16.0
+    assert match >= 0.8, f"translation exact-match {match} below threshold"

@@ -1,0 +1,128 @@
+"""Control-flow API (reference: fluid/layers/control_flow.py:1 While/Cond/
+Switch ops) — eager tape-differentiable loops + traced lax lowering."""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+from paddle_tpu.core.tensor import unwrap
+
+
+def test_while_loop_eager_dynamic_trip():
+    i = paddle.to_tensor(np.array(0, "int32"))
+    x = paddle.to_tensor(np.array(1.0, "float32"))
+    out = snn.while_loop(lambda i, x: i < 5,
+                         lambda i, x: [i + 1, x * 2.0], [i, x])
+    assert int(out[0]) == 5 and float(out[1]) == 32.0
+
+
+def test_while_loop_eager_differentiable():
+    """Dynamic-length loop differentiates through the tape (the reference's
+    while_grad_op role)."""
+    x = paddle.to_tensor(np.array(2.0, "float32"), stop_gradient=False)
+    i = paddle.to_tensor(np.array(0, "int32"))
+    # x -> x^(2^3) = x^8; dy/dx = 8 x^7
+    out = snn.while_loop(lambda i, y: i < 3,
+                         lambda i, y: [i + 1, y * y], [i, x])
+    out[1].backward()
+    np.testing.assert_allclose(float(x.grad), 8 * 2.0 ** 7, rtol=1e-5)
+
+
+def test_while_loop_traced_in_jit():
+    @paddle.jit.to_static
+    def collatz_steps(n):
+        i = paddle.zeros([], "int32")
+        out = snn.while_loop(
+            lambda n, i: n > 1,
+            lambda n, i: [snn.cond((n % 2) == 0, lambda: n // 2,
+                                   lambda: 3 * n + 1), i + 1],
+            [n, i])
+        return out[1]
+    got = collatz_steps(paddle.to_tensor(np.array(6, "int32")))
+    assert int(got) == 8  # 6→3→10→5→16→8→4→2→1
+
+
+def test_cond_eager_and_grad():
+    x = paddle.to_tensor(np.array(3.0, "float32"), stop_gradient=False)
+    y = snn.cond(x > 0, lambda: x * 2.0, lambda: x * -1.0)
+    y.backward()
+    assert float(y) == 6.0 and float(x.grad) == 2.0
+
+
+def test_cond_traced_grad():
+    from paddle_tpu.core.tensor import Tensor
+
+    def f(xv):
+        x = Tensor(xv)
+        y = snn.cond(x > 0, lambda: unwrap(x) * 2.0, lambda: unwrap(x) * -1.0)
+        return unwrap(y)
+    g = jax.grad(f)(jnp.float32(3.0))
+    assert float(g) == 2.0
+    g = jax.grad(f)(jnp.float32(-3.0))
+    assert float(g) == -1.0
+
+
+def test_case_eager_first_true_wins_and_default():
+    x = paddle.to_tensor(np.array(0.2, "float32"))
+    r = snn.case([(x > 0.5, lambda: paddle.to_tensor(1.0)),
+                  (x > 0.1, lambda: paddle.to_tensor(2.0))],
+                 default=lambda: paddle.to_tensor(3.0))
+    assert float(r) == 2.0
+    r = snn.case([(x > 0.5, lambda: paddle.to_tensor(1.0)),
+                  (x > 0.4, lambda: paddle.to_tensor(2.0))])
+    assert float(r) == 2.0  # no default: last branch runs
+
+
+def test_case_traced():
+    @paddle.jit.to_static
+    def f(x):
+        return snn.case([(x > 0.5, lambda: x * 1.0),
+                         (x > 0.1, lambda: x * 10.0)],
+                        default=lambda: x * 100.0)
+    assert float(f(paddle.to_tensor(np.array(0.3, "float32")))) == \
+        pytest.approx(3.0)
+    assert float(f(paddle.to_tensor(np.array(0.05, "float32")))) == \
+        pytest.approx(5.0)
+
+
+def test_switch_case_eager_and_traced():
+    def mk(i):
+        return snn.switch_case(
+            paddle.to_tensor(np.array(i, "int32")),
+            {1: lambda: paddle.to_tensor(10.0),
+             3: lambda: paddle.to_tensor(30.0)},
+            default=lambda: paddle.to_tensor(-1.0))
+    assert float(mk(1)) == 10.0 and float(mk(3)) == 30.0
+    assert float(mk(2)) == -1.0
+
+    @paddle.jit.to_static
+    def f(i):
+        return snn.switch_case(i, {1: lambda: paddle.to_tensor(10.0),
+                                   3: lambda: paddle.to_tensor(30.0)},
+                               default=lambda: paddle.to_tensor(-1.0))
+    assert float(f(paddle.to_tensor(np.array(3, "int32")))) == 30.0
+    assert float(f(paddle.to_tensor(np.array(7, "int32")))) == -1.0
+
+
+def test_while_loop_rnn_style_dynamic_length():
+    """Dynamic-length sequence sum via while_loop (the LoD-free RNN
+    pattern the reference's While op enables)."""
+    seq = paddle.to_tensor(np.arange(10, dtype="float32"))
+    n = paddle.to_tensor(np.array(7, "int32"))  # runtime length
+    i = paddle.to_tensor(np.array(0, "int32"))
+    acc = paddle.to_tensor(np.array(0.0, "float32"))
+
+    out = snn.while_loop(
+        lambda i, acc: i < n,
+        lambda i, acc: [i + 1, acc + seq[i]], [i, acc])
+    assert float(out[1]) == float(np.arange(7).sum())
+
+
+def test_while_loop_validations():
+    with pytest.raises(ValueError):
+        snn.while_loop(lambda x: paddle.to_tensor(np.ones((2,), "bool")),
+                       lambda x: [x], [paddle.to_tensor(1.0)])
+    with pytest.raises(ValueError):
+        snn.while_loop(lambda x: x < 1, lambda x: [x], [])

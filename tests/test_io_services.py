@@ -94,10 +94,18 @@ class TestCrypto:
         key = CipherUtils.gen_key(128)
         msg = os.urandom(1000) + b"tail"  # non-multiple of block size
         enc = c.encrypt(msg, key)
-        assert enc != msg and len(enc) == len(msg) + 21
+        # header (magic 4 + version 1 + IV 16) + 32-byte HMAC tag (v2)
+        assert enc != msg and len(enc) == len(msg) + 53
         assert c.decrypt(enc, key) == msg
+        # v2 is authenticated: a wrong key fails closed instead of
+        # yielding attacker-decodable garbage (advisor r2 hardening)
         wrong = CipherUtils.gen_key(128)
-        assert c.decrypt(enc, wrong) != msg
+        with pytest.raises(ValueError, match="integrity"):
+            c.decrypt(enc, wrong)
+        # tampering any ciphertext byte is rejected
+        bad = bytearray(enc); bad[30] ^= 1
+        with pytest.raises(ValueError, match="integrity"):
+            c.decrypt(bytes(bad), key)
 
     def test_file_roundtrip(self, tmp_path):
         from paddle_tpu.io.crypto import AESCipher, CipherUtils
