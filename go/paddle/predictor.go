@@ -1,0 +1,108 @@
+// Package paddle — Go client for the paddle_tpu C inference API.
+//
+// Reference: go/paddle/predictor.go:1 in the upstream repo (cgo over the
+// fluid inference C API).  Here the same shape wraps
+// paddle_tpu/native/src/capi.cc (libpdtpu_capi.so), which embeds the
+// CPython/JAX runtime behind a pure-C ABI.
+//
+// Build (Go toolchain not bundled in the dev image — on a host with go):
+//
+//	g++ -O2 -std=c++17 -shared -fPIC paddle_tpu/native/src/capi.cc \
+//	    $(python3-config --includes) $(python3-config --ldflags --embed) \
+//	    -o libpdtpu_capi.so
+//	CGO_LDFLAGS="-L$PWD -lpdtpu_capi" go build ./go/paddle
+//
+// Run with PYTHONPATH pointing at the repo and LD_LIBRARY_PATH at the .so.
+package paddle
+
+/*
+#cgo LDFLAGS: -lpdtpu_capi
+#include <stdint.h>
+#include <stdlib.h>
+
+extern int PD_Init(void);
+extern void PD_Finalize(void);
+extern void* PD_CreatePredictor(const char* model_prefix);
+extern int PD_PredictorRun(void* h, const float* in, const int64_t* shape,
+                           int ndim, float* out, int64_t cap,
+                           int64_t* out_shape, int* out_ndim);
+extern void PD_DeletePredictor(void* h);
+extern const char* PD_GetLastError(void);
+*/
+import "C"
+
+import (
+	"errors"
+	"runtime"
+	"unsafe"
+)
+
+// Init starts the embedded runtime (idempotent). Must be called once
+// before NewPredictor.
+func Init() error {
+	if C.PD_Init() != 0 {
+		return lastError("PD_Init")
+	}
+	return nil
+}
+
+// Finalize tears the embedded runtime down.
+func Finalize() { C.PD_Finalize() }
+
+func lastError(where string) error {
+	return errors.New(where + ": " + C.GoString(C.PD_GetLastError()))
+}
+
+// Predictor serves a paddle_tpu jit.save artifact (model_prefix.pdmodel +
+// .pdiparams.npz), mirroring the reference Predictor API surface.
+type Predictor struct {
+	handle unsafe.Pointer
+}
+
+// NewPredictor loads the artifact saved by paddle_tpu.jit.save(prefix).
+func NewPredictor(modelPrefix string) (*Predictor, error) {
+	cs := C.CString(modelPrefix)
+	defer C.free(unsafe.Pointer(cs))
+	h := C.PD_CreatePredictor(cs)
+	if h == nil {
+		return nil, lastError("PD_CreatePredictor")
+	}
+	p := &Predictor{handle: h}
+	runtime.SetFinalizer(p, func(p *Predictor) { p.Delete() })
+	return p, nil
+}
+
+// Delete releases the predictor (also installed as a finalizer).
+func (p *Predictor) Delete() {
+	if p.handle != nil {
+		C.PD_DeletePredictor(p.handle)
+		p.handle = nil
+	}
+}
+
+// Run feeds one float32 input of the given shape and returns the first
+// float32 output with its shape.
+func (p *Predictor) Run(input []float32, shape []int64) ([]float32, []int64, error) {
+	if p.handle == nil {
+		return nil, nil, errors.New("predictor deleted")
+	}
+	outCap := int64(1 << 24) // 16M floats; grow for larger heads
+	out := make([]float32, outCap)
+	outShape := make([]int64, 8)
+	var outNDim C.int
+	rc := C.PD_PredictorRun(p.handle,
+		(*C.float)(unsafe.Pointer(&input[0])),
+		(*C.int64_t)(unsafe.Pointer(&shape[0])), C.int(len(shape)),
+		(*C.float)(unsafe.Pointer(&out[0])), C.int64_t(outCap),
+		(*C.int64_t)(unsafe.Pointer(&outShape[0])), &outNDim)
+	if rc != 0 {
+		return nil, nil, lastError("PD_PredictorRun")
+	}
+	n := int64(1)
+	dims := make([]int64, int(outNDim))
+	for i := range dims {
+		dims[i] = outShape[i]
+		n *= dims[i]
+	}
+	return out[:n], dims, nil
+}

@@ -294,6 +294,8 @@ def save_train_state(directory: str, params, opt_state, step: int,
     scheduler state, so a resumed run reproduces the uninterrupted one even
     with dropout and a warmup/decay schedule active."""
     from ..core import rng as _rng
+    from ..utils.monitor import stat_add
+    stat_add("STAT_checkpoint_saves")
     extra = dict(extra_meta or {})
     extra["__rng__"] = np.asarray(_rng.get_rng_state()).tolist()
     sched = getattr(optimizer, "_lr_scheduler", None)

@@ -169,6 +169,8 @@ class Predictor:
         return self._inputs[name]
 
     def run(self) -> bool:
+        from ..utils.monitor import stat_add
+        stat_add("STAT_predictor_runs")
         args = []
         for name, t in self._inputs.items():
             if t._value is None:

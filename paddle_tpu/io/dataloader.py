@@ -306,7 +306,13 @@ class DataLoader:
     def __iter__(self):
         # device prefetch pipeline (buffered_reader equivalent): stage the
         # next `prefetch` batches onto the device asynchronously.
+        from ..utils.monitor import stat_add
+
         def to_device(np_batch):
+            stat_add("STAT_dataloader_batch_count")
+            stat_add("STAT_dataloader_bytes",
+                     sum(a.nbytes for a in jax.tree_util.tree_leaves(np_batch)
+                         if isinstance(a, np.ndarray)))
             return jax.tree_util.tree_map(
                 lambda a: Tensor(jax.device_put(a)) if isinstance(a, np.ndarray) else a,
                 np_batch)

@@ -334,13 +334,20 @@ class TrainStep:
                 full.update(train_params)
                 ctx = sr.SparseGradContext("apply", zeros=zvals)
                 with sr.use_ctx(ctx):
-                    loss = self._forward_loss(full, batch, rng_key)
-                return loss, ctx.ids
+                    if with_outputs:
+                        loss, outs = forward_loss(
+                            self.model, self.loss_fn, full, batch, rng_key,
+                            self.amp_level, self.amp_dtype,
+                            return_outputs=True)
+                    else:
+                        loss = self._forward_loss(full, batch, rng_key)
+                        outs = ()
+                return loss, (ctx.ids, outs)
 
             train_params = {k: v for k, v in params.items()
                             if k in trainable and k not in sparse_names}
             loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
-            (loss, ids), (grads, zgrads) = jax.value_and_grad(
+            (loss, (ids, outs)), (grads, zgrads) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True)(train_params, zeros)
             grads = dict(grads)
             for key, zg in zgrads.items():
@@ -349,7 +356,7 @@ class TrainStep:
                 grads[name] = (grads[name] + rsg) if name in grads else rsg
             new_params, new_opt = apply_updates(
                 opt, params, grads, opt_state, lr, step_no, decay)
-            return new_params, new_opt, loss, ()
+            return new_params, new_opt, loss, outs
 
         return jax.jit(step_sparse if sparse_specs else step,
                        donate_argnums=(0, 1))

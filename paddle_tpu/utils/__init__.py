@@ -5,3 +5,4 @@ from .flags import get_flags, set_flags  # noqa: F401
 def try_import(name):
     import importlib
     return importlib.import_module(name)
+from . import monitor  # noqa: F401,E402

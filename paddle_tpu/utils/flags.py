@@ -25,6 +25,8 @@ _FLAGS: Dict[str, Any] = {
     "FLAGS_use_flash_attention": True,
     "FLAGS_amp_dtype": "bfloat16",
     "FLAGS_allocator_strategy": "xla",
+    # monitor (reference platform/monitor.h STAT registry)
+    "FLAGS_reset_stats": False,
 }
 
 
@@ -36,6 +38,9 @@ def _apply_effect(key: str, value):
     elif key == "FLAGS_check_nan_inf":
         from ..core.op import set_check_nan_inf
         set_check_nan_inf(bool(value))
+    elif key == "FLAGS_reset_stats" and value:
+        from .monitor import stat_reset
+        stat_reset()
 
 
 def _bootstrap_from_env():

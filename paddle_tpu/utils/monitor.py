@@ -1,0 +1,53 @@
+"""Monitor: cheap always-on global STAT counters.
+
+Reference: paddle/fluid/platform/monitor.h:77 (StatRegistry,
+STAT_ADD/STAT_SUB/STAT_RESET macros backing e.g. the dataset-feed byte/ins
+counters in data_feed.cc) and monitor.h:130 (the int64 stat registration
+list).  TPU-native: a process-local dict with the same add/sub/get/reset
+verbs; the runtime hot paths (dataloader, dataset engine, checkpointing)
+bump these, `profiler.summary()` surfaces them next to op spans, and the
+`FLAGS_reset_stats` flag clears them live.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["stat_add", "stat_sub", "stat_get", "stat_reset", "stats",
+           "STAT_ADD", "STAT_SUB", "STAT_RESET"]
+
+_lock = threading.Lock()
+_stats: Dict[str, int] = {}
+
+
+def stat_add(name: str, value: int = 1) -> None:
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + int(value)
+
+
+def stat_sub(name: str, value: int = 1) -> None:
+    stat_add(name, -int(value))
+
+
+def stat_get(name: str) -> int:
+    with _lock:
+        return _stats.get(name, 0)
+
+
+def stat_reset(name: str = None) -> None:
+    with _lock:
+        if name is None:
+            _stats.clear()
+        else:
+            _stats.pop(name, None)
+
+
+def stats() -> Dict[str, int]:
+    with _lock:
+        return dict(_stats)
+
+
+# macro-style aliases matching the reference's spelling
+STAT_ADD = stat_add
+STAT_SUB = stat_sub
+STAT_RESET = stat_reset

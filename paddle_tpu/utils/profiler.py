@@ -117,7 +117,15 @@ class RecordEvent:
 
 
 def summary():
-    return dict(_records)
+    """Op-span records plus the monitor's STAT counters (reference:
+    platform/monitor.h StatRegistry — surfaced here the way the reference
+    prints stats alongside the profiler report)."""
+    out = dict(_records)
+    from .monitor import stats
+    st = stats()
+    if st:
+        out["__stats__"] = st
+    return out
 
 
 def export_chrome_tracing(path: str) -> str:

@@ -30,7 +30,8 @@ __all__ = [
     "yolo_box", "yolo_loss", "prior_box", "anchor_generator", "box_coder",
     "iou_similarity", "box_iou", "box_clip", "nms", "multiclass_nms",
     "distribute_fpn_proposals", "roi_align", "roi_pool", "deform_conv2d",
-    "DeformConv2D", "generate_proposals",
+    "DeformConv2D", "generate_proposals", "nms_padded",
+    "multiclass_nms_padded",
 ]
 
 
@@ -255,6 +256,24 @@ def box_clip(input, im_info, name=None):
     return dispatch("box_clip", raw, input, im_info)
 
 
+def _greedy_suppress(iou_sorted, init_keep, iou_threshold):
+    """Score-descending greedy suppression over a (n, n) IoU matrix whose
+    rows/cols are already sorted by score: slot i survives iff it starts
+    eligible (init_keep) and no higher-scored KEPT slot overlaps it above
+    the threshold.  Single source of truth for nms / nms_padded /
+    multiclass_nms_padded."""
+    n = iou_sorted.shape[0]
+
+    def body(i, keep):
+        higher_kept = jnp.logical_and(jnp.arange(n) < i, keep)
+        sup = jnp.any(jnp.logical_and(higher_kept,
+                                      iou_sorted[i] > iou_threshold))
+        return keep.at[i].set(jnp.logical_and(init_keep[i],
+                                              jnp.logical_not(sup)))
+
+    return jax.lax.fori_loop(0, n, body, init_keep)
+
+
 def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None, name=None):
     """Greedy hard NMS (reference: detection/nms_op; paddle.vision.ops.nms).
@@ -276,15 +295,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         iou = jnp.where(cv[:, None] == cv[None, :], iou, 0.0)
     order = jnp.argsort(-sv)
     iou_o = iou[order][:, order]  # sorted by descending score
-
-    def body(i, keep):
-        # suppressed iff a higher-scored KEPT box overlaps > threshold
-        higher_kept = jnp.logical_and(jnp.arange(n) < i, keep)
-        sup = jnp.any(jnp.logical_and(higher_kept,
-                                      iou_o[i] > iou_threshold))
-        return keep.at[i].set(jnp.logical_not(sup))
-
-    keep = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    keep = _greedy_suppress(iou_o, jnp.ones((n,), bool), iou_threshold)
     order_np = np.asarray(jax.device_get(order))
     keep_np = np.asarray(jax.device_get(keep))
     kept = order_np[keep_np]
@@ -929,3 +940,83 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         return rois, probs, Tensor(jnp.asarray(np.asarray(nums, np.int32)),
                                    stop_gradient=True)
     return rois, probs
+
+
+# ---------------------------------------------------------------------------
+# on-device (jittable) padded NMS variants
+
+
+def nms_padded(boxes, scores=None, iou_threshold=0.3, max_out=None,
+               name=None):
+    """Fully on-device NMS with a FIXED output extent — usable inside a
+    jitted eval loop or the serving path (the host-compacting `nms` above
+    cannot be).  Returns (indices (max_out,) int32, valid_count): kept
+    indices sorted by descending score, padded with -1."""
+    bv = unwrap(boxes)
+    n = bv.shape[0]
+    max_out = int(max_out) if max_out is not None else n
+    sv = unwrap(scores) if scores is not None else jnp.arange(
+        n, 0, -1, dtype=jnp.float32)
+
+    def raw(bv, sv):
+        iou = _iou_matrix(bv, bv)
+        order = jnp.argsort(-sv)
+        iou_o = iou[order][:, order]
+        keep = _greedy_suppress(iou_o, jnp.ones((n,), bool), iou_threshold)
+        pos = jnp.cumsum(keep) - 1          # output slot per kept box
+        slot = jnp.where(keep & (pos < max_out), pos, max_out)
+        out = jnp.full((max_out,), -1, jnp.int32).at[slot].set(
+            order.astype(jnp.int32), mode="drop")
+        count = jnp.minimum(jnp.sum(keep.astype(jnp.int32)), max_out)
+        return out, count
+
+    out, count = raw(bv, sv)
+    return (Tensor(out, stop_gradient=True),
+            Tensor(count, stop_gradient=True))
+
+
+def multiclass_nms_padded(bboxes, scores, score_threshold, nms_top_k,
+                          keep_top_k, nms_threshold=0.3,
+                          background_label=-1, name=None):
+    """Jittable multiclass NMS: per-class suppression vmapped on device,
+    fixed (keep_top_k, 6) output [label, score, x1, y1, x2, y2] padded with
+    -1 rows + valid count.  Same selection semantics as `multiclass_nms`
+    (threshold -> per-class top nms_top_k -> NMS -> global top keep_top_k)
+    but with static shapes throughout (the TPU-native serving variant)."""
+    bv = unwrap(bboxes)
+    sv = unwrap(scores)
+    c, n = sv.shape
+
+    def raw(bv, sv):
+        iou = _iou_matrix(bv, bv)
+        topn = min(nms_top_k, n) if nms_top_k and nms_top_k > 0 else n
+
+        def per_class(srow):
+            svm = jnp.where(srow >= score_threshold, srow, -jnp.inf)
+            order = jnp.argsort(-svm)
+            valid_sorted = jnp.isfinite(svm[order]) & (jnp.arange(n) < topn)
+            iou_o = iou[order][:, order]
+            keep = _greedy_suppress(iou_o, valid_sorted, nms_threshold)
+            return jnp.zeros((n,), bool).at[order].set(keep)
+
+        keep_cn = jax.vmap(per_class)(sv)          # (C, N)
+        if 0 <= background_label < c:
+            keep_cn = keep_cn.at[background_label].set(False)
+        flat = jnp.where(keep_cn, sv, -jnp.inf).reshape(-1)
+        k = min(keep_top_k, c * n)
+        top_s, top_i = jax.lax.top_k(flat, k)
+        cls = (top_i // n).astype(jnp.float32)
+        bix = top_i % n
+        valid = jnp.isfinite(top_s)
+        rows = jnp.concatenate(
+            [cls[:, None], jnp.where(valid, top_s, -1.0)[:, None],
+             bv[bix]], axis=1)
+        rows = jnp.where(valid[:, None], rows, -1.0)
+        if k < keep_top_k:
+            rows = jnp.concatenate(
+                [rows, jnp.full((keep_top_k - k, 6), -1.0)], axis=0)
+        return rows, jnp.sum(valid.astype(jnp.int32))
+
+    rows, count = raw(bv, sv)
+    return (Tensor(rows, stop_gradient=True),
+            Tensor(count, stop_gradient=True))

@@ -481,3 +481,70 @@ def test_generate_proposals_matches_numpy():
     assert rn.shape[0] == len(kept)
     np.testing.assert_allclose(rn, want_boxes, rtol=1e-4, atol=1e-3)
     np.testing.assert_allclose(pn[:, 0], want_scores, rtol=1e-5, atol=1e-6)
+
+
+def test_nms_padded_matches_host_nms():
+    rng = np.random.RandomState(7)
+    boxes = rng.rand(24, 4).astype("float32") * 40
+    boxes[:, 2:] = boxes[:, :2] + rng.rand(24, 2).astype("float32") * 25
+    scores = rng.rand(24).astype("float32")
+    host = ops.nms(paddle.to_tensor(boxes), 0.4,
+                   paddle.to_tensor(scores)).numpy()
+    idx, count = ops.nms_padded(paddle.to_tensor(boxes),
+                                paddle.to_tensor(scores), 0.4, max_out=24)
+    got = idx.numpy()[:int(count)]
+    assert (got == host).all(), (got, host)
+    assert (idx.numpy()[int(count):] == -1).all()
+    # truncation respects max_out
+    idx2, count2 = ops.nms_padded(paddle.to_tensor(boxes),
+                                  paddle.to_tensor(scores), 0.4, max_out=3)
+    assert int(count2) <= 3 and (idx2.numpy()[:int(count2)] == host[:3][:int(count2)]).all()
+
+
+def test_multiclass_nms_padded_matches_host():
+    rng = np.random.RandomState(8)
+    n, c = 18, 4
+    boxes = rng.rand(n, 4).astype("float32") * 30
+    boxes[:, 2:] = boxes[:, :2] + rng.rand(n, 2).astype("float32") * 20
+    scores = rng.rand(c, n).astype("float32")
+    host, host_count = ops.multiclass_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.3, nms_top_k=10, keep_top_k=12,
+        nms_threshold=0.4, background_label=0)
+    rows, count = ops.multiclass_nms_padded(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.3, nms_top_k=10, keep_top_k=12,
+        nms_threshold=0.4, background_label=0)
+    assert int(count) == host_count
+    hv, rv = host.numpy(), rows.numpy()
+    # same (label, score) multiset and same boxes, up to equal-score order
+    np.testing.assert_allclose(np.sort(rv[:int(count), 1])[::-1],
+                               np.sort(hv[:host_count, 1])[::-1], rtol=1e-5)
+    for i in range(int(count)):
+        match = np.isclose(hv[:host_count, 1], rv[i, 1], rtol=1e-5)
+        assert match.any()
+        j = int(np.argmax(match))
+        np.testing.assert_allclose(rv[i, 2:], hv[j, 2:], rtol=1e-4)
+        assert rv[i, 0] == hv[j, 0]
+    assert (rv[int(count):] == -1.0).all()
+
+
+def test_nms_padded_jittable_eval_loop():
+    """The point of the padded variants: they compile inside jit."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor, unwrap
+
+    @jax.jit
+    def eval_step(boxes, scores):
+        rows, count = ops.multiclass_nms_padded(
+            Tensor(boxes), Tensor(scores), score_threshold=0.2,
+            nms_top_k=8, keep_top_k=6, nms_threshold=0.5)
+        return unwrap(rows), unwrap(count)
+
+    rng = np.random.RandomState(9)
+    boxes = rng.rand(10, 4).astype("float32") * 20
+    boxes[:, 2:] = boxes[:, :2] + 5
+    rows, count = eval_step(jnp.asarray(boxes),
+                            jnp.asarray(rng.rand(3, 10).astype("float32")))
+    assert rows.shape == (6, 6) and int(count) >= 1

@@ -115,3 +115,34 @@ def test_grad_scaler_explicit_unscale_then_step_not_double_unscaled():
     np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
     # and the update magnitude is the unscaled one: w - lr*2
     np.testing.assert_allclose(run(True), 1.0 - 0.1 * 2.0, rtol=1e-5)
+
+
+def test_monitor_stat_counters():
+    """STAT registry (reference platform/monitor.h:77 STAT_ADD/StatRegistry):
+    counters bump from hot paths, surface in profiler.summary(), reset via
+    flags."""
+    from paddle_tpu.utils import monitor, profiler as prof
+    monitor.stat_reset()
+    monitor.STAT_ADD("STAT_test_counter", 5)
+    monitor.STAT_ADD("STAT_test_counter", 2)
+    monitor.STAT_SUB("STAT_test_counter", 1)
+    assert monitor.stat_get("STAT_test_counter") == 6
+    assert prof.summary()["__stats__"]["STAT_test_counter"] == 6
+
+    # dataloader instrumentation
+    from paddle_tpu.io import DataLoader
+    class DS:
+        def __len__(self):
+            return 8
+        def __getitem__(self, i):
+            return np.ones((4,), "float32"), np.int64(i % 2)
+    before = monitor.stat_get("STAT_dataloader_batch_count")
+    for _ in DataLoader(DS(), batch_size=4, num_workers=0):
+        pass
+    assert monitor.stat_get("STAT_dataloader_batch_count") == before + 2
+    assert monitor.stat_get("STAT_dataloader_bytes") > 0
+
+    # reset through the flag system
+    paddle.utils.flags.set_flags({"FLAGS_reset_stats": True})
+    assert monitor.stat_get("STAT_test_counter") == 0
+    assert "__stats__" not in prof.summary()
