@@ -1,3 +1,4 @@
 from . import dtype, device, rng, op, tape  # noqa: F401
 from .tensor import (Tensor, Parameter, no_grad, enable_grad,  # noqa: F401
                      is_grad_enabled, set_grad_enabled, unwrap, wrap)
+from . import errors  # noqa: F401,E402

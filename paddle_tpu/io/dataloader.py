@@ -223,6 +223,15 @@ class DataLoader:
         self.multiprocessing_context = multiprocessing_context
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
+            if num_workers:
+                import warnings
+                warnings.warn(
+                    "DataLoader(num_workers>0) over an IterableDataset "
+                    "runs single-process: parallel workers would need "
+                    "stream sharding the dataset does not declare "
+                    "(map-style datasets DO use the worker pool)",
+                    stacklevel=2)
+                self.num_workers = 0
             self.batch_sampler = None
             self.batch_size = batch_size
             self.drop_last = drop_last

@@ -205,3 +205,112 @@ void PD_DeletePredictor(void* handle) {
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Standalone trainer ABI (reference: paddle/fluid/train/demo/demo_trainer.cc
+// loads a saved ProgramDesc and drives the executor; here the artifact is an
+// exported StableHLO train step — jit/train_export.py — and the embedded
+// runtime replays it batch by batch).
+
+namespace {
+struct Trainer {
+  PyObject* sess;  // paddle_tpu.jit.train_export.TrainSession
+};
+}  // namespace
+
+extern "C" {
+
+void* PD_CreateTrainer(const char* model_prefix) {
+  PyGILState_STATE gil = PyGILState_Ensure();
+  void* result = nullptr;
+  PyObject* mod = PyImport_ImportModule("paddle_tpu.jit.train_export");
+  if (mod == nullptr) {
+    capture_py_error("import paddle_tpu.jit.train_export");
+  } else {
+    PyObject* sess =
+        PyObject_CallMethod(mod, "TrainSession", "(s)", model_prefix);
+    if (sess == nullptr) {
+      capture_py_error("TrainSession");
+    } else {
+      result = new Trainer{sess};
+    }
+    Py_DECREF(mod);
+  }
+  PyGILState_Release(gil);
+  return result;
+}
+
+// One optimizer step on a float32 feature buffer + int64 label buffer.
+// Returns 0 on success and writes the step's loss.
+int PD_TrainerStep(void* handle, const float* feats, const int64_t* fshape,
+                   int fndim, const int64_t* labels, const int64_t* lshape,
+                   int lndim, float* loss_out) {
+  if (handle == nullptr) {
+    g_last_error = "null trainer";
+    return 1;
+  }
+  Trainer* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = 1;
+  PyObject *np = nullptr, *farr = nullptr, *larr = nullptr, *loss = nullptr;
+  do {
+    np = PyImport_ImportModule("numpy");
+    if (np == nullptr) { capture_py_error("import numpy"); break; }
+    int64_t fn = 1, ln = 1;
+    for (int i = 0; i < fndim; ++i) fn *= fshape[i];
+    for (int i = 0; i < lndim; ++i) ln *= lshape[i];
+
+    PyObject* fmv = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<float*>(feats)),
+        fn * sizeof(float), PyBUF_READ);
+    if (fmv == nullptr) { capture_py_error("feat memoryview"); break; }
+    farr = PyObject_CallMethod(np, "frombuffer", "(Os)", fmv, "float32");
+    Py_DECREF(fmv);
+    if (farr == nullptr) { capture_py_error("np.frombuffer feats"); break; }
+    PyObject* fshp = PyTuple_New(fndim);
+    for (int i = 0; i < fndim; ++i)
+      PyTuple_SET_ITEM(fshp, i, PyLong_FromLongLong(fshape[i]));
+    PyObject* fre = PyObject_CallMethod(farr, "reshape", "(N)", fshp);
+    if (fre == nullptr) { capture_py_error("reshape feats"); break; }
+    Py_DECREF(farr);
+    farr = fre;
+
+    PyObject* lmv = PyMemoryView_FromMemory(
+        reinterpret_cast<char*>(const_cast<int64_t*>(labels)),
+        ln * sizeof(int64_t), PyBUF_READ);
+    if (lmv == nullptr) { capture_py_error("label memoryview"); break; }
+    larr = PyObject_CallMethod(np, "frombuffer", "(Os)", lmv, "int64");
+    Py_DECREF(lmv);
+    if (larr == nullptr) { capture_py_error("np.frombuffer labels"); break; }
+    PyObject* lshp = PyTuple_New(lndim);
+    for (int i = 0; i < lndim; ++i)
+      PyTuple_SET_ITEM(lshp, i, PyLong_FromLongLong(lshape[i]));
+    PyObject* lre = PyObject_CallMethod(larr, "reshape", "(N)", lshp);
+    if (lre == nullptr) { capture_py_error("reshape labels"); break; }
+    Py_DECREF(larr);
+    larr = lre;
+
+    loss = PyObject_CallMethod(t->sess, "step", "(OO)", farr, larr);
+    if (loss == nullptr) { capture_py_error("TrainSession.step"); break; }
+    *loss_out = static_cast<float>(PyFloat_AsDouble(loss));
+    if (PyErr_Occurred()) { capture_py_error("loss to float"); break; }
+    rc = 0;
+  } while (false);
+  Py_XDECREF(np);
+  Py_XDECREF(farr);
+  Py_XDECREF(larr);
+  Py_XDECREF(loss);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+void PD_DeleteTrainer(void* handle) {
+  if (handle == nullptr) return;
+  Trainer* t = static_cast<Trainer*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(t->sess);
+  PyGILState_Release(gil);
+  delete t;
+}
+
+}  // extern "C"

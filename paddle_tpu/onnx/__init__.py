@@ -18,8 +18,13 @@ def export(layer, path, input_spec=None, opset_version=9, **configs):
     """Export `layer` for interchange.
 
     Always writes the StableHLO artifact (`{path}.pdmodel` + weights) via
-    paddle.jit.save; converts to `{path}.onnx` when the `onnx` package is
-    importable, else raises ImportError after the StableHLO artifact is
+    paddle.jit.save; when the `onnx` package is importable it ALSO writes
+    `{path}.onnx` — but note that file is a single custom-domain
+    ("ai.paddle_tpu") node CARRYING the StableHLO bytes, not a standard
+    op-by-op ONNX graph: no stock ONNX runtime can execute it without a
+    StableHLO-aware plugin.  Serve the .pdmodel with
+    paddle_tpu.inference / paddle_tpu.jit.load instead.  Without `onnx`
+    installed this raises ImportError after the StableHLO artifact is
     written (mirroring the reference's hard paddle2onnx dependency,
     python/paddle/onnx/export.py:1).
     """

@@ -31,15 +31,14 @@ def create_mesh(axes: Optional[Dict[str, int]] = None,
     the analogue of putting the hierarchical-allreduce inner ring on NVLink
     (distributed_strategy.proto:128).
     """
+    from ..core.errors import enforce
     sizes = dict(axes or {})
     sizes.update(axis_sizes)
     for a in sizes:
-        if a not in AXES:
-            raise ValueError(f"unknown mesh axis {a!r}; valid: {AXES}")
+        enforce(a in AXES, f"unknown mesh axis {a!r}; valid: {AXES}")
     devices = list(devices if devices is not None else jax.devices())
     n = int(np.prod([sizes.get(a, 1) for a in AXES]))
-    if n > len(devices):
-        raise ValueError(
+    enforce(n <= len(devices),
             f"mesh wants {n} devices but only {len(devices)} available")
     shape = tuple(sizes.get(a, 1) for a in AXES)
     arr = np.array(devices[:n]).reshape(shape)

@@ -310,7 +310,12 @@ def multiclass_nms(bboxes, scores, score_threshold, nms_top_k,
     """Per-class NMS + global top-k (reference:
     detection/multiclass_nms_op).  bboxes (N, 4), scores (C, N).
     Returns (out (keep_top_k, 6) rows [label, score, x1, y1, x2, y2]
-    padded with -1, valid_count)."""
+    padded with -1, valid_count).
+
+    HOST-side eval/postprocessing path (per-class Python loop + numpy
+    compaction) — it cannot run inside jit.  For a jitted eval loop or
+    on-device serving use `multiclass_nms_padded`, which has the same
+    selection semantics with static shapes throughout."""
     bv = np.asarray(jax.device_get(unwrap(bboxes)))
     sv = np.asarray(jax.device_get(unwrap(scores)))
     c, n = sv.shape

@@ -167,3 +167,24 @@ def test_shape_op():
     assert paddle.shape(t).numpy().tolist() == [3, 4]
     assert paddle.numel(t).item() == 12
     assert paddle.rank(t).item() == 2
+
+
+def test_typed_error_taxonomy():
+    """enforce.h/errors.h parity: typed codes that also subclass the
+    natural builtin (so existing `except ValueError` keeps working)."""
+    from paddle_tpu.core import errors as E
+    with pytest.raises(E.EnforceNotMet):
+        E.enforce(False, "nope")
+    with pytest.raises(ValueError):
+        E.enforce(False, "nope")  # InvalidArgumentError IS a ValueError
+    with pytest.raises(E.InvalidArgumentError, match=r"\[InvalidArgument\]"):
+        E.enforce_eq(1, 2)
+    assert issubclass(E.NotFoundError, FileNotFoundError)
+    assert issubclass(E.UnimplementedError, NotImplementedError)
+    assert issubclass(E.ResourceExhaustedError, MemoryError)
+    # framework call sites raise typed errors that remain ValueError
+    from paddle_tpu import parallel
+    with pytest.raises(E.InvalidArgumentError):
+        parallel.create_mesh({"bogus": 2})
+    with pytest.raises(ValueError):
+        parallel.create_mesh({"bogus": 2})
