@@ -1,0 +1,25 @@
+# paddle_tpu inference from R (reference: r/example/mobilenet.r upstream).
+# Usage: Rscript predictor.r <model_prefix>
+library(reticulate)
+
+args <- commandArgs(trailingOnly = TRUE)
+if (length(args) < 1) stop("usage: Rscript predictor.r <model_prefix>")
+
+np <- import("numpy")
+inference <- import("paddle_tpu.inference")
+
+config <- inference$Config(args[1])
+predictor <- inference$create_predictor(config)
+
+in_names <- predictor$get_input_names()
+input_h <- predictor$get_input_handle(in_names[[1]])
+
+x <- np$ones(c(2L, 4L), dtype = "float32")
+input_h$copy_from_cpu(x)
+predictor$run()
+
+out_names <- predictor$get_output_names()
+output_h <- predictor$get_output_handle(out_names[[1]])
+result <- output_h$copy_to_cpu()
+print(dim(result))
+print(result)
