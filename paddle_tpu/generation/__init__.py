@@ -95,6 +95,17 @@ def _model_fns(model):
     return state_arrays(model), apply_fixed
 
 
+def _cached_jit(model, cfg_key, fn):
+    """Per-model cache of compiled decode loops: generate() with the same
+    shapes/strategy must not re-trace on every call (a fresh closure would
+    defeat jax.jit's cache in serving loops)."""
+    cache = model.__dict__.setdefault("_generate_jit_cache", {})
+    jitted = cache.get(cfg_key)
+    if jitted is None:
+        jitted = cache[cfg_key] = jax.jit(fn)
+    return jitted
+
+
 def generate(model, input_ids, max_length=None, max_new_tokens=None,
              decode_strategy: str = "greedy_search", temperature=1.0,
              top_k=0, top_p=1.0, num_beams=1, length_penalty=0.0,
@@ -148,7 +159,7 @@ def generate(model, input_ids, max_length=None, max_new_tokens=None,
 
 def _sample_loop(state, apply_fixed, model, ids, max_new, total, greedy,
                  temperature, top_k, top_p, eos, pad, key):
-    b = ids.shape[0]
+    b, prompt_len_ = ids.shape
     caches = model.gen_fixed_cache(b, total)
 
     def run(state, ids, caches, key):
@@ -180,7 +191,16 @@ def _sample_loop(state, apply_fixed, model, ids, max_new, total, greedy,
         carry, toks = jax.lax.scan(body, init, None, length=max_new)
         return toks.T, carry[5]
 
-    return jax.jit(run)(state, ids, caches, key)
+    fn = _cached_jit(
+        model,
+        ("sample", b, prompt_len_, max_new, total, greedy,
+         # None and 1.0 genuinely alias (both mean "no tempering");
+         # 0.0 must NOT fold into them
+         float(1.0 if temperature is None else temperature),
+         int(top_k or 0),
+         float(1.0 if top_p is None else top_p), eos, pad),
+        run)
+    return fn(state, ids, caches, key)
 
 
 def _beam_search(state, apply_fixed, model, ids, max_new, total, k, eos,
@@ -239,4 +259,7 @@ def _beam_search(state, apply_fixed, model, ids, max_new, total, k, eos,
         sc = jnp.take_along_axis(ranked, best[:, None], axis=1)[:, 0]
         return out, sc
 
-    return jax.jit(run)(state, ids, caches)
+    fn = _cached_jit(model,
+                     ("beam", b, prompt_len, max_new, total, k, eos, pad,
+                      float(length_penalty)), run)
+    return fn(state, ids, caches)
