@@ -96,3 +96,13 @@ enable_static = lambda *a, **k: None  # noqa: E731
 def in_dynamic_mode():
     return True
 from . import generation  # noqa: F401,E402
+from .compat import (tensordot, has_inf, has_nan,  # noqa: F401,E402
+                     elementwise_floordiv, elementwise_mod, elementwise_pow,
+                     reduce_max, reduce_min, reduce_mean, reduce_prod,
+                     reduce_sum, fill_constant, create_global_var, data,
+                     LoDTensor, LoDTensorArray,
+                     get_tensor_from_selected_rows,
+                     monkey_patch_math_varbase, monkey_patch_variable,
+                     crop_tensor, enable_dygraph, disable_dygraph,
+                     in_dygraph_mode)
+VarBase = Tensor  # fluid-era Tensor name

@@ -1,0 +1,162 @@
+"""Fluid-1.x top-level compatibility surface + stragglers.
+
+Reference: python/paddle/__init__.py re-exports a handful of fluid-era
+names next to the 2.0 API (elementwise_*/reduce_* math aliases,
+fill_constant/create_global_var/data graph builders, LoDTensor handles,
+monkey_patch_* bootstrap hooks).  Users migrating from the reference hit
+these immediately, so they exist here with 2.0-native semantics: LoD is
+subsumed by masked-dense tensors, `data` returns an InputSpec (tracing is
+the program capture), and the monkey-patchers are no-ops (Tensor carries
+its operators natively).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.tensor import Tensor, unwrap
+from .core import dtype as _dt
+
+__all__ = [
+    "tensordot", "has_inf", "has_nan", "elementwise_floordiv",
+    "elementwise_mod", "elementwise_pow", "reduce_max", "reduce_min",
+    "reduce_mean", "reduce_prod", "reduce_sum", "fill_constant",
+    "create_global_var", "data", "LoDTensor", "LoDTensorArray",
+    "get_tensor_from_selected_rows", "monkey_patch_math_varbase",
+    "monkey_patch_variable",
+]
+
+
+def tensordot(x, y, axes=2, name=None):
+    """paddle.tensordot (reference python/paddle/tensor/manipulation.py)."""
+    if isinstance(axes, Tensor):
+        axes = axes.tolist()
+    if isinstance(axes, (list, tuple)) and len(axes) == 2 \
+            and isinstance(axes[0], (list, tuple)):
+        axes = (tuple(axes[0]), tuple(axes[1]))
+    from .core.op import dispatch
+    return dispatch("tensordot",
+                    lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def has_inf(x, name=None):
+    from .core.op import dispatch
+    return dispatch("has_inf", lambda v: jnp.any(jnp.isinf(v)), x)
+
+
+def has_nan(x, name=None):
+    from .core.op import dispatch
+    return dispatch("has_nan", lambda v: jnp.any(jnp.isnan(v)), x)
+
+
+# fluid 1.x elementwise_*/reduce_* spellings over the 2.0 ops
+def elementwise_floordiv(x, y, name=None):
+    from .tensor.math import floor_divide
+    return floor_divide(x, y)
+
+
+def elementwise_mod(x, y, name=None):
+    from .tensor.math import mod
+    return mod(x, y)
+
+
+def elementwise_pow(x, y, name=None):
+    from .tensor.math import pow as _pow
+    return _pow(x, y)
+
+
+def _reduce(fn_name, x, dim=None, keep_dim=False, name=None):
+    from . import tensor as T
+    fn = getattr(T, fn_name)
+    return fn(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce("max", input, dim, keep_dim)
+
+
+def reduce_min(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce("min", input, dim, keep_dim)
+
+
+def reduce_mean(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce("mean", input, dim, keep_dim)
+
+
+def reduce_prod(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce("prod", input, dim, keep_dim)
+
+
+def reduce_sum(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    return _reduce("sum", input, dim, keep_dim)
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    from .tensor.creation import full
+    return full(shape, value, dtype=dtype)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """A mutable named global tensor (reference layers/tensor.py
+    create_global_var) — here simply a trainable=False Tensor."""
+    from .tensor.creation import full
+    t = full(shape, value, dtype=dtype)
+    t.stop_gradient = not persistable
+    if name:
+        t.name = name
+    return t
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """Static-graph input declaration.  Tracing replaces Program
+    construction, so this returns a paddle.static.InputSpec (usable with
+    jit.save / to_static input_spec)."""
+    from .jit import InputSpec
+    return InputSpec(shape, dtype, name)
+
+
+# LoD handles: LoD itself is subsumed by masked-dense tensors +
+# paddle_tpu.nn.functional.sequence (SURVEY §2.1); the names remain so
+# isinstance checks and annotations keep working.
+LoDTensor = Tensor
+
+
+class LoDTensorArray(list):
+    """reference: fluid LoDTensorArray — a list of tensors."""
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """Densify a row-sparse gradient (reference:
+    operators/get_tensor_from_selected_rows_op)."""
+    from .core.selected_rows import RowSparseGrad
+    if isinstance(x, RowSparseGrad):
+        return Tensor(x.to_dense())
+    return x if isinstance(x, Tensor) else Tensor(unwrap(x))
+
+
+def monkey_patch_math_varbase():
+    """no-op: Tensor defines its operators natively."""
+
+
+def monkey_patch_variable():
+    """no-op: tracing replaces Variable."""
+
+
+def crop_tensor(x, shape=None, offsets=None, name=None):
+    """fluid spelling of paddle.crop (crop_tensor_op)."""
+    from .tensor.manipulation import crop
+    return crop(x, shape, offsets)
+
+
+def enable_dygraph(place=None):
+    """no-op: eager IS the default execution mode here."""
+
+
+def disable_dygraph():
+    from . import enable_static
+    enable_static()
+
+
+def in_dygraph_mode():
+    from . import in_dynamic_mode
+    return in_dynamic_mode()

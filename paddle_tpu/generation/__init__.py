@@ -191,15 +191,16 @@ def _sample_loop(state, apply_fixed, model, ids, max_new, total, greedy,
         carry, toks = jax.lax.scan(body, init, None, length=max_new)
         return toks.T, carry[5]
 
-    fn = _cached_jit(
-        model,
-        ("sample", b, prompt_len_, max_new, total, greedy,
-         # None and 1.0 genuinely alias (both mean "no tempering");
-         # 0.0 must NOT fold into them
-         float(1.0 if temperature is None else temperature),
-         int(top_k or 0),
-         float(1.0 if top_p is None else top_p), eos, pad),
-        run)
+    if greedy:  # tempering/filtering params don't affect the greedy trace
+        cfg_key = ("sample", b, prompt_len_, max_new, total, True, eos, pad)
+    else:
+        cfg_key = ("sample", b, prompt_len_, max_new, total, False,
+                   # None and 1.0 genuinely alias (both mean "no
+                   # tempering"); 0.0 must NOT fold into them
+                   float(1.0 if temperature is None else temperature),
+                   int(top_k or 0),
+                   float(1.0 if top_p is None else top_p), eos, pad)
+    fn = _cached_jit(model, cfg_key, run)
     return fn(state, ids, caches, key)
 
 

@@ -1,0 +1,159 @@
+"""Linear-chain CRF: training loss + viterbi decoding, and hierarchical
+sigmoid loss.
+
+Reference: operators/linear_chain_crf_op.cc (forward algorithm over LoD
+sequences, transition matrix with start/stop rows), crf_decoding_op.cc
+(viterbi), hierarchical_sigmoid_op.cc (MatrixBitCode SimpleCode complete
+binary tree).  TPU-native: LoD sequences become padded (B, T, ...) + a
+length vector; the forward/viterbi recursions are `lax.scan` over time with
+masking, so everything jits with static shapes and differentiates via
+jax.grad (no hand-written grad kernels).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import dispatch
+
+__all__ = ["linear_chain_crf", "crf_decoding", "hsigmoid_loss"]
+
+_NEG = -1e30
+
+
+def linear_chain_crf(input, label, transition, length, name=None):  # noqa: A002
+    """Negative log-likelihood of a linear-chain CRF.
+
+    input: (B, T, n) emission scores; label: (B, T) int tags;
+    transition: (n + 2, n) — row 0 start weights, row 1 stop weights,
+    rows 2.. the tag-to-tag transitions; length: (B,) valid timesteps.
+    Returns (B, 1) NLL (the reference kernel's output convention).
+    """
+    def raw(emit, lab, trans, lens):
+        b, t, n = emit.shape
+        emit = emit.astype(jnp.float32)
+        trans = trans.astype(jnp.float32)
+        start, stop, step_tr = trans[0], trans[1], trans[2:]
+        lab = lab.astype(jnp.int32)
+        valid = jnp.arange(t)[None, :] < lens[:, None]      # (B, T)
+
+        # --- gold path score ---
+        e_score = jnp.take_along_axis(emit, lab[:, :, None],
+                                      axis=2)[..., 0]      # (B, T)
+        path = jnp.sum(jnp.where(valid, e_score, 0.0), axis=1)
+        path = path + start[lab[:, 0]]
+        tr_score = step_tr[lab[:, :-1], lab[:, 1:]]         # (B, T-1)
+        path = path + jnp.sum(jnp.where(valid[:, 1:], tr_score, 0.0),
+                              axis=1)
+        last_ix = jnp.clip(lens - 1, 0)
+        last_tag = jnp.take_along_axis(lab, last_ix[:, None], axis=1)[:, 0]
+        path = path + stop[last_tag]
+
+        # --- partition function (forward algorithm) ---
+        def body(alpha, xs):
+            em_t, valid_t = xs                              # (B, n), (B,)
+            nxt = jax.nn.logsumexp(
+                alpha[:, :, None] + step_tr[None], axis=1) + em_t
+            return jnp.where(valid_t[:, None], nxt, alpha), None
+
+        alpha0 = start[None] + emit[:, 0]
+        alpha, _ = jax.lax.scan(
+            body, alpha0,
+            (jnp.moveaxis(emit[:, 1:], 1, 0),
+             jnp.moveaxis(valid[:, 1:], 1, 0)))
+        logz = jax.nn.logsumexp(alpha + stop[None], axis=1)
+        return (logz - path)[:, None]
+    return dispatch("linear_chain_crf", raw, input, label, transition,
+                    length)
+
+
+def crf_decoding(input, transition, length, name=None):  # noqa: A002
+    """Viterbi decode: (B, T) best tag path (0-padded past each length)."""
+    def raw(emit, trans, lens):
+        b, t, n = emit.shape
+        emit = emit.astype(jnp.float32)
+        trans = trans.astype(jnp.float32)
+        start, stop, step_tr = trans[0], trans[1], trans[2:]
+        valid = jnp.arange(t)[None, :] < lens[:, None]
+
+        def body(score, xs):
+            em_t, valid_t = xs
+            cand = score[:, :, None] + step_tr[None]        # (B, n, n)
+            best = jnp.max(cand, axis=1) + em_t
+            ptr = jnp.argmax(cand, axis=1).astype(jnp.int32)
+            keep = valid_t[:, None]
+            return jnp.where(keep, best, score), \
+                jnp.where(keep, ptr,
+                          jnp.broadcast_to(jnp.arange(n, dtype=jnp.int32),
+                                           (b, n)))
+
+        score0 = start[None] + emit[:, 0]
+        score, ptrs = jax.lax.scan(
+            body, score0,
+            (jnp.moveaxis(emit[:, 1:], 1, 0),
+             jnp.moveaxis(valid[:, 1:], 1, 0)))             # (T-1, B, n)
+        last = jnp.argmax(score + stop[None], axis=1).astype(jnp.int32)
+
+        def back(tag, ptr_t):
+            prev = jnp.take_along_axis(ptr_t, tag[:, None],
+                                       axis=1)[:, 0]
+            return prev, tag
+
+        # reverse scan emits the tag at position u+1 into slot u and its
+        # final carry is the tag at position 0
+        first, tags_rev = jax.lax.scan(back, last, ptrs, reverse=True)
+        tags = jnp.concatenate(
+            [first[:, None], jnp.moveaxis(tags_rev, 0, 1)], axis=1)
+        return jnp.where(valid, tags, 0)
+    return dispatch("crf_decoding", raw, input, transition, length)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,  # noqa: A002
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference: hierarchical_sigmoid_op,
+    MatrixBitCode SimpleCode): the default complete binary tree over
+    `num_classes` leaves, or a custom tree via path_table/path_code.
+    input (B, D), label (B,), weight (num_classes-1, D), bias
+    (num_classes-1,).  Returns (B, 1)."""
+    max_len = max(int(math.ceil(math.log2(max(num_classes, 2)))), 1)
+
+    def default_paths(lab):
+        # SimpleCode: c = label + num_classes; path node i (from the root)
+        # has table index (c >> (len - i)) - 1 and bit (c >> (len-1-i)) & 1
+        c = lab.astype(jnp.int32) + num_classes
+        length = jnp.floor(
+            jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+        i = jnp.arange(max_len)[None, :]
+        active = i < length[:, None]
+        idx = (c[:, None] >> jnp.maximum(length[:, None] - i, 0)) - 1
+        bit = (c[:, None] >> jnp.maximum(length[:, None] - 1 - i, 0)) & 1
+        return idx, bit.astype(jnp.float32), active
+
+    def raw(x, lab, w, bv):
+        if path_table is not None:
+            from ...core.tensor import unwrap
+            idx = unwrap(path_table).astype(jnp.int32)
+            code = unwrap(path_code).astype(jnp.float32)
+            active = idx >= 0
+            idx = jnp.clip(idx, 0)
+        else:
+            idx, code, active = default_paths(lab)
+        wn = w[idx]                                         # (B, L, D)
+        pre = jnp.einsum("bld,bd->bl", wn.astype(jnp.float32),
+                         x.astype(jnp.float32))
+        if bv is not None:
+            pre = pre + bv[idx]
+        # BCE with the path bit as the label, summed over active nodes
+        loss = jnp.maximum(pre, 0) - pre * code + \
+            jnp.log1p(jnp.exp(-jnp.abs(pre)))
+        return jnp.sum(jnp.where(active, loss, 0.0), axis=1,
+                       keepdims=True)
+
+    if bias is not None:
+        return dispatch("hsigmoid_loss", raw, input, label, weight, bias)
+    return dispatch("hsigmoid_loss",
+                    lambda x, l, w: raw(x, l, w, None),
+                    input, label, weight)

@@ -1,0 +1,132 @@
+"""Vision functionals: affine_grid / grid_sample / temporal_shift.
+
+Reference: operators/affine_grid_op.cc, grid_sampler_op.cc (cudnn spatial
+transformer kernels), temporal_shift_op.cc — surfaced via
+python/paddle/nn/functional/vision.py.  TPU-native: the sampler is the same
+vectorized bilinear corner-gather used by deform_conv2d/roi_align (take
+along flattened spatial + weighted sum — XLA fuses it; fully
+differentiable), not a cudnn descriptor call.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.op import dispatch
+
+__all__ = ["affine_grid", "grid_sample", "temporal_shift"]
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta (N, 2, 3) -> sampling grid (N, H, W, 2) in [-1, 1] coords."""
+    from ...core.tensor import unwrap
+    if not isinstance(out_shape, (list, tuple)):
+        out_shape = [int(v) for v in unwrap(out_shape)]
+    n, _, h, w = [int(v) for v in out_shape]
+
+    def raw(theta):
+        def axis_coords(size):
+            if align_corners:
+                return jnp.linspace(-1.0, 1.0, size)
+            step = 2.0 / size
+            return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+        xs = axis_coords(w)
+        ys = axis_coords(h)
+        gx, gy = jnp.meshgrid(xs, ys)              # (H, W)
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (H, W, 3)
+        # (N, 2, 3) x (H, W, 3) -> (N, H, W, 2)
+        return jnp.einsum("nij,hwj->nhwi", theta.astype(jnp.float32), base)
+    return dispatch("affine_grid", raw, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x (N, C, H, W) at grid (N, Hg, Wg, 2) of [-1, 1] xy coords.
+
+    modes: bilinear | nearest; padding_mode: zeros | border | reflection.
+    """
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+
+    def raw(xv, gv):
+        n, c, h, w = xv.shape
+        gx = gv[..., 0].astype(jnp.float32)        # (N, Hg, Wg)
+        gy = gv[..., 1].astype(jnp.float32)
+
+        def unnorm(coord, size):
+            if align_corners:
+                return (coord + 1.0) * (size - 1) / 2.0
+            return ((coord + 1.0) * size - 1.0) / 2.0
+
+        fx = unnorm(gx, w)
+        fy = unnorm(gy, h)
+
+        if padding_mode == "reflection":
+            def reflect(v, size):
+                if align_corners:
+                    span = 2.0 * (size - 1)
+                    v = jnp.abs(jnp.mod(v, span))
+                    return jnp.where(v > size - 1, span - v, v)
+                span = 2.0 * size
+                v = jnp.mod(v + 0.5, span)
+                v = jnp.abs(v) - 0.5
+                v = jnp.where(v > size - 0.5, span - 1.0 - v - 0.5 - 0.5, v)
+                return jnp.clip(v, 0, size - 1)
+            fx = reflect(fx, w)
+            fy = reflect(fy, h)
+
+        def gather(yy, xx, wgt=None):
+            inside = (yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1)
+            yc = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            flat = (yc * w + xc).reshape(n, 1, -1)
+            g = jnp.take_along_axis(
+                xv.reshape(n, c, h * w),
+                jnp.broadcast_to(flat, (n, c, flat.shape[-1])), axis=2)
+            g = g.reshape(n, c, *yy.shape[1:])
+            if padding_mode == "zeros":
+                g = g * inside[:, None].astype(g.dtype)
+            if wgt is not None:
+                g = g * wgt[:, None].astype(g.dtype)
+            return g
+
+        if mode == "nearest":
+            return gather(jnp.round(fy), jnp.round(fx))
+
+        y0 = jnp.floor(fy)
+        x0 = jnp.floor(fx)
+        ly = fy - y0
+        lx = fx - x0
+        return (gather(y0, x0, (1 - ly) * (1 - lx))
+                + gather(y0, x0 + 1, (1 - ly) * lx)
+                + gather(y0 + 1, x0, ly * (1 - lx))
+                + gather(y0 + 1, x0 + 1, ly * lx))
+
+    return dispatch("grid_sample", raw, x, grid)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM temporal shift (reference: operators/temporal_shift_op): fold
+    (N*T, C, H, W) into segments and shift the first shift_ratio*C channels
+    back, the next block forward, zero-padding the ends."""
+    if data_format != "NCHW":
+        raise ValueError("temporal_shift: only NCHW here")
+
+    def raw(xv):
+        nt, c, h, w = xv.shape
+        t = seg_num
+        nb = nt // t
+        v = xv.reshape(nb, t, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        back = jnp.concatenate(
+            [v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+        rest = v[:, :, c2:]
+        return jnp.concatenate([back, fwd, rest], axis=2).reshape(
+            nt, c, h, w)
+    return dispatch("temporal_shift", raw, x)

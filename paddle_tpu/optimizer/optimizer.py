@@ -114,8 +114,9 @@ class Optimizer:
             step = jnp.asarray(self._step_count + 1, jnp.int32)
             for i, g in sorted(sparse, reverse=True):
                 p = params[i]
-                db = self._decay_applies(p.name)
-                m = float(p.optimize_attr.get("learning_rate", 1.0))
+                db = self._decay_applies(getattr(p, "name", None))
+                oa = getattr(p, "optimize_attr", None)
+                m = float(oa.get("learning_rate", 1.0)) if oa else 1.0
                 key = ("sparse", db, m)
                 fn = self._jit_cache.get(key)
                 if fn is None:
@@ -140,9 +141,14 @@ class Optimizer:
         p_raw = [p._data for p in params]
         g_raw = [g._data for g in grads]
         states = [self._get_state(p) for p in params]
-        lr_mults = tuple(float(p.optimize_attr.get("learning_rate", 1.0))
-                         for p in params)
-        decay_bits = tuple(self._decay_applies(p.name) for p in params)
+        # plain Tensors (to_tensor(stop_gradient=False)) are optimizable
+        # too, like the reference — they just lack Parameter attrs
+        lr_mults = tuple(float(getattr(p, "optimize_attr", None)
+                               .get("learning_rate", 1.0)
+                               if getattr(p, "optimize_attr", None)
+                               else 1.0) for p in params)
+        decay_bits = tuple(self._decay_applies(getattr(p, "name", None))
+                           for p in params)
         # per-param ParamAttr(regularizer=...) overrides the optimizer-level
         # decay (reference: append_regularization_ops picks the param's own
         # regularizer first)

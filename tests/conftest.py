@@ -75,6 +75,7 @@ _SLOW = {
     "test_generate_eos_padding_and_score", "test_gpt_causal",
     "test_gpt_chunked_decode_matches_full", "test_standalone_c_binary",
     "test_standalone_c_train_binary", "test_train_session_python_side",
+    "test_crf_trains_to_recover_transitions",
 }
 
 
