@@ -106,3 +106,6 @@ from .compat import (tensordot, has_inf, has_nan,  # noqa: F401,E402
                      crop_tensor, enable_dygraph, disable_dygraph,
                      in_dygraph_mode)
 VarBase = Tensor  # fluid-era Tensor name
+from . import version  # noqa: E402
+from .version import full_version  # noqa: F401,E402
+commit = version.commit

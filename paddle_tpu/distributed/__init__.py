@@ -13,3 +13,13 @@ from .spawn import spawn  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+
+
+def prepare_context(strategy=None):
+    """fluid dygraph parallel prepare_context: environment bootstrap for
+    DataParallel (reference dygraph/parallel.py).  jax.distributed handles
+    process wiring here; returns the strategy for API compat."""
+    from . import env as _env
+    if _env.get_world_size() > 1:
+        _env.init_parallel_env()
+    return strategy

@@ -104,3 +104,55 @@ class Categorical(Distribution):
         logq = jax.nn.log_softmax(other.logits, axis=-1)
         p = self._probs()
         return Tensor(jnp.sum(p * (logp - logq), axis=-1))
+
+
+class MultivariateNormalDiag(Distribution):
+    """Diagonal-covariance multivariate normal (reference:
+    python/paddle/distribution.py MultivariateNormalDiag)."""
+
+    def __init__(self, loc, scale):
+        self.loc = unwrap(loc).astype(jnp.float32)
+        self.scale = unwrap(scale).astype(jnp.float32)
+        # a diagonal MATRIX has exactly one more axis than loc; anything
+        # else is a (batch of) scale vectors — shape equality alone would
+        # misread a (D, D) batch of vectors as one matrix
+        if self.scale.ndim == self.loc.ndim + 1 and \
+                self.scale.shape[-1] == self.scale.shape[-2]:
+            self._diag = jnp.diagonal(self.scale, axis1=-2, axis2=-1)
+        else:
+            self._diag = self.scale
+
+    def sample(self, shape=(), seed=0):
+        key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+        eps = jax.random.normal(
+            key, tuple(shape) + self.loc.shape, jnp.float32)
+        return Tensor(self.loc + eps * self._diag)
+
+    def log_prob(self, value):
+        v = unwrap(value).astype(jnp.float32)
+        var = self._diag ** 2
+        d = self.loc.shape[-1]
+        lp = -0.5 * jnp.sum((v - self.loc) ** 2 / var, -1) \
+            - 0.5 * d * jnp.log(2 * jnp.pi) - jnp.sum(jnp.log(self._diag), -1)
+        return Tensor(lp)
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        return Tensor(0.5 * d * (1 + jnp.log(2 * jnp.pi))
+                      + jnp.sum(jnp.log(self._diag), -1))
+
+    def kl_divergence(self, other):
+        v1, v2 = self._diag ** 2, other._diag ** 2
+        kl = 0.5 * jnp.sum(v1 / v2 + (other.loc - self.loc) ** 2 / v2
+                           - 1.0 + jnp.log(v2) - jnp.log(v1), -1)
+        return Tensor(kl)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):  # noqa: A002
+    """Sample one category index per row of a probability matrix
+    (reference: operators/sampling_id_op)."""
+    from ..core.dtype import convert_dtype
+    probs = unwrap(x).astype(jnp.float32)
+    key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+    idx = jax.random.categorical(key, jnp.log(jnp.maximum(probs, 1e-30)))
+    return Tensor(idx.astype(convert_dtype(dtype)))

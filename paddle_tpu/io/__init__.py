@@ -7,3 +7,13 @@ from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
 from .fs import (LocalFS, HDFSClient, get_fs, ExecuteError,  # noqa: F401
                  FSFileExistsError, FSFileNotExistsError, FSTimeOut)
+
+
+from ._worker import WorkerInfo  # noqa: E402
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process: (id, num_workers); None in the
+    main process.  Map-style workers set this via io._worker."""
+    from . import _worker
+    return getattr(_worker, "_worker_info", None)

@@ -95,8 +95,23 @@ def decode(obj):
     return obj
 
 
+_worker_info = None  # set inside worker processes (io.get_worker_info)
+
+
+class WorkerInfo:
+    """reference: paddle.io.get_worker_info — worker id / pool size /
+    dataset handle (lives here so worker processes never import jax)."""
+
+    def __init__(self, id, num_workers, dataset=None):  # noqa: A002
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
 def worker_loop(dataset, collate_fn, task_q, result_q, worker_id,
-                use_shm, worker_init_fn):
+                use_shm, worker_init_fn, num_workers=0):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     while True:

@@ -84,7 +84,8 @@ class _WorkerPool:
         self._procs = [
             ctx.Process(target=_worker_loop,
                         args=(dataset, collate_fn, self._task_q,
-                              self._result_q, wid, use_shm, worker_init_fn),
+                              self._result_q, wid, use_shm, worker_init_fn,
+                              num_workers),
                         daemon=True)
             for wid in range(num_workers)]
         try:

@@ -176,3 +176,132 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A
         corr = jnp.any(topk_idx == lbl[..., None], axis=-1)
         return jnp.mean(corr.astype(jnp.float32))
     return dispatch("accuracy", raw, input, label)
+
+
+# ---------------------------------------------------------------------------
+# functional metric ops (reference: python/paddle/metric/metrics.py exposes
+# accuracy + the fluid ops mean_iou / chunk_eval)
+
+
+def mean_iou(input, label, num_classes, name=None):  # noqa: A002
+    """Mean Intersection-over-Union for segmentation (reference:
+    operators/mean_iou_op).  Returns (mean_iou scalar, out_wrong (C,),
+    out_correct (C,))."""
+    import jax.numpy as jnp
+    from ..core.op import dispatch
+
+    def raw(pred, lab):
+        p = pred.reshape(-1).astype(jnp.int32)
+        l = lab.reshape(-1).astype(jnp.int32)  # noqa: E741
+        valid = (l >= 0) & (l < num_classes)
+        correct_mask = valid & (p == l)
+        correct = jnp.zeros((num_classes,), jnp.int32).at[
+            jnp.where(correct_mask, l, num_classes)].add(1, mode="drop")
+        pred_cnt = jnp.zeros((num_classes,), jnp.int32).at[
+            jnp.where(valid, p, num_classes)].add(1, mode="drop")
+        lab_cnt = jnp.zeros((num_classes,), jnp.int32).at[
+            jnp.where(valid, l, num_classes)].add(1, mode="drop")
+        union = pred_cnt + lab_cnt - correct
+        present = union > 0
+        iou = jnp.where(present, correct / jnp.maximum(union, 1), 0.0)
+        miou = jnp.sum(iou) / jnp.maximum(jnp.sum(present), 1)
+        # reference out_wrong = union - correct, so streaming consumers can
+        # rebuild iou = correct / (correct + wrong)
+        wrong = union - correct
+        return miou.astype(jnp.float32), wrong, correct
+    return dispatch("mean_iou", raw, input, label)
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,  # noqa: A002
+               excluded_chunk_types=None, seq_length=None, name=None):
+    """Chunk-level precision/recall/F1 for sequence labeling (reference:
+    operators/chunk_eval_op, schemes IOB/IOE/IOBES/plain).
+
+    input/label: (B, T) int tag ids laid out scheme-major (IOB: tag =
+    chunk_type * 2 + {0: B, 1: I}, IOBES: * 4 + {B, I, E, S}; plain: tag =
+    chunk_type; num_chunk_types * tags_per_scheme is the "outside" tag).
+    Host-side eval metric (like multiclass_nms): returns (precision,
+    recall, f1, num_infer_chunks, num_label_chunks, num_correct_chunks).
+    """
+    import jax
+    pred = np.asarray(jax.device_get(unwrap(input)))
+    lab = np.asarray(jax.device_get(unwrap(label)))
+    if pred.ndim == 1:
+        pred, lab = pred[None], lab[None]
+    lens = (np.asarray(jax.device_get(unwrap(seq_length)))
+            if seq_length is not None
+            else np.full((pred.shape[0],), pred.shape[1]))
+    excluded = set(excluded_chunk_types or ())
+
+    tag_counts = {"IOB": 2, "IOE": 2, "IOBES": 4, "plain": 1}
+    if chunk_scheme not in tag_counts:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(f"unknown chunk_scheme {chunk_scheme!r}")
+    k = tag_counts[chunk_scheme]
+
+    def chunks(seq):
+        """Decode (start, end, type) chunks: one pass, explicit open/close
+        rules per scheme (IOB pos 0=B 1=I; IOE 0=I 1=E; IOBES 0=B 1=I 2=E
+        3=S; plain = maximal same-type runs)."""
+        out = []
+        start = ctype = None
+
+        def close(end):
+            nonlocal start, ctype
+            if start is not None:
+                out.append((start, end, ctype))
+            start = ctype = None
+
+        for i, t in enumerate(seq):
+            t = int(t)
+            if t >= num_chunk_types * k or t < 0:  # outside tag
+                close(i - 1)
+                continue
+            ty, pos = divmod(t, k)
+            if chunk_scheme == "plain":
+                if ctype != ty or start is None:
+                    close(i - 1)
+                    start, ctype = i, ty
+            elif chunk_scheme == "IOB":
+                if pos == 0 or ctype != ty or start is None:
+                    close(i - 1)
+                    start, ctype = i, ty
+            elif chunk_scheme == "IOE":
+                if ctype != ty or start is None:
+                    close(i - 1)
+                    start, ctype = i, ty
+                if pos == 1:  # E includes this position, then closes
+                    close(i)
+            else:  # IOBES
+                if pos == 3:  # S: single-token chunk
+                    close(i - 1)
+                    out.append((i, i, ty))
+                    continue
+                if pos == 0 or ctype != ty or start is None:
+                    close(i - 1)
+                    start, ctype = i, ty
+                if pos == 2:  # E closes including this position
+                    close(i)
+        close(len(seq) - 1)
+        return {c for c in out if c[2] not in excluded}
+
+    n_inf = n_lab = n_cor = 0
+    for b in range(pred.shape[0]):
+        L = int(lens[b])
+        ic = chunks(pred[b, :L])
+        lc = chunks(lab[b, :L])
+        n_inf += len(ic)
+        n_lab += len(lc)
+        n_cor += len(ic & lc)
+    prec = n_cor / n_inf if n_inf else 0.0
+    rec = n_cor / n_lab if n_lab else 0.0
+    f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+    import jax.numpy as jnp
+    mk = lambda v, dt=jnp.float32: Tensor(jnp.asarray(v, dt))  # noqa: E731
+    return (mk(prec), mk(rec), mk(f1), mk(n_inf, jnp.int64),
+            mk(n_lab, jnp.int64), mk(n_cor, jnp.int64))
+
+
+# reference module-name alias (paddle.metric.metrics)
+import sys as _sys
+metrics = _sys.modules[__name__]

@@ -15,3 +15,222 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None, **kw
 
 
 from . import nn  # noqa: F401,E402
+
+
+# ---------------------------------------------------------------------------
+# Static-graph compat shims (reference: python/paddle/static/__init__.py
+# re-exports the fluid Program/Executor machinery).  Tracing subsumes the
+# Program world here (SURVEY §7): these shims keep ported code importable
+# and give the legacy verbs their closest 2.0-native meaning — Executor.run
+# fetches already-computed eager tensors, append_backward/gradients call the
+# tape, scopes are dicts.  They are NOT a second execution engine.
+
+import contextlib as _ctx
+
+import numpy as _np
+
+
+class Program:
+    """Placeholder program object (identity-only: tracing is the capture)."""
+
+    def __init__(self):
+        self._state = {}
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    # block-protocol stubs so introspection code doesn't crash
+    @property
+    def ops(self):
+        return []
+
+    def all_parameters(self):
+        return []
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+@_ctx.contextmanager
+def program_guard(main_program, startup_program=None):
+    """no-op guard: eager/traced execution has no ambient Program."""
+    yield
+
+
+@_ctx.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+class Scope(dict):
+    """Name -> value scope (reference framework::Scope)."""
+
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+@_ctx.contextmanager
+def scope_guard(scope):
+    yield scope
+
+
+def cpu_places(device_count=None):
+    import jax
+    devs = [d for d in jax.devices() if d.platform == "cpu"] or jax.devices()
+    return devs[:device_count] if device_count else devs
+
+
+def cuda_places(device_ids=None):
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+    if device_ids:
+        devs = [devs[i] for i in device_ids if i < len(devs)]
+    return devs
+
+
+class BuildStrategy:
+    """Config holder (XLA owns fusion/memory passes — fields are inert)."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_all_reduce_ops = True
+        self.memory_optimize = True
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """Identity wrapper: jit compilation happens at trace time."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self
+
+
+class Executor:
+    """Legacy Executor verbs over the eager world: run() evaluates/fetches
+    tensors that the (dygraph-executed) model code already produced."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kw):
+        from ..core.tensor import Tensor, unwrap
+        outs = []
+        for f in (fetch_list or []):
+            if isinstance(f, Tensor):
+                outs.append(_np.asarray(unwrap(f)) if return_numpy else f)
+            elif callable(f):
+                r = f(**(feed or {}))
+                outs.append(_np.asarray(unwrap(r)) if return_numpy else r)
+            else:
+                raise TypeError(
+                    "Executor.run fetch_list entries must be Tensors (or "
+                    "callables) in the tracing world — Programs hold no "
+                    "graph to execute; see paddle_tpu.jit.to_static")
+        return outs
+
+    def close(self):
+        pass
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Tape-backed: runs loss.backward() and returns (param, grad) pairs.
+    With parameter_list=None (the dominant fluid pattern) the pairs cover
+    every requires-grad leaf reachable from `loss`, like the reference."""
+    if parameter_list is not None:
+        params = list(parameter_list)
+    else:  # discover leaves from the tape BEFORE backward frees it
+        from ..core import tape as _tape
+        params, seen = [], set()
+        if loss._node is not None:
+            for node in _tape._topo_order([loss._node]):
+                for t in node.inputs:
+                    if (t._node is None and not t.stop_gradient
+                            and id(t) not in seen):
+                        seen.add(id(t))
+                        params.append(t)
+    loss.backward()
+    return [(p, p.grad) for p in params if getattr(p, "grad", None)
+            is not None]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    from ..autograd import grad as _grad
+    outs = _grad(targets, inputs, grad_outputs=target_gradients)
+    return outs
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """Eager: a python function IS an op."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    return func(*xs)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: A002,N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=False,
+          print_phase="both"):
+    msg = message or ""
+    print(f"{msg} shape={tuple(input.shape)} "
+          f"values={_np.asarray(input.numpy()).reshape(-1)[:summarize]}")
+    return input
+
+
+class WeightNormParamAttr:
+    """Accepted for compat; weight normalization is applied via
+    nn.utils-style reparameterization in the 2.0 world, not a Program
+    pass.  Falls back to a plain ParamAttr."""
+
+    def __new__(cls, dim=None, **kwargs):
+        from ..nn.layer_base import ParamAttr
+        return ParamAttr(**kwargs)
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework import load as _load
+    state = _load(model_path + ".pdparams" if not
+                  model_path.endswith(".pdparams") else model_path,
+                  return_numpy=True)
+    return state
+
+
+def set_program_state(program, state_dict):
+    program._state = dict(state_dict)
+
+
+# legacy aliases: ParallelExecutor collapses into CompiledProgram; the
+# closest live object to a Variable is the Tensor itself
+ParallelExecutor = CompiledProgram
+from ..core.tensor import Tensor as Variable  # noqa: E402

@@ -1,0 +1,14 @@
+"""Version metadata (reference: python/paddle/version.py, generated at
+build time)."""
+full_version = "2.0.0-tpu"
+major = "2"
+minor = "0"
+patch = "0"
+rc = "0"
+istaged = False
+commit = "tpu-native-rewrite"
+with_mkl = "OFF"
+
+
+def show():
+    print(f"full_version: {full_version}\ncommit: {commit}")

@@ -1,2 +1,4 @@
 """paddle_tpu.vision (reference: python/paddle/vision/)."""
 from . import models, datasets, transforms, ops  # noqa: F401
+from . import image  # noqa: F401,E402
+from .image import set_image_backend, get_image_backend, image_load  # noqa: F401,E402

@@ -1,0 +1,46 @@
+"""Image IO helpers (reference: python/paddle/vision/image.py —
+set_image_backend/get_image_backend/image_load).  Zero-egress image:
+backends 'cv2'/'pil' exist only if those packages are importable; numpy
+.npy files always load."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_backend = "pil"
+
+
+def set_image_backend(backend):
+    global _backend
+    if backend not in ("pil", "cv2"):
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"image backend must be 'pil' or 'cv2', got {backend!r}")
+    _backend = backend
+
+
+def get_image_backend():
+    return _backend
+
+
+def image_load(path, backend=None):
+    backend = backend or _backend
+    if path.endswith(".npy"):
+        return np.load(path)
+    if backend == "cv2":
+        try:
+            import cv2
+        except ImportError as e:
+            raise ImportError("cv2 backend requested but OpenCV is not "
+                              "installed") from e
+        return cv2.imread(path)
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise ImportError(
+            "PIL backend requested but Pillow is not installed; .npy "
+            "arrays load without any image library") from e
+    return Image.open(path)

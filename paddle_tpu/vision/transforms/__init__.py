@@ -344,3 +344,10 @@ def center_crop(img, size):
 
 def crop(img, top, left, height, width):
     return _chw(np.asarray(img))[top:top + height, left:left + width]
+
+
+# reference layout exposes transforms.transforms / transforms.functional
+# module names; the implementations live flat in this package
+import sys as _sys
+transforms = _sys.modules[__name__]
+functional = _sys.modules[__name__]

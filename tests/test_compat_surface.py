@@ -1,0 +1,141 @@
+"""API-surface compat: fluid top-level names, static-graph shims, metric
+ops (mean_iou / chunk_eval), distribution additions, worker info
+(reference: python/paddle/__init__.py + static/ + metric/metrics.py +
+distribution.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import metric as M
+from paddle_tpu import static
+
+
+def test_top_level_fluid_names():
+    x = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+    y = paddle.to_tensor(np.ones((3, 2), "float32"))
+    assert paddle.tensordot(x, y, axes=1).shape == [2, 2]
+    assert not bool(paddle.has_nan(x))
+    assert bool(paddle.has_inf(x / paddle.to_tensor(0.0)))
+    assert float(paddle.reduce_sum(x)) == 15.0
+    assert paddle.reduce_mean(x, dim=0).shape == [3]
+    assert float(paddle.elementwise_mod(
+        paddle.to_tensor(7), paddle.to_tensor(4))) == 3.0
+    assert paddle.fill_constant([2], "int64", 5).numpy().tolist() == [5, 5]
+    assert paddle.VarBase is paddle.Tensor
+    assert isinstance(paddle.LoDTensorArray([1, 2]), list)
+    spec = paddle.data("ids", [None, 16], "int64")
+    assert spec.shape == (-1, 16)
+    out = paddle.crop_tensor(paddle.to_tensor(np.ones((4, 4), "float32")),
+                             shape=[2, -1], offsets=[1, 2])
+    assert out.shape == [2, 2]
+
+
+def test_static_shims_eager_semantics():
+    with static.program_guard(static.default_main_program(),
+                              static.default_startup_program()):
+        with static.name_scope("blk"):
+            x = paddle.to_tensor(np.full((2, 2), 2.0, "float32"),
+                                 stop_gradient=False)
+            loss = (x * x).sum()
+    pairs = static.append_backward(loss, parameter_list=[x])
+    np.testing.assert_allclose(pairs[0][1].numpy(), 4.0)
+
+    exe = static.Executor(static.cpu_places()[0])
+    out, = exe.run(fetch_list=[loss], return_numpy=True)
+    assert float(out) == 16.0
+    with pytest.raises(TypeError):
+        exe.run(fetch_list=["a_name_string"])
+
+    # gradients() needs a live graph (backward above released loss's tape)
+    x2 = paddle.to_tensor(np.full((2, 2), 2.0, "float32"),
+                          stop_gradient=False)
+    loss2 = (x2 * x2).sum()
+    g, = static.gradients(loss2, [x2])
+    np.testing.assert_allclose(g.numpy(), 4.0)
+
+    prog = static.CompiledProgram(static.default_main_program())
+    assert prog.with_data_parallel() is prog
+    scope = static.Scope()
+    with static.scope_guard(scope):
+        scope.var("w")
+    assert "w" in scope
+    y = static.py_func(lambda a: a + 1, paddle.to_tensor(1.0))
+    assert float(y) == 2.0
+
+
+def test_mean_iou():
+    pred = paddle.to_tensor(np.array([[0, 1], [1, 1]], "int64"))
+    lab = paddle.to_tensor(np.array([[0, 1], [0, 1]], "int64"))
+    miou, wrong, correct = M.mean_iou(pred, lab, 2)
+    np.testing.assert_allclose(float(miou), 7 / 12, rtol=1e-6)
+    assert correct.numpy().tolist() == [1, 2]
+    # out_wrong = union - correct (streaming iou = correct/(correct+wrong))
+    assert wrong.numpy().tolist() == [1, 1]
+    iou = correct.numpy() / (correct.numpy() + wrong.numpy())
+    np.testing.assert_allclose(iou.mean(), float(miou), rtol=1e-6)
+
+
+def test_chunk_eval_iob():
+    # tags: type*2 + {0:B, 1:I}; outside = 4.  Two entity types.
+    lab = np.array([[0, 1, 4, 2, 3, 4]], "int64")   # chunks A[0:1], B[3:4]
+    pred = np.array([[0, 1, 4, 2, 2, 4]], "int64")  # A[0:1] correct, B wrong
+    p, r, f1, ni, nl, nc = M.chunk_eval(paddle.to_tensor(pred),
+                                        paddle.to_tensor(lab), "IOB", 2)
+    assert int(nl) == 2 and int(nc) == 1
+    assert int(ni) == 3  # pred's second B starts a new chunk
+    np.testing.assert_allclose(float(p), 1 / 3)
+    np.testing.assert_allclose(float(r), 1 / 2)
+
+
+def test_chunk_eval_iobes_and_excluded():
+    # IOBES: type*4 + {0:B,1:I,2:E,3:S}; outside = 8
+    lab = np.array([[3, 8, 0, 1, 2]], "int64")   # S chunk t0, BIE chunk t0
+    pred = np.array([[3, 8, 0, 1, 2]], "int64")
+    p, r, f1, ni, nl, nc = M.chunk_eval(paddle.to_tensor(pred),
+                                        paddle.to_tensor(lab), "IOBES", 2)
+    assert int(nc) == int(nl) == int(ni) == 2 and float(f1) == 1.0
+    p, r, f1, ni, nl, nc = M.chunk_eval(
+        paddle.to_tensor(pred), paddle.to_tensor(lab), "IOBES", 2,
+        excluded_chunk_types=[0])
+    assert int(nl) == 0 and float(f1) == 0.0
+
+
+def test_distribution_additions():
+    from paddle_tpu.distribution import MultivariateNormalDiag, sampling_id
+    loc = paddle.to_tensor(np.array([1.0, -1.0], "float32"))
+    scale = paddle.to_tensor(np.array([0.5, 2.0], "float32"))
+    d = MultivariateNormalDiag(loc, scale)
+    s = d.sample([64], seed=3)
+    assert list(s.shape) == [64, 2]
+    # log_prob against scipy-free formula
+    v = np.array([1.0, -1.0], "float32")
+    want = -0.5 * 2 * np.log(2 * np.pi) - np.log(0.5 * 2.0)
+    np.testing.assert_allclose(float(d.log_prob(paddle.to_tensor(v))),
+                               want, rtol=1e-5)
+    d2 = MultivariateNormalDiag(loc, scale)
+    np.testing.assert_allclose(float(d.kl_divergence(d2)[()] if
+                                     d.kl_divergence(d2).ndim else
+                                     d.kl_divergence(d2)), 0.0, atol=1e-6)
+    probs = paddle.to_tensor(np.array([[0, 0, 1.0], [1.0, 0, 0]], "float32"))
+    ids = sampling_id(probs).numpy()
+    assert ids.tolist() == [2, 0]
+
+
+def test_worker_info_in_workers():
+    """get_worker_info: None in main process; populated inside workers."""
+    import paddle_tpu.io as io
+    assert io.get_worker_info() is None
+
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            info = io.get_worker_info()
+            assert info is not None and info.num_workers == 2
+            return np.float32(info.id)
+
+    seen = set()
+    for batch in io.DataLoader(DS(), batch_size=2, num_workers=2):
+        seen.update(np.asarray(batch.numpy()).reshape(-1).tolist())
+    assert seen.issubset({0.0, 1.0}) and seen
