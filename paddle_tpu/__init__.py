@@ -109,3 +109,4 @@ VarBase = Tensor  # fluid-era Tensor name
 from . import version  # noqa: E402
 from .version import full_version  # noqa: F401,E402
 commit = version.commit
+from . import incubate  # noqa: F401,E402

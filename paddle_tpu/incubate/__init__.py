@@ -1,0 +1,10 @@
+"""paddle.incubate (reference: python/paddle/incubate/__init__.py exposes
+fluid.contrib.reader; fluid/incubate carries auto-checkpoint + the PS
+fleet/data_generator family).  Here: reader conveniences alias the io
+module (the distributed reader role is DataLoader + DistributedBatchSampler)
+and checkpoint re-exports the auto-checkpoint machinery; the PS-only
+data_generator/fleet halves are scoped out per SURVEY §2.3."""
+from .. import io as reader  # noqa: F401
+from ..distributed import checkpoint  # noqa: F401
+
+__all__ = ["reader", "checkpoint"]
