@@ -41,3 +41,7 @@ from .layer.transformer import (MultiHeadAttention, TransformerEncoderLayer,  # 
 from .layer.moe import MoELayer  # noqa: F401
 from .decode import (Decoder, BeamSearchDecoder, dynamic_decode,  # noqa: F401
                      gather_tree)
+from . import utils  # noqa: F401,E402
+from .legacy_layers import (HSigmoidLoss, NCELoss, RowConv, Pool2D,  # noqa: F401,E402
+                            StaticRNN, BilinearTensorProduct,
+                            ctc_greedy_decoder, clip_by_norm, nce)

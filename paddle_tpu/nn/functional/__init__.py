@@ -187,3 +187,4 @@ def space_to_depth(x, blocksize, name=None):
 
 def shuffle_channel(x, group, name=None):
     return channel_shuffle(x, group)
+from ..legacy_layers import ctc_greedy_decoder, clip_by_norm, nce  # noqa: F401,E402

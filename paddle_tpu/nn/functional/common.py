@@ -96,6 +96,10 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
 
 from functools import partial as _partial  # noqa: E402
 
+# lookup count below which the exact scatter stays cheaper than the
+# (T, V) one-hot dot (patchable in tests to pin trajectory parity)
+_ONE_HOT_MIN_LOOKUPS = 256
+
 
 @_partial(jax.custom_vjp, nondiff_argnums=(0,))
 def _take_rows(tag, w, ids):
@@ -120,7 +124,7 @@ def _take_rows_bwd(tag, res, g):
     flat_ids = ids.reshape(-1)
     gm = g.reshape(-1, width)
     low_prec = w_dtype in (jnp.bfloat16, jnp.float16) or bool(amp)
-    if low_prec and gm.shape[0] >= 256:
+    if low_prec and gm.shape[0] >= _ONE_HOT_MIN_LOOKUPS:
         oh = jax.nn.one_hot(flat_ids, vocab, dtype=jnp.bfloat16)
         gw = jax.lax.dot_general(
             oh, gm.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),

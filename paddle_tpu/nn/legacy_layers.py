@@ -1,0 +1,259 @@
+"""Fluid-era layers the 2.0 surface re-exported: HSigmoidLoss, NCELoss,
+RowConv, Pool2D, StaticRNN, plus ctc_greedy_decoder / clip_by_norm
+functionals.
+
+Reference: python/paddle/fluid/layers/nn.py (hsigmoid, row_conv, nce,
+pool2d, ctc_greedy_decoder, clip_by_norm) and
+fluid/layers/control_flow.py StaticRNN.  TPU-native: every one is a plain
+jittable computation — no LayerHelper/append_op; StaticRNN builds its
+unrolled loop by running the user's Python step body per timestep (eager
+AND trace friendly), which is exactly what the reference's block-capture
+achieves.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+from .layer_base import Layer
+from . import initializer as I
+
+__all__ = ["HSigmoidLoss", "NCELoss", "RowConv", "Pool2D", "StaticRNN",
+           "BilinearTensorProduct", "ctc_greedy_decoder", "clip_by_norm",
+           "nce"]
+
+
+class HSigmoidLoss(Layer):
+    """Layer over functional hsigmoid_loss (reference: nn.HSigmoidLoss /
+    fluid hsigmoid)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        std = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), weight_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (num_classes - 1,), bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-std, std))
+
+    def forward(self, input, label, path_table=None, path_code=None):  # noqa: A002
+        from .functional import hsigmoid_loss
+        return hsigmoid_loss(input, label, self.num_classes, self.weight,
+                             self.bias, path_table, path_code)
+
+
+def nce(input, label, num_total_classes, num_neg_samples=10,  # noqa: A002
+        weight=None, bias=None, sample_weight=None, seed=0, name=None):
+    """Noise-contrastive estimation loss (reference: fluid layers nce →
+    operators/nce_op): one positive + uniformly drawn negatives per row,
+    BCE against the sampled logits.  Returns (B, 1)."""
+    if weight is None:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            "functional nce needs an explicit weight (num_total_classes, "
+            "D) — use nn.NCELoss for the stateful fluid.layers.nce "
+            "behavior that owns its parameters")
+
+    def raw(x, lab, w, b):
+        bsz = x.shape[0]
+        from ..core import rng as _rng
+        key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
+        neg = jax.random.randint(key, (bsz, num_neg_samples), 0,
+                                 num_total_classes)
+        cand = jnp.concatenate([lab.reshape(-1, 1).astype(jnp.int32), neg],
+                               axis=1)                  # (B, 1+K)
+        wv = w[cand]                                    # (B, 1+K, D)
+        logits = jnp.einsum("bkd,bd->bk", wv.astype(jnp.float32),
+                            x.astype(jnp.float32))
+        if b is not None:
+            logits = logits + b[cand]
+        tgt = jnp.concatenate(
+            [jnp.ones((bsz, 1)), jnp.zeros((bsz, num_neg_samples))], axis=1)
+        loss = jnp.maximum(logits, 0) - logits * tgt + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.sum(loss, axis=1, keepdims=True)
+
+    if bias is not None:
+        return dispatch("nce", raw, input, label, weight, bias)
+    return dispatch("nce", lambda x, l, w: raw(x, l, w, None),
+                    input, label, weight)
+
+
+class NCELoss(Layer):
+    """Stateful NCE (reference fluid nce's LayerHelper-created params)."""
+
+    def __init__(self, feature_size, num_total_classes, num_neg_samples=10,
+                 weight_attr=None, bias_attr=None, seed=0, name=None):
+        super().__init__()
+        self.num_total_classes = num_total_classes
+        self.num_neg_samples = num_neg_samples
+        self.seed = seed
+        std = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (num_total_classes, feature_size), weight_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (num_total_classes,), bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-std, std))
+
+    def forward(self, input, label):  # noqa: A002
+        return nce(input, label, self.num_total_classes,
+                   self.num_neg_samples, self.weight, self.bias,
+                   seed=self.seed)
+
+
+class RowConv(Layer):
+    """Lookahead row convolution (reference: fluid row_conv →
+    operators/row_conv_op, the DeepSpeech2 streaming op): out[t] =
+    sum_{j<k} x[t+j] * w[j], per channel."""
+
+    def __init__(self, num_channels, future_context_size, param_attr=None,
+                 act=None, name=None):
+        super().__init__()
+        self.k = future_context_size + 1
+        self.act = act
+        self.weight = self.create_parameter(
+            (self.k, num_channels), param_attr,
+            default_initializer=I.Uniform(
+                -1.0 / math.sqrt(self.k), 1.0 / math.sqrt(self.k)))
+
+    def forward(self, x):  # (B, T, C)
+        k = self.k
+
+        def raw(xv, w):
+            b, t, c = xv.shape
+            pad = jnp.concatenate(
+                [xv, jnp.zeros((b, k - 1, c), xv.dtype)], axis=1)
+            out = jnp.zeros_like(xv)
+            for j in range(k):  # k is small (lookahead window)
+                out = out + pad[:, j:j + t] * w[j]
+            return out
+        out = dispatch("row_conv", raw, x, self.weight)
+        if self.act:
+            from . import functional as F
+            out = getattr(F, self.act)(out)
+        return out
+
+
+class Pool2D(Layer):
+    """fluid.dygraph.Pool2D wrapper over the 2.0 pooling functionals
+    (ceil_mode / exclusive / data_format honored, not swallowed)."""
+
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True, data_format="NCHW", name=None):
+        super().__init__()
+        self._cfg = (pool_size, pool_type, pool_stride, pool_padding,
+                     global_pooling, ceil_mode, exclusive, data_format)
+
+    def forward(self, x):
+        from . import functional as F
+        (size, ptype, stride, padding, gp, ceil_mode, exclusive,
+         data_format) = self._cfg
+        if gp:
+            axis = (2, 3) if data_format == "NCHW" else (1, 2)
+            size = [x.shape[axis[0]], x.shape[axis[1]]]
+            stride, padding = size, 0
+        if ptype == "max":
+            return F.max_pool2d(x, size, stride=stride, padding=padding,
+                                ceil_mode=ceil_mode,
+                                data_format=data_format)
+        return F.avg_pool2d(x, size, stride=stride, padding=padding,
+                            ceil_mode=ceil_mode, exclusive=exclusive,
+                            data_format=data_format)
+
+
+class BilinearTensorProduct(Layer):
+    """fluid name for nn.Bilinear (x1^T W x2 + b)."""
+
+    def __new__(cls, input1_dim, input2_dim, output_dim, name=None,
+                act=None, param_attr=None, bias_attr=None):
+        from .layer.common import Bilinear
+        return Bilinear(input1_dim, input2_dim, output_dim,
+                        weight_attr=param_attr, bias_attr=bias_attr)
+
+
+class StaticRNN:
+    """Minimal StaticRNN (reference fluid/layers/control_flow.py
+    StaticRNN): declare step inputs/memories, run the step body per
+    timestep, collect outputs.  The body executes as ordinary ops (eager
+    or traced), replacing the reference's sub-block capture."""
+
+    def __init__(self, name=None):
+        self._inputs = []       # (T, B, ...) sequences
+        self._mem_init = []
+
+    def step(self):
+        import contextlib
+        return contextlib.nullcontext(self)
+
+    def step_input(self, x):
+        self._inputs.append(x)
+        return len(self._inputs) - 1
+
+    def memory(self, init):
+        self._mem_init.append(init)
+        return len(self._mem_init) - 1
+
+    def run(self, body):
+        """body(step_inputs: list, memories: list) -> (outputs, new_mems);
+        drives the loop over the leading time axis of the step inputs."""
+        t = unwrap(self._inputs[0]).shape[0]
+        mems = list(self._mem_init)
+        outs = []
+        for i in range(t):
+            step_ins = [Tensor(unwrap(x)[i]) for x in self._inputs]
+            o, mems = body(step_ins, mems)
+            outs.append(o)
+        from ..tensor.manipulation import stack
+        return stack(outs, axis=0), mems
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,  # noqa: A002
+                       name=None):
+    """Greedy CTC decode (reference: fluid ctc_greedy_decoder →
+    operators/ctc_align_op): argmax per step, merge repeats, drop blanks.
+    input: (B, T, C) probabilities/logits.  Returns (decoded (B, T) padded
+    with padding_value, lengths (B,))."""
+    import numpy as np
+    pv = np.asarray(jax.device_get(unwrap(input)))
+    lens = (np.asarray(jax.device_get(unwrap(input_length))).reshape(-1)
+            if input_length is not None
+            else np.full((pv.shape[0],), pv.shape[1]))
+    ids = pv.argmax(-1)
+    out = np.full(ids.shape, padding_value, np.int64)
+    out_lens = np.zeros((ids.shape[0],), np.int64)
+    for b in range(ids.shape[0]):
+        prev = -1
+        n = 0
+        for t in range(int(lens[b])):
+            cur = int(ids[b, t])
+            if cur != blank and cur != prev:
+                out[b, n] = cur
+                n += 1
+            prev = cur
+        out_lens[b] = n
+    return (Tensor(jnp.asarray(out), stop_gradient=True),
+            Tensor(jnp.asarray(out_lens), stop_gradient=True))
+
+
+def clip_by_norm(x, max_norm, name=None):
+    """reference: operators/clip_by_norm_op — scale x so ||x||_2 <=
+    max_norm."""
+    def raw(v):
+        norm = jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+        scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
+        return (v.astype(jnp.float32) * scale).astype(v.dtype)
+    return dispatch("clip_by_norm", raw, x)

@@ -1,0 +1,138 @@
+"""paddle.nn.utils: weight_norm / remove_weight_norm / spectral_norm.
+
+Reference: python/paddle/fluid/dygraph/nn.py weight_norm_hook (the
+reparameterization w = g * v / ||v|| recomputed by a forward pre-hook).
+Same mechanism here over the Layer hook system — g and v are the trainable
+parameters, the effective weight is rebuilt before every forward so
+gradients flow to g/v.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def _norm_except(v, dim):
+    """L2 norm over every axis except `dim` (dim=None: all axes)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize layer.<name> as g * v / ||v|| (trainables g, v)."""
+    w = getattr(layer, name)
+    raw = unwrap(w).astype(jnp.float32)
+    g0 = _norm_except(raw, dim)
+    v = layer.create_parameter(list(raw.shape))
+    v._set_data(raw)
+    g = layer.create_parameter(list(jnp.shape(g0)))
+    g._set_data(g0)
+    setattr(layer, name + "_v", v)
+    setattr(layer, name + "_g", g)
+    # the effective weight is derived state, not a parameter
+    params = layer._parameters
+    if name in params:
+        del params[name]
+
+    def rebuild(lyr, inputs):
+        # built from DISPATCHED tensor ops so the tape records the
+        # reparameterization and backward() reaches g and v
+        from .. import tensor as T
+        vv = getattr(lyr, name + "_v")
+        gg = getattr(lyr, name + "_g")
+        if dim is None:
+            n = T.sqrt(T.sum(vv * vv))
+        else:
+            axes = [i for i in range(vv.ndim) if i != dim]
+            n = T.sqrt(T.sum(vv * vv, axis=axes, keepdim=True))
+        eff = gg * vv / n
+        object.__setattr__(lyr, name, eff)
+        return None
+
+    handle = layer.register_forward_pre_hook(rebuild)
+    layer.__dict__["_weight_norm_hook_" + name] = (handle, rebuild)
+    rebuild(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Bake the CURRENT g/v (post-optimizer-steps) back into a plain
+    parameter."""
+    entry = layer.__dict__.pop("_weight_norm_hook_" + name, None)
+    if entry is None:
+        raise ValueError(f"no weight norm on {name!r}")
+    handle, rebuild = entry
+    rebuild(layer, None)  # refresh from the latest g/v before baking
+    handle.remove()
+    eff = unwrap(getattr(layer, name))
+    for suffix in ("_v", "_g"):
+        pname = name + suffix
+        if pname in layer._parameters:
+            del layer._parameters[pname]
+        if hasattr(layer, pname):
+            try:
+                delattr(layer, pname)
+            except AttributeError:
+                pass
+    w = layer.create_parameter(list(eff.shape))
+    w._set_data(eff)
+    setattr(layer, name, w)
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=0):
+    """Spectral normalization as a forward pre-hook (reference
+    nn.utils.spectral_norm; the SpectralNorm LAYER form already lives in
+    nn.layer.norm).  Divides the weight by its leading singular value
+    estimated with power iteration on a persistent u vector."""
+    import numpy as np
+    w = getattr(layer, name)
+    raw = unwrap(w).astype(jnp.float32)
+    mat = jnp.moveaxis(raw, dim, 0).reshape(raw.shape[dim], -1)
+    rng = np.random.RandomState(0)
+    u0 = rng.randn(mat.shape[0]).astype("float32")
+    layer.__dict__["_sn_u_" + name] = u0 / (np.linalg.norm(u0) + eps)
+    base = layer.create_parameter(list(raw.shape))
+    base._set_data(raw)
+    setattr(layer, name + "_orig", base)
+    if name in layer._parameters:
+        del layer._parameters[name]
+
+    def rebuild(lyr, inputs):
+        worig = getattr(lyr, name + "_orig")
+        # power iteration runs OUTSIDE the tape (u, v are constants, the
+        # torch/paddle convention); the division is a dispatched op so
+        # grads flow through w / sigma
+        wv = unwrap(worig).astype(jnp.float32)
+        m = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+        u = jnp.asarray(lyr.__dict__["_sn_u_" + name])
+        for _ in range(n_power_iterations):
+            v = m.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = m @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        import jax as _jax
+        if not isinstance(unwrap(worig), _jax.core.Tracer):
+            import numpy as _np
+            lyr.__dict__["_sn_u_" + name] = _np.asarray(u)  # persist u
+        # torch/paddle convention: u and v detach, sigma = u^T W v stays
+        # in the graph so dW picks up the -(u v^T) sigma term — build it
+        # from DISPATCHED ops on worig
+        from ..tensor.manipulation import reshape, moveaxis
+        from ..tensor.linalg import matmul
+        m_t = reshape(moveaxis(worig, dim, 0), [wv.shape[dim], -1])
+        sigma_t = matmul(Tensor(u[None, :]),
+                         matmul(m_t, Tensor(v[:, None])))
+        object.__setattr__(lyr, name, worig / reshape(sigma_t, []))
+        return None
+
+    handle = layer.register_forward_pre_hook(rebuild)
+    layer.__dict__["_spectral_norm_hook_" + name] = (handle, rebuild)
+    rebuild(layer, None)
+    return layer

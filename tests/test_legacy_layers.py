@@ -1,0 +1,118 @@
+"""Fluid-era layers + nn.utils (reference: fluid/layers/nn.py hsigmoid/
+nce/row_conv/pool2d/ctc_greedy_decoder/clip_by_norm, control_flow.py
+StaticRNN, dygraph weight_norm_hook)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_hsigmoid_layer_trains():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    h = nn.HSigmoidLoss(8, 10)
+    x = paddle.to_tensor(rng.randn(4, 8).astype("float32"),
+                         stop_gradient=False)
+    lab = paddle.to_tensor(rng.randint(0, 10, (4,)).astype("int64"))
+    loss = h(x, lab).sum()
+    loss.backward()
+    assert np.abs(x.grad.numpy()).sum() > 0
+    assert h.weight.grad is not None
+
+
+def test_nce_loss_shape_and_grad():
+    paddle.seed(0)
+    rng = np.random.RandomState(1)
+    n = nn.NCELoss(8, 50, num_neg_samples=5, seed=1)
+    x = paddle.to_tensor(rng.randn(4, 8).astype("float32"),
+                         stop_gradient=False)
+    lab = paddle.to_tensor(rng.randint(0, 50, (4,)).astype("int64"))
+    loss = n(x, lab)
+    assert list(loss.shape) == [4, 1]
+    loss.sum().backward()
+    assert np.isfinite(x.grad.numpy()).all()
+    assert n.weight.grad is not None
+
+
+def test_row_conv_lookahead_semantics():
+    rng = np.random.RandomState(2)
+    rc = nn.RowConv(3, 1)
+    # w[0]=0 (current), w[1]=1 (next step): out[t] == x[t+1], zero-pad end
+    rc.weight._set_data(np.array([[0, 0, 0], [1, 1, 1]], "float32"))
+    xs = paddle.to_tensor(rng.randn(1, 5, 3).astype("float32"))
+    out = rc(xs).numpy()
+    np.testing.assert_allclose(out[0, :4], xs.numpy()[0, 1:], rtol=1e-5)
+    np.testing.assert_allclose(out[0, 4], 0.0, atol=1e-6)
+
+
+def test_pool2d_layer_and_static_rnn():
+    rng = np.random.RandomState(3)
+    p2 = nn.Pool2D(pool_size=2, pool_type="avg", pool_stride=2)
+    img = paddle.to_tensor(rng.randn(1, 2, 4, 4).astype("float32"))
+    assert list(p2(img).shape) == [1, 2, 2, 2]
+
+    srnn = nn.StaticRNN()
+    seq = paddle.to_tensor(np.ones((4, 2, 3), "float32"))
+    srnn.step_input(seq)
+    srnn.memory(paddle.to_tensor(np.zeros((2, 3), "float32")))
+
+    def body(ins, mems):
+        s = mems[0] + ins[0]
+        return s, [s]
+
+    outs, final = srnn.run(body)
+    assert list(outs.shape) == [4, 2, 3]
+    np.testing.assert_allclose(outs.numpy()[-1], 4.0)
+    np.testing.assert_allclose(final[0].numpy(), 4.0)
+
+
+def test_ctc_greedy_decoder_and_clip_by_norm():
+    probs = np.zeros((1, 6, 4), "float32")
+    for t, c in enumerate([1, 1, 3, 2, 3, 3]):  # blank=3
+        probs[0, t, c] = 1.0
+    dec, lens = F.ctc_greedy_decoder(paddle.to_tensor(probs), blank=3)
+    assert dec.numpy()[0][:int(lens.numpy()[0])].tolist() == [1, 2]
+
+    v = paddle.to_tensor(np.array([3.0, 4.0], "float32"))
+    np.testing.assert_allclose(
+        np.linalg.norm(F.clip_by_norm(v, 1.0).numpy()), 1.0, rtol=1e-5)
+    # below the cap: unchanged
+    np.testing.assert_allclose(F.clip_by_norm(v, 10.0).numpy(), v.numpy())
+
+
+def test_weight_norm_roundtrip_and_grads():
+    paddle.seed(0)
+    rng = np.random.RandomState(4)
+    lin = nn.Linear(4, 3)
+    xin = paddle.to_tensor(rng.randn(2, 4).astype("float32"))
+    base = lin(xin).numpy()
+
+    nn.utils.weight_norm(lin, "weight", dim=0)
+    # reparameterization preserves the function
+    np.testing.assert_allclose(lin(xin).numpy(), base, rtol=1e-4,
+                               atol=1e-5)
+    names = [n for n, _ in lin.named_parameters()]
+    assert any("weight_g" in n for n in names)
+    assert any("weight_v" in n for n in names)
+    assert not any(n.endswith(".weight") or n == "weight" for n in names)
+    lin(xin).sum().backward()
+    assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
+
+    nn.utils.remove_weight_norm(lin, "weight")
+    np.testing.assert_allclose(lin(xin).numpy(), base, rtol=1e-4,
+                               atol=1e-5)
+    names = [n for n, _ in lin.named_parameters()]
+    assert not any("weight_g" in n for n in names)
+
+
+def test_spectral_norm_util_unit_sigma():
+    paddle.seed(0)
+    lin = nn.Linear(6, 6)
+    nn.utils.spectral_norm(lin, "weight", n_power_iterations=3)
+    for _ in range(5):  # power iteration refines u across forwards
+        lin(paddle.to_tensor(np.random.RandomState(5)
+                             .randn(1, 6).astype("float32")))
+    s = np.linalg.svd(lin.weight.numpy(), compute_uv=False)
+    assert abs(s[0] - 1.0) < 0.05, s[0]
