@@ -188,3 +188,68 @@ def space_to_depth(x, blocksize, name=None):
 def shuffle_channel(x, group, name=None):
     return channel_shuffle(x, group)
 from ..legacy_layers import ctc_greedy_decoder, clip_by_norm, nce  # noqa: F401,E402
+
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):  # noqa: A002
+    """Sinusoidal position encoding mix-in (reference: fluid
+    add_position_encoding -> operators/add_position_encoding_op):
+    out = alpha * x + beta * pe, pe the interleaved sin/cos table."""
+    import jax.numpy as jnp
+    from ...core.op import dispatch as _dispatch
+
+    def raw(x):
+        b, t, c = x.shape
+        half = (c + 1) // 2
+        pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+        div = jnp.power(10000.0, jnp.arange(half, dtype=jnp.float32) / half)
+        pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)],
+                             axis=1)[:, :c]  # odd C: drop the last cos col
+        return alpha * x + beta * pe[None].astype(x.dtype)
+    return _dispatch("add_position_encoding", raw, input)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape with pad_value (reference:
+    operators/pad_constant_like_op)."""
+    import jax.numpy as jnp
+    from ...core.op import dispatch as _dispatch
+
+    def raw(xv, yv):
+        pads = [(0, xv.shape[i] - yv.shape[i]) for i in range(yv.ndim)]
+        return jnp.pad(yv, pads, constant_values=pad_value)
+    return _dispatch("pad_constant_like", raw, x, y)
+
+
+def fsp_matrix(x, y, name=None):
+    """Flow-of-solution-procedure matrix for distillation (reference:
+    operators/fsp_op): (B, Cx, Cy) = x·y^T over spatial dims / (H*W)."""
+    import jax.numpy as jnp
+    from ...core.op import dispatch as _dispatch
+
+    def raw(xv, yv):
+        b, cx, h, w = xv.shape
+        cy = yv.shape[1]
+        xf = xv.reshape(b, cx, h * w)
+        yf = yv.reshape(b, cy, h * w)
+        return jnp.einsum("bim,bjm->bij", xf, yf) / (h * w)
+    return _dispatch("fsp_matrix", raw, x, y)
+
+
+def teacher_student_sigmoid_loss(input, label,  # noqa: A002
+                                 soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """CTR distillation loss (reference:
+    operators/teacher_student_sigmoid_loss_op): teacher signal encoded in
+    the label's fractional part."""
+    import jax.numpy as jnp
+    from ...core.op import dispatch as _dispatch
+
+    def raw(z, lab):
+        z = jnp.clip(z.astype(jnp.float32), soft_max_lower_bound,
+                     soft_max_up_bound)
+        lab = lab.astype(jnp.float32)
+        hard = (lab > -1.0).astype(jnp.float32)
+        soft = lab - jnp.floor(lab)
+        log1pez = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(z, 0)
+        return (log1pez - hard * z) + (log1pez - soft * z)
+    return _dispatch("teacher_student_sigmoid_loss", raw, input, label)

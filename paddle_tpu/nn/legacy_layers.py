@@ -56,18 +56,25 @@ def nce(input, label, num_total_classes, num_neg_samples=10,  # noqa: A002
         weight=None, bias=None, sample_weight=None, seed=0, name=None):
     """Noise-contrastive estimation loss (reference: fluid layers nce →
     operators/nce_op): one positive + uniformly drawn negatives per row,
-    BCE against the sampled logits.  Returns (B, 1)."""
+    BCE against the sampled logits.  Returns (B, 1).
+
+    Negatives are FRESH every call (the sampler rides the global RNG
+    stream — per-step keys under TrainStep tracing, like dropout);
+    `seed` folds into that stream for reproducibility, it does not
+    freeze the sample set."""
     if weight is None:
         from ..core.errors import InvalidArgumentError
         raise InvalidArgumentError(
             "functional nce needs an explicit weight (num_total_classes, "
             "D) — use nn.NCELoss for the stateful fluid.layers.nce "
             "behavior that owns its parameters")
+    from ..core import rng as _rng
+    key = _rng.next_key()  # drawn OUTSIDE dispatch: varies per traced step
+    if seed:
+        key = jax.random.fold_in(key, seed)
 
     def raw(x, lab, w, b):
         bsz = x.shape[0]
-        from ..core import rng as _rng
-        key = jax.random.PRNGKey(seed) if seed else _rng.next_key()
         neg = jax.random.randint(key, (bsz, num_neg_samples), 0,
                                  num_total_classes)
         cand = jnp.concatenate([lab.reshape(-1, 1).astype(jnp.int32), neg],

@@ -11,9 +11,10 @@ through python/paddle/vision/ops.py.  TPU-native rules applied throughout:
   `take`-style indexing that XLA fuses, not per-pixel scalar loops.
 
 Implemented: yolo_box, yolo_loss, prior_box, anchor_generator, box_coder,
-iou_similarity/box_iou, box_clip, nms, multiclass_nms,
-distribute_fpn_proposals, roi_align, roi_pool, deform_conv2d/DeformConv2D,
-generate_proposals.
+iou_similarity/box_iou, box_clip, nms(+nms_padded),
+multiclass_nms(+_padded), distribute/collect_fpn_proposals, roi_align,
+roi_pool, deform_conv2d/DeformConv2D, generate_proposals,
+bipartite_match, target_assign.
 """
 from __future__ import annotations
 
@@ -31,7 +32,8 @@ __all__ = [
     "iou_similarity", "box_iou", "box_clip", "nms", "multiclass_nms",
     "distribute_fpn_proposals", "roi_align", "roi_pool", "deform_conv2d",
     "DeformConv2D", "generate_proposals", "nms_padded",
-    "multiclass_nms_padded",
+    "multiclass_nms_padded", "bipartite_match", "target_assign",
+    "collect_fpn_proposals",
 ]
 
 
@@ -1025,3 +1027,96 @@ def multiclass_nms_padded(bboxes, scores, score_threshold, nms_top_k,
     rows, count = raw(bv, sv)
     return (Tensor(rows, stop_gradient=True),
             Tensor(count, stop_gradient=True))
+
+
+# ---------------------------------------------------------------------------
+# detection training assigners (SSD / FPN training side)
+
+
+def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
+                    name=None):
+    """Greedy bipartite matching (reference:
+    detection/bipartite_match_op): per batch, repeatedly take the global
+    max of the distance matrix (rows = ground-truth entities, cols =
+    priors/predictions), binding each row and column at most once; with
+    match_type='per_prediction', unmatched columns additionally match
+    their argmax row when above dist_threshold.
+
+    dist_matrix: (N, M) similarity (e.g. IoU) — a LIST of matrices for a
+    batch.  Returns (match_indices (M,) int32 row index or -1,
+    match_dist (M,)).  Host-side training-data prep (like the reference's
+    CPU-only kernel)."""
+    mats = dist_matrix if isinstance(dist_matrix, (list, tuple)) \
+        else [dist_matrix]
+    outs_i, outs_d = [], []
+    for m in mats:
+        dv = np.asarray(jax.device_get(unwrap(m))).astype(np.float64)
+        n, mm = dv.shape
+        match_idx = np.full((mm,), -1, np.int32)
+        match_dist = np.zeros((mm,), np.float32)
+        work = dv.copy()
+        for _ in range(min(n, mm)):
+            r, c = np.unravel_index(np.argmax(work), work.shape)
+            if work[r, c] <= 0:
+                break
+            match_idx[c] = r
+            match_dist[c] = dv[r, c]
+            work[r, :] = -1.0
+            work[:, c] = -1.0
+        if match_type == "per_prediction":
+            for c in range(mm):
+                if match_idx[c] == -1:
+                    r = int(np.argmax(dv[:, c]))
+                    if dv[r, c] >= dist_threshold:
+                        match_idx[c] = r
+                        match_dist[c] = dv[r, c]
+        outs_i.append(match_idx)
+        outs_d.append(match_dist)
+    ii = Tensor(jnp.asarray(np.stack(outs_i)), stop_gradient=True)
+    dd = Tensor(jnp.asarray(np.stack(outs_d)), stop_gradient=True)
+    return ii, dd
+
+
+def target_assign(input, matched_indices, negative_indices=None,  # noqa: A002
+                  mismatch_value=0, name=None):
+    """Scatter per-target rows by match indices (reference:
+    detection/target_assign_op): out[b, j] = input[b, match[b, j]] when
+    match[b, j] >= 0 else mismatch_value; out_weight 1/0 accordingly
+    (negative_indices rows also get weight 1)."""
+    def raw(xv, mi):
+        b, m = mi.shape
+        matched = mi >= 0
+        safe = jnp.clip(mi, 0)
+        rows = jnp.take_along_axis(
+            xv, safe[:, :, None].astype(jnp.int32), axis=1)
+        out = jnp.where(matched[:, :, None], rows,
+                        jnp.asarray(mismatch_value, xv.dtype))
+        wgt = matched.astype(jnp.float32)[:, :, None]
+        return out, wgt
+    out, wgt = raw(unwrap(input), unwrap(matched_indices))
+    if negative_indices is not None:
+        neg = unwrap(negative_indices)
+        wgt = wgt.at[:, :, 0].max(
+            jnp.zeros_like(wgt[:, :, 0]).at[
+                jnp.arange(neg.shape[0])[:, None],
+                jnp.clip(neg, 0)].set(1.0))
+    return Tensor(out), Tensor(wgt)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, rois_num_per_level=None,
+                          name=None):
+    """Re-merge per-level FPN proposals and keep the global top-N by score
+    (reference: detection/collect_fpn_proposals_op — the inverse of
+    distribute_fpn_proposals).  Host-side, like its reference kernel."""
+    rois = np.concatenate([np.asarray(jax.device_get(unwrap(r)))
+                           for r in multi_rois], axis=0)
+    scores = np.concatenate([np.asarray(jax.device_get(unwrap(s))).reshape(-1)
+                             for s in multi_scores], axis=0)
+    order = np.argsort(-scores, kind="stable")[:post_nms_top_n]
+    out = rois[order]
+    if rois_num_per_level is not None:
+        return (Tensor(jnp.asarray(out), stop_gradient=True),
+                Tensor(jnp.asarray(np.asarray([len(out)], np.int32)),
+                       stop_gradient=True))
+    return Tensor(jnp.asarray(out), stop_gradient=True)

@@ -548,3 +548,55 @@ def test_nms_padded_jittable_eval_loop():
     rows, count = eval_step(jnp.asarray(boxes),
                             jnp.asarray(rng.rand(3, 10).astype("float32")))
     assert rows.shape == (6, 6) and int(count) >= 1
+
+
+def test_bipartite_match_and_target_assign():
+    # 3 gt rows x 4 prior cols similarity
+    sim = np.array([[0.9, 0.1, 0.0, 0.3],
+                    [0.2, 0.8, 0.1, 0.0],
+                    [0.0, 0.0, 0.4, 0.6]], "float32")
+    mi, md = ops.bipartite_match(paddle.to_tensor(sim))
+    # greedy global max: (0,0)=0.9, (1,1)=0.8, (2,3)=0.6; col 2 unmatched
+    assert mi.numpy()[0].tolist() == [0, 1, -1, 2]
+    np.testing.assert_allclose(md.numpy()[0], [0.9, 0.8, 0.0, 0.6],
+                               rtol=1e-6)
+    mi2, _ = ops.bipartite_match(paddle.to_tensor(sim),
+                                 match_type="per_prediction",
+                                 dist_threshold=0.3)
+    assert mi2.numpy()[0][2] == 2  # col 2 matches its argmax row (0.4>=0.3)
+
+    # target_assign gathers matched rows, zeros unmatched
+    tgt = np.arange(12, dtype="float32").reshape(1, 3, 4)
+    out, wgt = ops.target_assign(paddle.to_tensor(tgt),
+                                 paddle.to_tensor(mi.numpy()))
+    np.testing.assert_allclose(out.numpy()[0, 0], tgt[0, 0])
+    np.testing.assert_allclose(out.numpy()[0, 2], 0.0)
+    assert wgt.numpy()[0, :, 0].tolist() == [1, 1, 0, 1]
+
+
+def test_collect_fpn_proposals_roundtrip():
+    rng = np.random.RandomState(11)
+    rois = rng.rand(9, 4).astype("float32") * 100
+    rois[:, 2:] += rois[:, :2]
+    scores = rng.rand(9).astype("float32")
+    outs, restore, = ops.distribute_fpn_proposals(
+        paddle.to_tensor(rois), min_level=2, max_level=4, refer_level=3,
+        refer_scale=30)
+    lvl_scores = []
+    i = 0
+    # rebuild per-level score lists using the restore mapping
+    cat = np.concatenate([o.numpy() for o in outs if o.shape[0]])
+    cat_scores = np.empty(len(rois), "float32")
+    cat_scores[:] = scores[np.argsort(restore.numpy())]  # scores per cat row
+    start = 0
+    for o in outs:
+        n = o.shape[0]
+        lvl_scores.append(cat_scores[start:start + n])
+        start += n
+    top = ops.collect_fpn_proposals(
+        [paddle.to_tensor(o.numpy()) for o in outs],
+        [paddle.to_tensor(s) for s in lvl_scores],
+        min_level=2, max_level=4, post_nms_top_n=5)
+    got = top.numpy()
+    order = np.argsort(-cat_scores, kind="stable")[:5]
+    np.testing.assert_allclose(got, cat[order], rtol=1e-6)
