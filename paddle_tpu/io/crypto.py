@@ -8,7 +8,9 @@ ctypes; CTR mode makes encrypt/decrypt one code path.  Wire format (v2):
 The HMAC-SHA256 (keyed off a derived mac key) covers version|IV|ciphertext
 and is verified BEFORE decryption — CTR is malleable and the payload often
 feeds pickle, so tampering must fail closed.  v1 artifacts (no tag, parity
-with the reference's unauthenticated cipher) still load.
+with the reference's unauthenticated cipher) load only behind an explicit
+allow_legacy=True: accepting a v1 header by default would let an attacker
+bypass the v2 HMAC by rewriting the version byte and stripping the tag.
 """
 from __future__ import annotations
 
@@ -91,7 +93,12 @@ class AESCipher:
         tag = _hmac.new(_mac_key(key), body, hashlib.sha256).digest()
         return _MAGIC + body + tag
 
-    def decrypt(self, ciphertext: bytes, key: bytes) -> bytes:
+    def decrypt(self, ciphertext: bytes, key: bytes,
+                allow_legacy: bool = False) -> bytes:
+        """allow_legacy gates v1 (unauthenticated) artifacts: without it a
+        v1 header is rejected, else rewriting the version byte and stripping
+        the tag would silently bypass the v2 HMAC (CTR is malleable and the
+        payload often feeds pickle)."""
         self._check_key(key)
         head = len(_MAGIC) + 1 + 16
         if (len(ciphertext) < head
@@ -111,6 +118,11 @@ class AESCipher:
             iv = ciphertext[len(_MAGIC) + 1:head]
             return _ctr_crypt(key, iv, ciphertext[head:-_TAG_LEN])
         elif version == 1:  # legacy unauthenticated format
+            if not allow_legacy:
+                raise ValueError(
+                    "refusing unauthenticated v1 encrypted artifact "
+                    "(version-downgrade would bypass the v2 HMAC); pass "
+                    "allow_legacy=True only for trusted legacy files")
             iv = ciphertext[len(_MAGIC) + 1:head]
             return _ctr_crypt(key, iv, ciphertext[head:])
         raise ValueError(f"unknown encrypted-artifact version {version}")
@@ -122,9 +134,10 @@ class AESCipher:
         with open(filename, "wb") as f:
             f.write(self.encrypt(plaintext, key))
 
-    def decrypt_from_file(self, key: bytes, filename: str) -> bytes:
+    def decrypt_from_file(self, key: bytes, filename: str,
+                          allow_legacy: bool = False) -> bytes:
         with open(filename, "rb") as f:
-            return self.decrypt(f.read(), key)
+            return self.decrypt(f.read(), key, allow_legacy=allow_legacy)
 
 
 class CipherFactory:

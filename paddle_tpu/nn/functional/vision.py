@@ -69,10 +69,12 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                     span = 2.0 * (size - 1)
                     v = jnp.abs(jnp.mod(v, span))
                     return jnp.where(v > size - 1, span - v, v)
+                # reference grid_sampler_op.h: reflect around the -0.5 /
+                # size-0.5 pixel-edge line: extra = |v+0.5| mod 2*size,
+                # reflected = min(extra, 2*size-extra) - 0.5
                 span = 2.0 * size
-                v = jnp.mod(v + 0.5, span)
-                v = jnp.abs(v) - 0.5
-                v = jnp.where(v > size - 0.5, span - 1.0 - v - 0.5 - 0.5, v)
+                extra = jnp.mod(jnp.abs(v + 0.5), span)
+                v = jnp.minimum(extra, span - extra) - 0.5
                 return jnp.clip(v, 0, size - 1)
             fx = reflect(fx, w)
             fy = reflect(fy, h)

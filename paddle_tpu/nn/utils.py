@@ -27,7 +27,13 @@ def weight_norm(layer, name="weight", dim=0):
     """Reparameterize layer.<name> as g * v / ||v|| (trainables g, v)."""
     w = getattr(layer, name)
     raw = unwrap(w).astype(jnp.float32)
+    if dim is not None:
+        dim = dim % raw.ndim  # negative dims: -1 must mean the last axis
+    # store g 1-D [d] (scalar for dim=None) — the reference's
+    # norm_except_dim layout, so weight-normed state_dicts interchange;
+    # rebuild() restores the keepdims broadcast shape on the fly
     g0 = _norm_except(raw, dim)
+    g0 = g0.reshape(() if dim is None else (raw.shape[dim],))
     v = layer.create_parameter(list(raw.shape))
     v._set_data(raw)
     g = layer.create_parameter(list(jnp.shape(g0)))
@@ -47,10 +53,13 @@ def weight_norm(layer, name="weight", dim=0):
         gg = getattr(lyr, name + "_g")
         if dim is None:
             n = T.sqrt(T.sum(vv * vv))
+            eff = gg * vv / n
         else:
             axes = [i for i in range(vv.ndim) if i != dim]
             n = T.sqrt(T.sum(vv * vv, axis=axes, keepdim=True))
-        eff = gg * vv / n
+            shape = [1] * vv.ndim
+            shape[dim] = -1
+            eff = T.reshape(gg, shape) * vv / n
         object.__setattr__(lyr, name, eff)
         return None
 

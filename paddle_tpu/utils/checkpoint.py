@@ -59,11 +59,14 @@ def save(obj, path, protocol=4, encrypt_key=None):
         f.write(payload)
 
 
-def load(path, return_numpy=False, encrypt_key=None, **kwargs):
-    """paddle.load."""
+def load(path, return_numpy=False, encrypt_key=None, allow_legacy=False,
+         **kwargs):
+    """paddle.load.  allow_legacy opts in to v1 (unauthenticated) encrypted
+    artifacts — see io/crypto.py on the downgrade hazard."""
     if encrypt_key is not None:
         from ..io.crypto import AESCipher
-        payload = AESCipher().decrypt_from_file(encrypt_key, path)
+        payload = AESCipher().decrypt_from_file(encrypt_key, path,
+                                                allow_legacy=allow_legacy)
         obj = pickle.loads(payload)
     else:
         with open(path, "rb") as f:

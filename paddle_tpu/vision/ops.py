@@ -1114,9 +1114,19 @@ def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
     scores = np.concatenate([np.asarray(jax.device_get(unwrap(s))).reshape(-1)
                              for s in multi_scores], axis=0)
     order = np.argsort(-scores, kind="stable")[:post_nms_top_n]
-    out = rois[order]
     if rois_num_per_level is not None:
+        # track each roi's image index through the global sort so the output
+        # carries one count PER IMAGE (the reference op's contract), then
+        # regroup the kept rois by image like collect_fpn_proposals_op does
+        counts = [np.asarray(jax.device_get(unwrap(c))).astype(np.int64)
+                  for c in rois_num_per_level]
+        n_imgs = len(counts[0])
+        img_idx = np.concatenate([np.repeat(np.arange(n_imgs), c)
+                                  for c in counts])
+        kept_img = img_idx[order]
+        regroup = np.argsort(kept_img, kind="stable")
+        out = rois[order][regroup]
+        rois_num = np.bincount(kept_img, minlength=n_imgs).astype(np.int32)
         return (Tensor(jnp.asarray(out), stop_gradient=True),
-                Tensor(jnp.asarray(np.asarray([len(out)], np.int32)),
-                       stop_gradient=True))
-    return Tensor(jnp.asarray(out), stop_gradient=True)
+                Tensor(jnp.asarray(rois_num), stop_gradient=True))
+    return Tensor(jnp.asarray(rois[order]), stop_gradient=True)

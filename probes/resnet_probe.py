@@ -1,0 +1,222 @@
+"""ResNet-50 TPU component profile (VERDICT r3 item #1).
+
+Each mode runs in its OWN process (two big models in one TPU process
+cross-contaminate HBM and inflate wall clocks — the r3 39ms-probe vs
+50.45ms-bench discrepancy).  Drive with probes/run_resnet_probes.sh or:
+
+    python probes/resnet_probe.py <mode> [batch]
+
+Modes: baseline fwd fwdbwd nobn o2 f32 convtower convtower_nhwc stem
+Prints one line:  PROBE <mode> <batch> <ms_per_step> <detail...>
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
+
+
+def _sync(x):
+    import jax
+    jax.block_until_ready(x)
+    return float(np.asarray(x).reshape(-1)[0])
+
+
+def timed_calls(fn, warmup=2, iters=4):
+    for _ in range(warmup):
+        out = fn()
+    _sync(out)
+    t0 = time.perf_counter()
+    per = []
+    for _ in range(iters):
+        t1 = time.perf_counter()
+        out = fn()
+        _sync(out)
+        per.append(time.perf_counter() - t1)
+    dt = (time.perf_counter() - t0) / iters
+    return dt, per
+
+
+def strip_bn(model):
+    from paddle_tpu import nn
+    for layer in model.sublayers(include_self=True):
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is not None and "BatchNorm" in type(sub).__name__:
+                layer._sub_layers[name] = nn.Identity()
+    return model
+
+
+def build(batch, nobn=False):
+    import paddle_tpu as paddle
+    from paddle_tpu.vision import models as vmodels
+    paddle.seed(0)
+    model = vmodels.resnet50()
+    if nobn:
+        strip_bn(model)
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 3, 224, 224).astype("float32")
+    y = rng.randint(0, 1000, (batch,)).astype("int64")
+    return paddle, model, x, y
+
+
+def mode_trainstep(batch, amp="O1", nobn=False, k=None):
+    if k is None:
+        k = int(os.environ.get("PROBE_K", "10"))
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.jit import TrainStep
+    paddle, model, x, y = build(batch, nobn=nobn)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    step = TrainStep(model, lambda logits, label: F.cross_entropy(
+        logits, label), opt, amp_level=amp, amp_dtype="bfloat16")
+    xs = paddle.to_tensor(np.broadcast_to(x, (k,) + x.shape).copy())
+    ys = paddle.to_tensor(np.broadcast_to(y, (k,) + y.shape).copy())
+
+    def call():
+        return step.run_steps(xs, ys)._data
+    dt, per = timed_calls(call, warmup=2, iters=3)
+    return dt / k, [p / k for p in per]
+
+
+def mode_fwd(batch, with_bwd=False):
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu import amp as amp_mod
+    from paddle_tpu.jit import forward_loss, state_arrays
+    import paddle_tpu.nn.functional as F
+    paddle, model, x, y = build(batch)
+    state = state_arrays(model)
+
+    def loss_of(state, xb, yb):
+        return forward_loss(model, lambda logits, label: F.cross_entropy(
+            logits, label), state, (xb, yb), rng_key=jax.random.PRNGKey(0),
+            amp_level="O1")
+
+    if with_bwd:
+        def _loss_plus_gradsum(s, xb, yb):
+            # fold every grad leaf into the output so XLA can't DCE the bwd
+            loss, grads = jax.value_and_grad(loss_of)(s, xb, yb)
+            return loss + sum(jnp.sum(g.astype(jnp.float32)) * 1e-30
+                              for g in jax.tree_util.tree_leaves(grads))
+        fn = jax.jit(_loss_plus_gradsum)
+    else:
+        fn = jax.jit(loss_of)
+    xj, yj = jnp.asarray(x), jnp.asarray(y)
+    dt, per = timed_calls(lambda: fn(state, xj, yj), warmup=2, iters=6)
+    return dt, per
+
+
+def _conv_list():
+    """(cin, cout, k, stride, hw_in) for every conv in ResNet-50 (stride on
+    the 3x3, paddle/torchvision convention)."""
+    convs = [(3, 64, 7, 2, 224)]  # stem; maxpool/2 follows -> 56
+    spec = [(64, 3, 1, 56), (128, 4, 2, 56), (256, 6, 2, 28), (512, 3, 2, 14)]
+    inplanes = 64
+    for planes, blocks, stride, hw_in in spec:
+        out = planes * 4
+        hw_out = hw_in // stride
+        for b in range(blocks):
+            s = stride if b == 0 else 1
+            hw = hw_in if b == 0 else hw_out
+            convs.append((inplanes, planes, 1, 1, hw))
+            convs.append((planes, planes, 3, s, hw))
+            convs.append((planes, out, 1, 1, hw_out))
+            if b == 0 and (s != 1 or inplanes != out):
+                convs.append((inplanes, out, 1, s, hw))
+            inplanes = out
+    return convs
+
+
+def mode_convtower(batch, layout="NCHW", with_bwd=True):
+    """Pure conv chain at ResNet-50 shapes: the achievable conv ceiling."""
+    import jax
+    import jax.numpy as jnp
+    convs = _conv_list()
+    dn = ("NCHW", "OIHW", "NCHW") if layout == "NCHW" else \
+         ("NHWC", "HWIO", "NHWC")
+    rng = np.random.RandomState(0)
+    weights = []
+    flops = 0.0
+    for cin, cout, kk, s, hw in convs:
+        if layout == "NCHW":
+            w = rng.randn(cout, cin, kk, kk).astype(np.float32) * 0.05
+        else:
+            w = rng.randn(kk, kk, cin, cout).astype(np.float32) * 0.05
+        weights.append(jnp.asarray(w, jnp.bfloat16))
+        hw_out = hw // s
+        flops += 2.0 * batch * hw_out * hw_out * cin * cout * kk * kk
+
+    def run(ws, inputs):
+        acc = jnp.float32(0)
+        for (cin, cout, kk, s, hw), w, x in zip(convs, ws, inputs):
+            pad = [(kk // 2, kk // 2)] * 2
+            o = jax.lax.conv_general_dilated(
+                x, w, window_strides=(s, s), padding=pad,
+                dimension_numbers=dn)
+            acc = acc + jnp.sum(o.astype(jnp.float32)) * 1e-12
+        return acc
+
+    inputs = []
+    for cin, cout, kk, s, hw in convs:
+        shp = (batch, cin, hw, hw) if layout == "NCHW" else (batch, hw, hw, cin)
+        inputs.append(jnp.asarray(rng.randn(*shp) * 0.05, jnp.bfloat16))
+
+    if with_bwd:
+        g = jax.jit(lambda ws, xs: jax.grad(
+            lambda ws2: run(ws2, xs))(ws)[0].astype(jnp.float32).sum())
+        fn = lambda: g(weights, inputs)
+        mult = 2.0  # fwd + grad_w only (inputs are leaves, no grad_x chain)
+    else:
+        j = jax.jit(run)
+        fn = lambda: j(weights, inputs)
+        mult = 1.0
+    dt, per = timed_calls(fn, warmup=2, iters=6)
+    tfs = flops * mult / dt / 1e12
+    return dt, tfs, flops * mult
+
+
+def main():
+    mode = sys.argv[1]
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    if mode == "baseline":
+        dt, per = mode_trainstep(batch)
+    elif mode == "o2":
+        dt, per = mode_trainstep(batch, amp="O2")
+    elif mode == "f32":
+        dt, per = mode_trainstep(batch, amp=None)
+    elif mode == "nobn":
+        dt, per = mode_trainstep(batch, nobn=True)
+    elif mode == "fwd":
+        dt, per = mode_fwd(batch, with_bwd=False)
+    elif mode == "fwdbwd":
+        dt, per = mode_fwd(batch, with_bwd=True)
+    elif mode in ("convtower", "convtower_nhwc"):
+        layout = "NHWC" if mode.endswith("nhwc") else "NCHW"
+        dt, tfs, fl = mode_convtower(batch, layout=layout)
+        print(f"PROBE {mode} {batch} {dt*1e3:.2f} tf_s={tfs:.1f} "
+              f"flops={fl:.3e}", flush=True)
+        return
+    elif mode in ("convfwd", "convfwd_nhwc"):
+        layout = "NHWC" if mode.endswith("nhwc") else "NCHW"
+        dt, tfs, fl = mode_convtower(batch, layout=layout, with_bwd=False)
+        print(f"PROBE {mode} {batch} {dt*1e3:.2f} tf_s={tfs:.1f} "
+              f"flops={fl:.3e}", flush=True)
+        return
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+    sps = batch / dt
+    mfu = RESNET50_TRAIN_FLOPS_PER_IMG * sps / 197e12 * 100
+    per_s = ",".join(f"{p*1e3:.1f}" for p in per)
+    print(f"PROBE {mode} {batch} {dt*1e3:.2f} sps={sps:.0f} mfu={mfu:.1f} "
+          f"per_rep_ms={per_s}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Serialized ResNet-50 TPU probes: one subprocess per config (two big models
+# in one TPU process cross-contaminate HBM/wall clocks).
+cd "$(dirname "$0")/.."
+out=probes/resnet_probe_results.txt
+: > "$out"
+for spec in "baseline 64" "fwd 64" "fwdbwd 64" "nobn 64" "o2 64" \
+            "baseline 128" "baseline 256" \
+            "convtower 64" "convtower_nhwc 64" "convfwd 64" "convfwd_nhwc 64"; do
+  set -- $spec
+  echo "=== $1 $2 ===" | tee -a "$out"
+  timeout 900 python probes/resnet_probe.py "$1" "$2" 2>&1 | tail -3 | tee -a "$out"
+done
+echo DONE | tee -a "$out"

@@ -600,3 +600,22 @@ def test_collect_fpn_proposals_roundtrip():
     got = top.numpy()
     order = np.argsort(-cat_scores, kind="stable")[:5]
     np.testing.assert_allclose(got, cat[order], rtol=1e-6)
+
+
+def test_collect_fpn_proposals_per_image_counts():
+    # advisor r3: with rois_num_per_level the op must return one count PER
+    # IMAGE (batch 2 here), with kept rois regrouped by image
+    lvl1 = np.arange(12, dtype="float32").reshape(3, 4)        # imgs [0,0,1]
+    lvl2 = 100 + np.arange(12, dtype="float32").reshape(3, 4)  # imgs [0,1,1]
+    s1 = np.array([0.9, 0.2, 0.8], "float32")
+    s2 = np.array([0.7, 0.1, 0.6], "float32")
+    rois, rois_num = ops.collect_fpn_proposals(
+        [paddle.to_tensor(lvl1), paddle.to_tensor(lvl2)],
+        [paddle.to_tensor(s1), paddle.to_tensor(s2)],
+        min_level=2, max_level=3, post_nms_top_n=4,
+        rois_num_per_level=[paddle.to_tensor(np.array([2, 1], "int32")),
+                            paddle.to_tensor(np.array([1, 2], "int32"))])
+    # global top-4 scores: 0.9 (img0), 0.8 (img1), 0.7 (img0), 0.6 (img1)
+    assert rois_num.numpy().tolist() == [2, 2]
+    np.testing.assert_allclose(
+        rois.numpy(), np.stack([lvl1[0], lvl2[0], lvl1[2], lvl2[2]]))

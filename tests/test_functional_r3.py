@@ -43,6 +43,21 @@ def test_grid_sample_nearest_and_zeros_padding():
     np.testing.assert_allclose(out_n.numpy()[0, 0], x[0, 0])
 
 
+def test_grid_sample_reflection_no_align_corners():
+    # x[y, x] = 4y + x is linear, so bilinear sampling returns 4*fy + fx
+    # exactly.  align_corners=False unnorm: v = ((c+1)*size - 1)/2, so
+    # c=1.35 -> v=4.2 which reflects to 2.8 (reference grid_sampler_op.h:
+    # min(extra, 2*size-extra) - 0.5 with extra=|v+0.5| mod 2*size), and
+    # c=-1.35 -> v=-1.2 which reflects to 0.2.
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    grid = np.array([[[[1.35, 1.35], [-1.35, -1.35]]]], "float32")
+    out = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        padding_mode="reflection", align_corners=False)
+    np.testing.assert_allclose(
+        out.numpy().reshape(-1), [4 * 2.8 + 2.8, 4 * 0.2 + 0.2],
+        rtol=1e-5, atol=1e-5)
+
+
 def test_grid_sample_grad_flows():
     rng = np.random.RandomState(1)
     x = paddle.to_tensor(rng.randn(1, 2, 4, 4).astype("float32"),

@@ -107,6 +107,21 @@ class TestCrypto:
         with pytest.raises(ValueError, match="integrity"):
             c.decrypt(bytes(bad), key)
 
+    def test_v1_downgrade_rejected(self):
+        # advisor r3: rewriting the version byte to 1 and stripping the tag
+        # must not silently bypass the v2 HMAC
+        from paddle_tpu.io.crypto import AESCipher, CipherUtils
+        c = AESCipher()
+        key = CipherUtils.gen_key(128)
+        msg = b"downgrade-me" * 10
+        enc = bytearray(c.encrypt(msg, key))
+        enc[4] = 1                      # version byte
+        v1 = bytes(enc[:-32])           # strip HMAC tag
+        with pytest.raises(ValueError, match="downgrade|legacy|v1"):
+            c.decrypt(v1, key)
+        # explicit opt-in still reads trusted legacy files (CTR unchanged)
+        assert c.decrypt(v1, key, allow_legacy=True) == msg
+
     def test_file_roundtrip(self, tmp_path):
         from paddle_tpu.io.crypto import AESCipher, CipherUtils
         c = AESCipher()

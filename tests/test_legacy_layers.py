@@ -96,6 +96,9 @@ def test_weight_norm_roundtrip_and_grads():
     names = [n for n, _ in lin.named_parameters()]
     assert any("weight_g" in n for n in names)
     assert any("weight_v" in n for n in names)
+    # reference norm_except_dim layout: g is 1-D [d], not keepdims —
+    # state_dicts interchange with reference weight-normed checkpoints
+    assert list(lin.weight_g.shape) == [lin.weight_v.shape[0]]
     assert not any(n.endswith(".weight") or n == "weight" for n in names)
     lin(xin).sum().backward()
     assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
