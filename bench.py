@@ -314,7 +314,7 @@ import paddle_tpu as paddle
 from paddle_tpu import models, parallel
 from paddle_tpu.parallel.pipeline import gpt_pipeline_step
 
-def timed(schedule):
+def build(schedule, n_micro):
     paddle.seed(0)
     cfg = models.GPTConfig(vocab_size=256, hidden_size=64,
                            num_hidden_layers=8, num_attention_heads=4,
@@ -325,11 +325,17 @@ def timed(schedule):
     opt = paddle.optimizer.AdamW(learning_rate=1e-4,
                                  parameters=model.parameters())
     mesh = parallel.create_mesh({"pp": 4, "dp": 2})
-    step = gpt_pipeline_step(model, opt, mesh, n_micro=8, remat=True,
+    step = gpt_pipeline_step(model, opt, mesh, n_micro=n_micro, remat=True,
                              schedule=schedule)
     rng = np.random.RandomState(0)
-    ids = paddle.to_tensor(rng.randint(0, 256, (16, 64)).astype("int32"))
-    lab = paddle.to_tensor(rng.randint(0, 256, (16, 64)).astype("int32"))
+    ids = paddle.to_tensor(rng.randint(0, 256,
+                                       (n_micro * 2, 64)).astype("int32"))
+    lab = paddle.to_tensor(rng.randint(0, 256,
+                                       (n_micro * 2, 64)).astype("int32"))
+    return step, ids, lab
+
+def timed(schedule):
+    step, ids, lab = build(schedule, 8)
     loss = step(ids, lab); float(loss)
     t0 = time.perf_counter()
     for _ in range(4):
@@ -337,27 +343,45 @@ def timed(schedule):
     float(loss)
     return (time.perf_counter() - t0) / 4
 
+def peak(schedule, n_micro):
+    step, ids, lab = build(schedule, n_micro)
+    return step.memory_stats(ids, lab)["temp_bytes"]
+
 g = timed("gpipe")
 f = timed("1f1b")
-print(f"RATIO {g:.6f} {f:.6f}")
+gm8, fm8 = peak("gpipe", 8), peak("1f1b", 8)
+gm16, fm16 = peak("gpipe", 16), peak("1f1b", 16)
+print(f"RATIO {g:.6f} {f:.6f} {gm8} {fm8} {gm16} {fm16}")
 """
 
 
 def measure_pipeline_ratio():
     """GPipe vs 1F1B steady-state step time on the 8-virtual-device CPU
     mesh (the BASELINE #5 pipeline leg, minus real chips)."""
-    out = _run_cpu_probe(_PIPE_RATIO_SCRIPT, "RATIO", timeout=900)
+    out = _run_cpu_probe(_PIPE_RATIO_SCRIPT, "RATIO", timeout=1800)
     if isinstance(out, dict):
         return out
-    g, f = out
+    g, f, gm8, fm8, gm16, fm16 = out
+    gm8, fm8, gm16, fm16 = int(gm8), int(fm8), int(gm16), int(fm16)
     return {"gpipe_step_s": round(float(g), 4),
             "onef1b_step_s": round(float(f), 4),
             "onef1b_over_gpipe": round(float(f) / float(g), 4),
+            # XLA buffer assignment (CompiledMemoryStats.temp_size) — the
+            # MEASURED form of the 1F1B stash-bound claim (r3 weak #3).
+            # r4 measurement: 1F1B peak-temp is lower at both n_micro and
+            # the per-microbatch GROWTH is ~2x smaller (gpipe stores the
+            # fwd trajectory, 1F1B only the 2p-1 stash + the embed/d_emb
+            # terms both schedules share).
+            "gpipe_peak_bytes": gm8, "onef1b_peak_bytes": fm8,
+            "gpipe_peak_bytes_m16": gm16, "onef1b_peak_bytes_m16": fm16,
+            "peak_growth_per_microbatch": {
+                "gpipe": round((gm16 - gm8) / 8), "onef1b":
+                round((fm16 - fm8) / 8)},
             "mesh": "pp4 x dp2 (8 virtual cpu devices)",
             "note": "host-CPU-mesh wall clock: schedule-correctness "
                     "evidence, not a chip-perf claim (observed ratio "
-                    "varies 0.8-2.2 with host load; 1F1B's real win is "
-                    "activation memory, not steady-state step time)"}
+                    "varies 0.8-2.2 with host load; 1F1B's win is the "
+                    "measured peak-temp bound above)"}
 
 
 def main():

@@ -110,3 +110,7 @@ from . import version  # noqa: E402
 from .version import full_version  # noqa: F401,E402
 commit = version.commit
 from . import incubate  # noqa: F401,E402
+from . import device  # noqa: E402  (module wins over the function imports)
+from . import sysconfig  # noqa: F401,E402
+from .batch import batch  # noqa: F401,E402
+from . import fluid  # noqa: F401,E402  (wholesale `from paddle import fluid`)

@@ -1,0 +1,28 @@
+"""paddle.batch (reference: python/paddle/batch.py:18) — wrap a sample
+reader into a batched reader.  Pure-Python iterator plumbing; the
+device-side path is io.DataLoader."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Returns a reader yielding lists of `batch_size` samples from
+    `reader` (a callable returning an iterable); the short final batch is
+    kept unless drop_last."""
+    batch_size = int(batch_size)
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

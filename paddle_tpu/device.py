@@ -1,0 +1,15 @@
+"""paddle.device submodule (reference: python/paddle/device.py) — device
+selection/introspection over the jax backend; implementations live in
+core.device."""
+from __future__ import annotations
+
+from .core.device import (  # noqa: F401
+    set_device, get_device, device_count, is_compiled_with_cuda,
+    is_compiled_with_tpu, is_compiled_with_xpu, get_cudnn_version,
+    XPUPlace, CPUPlace, CUDAPlace, CUDAPinnedPlace,
+)
+
+__all__ = ["set_device", "get_device", "device_count",
+           "is_compiled_with_cuda", "is_compiled_with_tpu",
+           "is_compiled_with_xpu", "get_cudnn_version", "XPUPlace",
+           "CPUPlace", "CUDAPlace", "CUDAPinnedPlace"]

@@ -1,0 +1,44 @@
+"""paddle.fluid alias package — wholesale `from paddle import fluid` ports.
+
+Reference: python/paddle/fluid/__init__.py.  Pure wiring (documented as
+such): every name resolves to its 2.0-native home in this repo — tracing
+replaces Programs, masked-dense tensors replace LoD — so era code keeps
+its spelling while running the TPU-native path.
+"""
+from __future__ import annotations
+
+# executor / program machinery (static shims)
+from ..static import (  # noqa: F401
+    Program, Executor, CompiledProgram, ParallelExecutor, BuildStrategy,
+    ExecutionStrategy, Scope, Variable, default_main_program,
+    default_startup_program, program_guard, name_scope, global_scope,
+    scope_guard, cpu_places, cuda_places, append_backward, gradients,
+    load_program_state, set_program_state, save, load,
+)
+from ..compat import (  # noqa: F401
+    data, create_global_var, fill_constant, LoDTensor, LoDTensorArray,
+    get_tensor_from_selected_rows,
+)
+from ..core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace, is_compiled_with_cuda,
+    device_count as core_device_count,
+)
+from ..nn.layer_base import ParamAttr  # noqa: F401
+from ..nn import initializer  # noqa: F401
+from .. import regularizer  # noqa: F401
+from ..core.tensor import Tensor  # noqa: F401
+from ..utils.checkpoint import save as save_dygraph, load as load_dygraph  # noqa: F401
+
+from . import layers  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import io  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import core  # noqa: F401
+
+# fluid.embedding / one_hot live at the package top level too
+from .layers import embedding, one_hot  # noqa: F401
+
+
+def install_check():  # fluid.install_check.run_check analogue
+    from ..utils import run_check
+    run_check()

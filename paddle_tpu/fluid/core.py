@@ -1,0 +1,24 @@
+"""fluid.core alias module (reference: paddle/fluid/pybind — the C++
+binding surface).  The handles era code touches resolve to their Python
+homes; there is no separate binding layer to expose (SURVEY: pybind is
+subsumed by running on jax)."""
+from __future__ import annotations
+
+from ..compat import (  # noqa: F401
+    LoDTensor, LoDTensorArray, get_tensor_from_selected_rows,
+)
+from ..core.device import (  # noqa: F401
+    CPUPlace, CUDAPlace, CUDAPinnedPlace, XPUPlace,
+)
+from ..core.selected_rows import RowSparseGrad as SelectedRows  # noqa: F401
+from ..core.errors import EnforceNotMet  # noqa: F401
+
+
+def get_cuda_device_count():
+    from ..core.device import device_count
+    return device_count()
+
+
+def is_compiled_with_cuda():
+    from ..core.device import is_compiled_with_cuda as f
+    return f()

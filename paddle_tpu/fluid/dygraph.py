@@ -1,0 +1,33 @@
+"""fluid.dygraph alias module (reference: python/paddle/fluid/dygraph/).
+Eager IS the execution model here, so guard() is a no-op context and
+to_variable is to_tensor."""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer_base import Layer, ParamAttr  # noqa: F401
+from ..nn import (  # noqa: F401
+    Linear, Conv2D, BatchNorm, Embedding, Dropout, LayerNorm, GRUCell,
+    LSTMCell, Sequential, LayerList, ParameterList,
+)
+from ..nn.legacy_layers import Pool2D, NCELoss as NCE  # noqa: F401
+from ..core.tensor import Tensor  # noqa: F401
+from ..tensor.creation import to_tensor as to_variable  # noqa: F401
+from ..utils.checkpoint import (  # noqa: F401
+    save as save_dygraph, load as load_dygraph,
+)
+from ..distributed.parallel_layer import DataParallel  # noqa: F401
+from ..jit import to_static as jit_to_static  # noqa: F401
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """fluid.dygraph.guard — eager is always on; accepted for compat."""
+    yield
+
+
+def enabled():
+    return True
+
+
+no_grad = __import__("paddle_tpu.core.tensor", fromlist=["no_grad"]).no_grad

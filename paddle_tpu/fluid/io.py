@@ -1,0 +1,27 @@
+"""fluid.io alias module (reference: python/paddle/fluid/io.py) — save /
+load / inference-model entry points over the 2.0 io + jit homes."""
+from __future__ import annotations
+
+from ..framework import save, load  # noqa: F401
+from ..utils.checkpoint import (  # noqa: F401
+    save as save_dygraph, load as load_dygraph,
+)
+from ..jit import (  # noqa: F401
+    save as save_inference_model, load as load_inference_model,
+)
+from ..io import DataLoader  # noqa: F401
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    """Static-era save_params: persist the tracked program state."""
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    save(prog._state, dirname if filename is None
+         else f"{dirname}/{filename}")
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    prog._state = load(dirname if filename is None
+                       else f"{dirname}/{filename}", return_numpy=True)

@@ -1,0 +1,76 @@
+"""fluid.layers alias module (reference: python/paddle/fluid/layers/) —
+the era-typical flat namespace, re-exported from the 2.0-native homes:
+tensor ops from `tensor`, nn functionals from `nn.functional`, detection
+from `vision.ops`, dynamic RNN from `nn.legacy_rnn`, control flow +
+TensorArray verbs from `static.nn`.  Wiring only; see each target for the
+implementation and its reference citation."""
+from __future__ import annotations
+
+# --- tensor / math (2.0 names are a superset of the fluid ones) ---------
+from ..tensor import *  # noqa: F401,F403
+from ..compat import (  # noqa: F401
+    reduce_max, reduce_min, reduce_mean, reduce_prod, reduce_sum,
+    elementwise_floordiv, elementwise_mod, elementwise_pow, fill_constant,
+    create_global_var, data, tensordot, has_inf, has_nan, crop_tensor,
+)
+from ..tensor.math import (  # noqa: F401
+    add as elementwise_add, subtract as elementwise_sub,
+    multiply as elementwise_mul, divide as elementwise_div,
+    maximum as elementwise_max, minimum as elementwise_min,
+)
+
+# --- nn functionals (activations, fc, pooling, losses, sequence) --------
+from ..nn.functional import *  # noqa: F401,F403
+from ..nn.functional import (  # noqa: F401
+    fc, pool2d, pool3d, pad2d, smooth_l1, softmax_with_cross_entropy,
+    sequence_pad, sequence_unpad, sequence_pool, sequence_softmax,
+    sequence_reverse, sequence_concat, sequence_enumerate,
+    sequence_expand_as, linear_chain_crf, crf_decoding,
+)
+from ..nn.functional.loss import (  # noqa: F401
+    binary_cross_entropy_with_logits as sigmoid_cross_entropy_with_logits,
+)
+
+# --- embeddings ----------------------------------------------------------
+from ..nn.functional.common import embedding  # noqa: F401
+from ..nn.functional.common import one_hot  # noqa: F401
+
+# --- dynamic RNN + units (masked-dense LoD answer) ----------------------
+from ..nn.legacy_rnn import (  # noqa: F401
+    dynamic_lstm, dynamic_lstmp, dynamic_gru, gru_unit, lstm_unit,
+)
+from ..nn.legacy_layers import (  # noqa: F401
+    StaticRNN, ctc_greedy_decoder, clip_by_norm, nce, data_norm,
+    affine_channel, center_loss, im2sequence,
+)
+
+# --- control flow + TensorArray -----------------------------------------
+from ..static.nn import (  # noqa: F401
+    while_loop, cond, case, switch_case, create_array, array_write,
+    array_read, array_length,
+)
+
+# --- detection family (vision.ops is the 2.0 home) ----------------------
+from ..vision.ops import (  # noqa: F401
+    prior_box, density_prior_box, anchor_generator, box_coder,
+    iou_similarity, box_clip, bipartite_match, target_assign, ssd_loss,
+    detection_output, multiclass_nms, yolo_box, roi_align, roi_pool,
+    psroi_pool, prroi_pool, deformable_roi_pooling, generate_proposals,
+    distribute_fpn_proposals, collect_fpn_proposals,
+)
+from ..vision.ops import yolo_loss as yolov3_loss  # noqa: F401
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   head=None, **kwargs):
+    """fluid.layers.multi_box_head: stateful conv heads cannot be built by
+    a traced function (no LayerHelper param store) — construct a
+    `paddle.vision.models.MultiBoxHead` once and pass it as `head`, or use
+    it directly as a Layer."""
+    from ..core.errors import InvalidArgumentError
+    if head is None:
+        raise InvalidArgumentError(
+            "multi_box_head: pass `head=MultiBoxHead(...)` (see "
+            "paddle.vision.models.MultiBoxHead) — the repo's fluid "
+            "convention for LayerHelper-created parameters")
+    return head(inputs, image)

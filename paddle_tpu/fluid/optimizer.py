@@ -1,0 +1,20 @@
+"""fluid.optimizer alias module (reference:
+python/paddle/fluid/optimizer.py) — the *Optimizer spellings over the 2.0
+optimizer classes.  fluid's `learning_rate` positional convention matches
+the 2.0 classes here, so aliasing is exact."""
+from __future__ import annotations
+
+from ..optimizer import (  # noqa: F401
+    SGD, Momentum, Adagrad, Adam, AdamW, Adamax, RMSProp, Adadelta, Lamb,
+    LarsMomentum,
+)
+
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+RMSPropOptimizer = RMSProp
+AdadeltaOptimizer = Adadelta
+LambOptimizer = Lamb
+LarsMomentumOptimizer = LarsMomentum

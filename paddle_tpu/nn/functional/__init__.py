@@ -253,3 +253,12 @@ def teacher_student_sigmoid_loss(input, label,  # noqa: A002
         log1pez = jnp.log1p(jnp.exp(-jnp.abs(z))) + jnp.maximum(z, 0)
         return (log1pez - hard * z) + (log1pez - soft * z)
     return _dispatch("teacher_student_sigmoid_loss", raw, input, label)
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, **kwargs):
+    """paddle.nn.functional.ssd_loss (reference alias of
+    fluid/layers/detection.py:1513) — implementation in vision.ops."""
+    from ...vision.ops import ssd_loss as _impl
+    return _impl(location, confidence, gt_box, gt_label, prior_box,
+                 prior_box_var, **kwargs)

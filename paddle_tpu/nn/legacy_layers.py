@@ -24,7 +24,8 @@ from . import initializer as I
 
 __all__ = ["HSigmoidLoss", "NCELoss", "RowConv", "Pool2D", "StaticRNN",
            "BilinearTensorProduct", "ctc_greedy_decoder", "clip_by_norm",
-           "nce"]
+           "nce", "DataNorm", "data_norm", "affine_channel", "center_loss",
+           "im2sequence"]
 
 
 class HSigmoidLoss(Layer):
@@ -264,3 +265,188 @@ def clip_by_norm(x, max_norm, name=None):
         scale = jnp.minimum(max_norm / jnp.maximum(norm, 1e-12), 1.0)
         return (v.astype(jnp.float32) * scale).astype(v.dtype)
     return dispatch("clip_by_norm", raw, x)
+
+
+class DataNorm(Layer):
+    """fluid data_norm (reference: fluid/layers/nn.py:3217 over
+    data_norm_op.cc): normalize by ACCUMULATED global per-channel stats
+    (batch_size / batch_sum / batch_square_sum) rather than per-batch
+    moments.  The reference threads the stat update through a fake
+    gradient (data_norm_op.cc:661-695); here training forwards update the
+    buffers directly with the same running-summary semantics."""
+
+    def __init__(self, channels, epsilon=1e-5, data_layout="NCHW",
+                 summary_decay_rate=0.9999999,
+                 enable_scale_and_shift=False):
+        super().__init__()
+        self.epsilon = epsilon
+        self.data_layout = data_layout
+        self.decay = summary_decay_rate
+        init_val = 1e4
+        self.batch_size = self.create_parameter(
+            [channels], default_initializer=I.Constant(init_val))
+        self.batch_sum = self.create_parameter(
+            [channels], default_initializer=I.Constant(0.0))
+        self.batch_square_sum = self.create_parameter(
+            [channels], default_initializer=I.Constant(init_val))
+        for p in (self.batch_size, self.batch_sum, self.batch_square_sum):
+            p.trainable = False
+        self.enable_scale_and_shift = enable_scale_and_shift
+        if enable_scale_and_shift:
+            self.scale_w = self.create_parameter(
+                [channels], default_initializer=I.Constant(1.0))
+            self.bias = self.create_parameter(
+                [channels], default_initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        axis = 1 if self.data_layout.startswith("NC") else -1
+        xv = unwrap(x)
+        shape = [1] * xv.ndim
+        shape[axis] = -1
+        # normalize with the stats AS OF ENTRY (the reference applies its
+        # gradient-carried update after the step), then accumulate
+        entry = (unwrap(self.batch_size), unwrap(self.batch_sum),
+                 unwrap(self.batch_square_sum))
+        if self.training and not isinstance(xv, jax.core.Tracer):
+            red = tuple(i for i in range(xv.ndim) if i != axis % xv.ndim)
+            n = 1
+            for i in red:
+                n *= xv.shape[i]
+            d = self.decay
+            self.batch_size._set_data(
+                d * entry[0] + jnp.full_like(entry[0], float(n)))
+            self.batch_sum._set_data(d * entry[1] + jnp.sum(xv, axis=red))
+            self.batch_square_sum._set_data(
+                d * entry[2] + jnp.sum(jnp.square(xv), axis=red))
+
+        def raw(xv, bsz, bsum, bsq, *sw):
+            mean = (bsum / bsz).reshape(shape)
+            scale = jnp.sqrt(bsq / bsz + self.epsilon).reshape(shape)
+            out = (xv - mean) / scale
+            if sw:
+                out = out * sw[0].reshape(shape) + sw[1].reshape(shape)
+            return out
+
+        extra = ((self.scale_w, self.bias)
+                 if self.enable_scale_and_shift else ())
+        return dispatch("data_norm", raw, x, Tensor(entry[0]),
+                        Tensor(entry[1]), Tensor(entry[2]), *extra)
+
+
+def _apply_act(out, act):
+    """fluid layers' trailing `act` hook — fail loudly on an unknown name
+    rather than silently returning the un-activated output."""
+    if act is None:
+        return out
+    from . import functional as _F
+    fn = getattr(_F, act, None)
+    if fn is None:
+        raise NotImplementedError(f"unsupported act={act!r}")
+    return fn(out)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,  # noqa: A002
+              data_layout="NCHW", stats=None, name=None, **_ignored):
+    """Functional data_norm: pass `stats` = (batch_size, batch_sum,
+    batch_square_sum) explicitly (the repo's fluid convention, see
+    nn.functional.fc) or use the DataNorm layer for the stateful form."""
+    from ..core.errors import InvalidArgumentError
+    if stats is None:
+        raise InvalidArgumentError(
+            "data_norm: pass stats=(batch_size, batch_sum, "
+            "batch_square_sum) explicitly, or use nn.DataNorm")
+    bsz, bsum, bsq = stats
+    axis = 1 if data_layout.startswith("NC") else -1
+
+    def raw(xv, bsz, bsum, bsq):
+        shape = [1] * xv.ndim
+        shape[axis] = -1
+        mean = (bsum / bsz).reshape(shape)
+        scale = jnp.sqrt(bsq / bsz + epsilon).reshape(shape)
+        return (xv - mean) / scale
+
+    return _apply_act(dispatch("data_norm", raw, input, bsz, bsum, bsq),
+                      act)
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", act=None,
+                   name=None):
+    """fluid affine_channel (reference: fluid/layers/nn.py:12636): per
+    channel x * scale + bias — scale/bias are INPUT tensors (C,) in the
+    reference too, so this is directly portable."""
+    axis = 1 if data_layout.startswith("NC") else -1
+
+    def raw(xv, sv, bv):
+        shape = [1] * xv.ndim
+        shape[axis] = -1
+        out = xv
+        if sv is not None:
+            out = out * sv.reshape(shape)
+        if bv is not None:
+            out = out + bv.reshape(shape)
+        return out
+
+    return _apply_act(dispatch("affine_channel", raw, x, scale, bias), act)
+
+
+def center_loss(input, label, num_classes, alpha, centers=None,  # noqa: A002
+                param_attr=None, update_center=True, name=None):
+    """Center loss (reference: fluid/layers/loss.py:54 over
+    center_loss_op): 0.5 * ||x - center_{label}||^2 per sample, with the
+    class centers nudged toward their members when update_center.  Centers
+    are explicit (the repo's fluid convention) — pass a (num_classes, D)
+    parameter/Tensor."""
+    from ..core.errors import InvalidArgumentError
+    if centers is None:
+        raise InvalidArgumentError(
+            "center_loss: pass `centers` (a [num_classes, D] parameter) "
+            "explicitly — tracing has no LayerHelper param store")
+    lab = unwrap(label).reshape(-1).astype(jnp.int32)
+
+    def raw(xv, cv):
+        diff = xv - cv[lab]
+        return 0.5 * jnp.sum(jnp.square(diff), axis=-1, keepdims=True)
+
+    out = dispatch("center_loss", raw, input, centers)
+    if update_center and not isinstance(unwrap(input), jax.core.Tracer):
+        xv = unwrap(input)
+        cv = unwrap(centers)
+        diff = cv[lab] - xv                              # (N, D)
+        delta = jnp.zeros_like(cv).at[lab].add(diff)
+        count = jnp.zeros((cv.shape[0],), xv.dtype).at[lab].add(1.0)
+        centers._set_data(cv - alpha * delta / (1.0 + count)[:, None])
+    return out
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0,  # noqa: A002
+                input_image_size=None, out_stride=1, name=None):
+    """fluid im2sequence (reference: fluid/layers/nn.py:5524 over
+    im2sequence_op): slide a filter over (N, C, H, W) and emit one row per
+    window, (N * OH * OW, C * fh * fw), windows in raster order, row
+    layout (c, fh, fw) — the im2col sequence form.  TPU-native:
+    lax.conv_general_dilated_patches emits exactly this layout."""
+    if input_image_size is not None:
+        raise NotImplementedError(
+            "im2sequence: per-image real-size windows (input_image_size/"
+            "out_stride) are a dynamic-shape contract that cannot jit; "
+            "crop per image before calling, or open the padded windows "
+            "with the default path")
+    fh, fw = ((filter_size, filter_size)
+              if isinstance(filter_size, int) else tuple(filter_size))
+    sh, sw = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    if isinstance(padding, int):
+        pu = pd = pl = pr = padding
+    elif len(padding) == 2:
+        pu = pd = padding[0]
+        pl = pr = padding[1]
+    else:
+        pu, pl, pd, pr = padding
+
+    def raw(xv):
+        patches = jax.lax.conv_general_dilated_patches(
+            xv, (fh, fw), (sh, sw), [(pu, pd), (pl, pr)])
+        # (N, C*fh*fw, OH, OW) -> (N*OH*OW, C*fh*fw)
+        n, cf, oh, ow = patches.shape
+        return patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, cf)
+
+    return dispatch("im2sequence", raw, input)

@@ -1,0 +1,275 @@
+"""Fluid 1.x dynamic-RNN functional surface.
+
+Reference: python/paddle/fluid/layers/rnn.py — dynamic_lstm(:2249),
+dynamic_lstmp(:2603), dynamic_gru(:2822), gru_unit(:2985), lstm_unit(:3379)
+over the lstm/lstmp/gru/gru_unit/lstm_unit op kernels.
+
+TPU-native: the LoD inputs become masked-dense (B, T, ...) batches with an
+optional `sequence_length` (the repo's LoD answer); the time loop is one
+`lax.scan` (no DynamicRNN program regions); and — the repo's fluid
+convention (see nn.functional.fc) — recurrent weights are EXPLICIT
+arguments instead of LayerHelper-created state.  Gate layouts match the
+reference kernels exactly so reference-trained weights drop in:
+  lstm  W (H, 4H) gates [c, i, f, o]; bias (1, 4H), peephole (1, 7H)
+        appending [W_ic, W_fc, W_oc]
+  lstmp W (P, 4H), projection (H, P)
+  gru   W (D, 3D): [W_u | W_r] then W_c; bias (1, 3D)
+  lstm_unit W (Dx+Dh, 4Dh) gates [i, f, o, g] (lstm_unit_op.h:64-67)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import InvalidArgumentError
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+
+__all__ = ["dynamic_lstm", "dynamic_lstmp", "dynamic_gru", "gru_unit",
+           "lstm_unit"]
+
+_ACTS = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+def _act(name):
+    if name not in _ACTS:
+        raise InvalidArgumentError(
+            f"unsupported activation {name!r}; expected one of "
+            f"{sorted(_ACTS)}")
+    return _ACTS[name]
+
+
+def _need(weight, op):
+    if weight is None:
+        raise InvalidArgumentError(
+            f"{op}: pass `weight` explicitly (tracing has no LayerHelper "
+            f"param store; see nn.functional.fc for the convention) or use "
+            f"nn.LSTM/nn.GRU for the stateful form")
+
+
+def _mask_seq(xv, sequence_length):
+    if sequence_length is None:
+        return None
+    sl = unwrap(sequence_length)
+    return (jnp.arange(xv.shape[1])[None, :] < sl[:, None]).astype(xv.dtype)
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, weight=None, bias=None,  # noqa: A002
+                 use_peepholes=True, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32",
+                 sequence_length=None, name=None, **_ignored):
+    """Returns (hidden (B, T, H), cell (B, T, H)).  `input` is the
+    PRE-PROJECTED (B, T, 4H) batch (the reference contract: an fc of size
+    4*hidden feeds the op)."""
+    _need(weight, "dynamic_lstm")
+    h = size // 4
+    actg = _act(gate_activation)
+    actc = _act(cell_activation)
+    actd = _act(candidate_activation)
+
+    def raw(xv, wv, bv, h0, c0):
+        b = xv.shape[0]
+        mask = _mask_seq(xv, sequence_length)
+        hp = jnp.zeros((b, h), xv.dtype) if h0 is None else h0
+        cp = jnp.zeros((b, h), xv.dtype) if c0 is None else c0
+        bb = bv.reshape(-1) if bv is not None else jnp.zeros(
+            (7 * h if use_peepholes else 4 * h,), xv.dtype)
+        w_ic, w_fc, w_oc = (
+            (bb[4 * h:5 * h], bb[5 * h:6 * h], bb[6 * h:7 * h])
+            if use_peepholes else (0.0, 0.0, 0.0))
+
+        xs = jnp.swapaxes(xv, 0, 1)                     # (T, B, 4H)
+        if is_reverse:
+            xs = xs[::-1]
+        ms = (jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None
+              else None)
+        if ms is not None and is_reverse:
+            ms = ms[::-1]
+
+        def step(carry, inp):
+            hp, cp = carry
+            x_t, m_t = inp
+            g = x_t + hp @ wv + bb[:4 * h]
+            gc, gi, gf, go = jnp.split(g, 4, axis=-1)   # [c, i, f, o]
+            i = actg(gi + w_ic * cp if use_peepholes else gi)
+            f = actg(gf + w_fc * cp if use_peepholes else gf)
+            c = f * cp + i * actd(gc)
+            o = actg(go + w_oc * c if use_peepholes else go)
+            hn = o * actc(c)
+            if m_t is not None:
+                hn = m_t * hn + (1 - m_t) * hp
+                c = m_t * c + (1 - m_t) * cp
+            return (hn, c), (hn, c)
+
+        # one scan handles both cases via a mask of ones
+        m_use = ms if ms is not None else jnp.ones(
+            (xs.shape[0], b, 1), xv.dtype)
+        (_, _), (hs, cs) = jax.lax.scan(step, (hp, cp), (xs, m_use))
+        if is_reverse:
+            hs, cs = hs[::-1], cs[::-1]
+        return jnp.swapaxes(hs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+    return dispatch("dynamic_lstm", raw, input, weight, bias, h_0, c_0)
+
+
+def dynamic_lstmp(input, size, proj_size, weight=None, proj_weight=None,  # noqa: A002
+                  bias=None, use_peepholes=True, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="tanh",
+                  dtype="float32", h_0=None, c_0=None, cell_clip=None,
+                  proj_clip=None, sequence_length=None, name=None,
+                  **_ignored):
+    """LSTM with recurrent projection (reference rnn.py:2603): the
+    recurrence runs on the P-dim projection r_t = proj_act(h_t @ proj_w).
+    Returns (projection (B, T, P), cell (B, T, H))."""
+    _need(weight, "dynamic_lstmp")
+    _need(proj_weight, "dynamic_lstmp")
+    h = size // 4
+    actg = _act(gate_activation)
+    actc = _act(cell_activation)
+    actd = _act(candidate_activation)
+    actp = _act(proj_activation)
+
+    def raw(xv, wv, pw, bv, h0, c0):
+        b = xv.shape[0]
+        mask = _mask_seq(xv, sequence_length)
+        rp = jnp.zeros((b, pw.shape[1]), xv.dtype) if h0 is None else h0
+        cp = jnp.zeros((b, h), xv.dtype) if c0 is None else c0
+        bb = bv.reshape(-1) if bv is not None else jnp.zeros(
+            (7 * h if use_peepholes else 4 * h,), xv.dtype)
+        w_ic, w_fc, w_oc = (
+            (bb[4 * h:5 * h], bb[5 * h:6 * h], bb[6 * h:7 * h])
+            if use_peepholes else (0.0, 0.0, 0.0))
+        xs = jnp.swapaxes(xv, 0, 1)
+        if is_reverse:
+            xs = xs[::-1]
+        ms = (jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None
+              else jnp.ones((xs.shape[0], b, 1), xv.dtype))
+        if mask is not None and is_reverse:
+            ms = ms[::-1]
+
+        def step(carry, inp):
+            rp, cp = carry
+            x_t, m_t = inp
+            g = x_t + rp @ wv + bb[:4 * h]
+            gc, gi, gf, go = jnp.split(g, 4, axis=-1)
+            i = actg(gi + w_ic * cp if use_peepholes else gi)
+            f = actg(gf + w_fc * cp if use_peepholes else gf)
+            c = f * cp + i * actd(gc)
+            if cell_clip is not None:
+                c = jnp.clip(c, -cell_clip, cell_clip)
+            o = actg(go + w_oc * c if use_peepholes else go)
+            hn = o * actc(c)
+            r = actp(hn @ pw)
+            if proj_clip is not None:
+                r = jnp.clip(r, -proj_clip, proj_clip)
+            r = m_t * r + (1 - m_t) * rp
+            c = m_t * c + (1 - m_t) * cp
+            return (r, c), (r, c)
+
+        (_, _), (rs, cs) = jax.lax.scan(step, (rp, cp), (xs, ms))
+        if is_reverse:
+            rs, cs = rs[::-1], cs[::-1]
+        return jnp.swapaxes(rs, 0, 1), jnp.swapaxes(cs, 0, 1)
+
+    return dispatch("dynamic_lstmp", raw, input, weight, proj_weight, bias,
+                    h_0, c_0)
+
+
+def _gru_step(x_t, hp, wv, bb, actg, actc, origin_mode):
+    d = hp.shape[-1]
+    xu, xr, xc = jnp.split(x_t + bb, 3, axis=-1)
+    ur = hp @ wv[:, :2 * d]
+    u = actg(xu + ur[:, :d])
+    r = actg(xr + ur[:, d:])
+    rh = r * hp
+    c = actc(xc + rh @ wv[:, 2 * d:])
+    if origin_mode:
+        hn = u * hp + (1 - u) * c
+    else:
+        hn = (1 - u) * hp + u * c
+    return hn, rh, jnp.concatenate([u, r, c], axis=-1)
+
+
+def dynamic_gru(input, size, weight=None, bias=None, is_reverse=False,  # noqa: A002
+                gate_activation="sigmoid", candidate_activation="tanh",
+                h_0=None, origin_mode=False, sequence_length=None,
+                name=None, **_ignored):
+    """Returns hidden (B, T, D).  `input` is the pre-projected (B, T, 3D)
+    batch; weight (D, 3D) = [W_u | W_r | W_c] (reference layout)."""
+    _need(weight, "dynamic_gru")
+    actg = _act(gate_activation)
+    actc = _act(candidate_activation)
+
+    def raw(xv, wv, bv, h0):
+        b = xv.shape[0]
+        mask = _mask_seq(xv, sequence_length)
+        hp = jnp.zeros((b, size), xv.dtype) if h0 is None else h0
+        bb = bv.reshape(-1) if bv is not None else jnp.zeros((3 * size,),
+                                                            xv.dtype)
+        xs = jnp.swapaxes(xv, 0, 1)
+        if is_reverse:
+            xs = xs[::-1]
+        ms = (jnp.swapaxes(mask, 0, 1)[..., None] if mask is not None
+              else jnp.ones((xs.shape[0], b, 1), xv.dtype))
+        if mask is not None and is_reverse:
+            ms = ms[::-1]
+
+        def step(hp, inp):
+            x_t, m_t = inp
+            hn, _, _ = _gru_step(x_t, hp, wv, bb, actg, actc, origin_mode)
+            hn = m_t * hn + (1 - m_t) * hp
+            return hn, hn
+
+        _, hs = jax.lax.scan(step, hp, (xs, ms))
+        if is_reverse:
+            hs = hs[::-1]
+        return jnp.swapaxes(hs, 0, 1)
+
+    return dispatch("dynamic_gru", raw, input, weight, bias, h_0)
+
+
+def gru_unit(input, hidden, size, weight=None, bias=None,  # noqa: A002
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False, name=None, **_ignored):
+    """One GRU step (reference rnn.py:2985).  Returns
+    (new_hidden (B, D), reset_hidden_pre (B, D), gates (B, 3D))."""
+    _need(weight, "gru_unit")
+    actg = _act(gate_activation)
+    actc = _act(activation)
+    d = size // 3  # reference convention: callers pass 3*hidden_size
+
+    def raw(xv, hv, wv, bv):
+        bb = bv.reshape(-1) if bv is not None else jnp.zeros((3 * d,),
+                                                             xv.dtype)
+        hn, rh, g = _gru_step(xv, hv, wv, bb, actg, actc, origin_mode)
+        return hn, rh, g
+
+    return dispatch("gru_unit", raw, input, hidden, weight, bias)
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,  # noqa: A002
+              weight=None, bias=None, name=None, **_ignored):
+    """One LSTM step over concat([x, h]) @ W (reference rnn.py:3379 +
+    lstm_unit_op.h:64-67, gates [i, f, o, g]).  Returns (hidden, cell)."""
+    _need(weight, "lstm_unit")
+
+    def raw(xv, hv, cv, wv, bv):
+        g = jnp.concatenate([xv, hv], axis=-1) @ wv
+        if bv is not None:
+            g = g + bv.reshape(-1)
+        gi, gf, go, gg = jnp.split(g, 4, axis=-1)
+        i = jax.nn.sigmoid(gi)
+        f = jax.nn.sigmoid(gf + forget_bias)
+        o = jax.nn.sigmoid(go)
+        c = f * cv + i * jnp.tanh(gg)
+        return o * jnp.tanh(c), c
+
+    return dispatch("lstm_unit", raw, x_t, hidden_t_prev, cell_t_prev,
+                    weight, bias)

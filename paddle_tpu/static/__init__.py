@@ -234,3 +234,9 @@ def set_program_state(program, state_dict):
 # closest live object to a Variable is the Tensor itself
 ParallelExecutor = CompiledProgram
 from ..core.tensor import Tensor as Variable  # noqa: E402
+
+# the fluid graph-builder verbs era code reaches via paddle.static.*
+# (reference python/paddle/static/__init__.py re-exports)
+from ..compat import data, create_global_var  # noqa: E402,F401
+from ..tensor.creation import create_parameter  # noqa: E402,F401
+from ..framework import save, load  # noqa: E402,F401

@@ -25,7 +25,9 @@ import jax.numpy as jnp
 
 from ..core.tensor import Tensor, unwrap
 
-__all__ = ["while_loop", "cond", "case", "switch_case"]
+__all__ = ["while_loop", "cond", "case", "switch_case",
+           "create_array", "array_write", "array_read",
+           "array_length"]
 
 
 def _is_traced(*vals):
@@ -171,3 +173,54 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     match = key_arr == idx
     dense = jnp.where(jnp.any(match), jnp.argmax(match), len(fns))
     return _wrap_tree(jax.lax.switch(dense, table))
+
+
+# ---------------------------------------------------------------------------
+# TensorArray verbs (reference: fluid/layers/control_flow.py —
+# array_write:1455, array_read:1894, array_length:2023, create_array:1552).
+# TPU-native: LoDTensorArray is a plain Python list (compat.py:124); these
+# verbs give era-typical code its spelling.  In eager/StaticRNN use the
+# index may be a Tensor or int; inside lax loops use lax.scan-carried
+# dense buffers instead (the repo's jit answer to dynamic arrays).
+
+
+def create_array(dtype="float32", initialized_list=None):
+    from ..compat import LoDTensorArray
+    arr = LoDTensorArray()
+    if initialized_list:
+        arr.extend(initialized_list)
+    return arr
+
+
+def _arr_index(i):
+    from ..core.tensor import Tensor
+    if isinstance(i, Tensor):
+        return int(i.numpy().reshape(()))
+    return int(i)
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = create_array()
+    idx = _arr_index(i)
+    if idx > len(array):
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"array_write: index {idx} would leave unwritten slots "
+            f"(array length {len(array)}); the reference requires "
+            f"i <= len(array)")
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    return array[_arr_index(i)]
+
+
+def array_length(array):
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(len(array), jnp.int64), stop_gradient=True)

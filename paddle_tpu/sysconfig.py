@@ -1,0 +1,21 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py) — install
+tree introspection: include dir (C API headers, native/include) and lib
+dir (the ctypes-built native modules)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of the native sources/headers (native/src)."""
+    return os.path.join(_ROOT, "native", "src")
+
+
+def get_lib() -> str:
+    """Directory holding the built native shared objects
+    (libpdtpu_*.so live next to native/__init__.py)."""
+    return os.path.join(_ROOT, "native")

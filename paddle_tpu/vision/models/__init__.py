@@ -5,6 +5,8 @@ from .resnet import (ResNet, BasicBlock, BottleneckBlock,  # noqa: F401
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from .mobilenet import (MobileNetV1, MobileNetV2,  # noqa: F401
                         mobilenet_v1, mobilenet_v2)
+from .ssd import (MultiBoxHead, SSDMobileNetV1,  # noqa: F401
+                  ssd_mobilenet_v1)
 
 
 # reference module-name aliases (models.mobilenetv1/mobilenetv2 modules)

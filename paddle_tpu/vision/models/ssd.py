@@ -1,0 +1,218 @@
+"""SSD detection family: MultiBoxHead + SSD over a MobileNetV1 backbone.
+
+Reference: fluid/layers/detection.py:2106 multi_box_head (LayerHelper-built
+conv heads + prior_box per feature map, concatenated) — rebuilt as a proper
+Layer (this repo's answer to LayerHelper params, like nn/legacy_layers.py),
+so the whole model trains through TrainStep and serves through the padded
+NMS path.  The classic SSD-MobileNet wiring follows the reference's
+PaddleCV/ssd mobilenet_ssd config (extra depthwise blocks + 6 heads).
+"""
+from __future__ import annotations
+
+import math
+
+from ... import nn
+from ...tensor.manipulation import concat, flatten, reshape, transpose
+from .. import ops as vops
+from .mobilenet import ConvBNLayer, DepthwiseSeparable, MobileNetV1
+
+__all__ = ["MultiBoxHead", "SSDMobileNetV1", "ssd_mobilenet_v1"]
+
+
+def _num_priors(min_size, max_size, aspect_ratio, flip):
+    """Prior count per cell, matching prior_box's wh enumeration."""
+    if not isinstance(min_size, (list, tuple)):
+        min_size = [min_size]
+    if max_size is not None and not isinstance(max_size, (list, tuple)):
+        max_size = [max_size]
+    ars = [1.0]
+    for ar in (aspect_ratio or []):
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    return len(ars) * len(min_size) + (len(max_size) if max_size else 0)
+
+
+def _ratio_schedule(base_size, min_ratio, max_ratio, num_layer):
+    """The reference's min/max size derivation (detection.py:2285-2294):
+    first head at base*0.10/0.20, rest on an even ratio walk."""
+    min_sizes, max_sizes = [], []
+    step = int(math.floor((max_ratio - min_ratio) / (num_layer - 2)))
+    for ratio in range(min_ratio, max_ratio + 1, step):
+        min_sizes.append(base_size * ratio / 100.0)
+        max_sizes.append(base_size * (ratio + step) / 100.0)
+    return ([base_size * 0.10] + min_sizes[:num_layer - 1],
+            [base_size * 0.20] + max_sizes[:num_layer - 1])
+
+
+class MultiBoxHead(nn.Layer):
+    """SSD prediction head over a list of feature maps.
+
+    forward(feats, image) -> (mbox_locs (N, P, 4), mbox_confs (N, P, C),
+    boxes (P, 4), variances (P, 4)) with P the total prior count — the
+    reference multi_box_head's four outputs.
+    """
+
+    def __init__(self, in_channels, base_size, num_classes, aspect_ratios,
+                 min_ratio=None, max_ratio=None, min_sizes=None,
+                 max_sizes=None, steps=None, step_w=None, step_h=None,
+                 offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                 clip=False, kernel_size=1, pad=0, stride=1,
+                 min_max_aspect_ratios_order=False):
+        super().__init__()
+        num_layer = len(in_channels)
+        if min_sizes is None:
+            if num_layer < 3 or min_ratio is None or max_ratio is None:
+                raise ValueError(
+                    "multi_box_head: give min_sizes/max_sizes explicitly, "
+                    "or min_ratio/max_ratio with >= 3 feature maps")
+            min_sizes, max_sizes = _ratio_schedule(
+                base_size, min_ratio, max_ratio, num_layer)
+        self.min_sizes = min_sizes
+        self.max_sizes = max_sizes
+        self.aspect_ratios = aspect_ratios
+        self.num_classes = num_classes
+        self.variance = tuple(variance)
+        self.flip = flip
+        self.clip = clip
+        self.offset = offset
+        self.min_max_aspect_ratios_order = min_max_aspect_ratios_order
+        if steps is not None:
+            step_w = step_h = None
+            self.steps = steps
+        else:
+            self.steps = None
+        self.step_w = step_w
+        self.step_h = step_h
+
+        locs, confs = [], []
+        for i, cin in enumerate(in_channels):
+            ms = min_sizes[i]
+            mx = max_sizes[i] if max_sizes else None
+            ar = aspect_ratios[i] if aspect_ratios else []
+            if not isinstance(ar, (list, tuple)):
+                ar = [ar]
+            np_i = _num_priors(ms, mx, ar, flip)
+            locs.append(nn.Conv2D(cin, np_i * 4, kernel_size,
+                                  stride=stride, padding=pad))
+            confs.append(nn.Conv2D(cin, np_i * num_classes, kernel_size,
+                                   stride=stride, padding=pad))
+        self.loc_convs = nn.LayerList(locs)
+        self.conf_convs = nn.LayerList(confs)
+
+    def _level_steps(self, i):
+        if self.steps is not None:
+            s = self.steps[i]
+            return (s, s) if not isinstance(s, (list, tuple)) else tuple(s)
+        if self.step_w is not None:
+            return (self.step_w[i], self.step_h[i])
+        return (0.0, 0.0)
+
+    def forward(self, feats, image):
+        locs, confs, boxes, vars_ = [], [], [], []
+        for i, feat in enumerate(feats):
+            ms = self.min_sizes[i]
+            mx = self.max_sizes[i] if self.max_sizes else None
+            ar = self.aspect_ratios[i] if self.aspect_ratios else []
+            if not isinstance(ar, (list, tuple)):
+                ar = [ar]
+            ms_l = ms if isinstance(ms, (list, tuple)) else [ms]
+            mx_l = (mx if isinstance(mx, (list, tuple)) else [mx]) \
+                if mx is not None else None
+            box, var = vops.prior_box(
+                feat, image, ms_l, mx_l, ar, self.variance, flip=self.flip,
+                clip=self.clip, steps=self._level_steps(i),
+                offset=self.offset,
+                min_max_aspect_ratios_order=self.min_max_aspect_ratios_order)
+            boxes.append(reshape(box, [-1, 4]))
+            vars_.append(reshape(var, [-1, 4]))
+            n = feat.shape[0]
+            loc = transpose(self.loc_convs[i](feat), [0, 2, 3, 1])
+            locs.append(reshape(flatten(loc, 1), [n, -1, 4]))
+            conf = transpose(self.conf_convs[i](feat), [0, 2, 3, 1])
+            confs.append(reshape(flatten(conf, 1),
+                                 [n, -1, self.num_classes]))
+        return (concat(locs, axis=1), concat(confs, axis=1),
+                concat(boxes, axis=0), concat(vars_, axis=0))
+
+
+class _MobileNetV1Feats(nn.Layer):
+    """MobileNetV1 trunk exposing the two SSD tap points (conv4_3-analogue
+    after block 10 and the final block), headless."""
+
+    def __init__(self, scale=1.0):
+        super().__init__()
+        base = MobileNetV1(scale=scale, num_classes=0, with_pool=False)
+        self.conv1 = base.conv1
+        self.blocks = base.blocks
+
+    def forward(self, x):
+        x = self.conv1(x)
+        feats = []
+        for i, blk in enumerate(self.blocks):
+            x = blk(x)
+            if i == 10:      # 512-ch stride-16 map
+                feats.append(x)
+        feats.append(x)      # 1024-ch stride-32 map
+        return feats
+
+
+class SSDMobileNetV1(nn.Layer):
+    """SSD-MobileNetV1 (the reference PaddleCV mobilenet_ssd lineage):
+    MobileNetV1 trunk + depthwise extra blocks + MultiBoxHead.
+
+    forward(image) -> (locs (N, P, 4), confs (N, P, C), boxes, vars);
+    `postprocess` runs the padded on-device NMS serving path.
+    """
+
+    def __init__(self, num_classes=21, scale=1.0, img_size=300):
+        super().__init__()
+        self.num_classes = num_classes
+        self.backbone = _MobileNetV1Feats(scale)
+        c = lambda ch: max(8, int(ch * scale))
+        self.extra1 = nn.Sequential(ConvBNLayer(c(1024), c(256), 1),
+                                    ConvBNLayer(c(256), c(512), 3, stride=2,
+                                                padding=1))
+        self.extra2 = nn.Sequential(ConvBNLayer(c(512), c(128), 1),
+                                    ConvBNLayer(c(128), c(256), 3, stride=2,
+                                                padding=1))
+        self.extra3 = nn.Sequential(ConvBNLayer(c(256), c(128), 1),
+                                    ConvBNLayer(c(128), c(256), 3, stride=2,
+                                                padding=1))
+        self.extra4 = nn.Sequential(ConvBNLayer(c(256), c(64), 1),
+                                    ConvBNLayer(c(64), c(128), 3, stride=2,
+                                                padding=1))
+        self.head = MultiBoxHead(
+            in_channels=[c(512), c(1024), c(512), c(256), c(256), c(128)],
+            base_size=img_size, num_classes=num_classes,
+            aspect_ratios=[[2.0], [2.0, 3.0], [2.0, 3.0], [2.0, 3.0],
+                           [2.0, 3.0], [2.0, 3.0]],
+            min_ratio=20, max_ratio=90, flip=True)
+
+    def forward(self, image):
+        feats = list(self.backbone(image))
+        x = feats[-1]
+        for extra in (self.extra1, self.extra2, self.extra3, self.extra4):
+            x = extra(x)
+            feats.append(x)
+        return self.head(feats, image)
+
+    def postprocess(self, locs, confs, boxes, vars_, score_threshold=0.01,
+                    nms_threshold=0.45, keep_top_k=200, nms_top_k=400):
+        """Serve: softmax confidences + detection_output (decode + padded
+        multiclass NMS, fully on device)."""
+        from ...nn.functional import softmax
+        return vops.detection_output(
+            locs, softmax(confs, axis=-1), boxes, vars_,
+            background_label=0, nms_threshold=nms_threshold,
+            nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+            score_threshold=score_threshold)
+
+
+def ssd_mobilenet_v1(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled (no network access); "
+            "load a state dict via set_state_dict")
+    return SSDMobileNetV1(**kwargs)

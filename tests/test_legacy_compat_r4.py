@@ -1,0 +1,215 @@
+"""Round-4 legacy compat sweep (VERDICT r3 item #8): dynamic RNN surface
+vs numpy oracles, TensorArray verbs, misc legacy ops, and the namespace
+stragglers (paddle.batch / sysconfig / device / fluid alias).
+Reference: fluid/layers/rnn.py:2249,2603,2822,2985,3379; control_flow.py
+:1455,1552,1894,2023; nn.py:3217,5524,12636; loss.py:54."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.fluid import layers as fl
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def test_dynamic_lstm_oracle_and_mask():
+    rng = np.random.RandomState(0)
+    b, t, h = 2, 4, 3
+    x = rng.randn(b, t, 4 * h).astype("float32")
+    w = rng.randn(h, 4 * h).astype("float32") * 0.3
+    bias = rng.randn(1, 7 * h).astype("float32") * 0.3
+    seq_len = np.array([4, 2], "int32")
+    hid, cell = fl.dynamic_lstm(
+        paddle.to_tensor(x), 4 * h, weight=paddle.to_tensor(w),
+        bias=paddle.to_tensor(bias), use_peepholes=True,
+        sequence_length=paddle.to_tensor(seq_len))
+    # numpy oracle, gates [c, i, f, o], peepholes appended in bias
+    bb = bias.reshape(-1)
+    w_ic, w_fc, w_oc = bb[4*h:5*h], bb[5*h:6*h], bb[6*h:7*h]
+    hp = np.zeros((b, h)); cp = np.zeros((b, h))
+    hs = np.zeros((b, t, h)); cs = np.zeros((b, t, h))
+    for step in range(t):
+        g = x[:, step] + hp @ w + bb[:4*h]
+        gc, gi, gf, go = np.split(g, 4, axis=-1)
+        i = _sig(gi + w_ic * cp)
+        f = _sig(gf + w_fc * cp)
+        c = f * cp + i * np.tanh(gc)
+        o = _sig(go + w_oc * c)
+        hn = o * np.tanh(c)
+        m = (step < seq_len).astype("float64")[:, None]
+        hp = m * hn + (1 - m) * hp
+        cp = m * c + (1 - m) * cp
+        hs[:, step] = hp; cs[:, step] = cp
+    np.testing.assert_allclose(hid.numpy(), hs, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(cell.numpy(), cs, rtol=1e-4, atol=1e-5)
+    # the padded sample's state freezes after its length
+    np.testing.assert_allclose(hid.numpy()[1, 2], hid.numpy()[1, 1])
+
+
+def test_dynamic_lstmp_projection_shape():
+    rng = np.random.RandomState(1)
+    b, t, h, p = 2, 3, 4, 2
+    proj, cell = fl.dynamic_lstmp(
+        paddle.to_tensor(rng.randn(b, t, 4 * h).astype("float32")), 4 * h, p,
+        weight=paddle.to_tensor(rng.randn(p, 4 * h).astype("float32") * .3),
+        proj_weight=paddle.to_tensor(rng.randn(h, p).astype("float32") * .3),
+        use_peepholes=False)
+    assert list(proj.shape) == [b, t, p]
+    assert list(cell.shape) == [b, t, h]
+
+
+def test_dynamic_gru_oracle_and_reverse():
+    rng = np.random.RandomState(2)
+    b, t, d = 2, 3, 4
+    x = rng.randn(b, t, 3 * d).astype("float32")
+    w = rng.randn(d, 3 * d).astype("float32") * 0.3
+    bias = rng.randn(1, 3 * d).astype("float32") * 0.3
+    out = fl.dynamic_gru(paddle.to_tensor(x), d, weight=paddle.to_tensor(w),
+                         bias=paddle.to_tensor(bias))
+    bb = bias.reshape(-1)
+    hp = np.zeros((b, d)); want = np.zeros((b, t, d))
+    for step in range(t):
+        xu, xr, xc = np.split(x[:, step] + bb, 3, axis=-1)
+        ur = hp @ w[:, :2 * d]
+        u = _sig(xu + ur[:, :d])
+        r = _sig(xr + ur[:, d:])
+        c = np.tanh(xc + (r * hp) @ w[:, 2 * d:])
+        hp = (1 - u) * hp + u * c  # origin_mode=False
+        want[:, step] = hp
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+    # reverse = run on flipped time then flip back
+    rev = fl.dynamic_gru(paddle.to_tensor(x), d, weight=paddle.to_tensor(w),
+                         bias=paddle.to_tensor(bias), is_reverse=True)
+    fwd_on_flipped = fl.dynamic_gru(
+        paddle.to_tensor(x[:, ::-1].copy()), d, weight=paddle.to_tensor(w),
+        bias=paddle.to_tensor(bias))
+    np.testing.assert_allclose(rev.numpy(), fwd_on_flipped.numpy()[:, ::-1],
+                               rtol=1e-5)
+
+
+def test_gru_unit_and_lstm_unit():
+    rng = np.random.RandomState(3)
+    b, d = 3, 4
+    xg = rng.randn(b, 3 * d).astype("float32")
+    hprev = rng.randn(b, d).astype("float32")
+    w = rng.randn(d, 3 * d).astype("float32") * 0.3
+    hn, rh, gates = fl.gru_unit(paddle.to_tensor(xg),
+                                paddle.to_tensor(hprev), 3 * d,
+                                weight=paddle.to_tensor(w))
+    full = fl.dynamic_gru(paddle.to_tensor(xg[:, None]), d,
+                          weight=paddle.to_tensor(w),
+                          h_0=paddle.to_tensor(hprev))
+    np.testing.assert_allclose(hn.numpy(), full.numpy()[:, 0], rtol=1e-5)
+    assert list(rh.shape) == [b, d] and list(gates.shape) == [b, 3 * d]
+
+    dx, dh = 3, 4
+    xt = rng.randn(b, dx).astype("float32")
+    hp = rng.randn(b, dh).astype("float32")
+    cp = rng.randn(b, dh).astype("float32")
+    wl = rng.randn(dx + dh, 4 * dh).astype("float32") * 0.3
+    h2, c2 = fl.lstm_unit(paddle.to_tensor(xt), paddle.to_tensor(hp),
+                          paddle.to_tensor(cp), forget_bias=1.0,
+                          weight=paddle.to_tensor(wl))
+    g = np.concatenate([xt, hp], -1) @ wl
+    gi, gf, go, gg = np.split(g, 4, -1)
+    cw = _sig(gf + 1.0) * cp + _sig(gi) * np.tanh(gg)
+    np.testing.assert_allclose(c2.numpy(), cw, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h2.numpy(), _sig(go) * np.tanh(cw),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_tensor_array_verbs():
+    arr = fl.create_array("float32")
+    fl.array_write(paddle.to_tensor(np.ones(3, "float32")), 0, arr)
+    fl.array_write(paddle.to_tensor(np.full(3, 2.0, "float32")),
+                   paddle.to_tensor(np.asarray(1, "int64")), arr)
+    assert int(fl.array_length(arr)) == 2
+    np.testing.assert_allclose(fl.array_read(arr, 1).numpy(), 2.0)
+
+
+def test_affine_channel_and_im2sequence():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    s = np.array([1.0, 2.0, -1.0], "float32")
+    b = np.array([0.5, 0.0, 1.0], "float32")
+    out = fl.affine_channel(paddle.to_tensor(x), paddle.to_tensor(s),
+                            paddle.to_tensor(b))
+    np.testing.assert_allclose(
+        out.numpy(), x * s[None, :, None, None] + b[None, :, None, None],
+        rtol=1e-6)
+
+    seq = fl.im2sequence(paddle.to_tensor(x), filter_size=2, stride=2)
+    assert list(seq.shape) == [2 * 2 * 2, 3 * 2 * 2]
+    # first row = window (0:2, 0:2) of sample 0, layout (c, fh, fw)
+    np.testing.assert_allclose(seq.numpy()[0],
+                               x[0, :, 0:2, 0:2].reshape(-1), rtol=1e-6)
+    # raster order: second row is the window at (0:2, 2:4)
+    np.testing.assert_allclose(seq.numpy()[1],
+                               x[0, :, 0:2, 2:4].reshape(-1), rtol=1e-6)
+
+
+def test_center_loss_and_update():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 3).astype("float32")
+    lab = np.array([0, 1, 1, 2], "int64")
+    centers = paddle.to_tensor(np.zeros((3, 3), "float32"))
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    loss = fl.center_loss(xt, paddle.to_tensor(lab), 3, alpha=0.5,
+                          centers=centers, update_center=False)
+    np.testing.assert_allclose(
+        loss.numpy().ravel(), 0.5 * (x ** 2).sum(-1), rtol=1e-5)
+    loss.sum().backward()
+    assert np.abs(xt.grad.numpy()).sum() > 0
+    # update nudges class 1's center toward the mean of its two members
+    fl.center_loss(paddle.to_tensor(x), paddle.to_tensor(lab), 3, alpha=0.5,
+                   centers=centers, update_center=True)
+    c1 = centers.numpy()[1]
+    want = 0.5 * (x[1] + x[2]) / (1 + 2)  # alpha * sum(diff)/(1+count)
+    np.testing.assert_allclose(c1, want, rtol=1e-4, atol=1e-6)
+
+
+def test_data_norm_layer():
+    paddle.seed(0)
+    dn = nn.legacy_layers.DataNorm(3)
+    rng = np.random.RandomState(6)
+    x = rng.randn(8, 3).astype("float32") * 2 + 1
+    out = dn(paddle.to_tensor(x))
+    # initial stats: mean 0, scale sqrt(1e4/1e4 + eps) ~ 1
+    np.testing.assert_allclose(out.numpy(), x, rtol=1e-3, atol=1e-3)
+    # training forward accumulated the batch into the summaries
+    assert float(dn.batch_sum.numpy().sum()) != 0.0
+    dn.eval()
+    before = dn.batch_sum.numpy().copy()
+    dn(paddle.to_tensor(x))
+    np.testing.assert_allclose(dn.batch_sum.numpy(), before)  # frozen
+
+
+def test_namespace_stragglers():
+    # paddle.batch
+    reader = paddle.batch(lambda: iter(range(7)), batch_size=3)
+    batches = list(reader())
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    assert list(paddle.batch(lambda: iter(range(7)), 3, drop_last=True)()) \
+        == [[0, 1, 2], [3, 4, 5]]
+    # sysconfig points at real install-tree dirs
+    import os
+    assert os.path.isdir(paddle.sysconfig.get_lib())
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    # device submodule
+    assert paddle.device.get_device() in ("cpu:0",) or ":" in \
+        paddle.device.get_device()
+    # wholesale fluid port surface
+    from paddle_tpu import fluid
+    assert fluid.layers.fc is not None
+    assert fluid.optimizer.SGDOptimizer is not None
+    assert fluid.dygraph.to_variable is not None
+    with fluid.dygraph.guard():
+        t = fluid.dygraph.to_variable(np.ones(2, "float32"))
+    assert isinstance(t, paddle.Tensor)
+    # static re-exports
+    for name in ("data", "save", "load", "create_parameter",
+                 "create_global_var"):
+        assert hasattr(paddle.static, name), name

@@ -1,0 +1,162 @@
+"""psroi_pool / prroi_pool / deformable_roi_pooling vs direct numpy
+oracles of the kernels' documented algorithms (reference:
+psroi_pool_op.h:24, prroi_pool_op, deformable_psroi_pooling_op.h:59)."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def _np_psroi(x, rois, ids, out_c, scale, ph_n, pw_n):
+    n_roi = len(rois)
+    _, c_in, H, W = x.shape
+    out = np.zeros((n_roi, out_c, ph_n, pw_n), np.float64)
+    for r, roi in enumerate(rois):
+        sw = round(roi[0]) * scale
+        sh = round(roi[1]) * scale
+        ew = (round(roi[2]) + 1.0) * scale
+        eh = (round(roi[3]) + 1.0) * scale
+        bh = max(eh - sh, 0.1) / ph_n
+        bw = max(ew - sw, 0.1) / pw_n
+        for c in range(out_c):
+            for i in range(ph_n):
+                for j in range(pw_n):
+                    hs = min(max(int(np.floor(i * bh + sh)), 0), H)
+                    he = min(max(int(np.ceil((i + 1) * bh + sh)), 0), H)
+                    ws = min(max(int(np.floor(j * bw + sw)), 0), W)
+                    we = min(max(int(np.ceil((j + 1) * bw + sw)), 0), W)
+                    ch = (c * ph_n + i) * pw_n + j
+                    if he <= hs or we <= ws:
+                        continue
+                    out[r, c, i, j] = x[ids[r], ch, hs:he, ws:we].mean()
+    return out
+
+
+def test_psroi_pool_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 2 * 2 * 2, 8, 8).astype("float32")
+    rois = np.array([[0, 0, 4, 4], [2, 1, 7, 6], [1, 1, 6, 7]], "float32")
+    bn = np.array([2, 1], "int32")
+    got = ops.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                         output_channels=2, spatial_scale=1.0,
+                         pooled_height=2, pooled_width=2,
+                         boxes_num=paddle.to_tensor(bn))
+    want = _np_psroi(x.astype(np.float64), rois, [0, 0, 1], 2, 1.0, 2, 2)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_prroi_pool_exact_cases():
+    # constant feature: exact integral average must be that constant
+    x = np.full((1, 1, 6, 6), 3.5, "float32")
+    rois = np.array([[0.7, 0.3, 4.2, 4.9]], "float32")
+    got = ops.prroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                         pooled_height=2, pooled_width=2)
+    np.testing.assert_allclose(got.numpy(), np.full((1, 1, 2, 2), 3.5),
+                               rtol=1e-5)
+    # linear ramp f(h, w) = w: integral average over [w1, w2] = midpoint
+    ramp = np.tile(np.arange(6, dtype="float32"), (6, 1))[None, None]
+    rois2 = np.array([[1.0, 1.0, 4.0, 4.0]], "float32")
+    got2 = ops.prroi_pool(paddle.to_tensor(ramp), paddle.to_tensor(rois2),
+                          pooled_height=1, pooled_width=2)
+    # bins [1, 2.5] and [2.5, 4] along w -> means 1.75 and 3.25
+    np.testing.assert_allclose(got2.numpy().ravel(), [1.75, 3.25],
+                               rtol=1e-5)
+    # differentiable through roi coords (the op's selling point)
+    r = paddle.to_tensor(rois2, stop_gradient=False)
+    out = ops.prroi_pool(paddle.to_tensor(ramp), r, 1, 1)
+    out.sum().backward()
+    assert np.abs(r.grad.numpy()).sum() > 0
+
+
+def _np_deform(x, rois, ids, trans, scale, ph_n, pw_n, spp, trans_std,
+               gh_n=1, gw_n=1, position_sensitive=False):
+    n_roi = len(rois)
+    _, c_in, H, W = x.shape
+    out_c = c_in // (ph_n * pw_n) if position_sensitive else c_in
+    part_h, part_w = trans.shape[2], trans.shape[3]
+    num_classes = trans.shape[1] // 2
+    ch_each = max(out_c // num_classes, 1)
+    out = np.zeros((n_roi, out_c, ph_n, pw_n), np.float64)
+    for r, roi in enumerate(rois):
+        sw = round(roi[0]) * scale - 0.5
+        sh = round(roi[1]) * scale - 0.5
+        ew = (round(roi[2]) + 1.0) * scale - 0.5
+        eh = (round(roi[3]) + 1.0) * scale - 0.5
+        rw = max(ew - sw, 0.1)
+        rh = max(eh - sh, 0.1)
+        bh, bw = rh / ph_n, rw / pw_n
+        for c in range(out_c):
+            cls = c // ch_each
+            for i in range(ph_n):
+                for j in range(pw_n):
+                    p_h = int(np.floor(i / ph_n * part_h))
+                    p_w = int(np.floor(j / pw_n * part_w))
+                    tx = trans[r, cls * 2, p_h, p_w] * trans_std
+                    ty = trans[r, cls * 2 + 1, p_h, p_w] * trans_std
+                    ws = j * bw + sw + tx * rw
+                    hs = i * bh + sh + ty * rh
+                    gh = min(max(i * gh_n // ph_n, 0), gh_n - 1)
+                    gw = min(max(j * gw_n // pw_n, 0), gw_n - 1)
+                    ch = (c * gh_n + gh) * gw_n + gw
+                    acc, cnt = 0.0, 0
+                    for ih in range(spp):
+                        for iw in range(spp):
+                            w = ws + iw * (bw / spp)
+                            h = hs + ih * (bh / spp)
+                            if w < -0.5 or w > W - 0.5 or h < -0.5 \
+                                    or h > H - 0.5:
+                                continue
+                            w = min(max(w, 0.0), W - 1.0)
+                            h = min(max(h, 0.0), H - 1.0)
+                            h0, w0 = int(np.floor(h)), int(np.floor(w))
+                            h1, w1 = min(h0 + 1, H - 1), min(w0 + 1, W - 1)
+                            lh, lw = h - h0, w - w0
+                            f = x[ids[r], ch]
+                            acc += (f[h0, w0] * (1 - lh) * (1 - lw)
+                                    + f[h0, w1] * (1 - lh) * lw
+                                    + f[h1, w0] * lh * (1 - lw)
+                                    + f[h1, w1] * lh * lw)
+                            cnt += 1
+                    out[r, c, i, j] = acc / cnt if cnt else 0.0
+    return out
+
+
+def test_deformable_roi_pooling_oracle():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 3, 8, 8).astype("float32")
+    rois = np.array([[1, 1, 5, 5], [0, 2, 6, 7]], "float32")
+    trans = (rng.randn(2, 2, 2, 2) * 0.5).astype("float32")
+    got = ops.deformable_roi_pooling(
+        paddle.to_tensor(x), paddle.to_tensor(rois),
+        paddle.to_tensor(trans), spatial_scale=1.0, pooled_height=2,
+        pooled_width=2, part_size=2, sample_per_part=2, trans_std=0.1)
+    want = _np_deform(x.astype(np.float64), rois, [0, 0],
+                      trans.astype(np.float64), 1.0, 2, 2, 2, 0.1)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-5)
+    # no_trans path + grads flow into input and trans
+    xt = paddle.to_tensor(x, stop_gradient=False)
+    tt = paddle.to_tensor(trans, stop_gradient=False)
+    out = ops.deformable_roi_pooling(xt, paddle.to_tensor(rois), tt,
+                                     pooled_height=2, pooled_width=2,
+                                     part_size=2, sample_per_part=2)
+    out.sum().backward()
+    assert np.abs(xt.grad.numpy()).sum() > 0
+    assert np.abs(tt.grad.numpy()).sum() > 0
+
+
+def test_deformable_position_sensitive():
+    rng = np.random.RandomState(2)
+    ph = pw = 2
+    x = rng.randn(1, 2 * ph * pw, 6, 6).astype("float32")
+    rois = np.array([[0, 0, 5, 5]], "float32")
+    trans = np.zeros((1, 2, 2, 2), "float32")
+    got = ops.deformable_roi_pooling(
+        paddle.to_tensor(x), paddle.to_tensor(rois),
+        paddle.to_tensor(trans), no_trans=True, group_size=(ph, pw),
+        pooled_height=ph, pooled_width=pw, part_size=2, sample_per_part=3,
+        position_sensitive=True)
+    assert list(got.shape) == [1, 2, ph, pw]
+    want = _np_deform(x.astype(np.float64), rois, [0],
+                      np.zeros((1, 2, 2, 2)), 1.0, ph, pw, 3, 0.1,
+                      gh_n=ph, gw_n=pw, position_sensitive=True)
+    np.testing.assert_allclose(got.numpy(), want, rtol=1e-4, atol=1e-5)
