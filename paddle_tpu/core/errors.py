@@ -54,7 +54,9 @@ class PermissionDeniedError(EnforceNotMet, PermissionError):
     code = "PermissionDenied"
 
 
-class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError, RuntimeError):
+    # RuntimeError base kept for continuity: timeout paths (DataLoader)
+    # raised RuntimeError before the taxonomy existed
     code = "ExecutionTimeout"
 
 

@@ -59,16 +59,10 @@ class Model:
         metrics_out = []
         if self._metrics:
             # metrics consume the SAME forward the loss used (the reference's
-            # train_batch does too) — no second forward pass
+            # train_batch does too) — no second forward pass; the sparse-grad
+            # step threads outputs through its aux channel like the dense one
             outs = self._train_step.last_outputs
-            if outs is None:  # sparse-grad path: fall back to a fresh forward
-                with no_grad():
-                    self.network.eval()
-                    preds = self.network(
-                        *[Tensor(b) for b in batch[:len(inputs)]])
-                    self.network.train()
-            else:
-                preds = outs if len(outs) > 1 else outs[0]
+            preds = outs if len(outs) > 1 else outs[0]
             for m in self._metrics:
                 m.update(unwrap(m.compute(preds, Tensor(batch[-1]))))
                 metrics_out.append(m.accumulate())

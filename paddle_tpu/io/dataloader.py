@@ -108,13 +108,17 @@ class _WorkerPool:
                 return self._result_q.get(timeout=1.0)
             except queue.Empty:
                 if not self.alive():
-                    raise RuntimeError(
-                        "DataLoader worker process died unexpectedly "
-                        "(killed or crashed) with a task in flight")
+                    from ..core.errors import UnavailableError
+                    raise UnavailableError(
+                        "[Unavailable] DataLoader worker process died "
+                        "unexpectedly (killed or crashed) with a task in "
+                        "flight")
                 waited += 1.0
                 if self._timeout is not None and waited >= self._timeout:
-                    raise RuntimeError(
-                        f"DataLoader worker timed out after {waited:.0f}s")
+                    from ..core.errors import ExecutionTimeoutError
+                    raise ExecutionTimeoutError(
+                        f"[ExecutionTimeout] DataLoader worker timed out "
+                        f"after {waited:.0f}s")
 
     def run(self, index_batches, max_in_flight):
         """Yield collated numpy batches in order.
@@ -211,6 +215,19 @@ class DataLoader:
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False, multiprocessing_context=None):
+        from ..core.errors import InvalidArgumentError
+        if batch_sampler is None and (not isinstance(batch_size, int)
+                                      or batch_size <= 0):
+            raise InvalidArgumentError(
+                f"[DataLoader] batch_size must be a positive int, got "
+                f"{batch_size!r}")
+        if not isinstance(num_workers, int) or num_workers < 0:
+            raise InvalidArgumentError(
+                f"[DataLoader] num_workers must be a non-negative int, got "
+                f"{num_workers!r}")
+        if timeout and timeout < 0:
+            raise InvalidArgumentError(
+                f"[DataLoader] timeout must be >= 0, got {timeout!r}")
         self.dataset = dataset
         self.return_list = return_list
         self.collate_fn = collate_fn or default_collate_fn

@@ -227,8 +227,8 @@ class TrainStep:
         self.amp_level = amp_level
         self.amp_dtype = amp_dtype
         # with_outputs: the compiled step also returns the forward outputs
-        # (hapi metric reuse); ignored on the sparse-grad path, where
-        # last_outputs stays None
+        # (hapi metric reuse) — on the sparse-grad path too (step_sparse
+        # threads them through the aux channel)
         self._with_outputs = with_outputs
         self.last_outputs = None
         self._names = list(model.state_dict().keys())
@@ -260,6 +260,53 @@ class TrainStep:
         return forward_loss(self.model, self.loss_fn, state, batch, rng_key,
                             self.amp_level, self.amp_dtype)
 
+    def _sparse_setup(self, example_state, example_batch):
+        """Shared sparse-grad preamble for the single- and multi-step
+        builds: shape-probe each sparse lookup's (n, width, dtype), map ctx
+        keys back to state keys, and run the embedding-only misuse guard
+        once (its verdict is shape-independent)."""
+        from ..core import selected_rows as sr
+        rec = sr.SparseGradContext("record")
+        with sr.use_ctx(rec):
+            jax.eval_shape(
+                lambda s, b: self._forward_loss(s, b, jax.random.PRNGKey(0)),
+                example_state, example_batch)
+        sparse_specs = rec.specs
+        # ctx keys carry the param's unique .name; map back to state keys
+        name_to_key = {getattr(v, "name", None) or k: k
+                       for k, v in self.model.state_dict().items()}
+        sparse_names = {name_to_key[sr.param_name(k)] for k in sparse_specs}
+
+        # misuse guard: error out (rather than silently drop grads) if a
+        # sparse weight is also consumed densely, e.g. by a tied LM head
+        if not self._sparse_checked:
+            def probe(sparse_vals):
+                zs = {k: jnp.zeros((n, w), dt)
+                      for k, (n, w, dt) in sparse_specs.items()}
+                full = dict(example_state)
+                full.update(sparse_vals)
+                ctx = sr.SparseGradContext("apply", zeros=zs)
+                with sr.use_ctx(ctx):
+                    return self._forward_loss(full, example_batch,
+                                              jax.random.PRNGKey(0))
+            sr.check_embedding_only_use(
+                probe, {k: example_state[k] for k in sparse_names})
+            self._sparse_checked = True
+        return sparse_specs, name_to_key, sparse_names
+
+    @staticmethod
+    def _merge_sparse_grads(grads, zgrads, ids, params, name_to_key):
+        """Fold the zeros-cotangent channel into the dense grad dict as
+        RowSparseGrads (shared by the single- and multi-step sparse
+        builds)."""
+        from ..core import selected_rows as sr
+        grads = dict(grads)
+        for zk, zg in zgrads.items():
+            nm = name_to_key[sr.param_name(zk)]
+            rsg = sr.RowSparseGrad(ids[zk], zg, params[nm].shape)
+            grads[nm] = (grads[nm] + rsg) if nm in grads else rsg
+        return grads
+
     def _build(self, example_state, example_opt, example_batch):
         from ..optimizer.functional import apply_updates, decay_flags
         opt = self.optimizer
@@ -267,39 +314,10 @@ class TrainStep:
         # structured param names let AdamW's apply_decay_param_fun work here
         decay = decay_flags(opt, trainable)
 
-        sparse_specs, sparse_names = {}, set()
+        sparse_specs, sparse_names, name_to_key = {}, set(), {}
         if self._sparse:
-            # shape-probe pass: learn each sparse lookup's (n, width, dtype)
-            from ..core import selected_rows as sr
-            rec = sr.SparseGradContext("record")
-            with sr.use_ctx(rec):
-                jax.eval_shape(
-                    lambda s, b: self._forward_loss(
-                        s, b, jax.random.PRNGKey(0)),
-                    example_state, example_batch)
-            sparse_specs = rec.specs
-            # ctx keys carry the param's unique .name; map back to state keys
-            name_to_key = {getattr(v, "name", None) or k: k
-                           for k, v in self.model.state_dict().items()}
-            sparse_names = {name_to_key[sr.param_name(k)]
-                            for k in sparse_specs}
-
-            # misuse guard: error out (rather than silently drop grads) if a
-            # sparse weight is also consumed densely, e.g. by a tied LM head.
-            # The verdict is shape-independent — one probe trace suffices.
-            if not self._sparse_checked:
-                def probe(sparse_vals):
-                    zs = {k: jnp.zeros((n, w), dt)
-                          for k, (n, w, dt) in sparse_specs.items()}
-                    full = dict(example_state)
-                    full.update(sparse_vals)
-                    ctx = sr.SparseGradContext("apply", zeros=zs)
-                    with sr.use_ctx(ctx):
-                        return self._forward_loss(full, example_batch,
-                                                  jax.random.PRNGKey(0))
-                sr.check_embedding_only_use(
-                    probe, {k: example_state[k] for k in sparse_names})
-                self._sparse_checked = True
+            sparse_specs, name_to_key, sparse_names = self._sparse_setup(
+                example_state, example_batch)
 
         with_outputs = self._with_outputs
 
@@ -349,11 +367,8 @@ class TrainStep:
             loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
             (loss, (ids, outs)), (grads, zgrads) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True)(train_params, zeros)
-            grads = dict(grads)
-            for key, zg in zgrads.items():
-                name = name_to_key[sr.param_name(key)]
-                rsg = sr.RowSparseGrad(ids[key], zg, params[name].shape)
-                grads[name] = (grads[name] + rsg) if name in grads else rsg
+            grads = self._merge_sparse_grads(grads, zgrads, ids, params,
+                                             name_to_key)
             new_params, new_opt = apply_updates(
                 opt, params, grads, opt_state, lr, step_no, decay)
             return new_params, new_opt, loss, outs
@@ -404,20 +419,76 @@ class TrainStep:
 
         return jax.jit(multi, donate_argnums=(0, 1))
 
+    def _build_multi_sparse(self, example_state, example_batch_one):
+        """K sparse-grad steps per compiled call: the same zeros-cotangent
+        channel as the single-step sparse build, inside the lax.scan body —
+        each step's RowSparseGrad feeds the lazy row-wise optimizer update,
+        so the big-vocab path gets the same per-call amortization as dense
+        (r3 weak #4: run_steps used to reject sparse)."""
+        from ..optimizer.functional import apply_updates, decay_flags
+        from ..core import selected_rows as sr
+        opt = self.optimizer
+        trainable = self._trainable
+        decay = decay_flags(opt, trainable)
+
+        sparse_specs, name_to_key, sparse_names = self._sparse_setup(
+            example_state, example_batch_one)
+
+        def multi(params, opt_state, step_no0, lr, rng_key, stacked):
+            def body(carry, xs):
+                params, opt_state, i = carry
+                key = jax.random.fold_in(rng_key, i)
+                zeros = {k: jnp.zeros((n, w), dt)
+                         for k, (n, w, dt) in sparse_specs.items()}
+
+                def loss_of(train_params, zvals):
+                    full = dict(params)
+                    full.update(train_params)
+                    ctx = sr.SparseGradContext("apply", zeros=zvals)
+                    with sr.use_ctx(ctx):
+                        loss = self._forward_loss(full, xs, key)
+                    return loss, ctx.ids
+
+                train_params = {k: v for k, v in params.items()
+                                if k in trainable and k not in sparse_names}
+                loss_fn = (jax.checkpoint(loss_of) if self._remat
+                           else loss_of)
+                (loss, ids), (grads, zgrads) = jax.value_and_grad(
+                    loss_fn, argnums=(0, 1), has_aux=True)(train_params,
+                                                           zeros)
+                grads = self._merge_sparse_grads(grads, zgrads, ids, params,
+                                                 name_to_key)
+                new_params, new_opt = apply_updates(
+                    opt, params, grads, opt_state, lr, step_no0 + i, decay)
+                return (new_params, new_opt, i + 1), loss
+
+            (params, opt_state, _), losses = jax.lax.scan(
+                body, (params, opt_state, jnp.int32(0)), stacked)
+            return params, opt_state, losses
+
+        return jax.jit(multi, donate_argnums=(0, 1))
+
     def run_steps(self, *stacked_batch):
         """Run K train steps in ONE compiled call.
 
         Each arg is a stacked batch whose leading axis K is the step count
         (e.g. ids of shape (K, batch, seq)).  Returns the (K,) per-step loss
-        array.  Not supported together with Embedding(sparse=True)."""
-        if self._sparse:
-            raise NotImplementedError(
-                "run_steps with sparse embedding grads: use per-call steps")
+        array.  Works with Embedding(sparse=True): lookup counts are baked
+        per batch-shape signature, so each signature compiles its own
+        multi-step program."""
         state = state_arrays(self.model)
         if self._opt_state is None:
             self._opt_state = self.init_opt_state(state)
         raw = tuple(unwrap(b) for b in stacked_batch)
         k_steps = raw[0].shape[0]
+        if self._sparse:
+            sig = ("multi",) + tuple(
+                (tuple(b.shape), str(b.dtype)) for b in raw)
+            self._compiled_multi = self._sig_cache.get(sig)
+            if self._compiled_multi is None:
+                one = tuple(b[0] for b in raw)
+                self._compiled_multi = self._sig_cache[sig] = \
+                    self._build_multi_sparse(state, one)
         if self._compiled_multi is None:
             self._compiled_multi = self._build_multi()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)

@@ -71,6 +71,17 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     instead of densifying the full table every step.  Restriction (as in the
     reference): a sparse weight must only be consumed via embedding lookups.
     """
+    from ...core.errors import InvalidArgumentError
+    from ...core.tensor import unwrap as _unwrap
+    ids_v, w_v = _unwrap(x), _unwrap(weight)
+    if not jnp.issubdtype(ids_v.dtype, jnp.integer):
+        raise InvalidArgumentError(
+            f"[embedding] ids must be an integer tensor, got dtype "
+            f"{ids_v.dtype}")
+    if w_v.ndim != 2:
+        raise InvalidArgumentError(
+            f"[embedding] weight must be 2-D (vocab, dim), got shape "
+            f"{tuple(w_v.shape)}")
     if sparse:
         from ...core import selected_rows as sr
         from ...core.tensor import is_grad_enabled

@@ -43,6 +43,23 @@ def _dims(n, channel_last):
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
     channel_last = not data_format.startswith("NC")
+    from ...core.errors import InvalidArgumentError
+    from ...core.tensor import unwrap as _unwrap
+    xv, wv = _unwrap(x), _unwrap(weight)
+    if xv.ndim != n + 2:
+        raise InvalidArgumentError(
+            f"[conv{n}d] expected a rank-{n + 2} input ({data_format}), "
+            f"got shape {tuple(xv.shape)}")
+    cin = xv.shape[1] if not channel_last else xv.shape[-1]
+    if wv.shape[1] * groups != cin:
+        raise InvalidArgumentError(
+            f"[conv{n}d] input channels {cin} != weight in_channels "
+            f"{wv.shape[1]} * groups {groups} (weight shape "
+            f"{tuple(wv.shape)}, layout (out_c, in_c/groups, *k))")
+    if wv.shape[0] % groups:
+        raise InvalidArgumentError(
+            f"[conv{n}d] out_channels {wv.shape[0]} not divisible by "
+            f"groups {groups}")
     lhs_spec, rhs_spec, out_spec = _dims(n, channel_last)
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)

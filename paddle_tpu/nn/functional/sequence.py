@@ -121,7 +121,9 @@ def sequence_pool(x, lengths, pool_type="average", pad_value=0.0, name=None):
                 x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
             )[:, 0]
         else:
-            raise ValueError(f"unknown pool_type {pool_type!r}")
+            from ...core.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"[sequence_pool] unknown pool_type {pool_type!r}")
         return jnp.where(empty, pad, out)
     return dispatch("sequence_pool", raw, x, Tensor(lv, stop_gradient=True))
 

@@ -46,9 +46,12 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
     modes: bilinear | nearest; padding_mode: zeros | border | reflection.
     """
     if mode not in ("bilinear", "nearest"):
-        raise ValueError(f"unsupported mode {mode!r}")
+        from ...core.errors import InvalidArgumentError
+        raise InvalidArgumentError(f"[grid_sample] unsupported mode {mode!r}")
     if padding_mode not in ("zeros", "border", "reflection"):
-        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+        from ...core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"[grid_sample] unsupported padding_mode {padding_mode!r}")
 
     def raw(xv, gv):
         n, c, h, w = xv.shape

@@ -11,6 +11,19 @@ from ..core.tensor import Tensor, unwrap
 
 
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    from ..core.tensor import unwrap as _unwrap
+    from ..core.errors import InvalidArgumentError
+    xv, yv = _unwrap(x), _unwrap(y)
+    if xv.ndim >= 2 and yv.ndim >= 2:
+        k_x = xv.shape[-2] if transpose_x else xv.shape[-1]
+        k_y = yv.shape[-1] if transpose_y else yv.shape[-2]
+        if k_x != k_y:
+            raise InvalidArgumentError(
+                f"[matmul] contraction dims differ: x{tuple(xv.shape)}"
+                f"{' (transposed)' if transpose_x else ''} gives K={k_x}, "
+                f"y{tuple(yv.shape)}"
+                f"{' (transposed)' if transpose_y else ''} gives K={k_y}")
+
     def raw(x, y):
         a = jnp.swapaxes(x, -1, -2) if transpose_x and x.ndim >= 2 else x
         b = jnp.swapaxes(y, -1, -2) if transpose_y and y.ndim >= 2 else y

@@ -37,6 +37,22 @@ def cast(x, dtype):
 
 def reshape(x, shape, name=None):
     shape = _ints(shape)
+    from ..core.errors import InvalidArgumentError
+    n_infer = sum(1 for s in shape if s == -1)
+    if n_infer > 1:
+        raise InvalidArgumentError(
+            f"[reshape] at most one dimension may be -1, got shape {shape}")
+    xv = unwrap(x)
+    if hasattr(xv, "size") and n_infer == 0:
+        have = int(xv.size)
+        prod = 1
+        for s in shape:
+            prod *= int(s) if s != 0 else 1
+        if 0 not in shape and prod != have:
+            raise InvalidArgumentError(
+                f"[reshape] cannot reshape {have} elements (input shape "
+                f"{tuple(xv.shape)}) into shape {tuple(shape)} "
+                f"({prod} elements)")
     return dispatch("reshape", lambda x: jnp.reshape(x, shape), x)
 
 
@@ -99,6 +115,20 @@ def squeeze(x, axis=None, name=None):
 
 def concat(x, axis=0, name=None):
     axis = int(unwrap(axis))
+    from ..core.errors import InvalidArgumentError
+    if len(x) == 0:
+        raise InvalidArgumentError("[concat] got an empty tensor list")
+    r0 = unwrap(x[0]).ndim
+    if not -r0 <= axis < max(r0, 1):
+        raise InvalidArgumentError(
+            f"[concat] axis {axis} out of range for rank-{r0} inputs "
+            f"(expected [-{r0}, {r0 - 1}])")
+    for i, t in enumerate(x[1:], 1):
+        ri = unwrap(t).ndim
+        if ri != r0:
+            raise InvalidArgumentError(
+                f"[concat] rank mismatch: input 0 has rank {r0} but input "
+                f"{i} has rank {ri}")
     return dispatch("concat", lambda *xs: jnp.concatenate(xs, axis=axis), *x)
 
 

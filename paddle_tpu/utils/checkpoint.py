@@ -63,6 +63,11 @@ def load(path, return_numpy=False, encrypt_key=None, allow_legacy=False,
          **kwargs):
     """paddle.load.  allow_legacy opts in to v1 (unauthenticated) encrypted
     artifacts — see io/crypto.py on the downgrade hazard."""
+    import os
+    if not os.path.exists(path):
+        from ..core.errors import NotFoundError
+        raise NotFoundError(
+            f"[NotFound] paddle.load: no artifact at {path!r}")
     if encrypt_key is not None:
         from ..io.crypto import AESCipher
         payload = AESCipher().decrypt_from_file(encrypt_key, path,

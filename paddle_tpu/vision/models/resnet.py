@@ -1,9 +1,12 @@
 """ResNet family (reference: python/paddle/vision/models/resnet.py —
 ResNet18/34/50/101/152 with BasicBlock/BottleneckBlock).
 
-TPU-first: NCHW in the API (paddle layout) but convs lower through
-nn.functional.conv2d → lax.conv_general_dilated which XLA lays out for the
-MXU; BN folds into conv at inference via XLA fusion.
+TPU-first: convs lower through nn.functional.conv2d →
+lax.conv_general_dilated which XLA lays out for the MXU; BN folds into
+conv at inference via XLA fusion.  `data_format="NHWC"` runs the whole
+trunk channels-last — the TPU-preferred layout (r4 probe: conv tower
+~13% faster than NCHW at ResNet-50 shapes, no relayout transposes);
+inputs must then be (N, H, W, C) like paddle's own data_format contract.
 """
 from __future__ import annotations
 
@@ -14,15 +17,20 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        # only pass the kwarg off-default: custom norm_layer callables
+        # need not accept data_format in NCHW mode
+        df = {} if data_format == "NCHW" else dict(data_format=data_format)
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn1 = norm_layer(planes)
+                               bias_attr=False, **df)
+        self.bn1 = norm_layer(planes, **df)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               **df)
+        self.bn2 = norm_layer(planes, **df)
         self.downsample = downsample
         self.stride = stride
 
@@ -39,19 +47,23 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        # only pass the kwarg off-default: custom norm_layer callables
+        # need not accept data_format in NCHW mode
+        df = {} if data_format == "NCHW" else dict(data_format=data_format)
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, **df)
+        self.bn1 = norm_layer(width, **df)
         self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=dilation,
                                groups=groups, dilation=dilation,
-                               bias_attr=False)
-        self.bn2 = norm_layer(width)
+                               bias_attr=False, **df)
+        self.bn2 = norm_layer(width, **df)
         self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
-                               bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                               bias_attr=False, **df)
+        self.bn3 = norm_layer(planes * self.expansion, **df)
         self.relu = nn.ReLU()
         self.downsample = downsample
 
@@ -67,7 +79,7 @@ class BottleneckBlock(nn.Layer):
 
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, data_format="NCHW"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -76,39 +88,45 @@ class ResNet(nn.Layer):
         self.with_pool = with_pool
         self.groups = groups
         self.base_width = width
+        self.data_format = data_format
         self._norm_layer = nn.BatchNorm2D
         self.inplanes = 64
         self.dilation = 1
+        df = {} if data_format == "NCHW" else dict(data_format=data_format)
 
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
-        self.bn1 = self._norm_layer(self.inplanes)
+                               bias_attr=False, **df)
+        self.bn1 = self._norm_layer(self.inplanes, **df)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1, **df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), **df)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
         norm_layer = self._norm_layer
+        df = ({} if self.data_format == "NCHW"
+              else dict(data_format=self.data_format))
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                norm_layer(planes * block.expansion))
+                          stride=stride, bias_attr=False, **df),
+                norm_layer(planes * block.expansion, **df))
         layers = [block(self.inplanes, planes, stride, downsample,
-                        self.groups, self.base_width, norm_layer=norm_layer)]
+                        self.groups, self.base_width, norm_layer=norm_layer,
+                        data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
                                 base_width=self.base_width,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer,
+                                data_format=self.data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):

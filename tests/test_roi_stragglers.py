@@ -2,6 +2,7 @@
 oracles of the kernels' documented algorithms (reference:
 psroi_pool_op.h:24, prroi_pool_op, deformable_psroi_pooling_op.h:59)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.vision import ops
@@ -121,6 +122,7 @@ def _np_deform(x, rois, ids, trans, scale, ph_n, pw_n, spp, trans_std,
     return out
 
 
+@pytest.mark.slow
 def test_deformable_roi_pooling_oracle():
     rng = np.random.RandomState(1)
     x = rng.randn(1, 3, 8, 8).astype("float32")

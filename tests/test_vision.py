@@ -17,6 +17,24 @@ def test_resnet_forward(ctor, depth):
     assert out.shape == [2, 10]
 
 
+@pytest.mark.slow
+def test_resnet_nhwc_matches_nchw():
+    """data_format='NHWC' (the TPU-preferred channels-last trunk) must be
+    numerically identical to NCHW: same paddle OIHW weights, transposed
+    input/output."""
+    paddle.seed(0)
+    m_nchw = models.resnet18(num_classes=6)
+    paddle.seed(0)
+    m_nhwc = models.resnet18(num_classes=6, data_format="NHWC")
+    # identical construction order -> identical params; assert anyway
+    m_nhwc.set_state_dict(m_nchw.state_dict())
+    m_nchw.eval(); m_nhwc.eval()
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype("float32")
+    out_c = m_nchw(paddle.to_tensor(x)).numpy()
+    out_l = m_nhwc(paddle.to_tensor(x.transpose(0, 2, 3, 1).copy())).numpy()
+    np.testing.assert_allclose(out_l, out_c, rtol=2e-4, atol=2e-4)
+
+
 def test_resnet_train_step():
     m = models.resnet18(num_classes=4)
     opt = paddle.optimizer.Momentum(learning_rate=0.05,
