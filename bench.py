@@ -65,6 +65,15 @@ def gpt_train_flops(batch, seq, cfg) -> float:
 RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.09e9
 
 
+def _rep_stats(rep_ms):
+    """Methodology fields (r3 verdict #10): every TPU config reports its
+    per-rep ms so cross-round deltas carry their own noise floor."""
+    mean = sum(rep_ms) / len(rep_ms)
+    return {"step_ms": round(mean, 2),
+            "step_ms_reps": [round(r, 2) for r in rep_ms],
+            "step_ms_spread": round((max(rep_ms) - min(rep_ms)) / 2, 2)}
+
+
 def measure_bert(on_tpu):
     import paddle_tpu as paddle
     from paddle_tpu import models
@@ -126,116 +135,242 @@ def measure_bert(on_tpu):
     for _ in range(warmup):
         losses = step.run_steps(ids, labels)
     float(losses[-1])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        losses = step.run_steps(ids, labels)
-    final_loss = float(losses[-1])
-    dt = (time.perf_counter() - t0) / (iters * k_per_call)
+    # 3 measured reps x (iters/3) calls each, one sync per rep
+    reps, final_loss = [], 0.0
+    calls_per_rep = max(iters // 3, 1)
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(calls_per_rep):
+            losses = step.run_steps(ids, labels)
+        final_loss = float(losses[-1])
+        reps.append((time.perf_counter() - t0) * 1e3
+                    / (calls_per_rep * k_per_call))
+    dt = sum(reps) / len(reps) / 1e3
 
     flops = bert_train_flops(batch, seq, cfg)
     peak = detect_peak_tflops() * 1e12
     mfu = flops / dt / peak * 100.0
-    return {
+    out = {
         "mfu": mfu,
         "tokens_per_sec_per_chip": round(batch * seq / dt, 1),
-        "step_ms": round(dt * 1e3, 2),
         "config": "bert-large-512" if on_tpu else "bert-tiny-cpu",
+        "methodology": f"warmup {warmup}x{k_per_call} steps, 3 reps of "
+                       f"{calls_per_rep}x{k_per_call} steps, sync per rep",
         "loss": final_loss,
     }
+    out.update(_rep_stats(reps))
+    return out
+
+
+def _run_tpu_probe(script, tag, timeout, smoke=False):
+    """Run a TPU measurement in its OWN process (env inherited — the axon
+    sitecustomize attaches the tunnel chip).  Two big models sharing one
+    TPU process cross-contaminate HBM and inflate wall clocks 20-30% (the
+    r3 resnet 39ms-probe vs 50.45ms-bench discrepancy, reproduced and
+    closed in r4) — so every secondary config is measured solo.
+
+    smoke=True runs the SAME script at tiny shapes on CPU, so script-string
+    breakage surfaces off-TPU instead of minutes into a remote compile."""
+    env = dict(os.environ)
+    if smoke:
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PDTPU_BENCH_SMOKE"] = "1"
+    proc = subprocess.run([sys.executable, "-c", script],
+                          capture_output=True, text=True, timeout=timeout,
+                          env=env,
+                          cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in proc.stdout.splitlines():
+        if line.startswith(tag):
+            return json.loads(line[len(tag):])
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
+def run_reps(step, args, k, warmup=2, reps=3):
+    """Shared by the per-config TPU subprocess scripts (they import this
+    module — cwd is the repo root)."""
+    for _ in range(warmup):
+        losses = step.run_steps(*args)
+    float(losses[-1])
+    out = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        losses = step.run_steps(*args)
+        float(losses[-1])
+        out.append((time.perf_counter() - t0) * 1e3 / k)
+    return out
+
+
+_TPU_COMMON = r"""
+import json, os, time
+import numpy as np
+import jax
+jax.config.update("jax_default_prng_impl", "rbg")
+import paddle_tpu as paddle
+from paddle_tpu.jit import TrainStep
+from bench import (run_reps, _rep_stats as rep_stats, detect_peak_tflops,
+                   bert_train_flops, gpt_train_flops,
+                   RESNET50_TRAIN_FLOPS_PER_IMG)
+
+# PDTPU_BENCH_SMOKE=1: tiny shapes on CPU so the script strings stay
+# executable off-TPU (a NameError must not wait for the remote compile)
+SMOKE = os.environ.get("PDTPU_BENCH_SMOKE") == "1"
+PEAK = detect_peak_tflops() * 1e12
+"""
+
+
+_RESNET_TPU_SCRIPT = _TPU_COMMON + r"""
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import models as vmodels
+
+# r4 operating point from the probe sweep (solo process, async dispatch,
+# sync per rep; probes/resnet_probe.py):
+#   O1 NCHW:  b64 43.9ms/9.1%  b128 --     b256 146.5ms/10.9%
+#   O1 NHWC:  b64 42.5ms/9.4%  b128 10.6%  b256 147.3ms/10.8%
+#   O2 NCHW:  b256 118.0ms/13.5%   O2 NHWC: b256 118.6ms/13.4%
+# -> O2 (bf16 end-to-end incl. BN — the MLPerf-ResNet convention; batch
+#    stats in bf16) at b256; layout is a wash at large batch (XLA's own
+#    relayout), NHWC only helps ~3% at b64.  Component ablations at b64:
+#    BN costs ~2ms, optimizer ~1ms — the time is IN the convs: the
+#    isolated conv tower at ResNet-50 shapes runs ~26-30 TF/s (13-15% of
+#    peak), so ~13.5% MFU is the structural ceiling for these conv shapes
+#    on v5e via XLA, not a scheduling bug (r3's 7.9% was: BERT sharing
+#    the process (HBM cross-contamination, ~30%) + f32 BN boundaries +
+#    b64 under-utilization).
+batch, hw, k = (2, 64, 2) if SMOKE else (256, 224, 3)
+paddle.seed(0)
+model = vmodels.resnet18() if SMOKE else vmodels.resnet50()
+opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                parameters=model.parameters())
+step = TrainStep(model, lambda logits, label: F.cross_entropy(
+    logits, label), opt, amp_level="O2", amp_dtype="bfloat16")
+rng = np.random.RandomState(0)
+x = paddle.to_tensor(rng.randn(k, batch, 3, hw, hw).astype("float32"))
+y = paddle.to_tensor(rng.randint(0, 1000, (k, batch)).astype("int64"))
+reps = run_reps(step, (x, y), k)
+dt = sum(reps) / len(reps) / 1e3
+sps = batch / dt
+out = {"samples_per_sec_per_chip": round(sps, 1),
+       "mfu": (round(RESNET50_TRAIN_FLOPS_PER_IMG * sps / PEAK * 100.0, 2)
+               if not SMOKE else None),
+       "config": f"resnet50-b{batch}-{hw}-O2" if not SMOKE
+       else "resnet18-cpu-smoke",
+       "methodology": "solo process, warmup 2x3 steps, 3 reps of 3 steps"}
+out.update(rep_stats(reps))
+print("RESNET" + json.dumps(out), flush=True)
+"""
+
+
+_GPT2_TPU_SCRIPT = _TPU_COMMON + r"""
+from paddle_tpu import models
+import paddle_tpu.nn as nn
+from paddle_tpu.tensor.stat import mean as tmean
+
+# operating point (r4): b4 s1024, fused tied-head CE (ops/fused_ce.py —
+# the (B*S, 50k) logits never materialize between fwd and bwd), flash
+# defaults for s1024.  r3 sweep: b8 and b8+remat regress (activation-stash
+# HBM pressure), so b4 no-remat stays.
+paddle.seed(0)
+if SMOKE:
+    cfg = models.GPTConfig(vocab_size=128, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           max_position_embeddings=32)
+    batch, seq, k = 2, 32, 2
+else:
+    cfg = models.gpt2_medium_config()
+    batch, seq, k = 4, 1024, 5
+inner = models.GPTForPretraining(cfg)
+
+class FusedLM(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.lm = inner
+    def forward(self, ids, labels):
+        return self.lm(ids, labels=labels)
+
+model = FusedLM()
+opt = paddle.optimizer.AdamW(learning_rate=1e-4,
+                             parameters=model.parameters())
+step = TrainStep(model, lambda per_tok, label: tmean(per_tok), opt,
+                 amp_level="O1", amp_dtype="bfloat16")
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(
+    0, cfg.vocab_size, (k, batch, seq)).astype("int32"))
+labels = paddle.to_tensor(rng.randint(
+    0, cfg.vocab_size, (k, batch, seq)).astype("int32"))
+reps = run_reps(step, (ids, labels, labels), k)
+dt = sum(reps) / len(reps) / 1e3
+flops = gpt_train_flops(batch, seq, cfg)
+out = {"tokens_per_sec_per_chip": round(batch * seq / dt, 1),
+       "mfu": round(flops / dt / PEAK * 100.0, 2) if not SMOKE else None,
+       "config": ("gpt2-medium-1024-fusedce" if not SMOKE
+                  else "gpt2-tiny-cpu-smoke"),
+       "methodology": "solo process, warmup 2x5 steps, 3 reps of 5 steps"}
+out.update(rep_stats(reps))
+print("GPT2" + json.dumps(out), flush=True)
+"""
+
+
+_ERNIE_TPU_SCRIPT = _TPU_COMMON + r"""
+from paddle_tpu import models
+
+# BASELINE config #4's model measured single-chip (the ZeRO sharding axis
+# runs on the virtual mesh in dryrun_multichip section 1 — one real chip
+# hosts no sharding): ERNIE-large b8 s512, same harness as BERT.
+paddle.seed(0)
+if SMOKE:
+    cfg = models.ErnieConfig(vocab_size=128, hidden_size=32,
+                             num_hidden_layers=2, num_attention_heads=2,
+                             intermediate_size=64,
+                             max_position_embeddings=32)
+    batch, seq, k = 2, 32, 2
+else:
+    cfg = models.ernie_large_config(max_position_embeddings=512)
+    batch, seq, k = 8, 512, 20
+model = models.ErnieForPretraining(cfg)
+crit = models.ErniePretrainingCriterion()
+opt = paddle.optimizer.AdamW(
+    learning_rate=1e-4, parameters=model.parameters(),
+    apply_decay_param_fun=lambda n: "bias" not in n and "norm" not in n)
+step = TrainStep(model, lambda logits, nsp, label: crit(logits, nsp, label),
+                 opt, amp_level="O1", amp_dtype="bfloat16")
+rng = np.random.RandomState(0)
+ids = paddle.to_tensor(rng.randint(
+    0, cfg.vocab_size, (k, batch, seq)).astype("int32"))
+labels = paddle.to_tensor(rng.randint(
+    0, cfg.vocab_size, (k, batch, seq)).astype("int32"))
+reps = run_reps(step, (ids, labels), k)
+dt = sum(reps) / len(reps) / 1e3
+flops = bert_train_flops(batch, seq, cfg)  # ERNIE == BERT encoder shape
+out = {"tokens_per_sec_per_chip": round(batch * seq / dt, 1),
+       "mfu": round(flops / dt / PEAK * 100.0, 2) if not SMOKE else None,
+       "config": ("ernie-large-512" if not SMOKE
+                  else "ernie-tiny-cpu-smoke"),
+       "methodology": "solo process, warmup 2x20 steps, 3 reps of 20 steps"}
+out.update(rep_stats(reps))
+print("ERNIE" + json.dumps(out), flush=True)
+"""
 
 
 def measure_resnet50(on_tpu):
-    """BASELINE config #2: ResNet-50, jit/static path, single device."""
-    import paddle_tpu as paddle
-    import paddle_tpu.nn.functional as F
-    from paddle_tpu.jit import TrainStep
-    from paddle_tpu.vision import models as vmodels
-
-    paddle.seed(0)
-    if on_tpu:
-        batch, hw, iters, warmup = 64, 224, 5, 2
-        model = vmodels.resnet50()
-    else:
-        batch, hw, iters, warmup = 4, 32, 2, 1
-        model = vmodels.resnet18()
-    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
-                                    parameters=model.parameters())
-    step = TrainStep(model, lambda logits, label: F.cross_entropy(
-        logits, label), opt, amp_level="O1", amp_dtype="bfloat16")
-
-    rng = np.random.RandomState(0)
-    k = 3 if on_tpu else 2
-    x = paddle.to_tensor(rng.randn(k, batch, 3, hw, hw).astype("float32"))
-    y = paddle.to_tensor(rng.randint(0, 1000, (k, batch)).astype("int64"))
-    # K steps per compiled call, like the flagship: per-call stepping pays
-    # seconds of tunnel overhead (measured 26 s/call at b64!), run_steps
-    # K=3 lands at ~39 ms/step on the same chip
-    for _ in range(warmup):
-        losses = step.run_steps(x, y)
-    float(losses[-1])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        losses = step.run_steps(x, y)
-    float(losses[-1])
-    dt = (time.perf_counter() - t0) / (iters * k)
-    sps = batch / dt
-    mfu = (RESNET50_TRAIN_FLOPS_PER_IMG * sps
-           / (detect_peak_tflops() * 1e12) * 100.0) if on_tpu else None
-    return {"samples_per_sec_per_chip": round(sps, 1),
-            "step_ms": round(dt * 1e3, 2),
-            "mfu": round(mfu, 2) if mfu is not None else None,
-            "config": f"resnet50-b{batch}-{hw}" if on_tpu
-            else f"resnet18-cpu-smoke-b{batch}"}
+    """BASELINE config #2: ResNet-50, jit path, solo TPU subprocess."""
+    return _run_tpu_probe(_RESNET_TPU_SCRIPT, "RESNET", timeout=1500,
+                          smoke=not on_tpu)
 
 
 def measure_gpt2(on_tpu):
-    """BASELINE config #5's model (GPT-2 medium) single-chip; the
-    pipeline+recompute leg is exercised on the virtual mesh (see
-    pipeline_ratio) since one chip hosts no pp axis.
+    """BASELINE config #5's model (GPT-2 medium) single-chip, solo TPU
+    subprocess; the pipeline+recompute leg runs on the virtual mesh (see
+    pipeline_ratio) since one chip hosts no pp axis."""
+    return _run_tpu_probe(_GPT2_TPU_SCRIPT, "GPT2", timeout=1500,
+                          smoke=not on_tpu)
 
-    Operating point (r3 sweep): b4 s1024 run_steps K=5 = 117.6 ms/step,
-    40.2% MFU; b8 regresses to 39.0% (242 ms — same super-linear
-    activation-stash pressure as BERT's b16 cliff) and b8+remat to 30.2%,
-    so b4 no-remat stays the measured config."""
-    import paddle_tpu as paddle
-    from paddle_tpu import models
-    from paddle_tpu.jit import TrainStep
 
-    paddle.seed(0)
-    if on_tpu:
-        cfg = models.gpt2_medium_config()
-        batch, seq, iters, warmup = 4, 1024, 5, 2
-    else:
-        cfg = models.GPTConfig(vocab_size=512, hidden_size=64,
-                               num_hidden_layers=2, num_attention_heads=4,
-                               max_position_embeddings=128)
-        batch, seq, iters, warmup = 2, 64, 2, 1
-    model = models.GPTForPretraining(cfg)
-    crit = models.GPTPretrainingCriterion()
-    opt = paddle.optimizer.AdamW(learning_rate=1e-4,
-                                 parameters=model.parameters())
-    step = TrainStep(model, lambda logits, label: crit(logits, label),
-                     opt, amp_level="O1", amp_dtype="bfloat16")
-    rng = np.random.RandomState(0)
-    k = 5 if on_tpu else 2
-    ids = paddle.to_tensor(rng.randint(
-        0, cfg.vocab_size, (k, batch, seq)).astype("int32"))
-    labels = paddle.to_tensor(rng.randint(
-        0, cfg.vocab_size, (k, batch, seq)).astype("int32"))
-    for _ in range(warmup):
-        losses = step.run_steps(ids, labels)
-    float(losses[-1])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        losses = step.run_steps(ids, labels)
-    float(losses[-1])
-    dt = (time.perf_counter() - t0) / (iters * k)
-    mfu = (gpt_train_flops(batch, seq, cfg) / dt
-           / (detect_peak_tflops() * 1e12) * 100.0) if on_tpu else None
-    return {"tokens_per_sec_per_chip": round(batch * seq / dt, 1),
-            "step_ms": round(dt * 1e3, 2),
-            "mfu": round(mfu, 2) if mfu is not None else None,
-            "config": "gpt2-medium-1024" if on_tpu else "gpt2-tiny-cpu"}
+def measure_ernie(on_tpu):
+    """BASELINE config #4's model (ERNIE-large) single-chip, solo TPU
+    subprocess (r3 weak #6: a measured number instead of a note)."""
+    return _run_tpu_probe(_ERNIE_TPU_SCRIPT, "ERNIE", timeout=1500,
+                          smoke=not on_tpu)
 
 
 _MNIST_EAGER_SCRIPT = r"""
@@ -413,11 +548,11 @@ def main():
     extras = os.environ.get("BENCH_EXTRA", "1") != "0"
     if extras:
         detail["ernie_zero"] = {
-            "note": "BASELINE config #4 (ERNIE-large ZeRO sharding) needs "
-                    "multiple chips; only one is reachable here.  The "
-                    "dp x tp x ZeRO-3 path is exercised functionally on "
-                    "the 8-virtual-device mesh by section 1 of "
-                    "__graft_entry__.dryrun_multichip."}
+            "note": "the ZeRO-sharding axis of BASELINE config #4 needs "
+                    "multiple chips; it runs functionally on the "
+                    "8-virtual-device mesh (dryrun_multichip section 1). "
+                    "detail.ernie_large below is the measured single-chip "
+                    "perf line for the same model."}
         # checkpoint the flagship record NOW: the secondary legs add
         # minutes of remote-compile time, and a wall-clock kill mid-extras
         # must not discard the already-measured flagship MFU.  stdout
@@ -428,6 +563,7 @@ def main():
             f.write(line() + "\n")
         for name, fn in (("resnet50", lambda: measure_resnet50(on_tpu)),
                          ("gpt2_medium", lambda: measure_gpt2(on_tpu)),
+                         ("ernie_large", lambda: measure_ernie(on_tpu)),
                          ("mnist_eager", measure_mnist_eager),
                          ("pipeline", measure_pipeline_ratio)):
             try:

@@ -224,10 +224,25 @@ class GPTForPretraining(Layer):
         super().__init__()
         self.gpt = GPTModel(cfg, **kw)
 
-    def forward(self, input_ids, position_ids=None, cache=None):
+    def forward(self, input_ids, position_ids=None, cache=None,
+                labels=None):
+        """With `labels`, returns PER-TOKEN losses via the fused tied-head
+        CE (ops/fused_ce.py) — the (B, S, V) logits never materialize
+        between forward and backward, the r3-verdict big-vocab lever.
+        Without labels: logits, the reference-parity contract."""
         from ..tensor.linalg import matmul
         out = self.gpt(input_ids, position_ids, cache)
         h = out[0] if isinstance(out, tuple) else out
+        if labels is not None:
+            if cache is not None:
+                from ..core.errors import InvalidArgumentError
+                raise InvalidArgumentError(
+                    "[gpt] labels with cache is unsupported — the fused CE "
+                    "is a training path; compute losses from the returned "
+                    "logits when decoding")
+            from ..ops.fused_ce import fused_linear_cross_entropy
+            return fused_linear_cross_entropy(
+                h, self.gpt.word_embeddings.weight, labels)
         logits = matmul(h, self.gpt.word_embeddings.weight, transpose_y=True)
         return logits if cache is None else (logits, out[1])
 

@@ -54,7 +54,21 @@ def _available() -> bool:
         return False
 
 
+def _env_int(name):
+    import os
+    v = os.environ.get(name)
+    if not v:
+        return None
+    try:
+        return int(v)
+    except ValueError:
+        return None  # tuning knob: garbage falls back to the heuristic
+
+
 def _block(size: int) -> int:
+    override = _env_int("PDTPU_FLASH_BLOCK")
+    if override in (128, 256, 512) and size % override == 0:
+        return override
     return next(b for b in (512, 256, 128) if size % b == 0)
 
 
@@ -66,6 +80,12 @@ def _head_group(h: int, d: int):
     # lane width g*d must be a multiple of 128 (or the array's full last
     # dim h*d, the one exemption Mosaic grants) — h=6,d=64 must pick g=2
     # (128 lanes), not g=3 (192 lanes, unlowerable)
+    override = _env_int("PDTPU_FLASH_GROUP")
+    if override and h % override == 0 and (override * d) % 128 == 0 \
+            and 128 <= override * d <= 1024:
+        # the override must still satisfy Mosaic's 128-lane constraint —
+        # an unlowerable g would fail with an opaque kernel error
+        return override
     cands = [g for g in range(1, h + 1)
              if h % g == 0 and 128 <= g * d <= 512 and (g * d) % 128 == 0]
     if not cands:

@@ -1,0 +1,111 @@
+"""Fused tied-head softmax cross-entropy.
+
+Reference context: the GPT pretraining head is logits = h @ W_e^T followed
+by softmax-CE over a ~50k vocab (models/gpt.py).  Materializing the
+(tokens, vocab) logits between forward and backward costs ~0.4-0.8 GB of
+HBM traffic per step at GPT-2-medium shapes — the r3 verdict's named lever
+("shard or chunk the vocab axis so the CE never materializes (B*S, V) in
+f32").
+
+TPU-native: a custom-vjp op that scans TOKEN chunks; each chunk's logits
+live only inside the scan step (bf16 MXU dot, f32 accumulation/softmax
+math) and the backward recomputes them from the saved (h, W) instead of
+stashing (T, V) activations.  dW accumulates in f32 across chunks.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..core.op import dispatch
+from ..core.tensor import unwrap
+
+__all__ = ["fused_linear_cross_entropy"]
+
+
+def _chunk_of(t: int, want: int) -> int:
+    want = min(want, t)
+    while t % want:
+        want -= 1
+    return want
+
+
+def _chunk_losses(hc, w, lc):
+    """One chunk: (c, H) x (V, H) -> per-token CE, logits never escape."""
+    logits = jax.lax.dot_general(
+        hc.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (c, V) f32
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, lc[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    return lse - picked
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flce(h, w, labels, chunk):
+    losses, _ = _flce_fwd(h, w, labels, chunk)
+    return losses
+
+
+def _flce_fwd(h, w, labels, chunk):
+    t, hid = h.shape
+    c = _chunk_of(t, chunk)
+    hs = h.reshape(t // c, c, hid)
+    ls = labels.reshape(t // c, c)
+    _, losses = jax.lax.scan(
+        lambda _, xs: (None, _chunk_losses(xs[0], w, xs[1])), None, (hs, ls))
+    return losses.reshape(t), (h, w, labels)
+
+
+def _flce_bwd(chunk, res, ct):
+    h, w, labels = res
+    t, hid = h.shape
+    c = _chunk_of(t, chunk)
+    n = t // c
+
+    def body(dw, xs):
+        hc, lc, ctc = xs
+        logits = jax.lax.dot_general(
+            hc.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        g = p.at[jnp.arange(c), lc.astype(jnp.int32)].add(-1.0)
+        g = g * ctc[:, None]                          # (c, V) f32
+        gb = g.astype(jnp.bfloat16)
+        dh_c = jax.lax.dot_general(
+            gb, w.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (c, H)
+        dw = dw + jax.lax.dot_general(
+            gb, hc.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)       # (V, H)
+        return dw, dh_c
+
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    dw, dh = jax.lax.scan(
+        body, dw0, (h.reshape(n, c, hid), labels.reshape(n, c),
+                    ct.reshape(n, c)))
+    return dh.reshape(t, hid).astype(h.dtype), dw.astype(w.dtype), None
+
+
+def fused_linear_cross_entropy(h, weight, labels, chunk_size=2048,
+                               name=None):
+    """Per-token CE of (h @ weight^T) vs labels WITHOUT materializing the
+    (tokens, vocab) logits between forward and backward.
+
+    h (..., H) hidden states, weight (V, H) (the tied embedding layout),
+    labels (...) int.  Returns per-token losses shaped like labels.
+    """
+    lead = unwrap(labels).shape
+
+    def raw(hv, wv, lv):
+        flat = _flce(hv.reshape(-1, hv.shape[-1]), wv,
+                     lv.reshape(-1), chunk_size)
+        return flat.reshape(lead)
+
+    return dispatch("fused_linear_cross_entropy", raw, h, weight, labels)
+
+_flce.defvjp(_flce_fwd, _flce_bwd)

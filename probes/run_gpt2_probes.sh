@@ -1,0 +1,18 @@
+#!/bin/bash
+# GPT-2-medium sweep: fused CE + flash block/group + batch. Serialized.
+cd "$(dirname "$0")/.."
+out=probes/gpt2_probe_results.txt
+: > "$out"
+run() {  # tag batch [env...]
+  tag=$1; b=$2; shift 2
+  echo "=== $tag b$b $* ===" | tee -a "$out"
+  env "$@" timeout 1200 python probes/gpt2_probe.py "$tag" "$b" 2>&1 | grep -v WARNING | tail -2 | tee -a "$out"
+}
+run baseline 4
+run fused 4
+run fused_blk256 4 PDTPU_FLASH_BLOCK=256
+run fused_g2 4 PDTPU_FLASH_GROUP=2
+run fused_g8 4 PDTPU_FLASH_GROUP=8
+run fused_b6 6
+run fused_b8 8
+echo DONE | tee -a "$out"

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Round 2: async bench-style harness; NHWC vs NCHW full model; tower at 256.
+cd "$(dirname "$0")/.."
+out=probes/resnet_probe_results2.txt
+: > "$out"
+for spec in "baseline 64" "baseline 256" "nhwc 64" "nhwc 128" "nhwc 256" \
+            "nhwc_o2 256" "o2 256" "convtower 256" "convtower_nhwc 256"; do
+  set -- $spec
+  echo "=== $1 $2 ===" | tee -a "$out"
+  timeout 1200 python probes/resnet_probe.py "$1" "$2" 2>&1 | grep -v WARNING | tail -3 | tee -a "$out"
+done
+echo DONE | tee -a "$out"
