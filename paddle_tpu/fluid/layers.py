@@ -59,6 +59,29 @@ from ..vision.ops import (  # noqa: F401
     distribute_fpn_proposals, collect_fpn_proposals,
 )
 from ..vision.ops import yolo_loss as yolov3_loss  # noqa: F401
+from ..vision.ops import matrix_nms  # noqa: F401
+from ..vision.rcnn_ops import (  # noqa: F401
+    rpn_target_assign, retinanet_target_assign, generate_proposal_labels,
+    generate_mask_labels, retinanet_detection_output, locality_aware_nms,
+    box_decoder_and_assign, roi_perspective_transform,
+    polygon_box_transform,
+)
+
+# --- seq2seq decode family (nn.decode is the 2.0 home) ------------------
+from ..nn.decode import (  # noqa: F401
+    Decoder, BeamSearchDecoder, DecodeHelper, TrainingHelper,
+    GreedyEmbeddingHelper, SampleEmbeddingHelper, BasicDecoder,
+    dynamic_decode, beam_search, beam_search_decode, gather_tree,
+)
+from ..nn.layer.rnn import (  # noqa: F401
+    RNNCellBase as RNNCell, GRUCell, LSTMCell,
+)
+
+
+from .layers_extra import *  # noqa: F401,F403,E402  (nn/control_flow/loss/
+#                              sequence/tensor/io long tail)
+# kept OUT of layers_extra so its internal loops keep the builtin range
+from ..tensor.creation import arange as range  # noqa: F401,E402,A004
 
 
 def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
@@ -74,3 +97,41 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
             "paddle.vision.models.MultiBoxHead) — the repo's fluid "
             "convention for LayerHelper-created parameters")
     return head(inputs, image)
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """fluid.layers.rnn (reference rnn.py:520): run `cell` over the time
+    axis — the nn.RNN layer is the 2.0 home; this wraps it with fluid's
+    argument order."""
+    from ..nn.layer.rnn import RNN
+    runner = RNN(cell, is_reverse=is_reverse, time_major=time_major)
+    return runner(inputs, initial_states, sequence_length)
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    """fluid.layers.birnn (reference rnn.py:660) over nn.BiRNN."""
+    from ..nn.layer.rnn import BiRNN
+    runner = BiRNN(cell_fw, cell_bw, time_major=time_major)
+    return runner(inputs,
+                  None if initial_states is None else tuple(initial_states),
+                  sequence_length)
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,  # noqa: A002
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1, layer=None):
+    """fluid.layers.lstm — the cudnn-style stacked LSTM (reference
+    rnn.py:2426).  Stateful weights cannot be created by a traced
+    function (no LayerHelper param store): build `paddle.nn.LSTM(...)`
+    once and pass it as `layer`, the repo's fluid convention (see
+    nn.functional.fc)."""
+    from ..core.errors import InvalidArgumentError
+    if layer is None:
+        raise InvalidArgumentError(
+            "fluid.layers.lstm: pass `layer=paddle.nn.LSTM(input_size, "
+            "hidden_size, num_layers, direction=...)` — LayerHelper "
+            "param creation does not exist here")
+    out, (h, c) = layer(input, (init_h, init_c))
+    return out, h, c

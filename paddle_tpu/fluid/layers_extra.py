@@ -1,0 +1,695 @@
+"""fluid.layers long tail: the remaining nn.py / control_flow.py /
+loss.py / sequence_lod.py / tensor.py / io.py names.
+
+Reference: python/paddle/fluid/layers/{nn,control_flow,loss,sequence_lod,
+tensor,io}.py.  Split by kind: pure ALIASES to 2.0 homes, small direct
+implementations of ops with no 2.0 successor, and (for the LoD/program
+machinery masked-dense tracing genuinely subsumes — py_reader,
+reorder_lod_tensor_by_rank) explicit UnimplementedError pointers to the
+modern path, so ports fail loudly with guidance instead of silently
+misbehaving.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError, UnimplementedError
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+
+# --- straight aliases ----------------------------------------------------
+from ..nn.functional import (  # noqa: F401
+    grid_sample as grid_sampler,
+    hardsigmoid as hard_sigmoid,
+    hardswish as hard_swish,
+)
+from ..metric import mean_iou, chunk_eval  # noqa: F401
+from ..distribution import sampling_id  # noqa: F401
+from ..compat import get_tensor_from_selected_rows  # noqa: F401
+from ..tensor.math import add_n as sums  # noqa: F401
+# NOTE: fluid.layers.range is wired in layers.py — aliasing it HERE would
+# shadow the builtin for every loop in this module
+from ..nn.functional.loss import kl_div as kldiv_loss  # noqa: F401
+from ..nn.functional.crf import hsigmoid_loss as hsigmoid  # noqa: F401
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max",  # noqa: A002
+                    require_index=False, name=None):
+    from ..nn import functional as F
+    fn = (F.adaptive_max_pool2d if pool_type == "max"
+          else F.adaptive_avg_pool2d)
+    return fn(input, pool_size)
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",  # noqa: A002
+                    require_index=False, name=None):
+    from ..nn import functional as F
+    fn = (F.adaptive_max_pool3d if pool_type == "max"
+          else F.adaptive_avg_pool3d)
+    return fn(input, pool_size)
+
+
+_step_counters = {}
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Per-name python counter (the reference's persistable counter var)."""
+    key = counter_name or "@STEP_COUNTER@"
+    val = _step_counters.get(key, begin - step) + step
+    _step_counters[key] = val
+    return Tensor(jnp.asarray([val], jnp.int64), stop_gradient=True)
+
+
+def bilinear_tensor_product(x, y, size, weight=None, bias=None,
+                            act=None, name=None, **_ignored):
+    """x^T W_k y per output k (reference nn.py bilinear_tensor_product);
+    weight (size, dx, dy) explicit per the repo's fluid convention."""
+    if weight is None:
+        raise InvalidArgumentError(
+            "bilinear_tensor_product: pass `weight` explicitly or use "
+            "nn.Bilinear / legacy_layers.BilinearTensorProduct")
+
+    def raw(xv, yv, wv, bv):
+        out = jnp.einsum("bi,kij,bj->bk", xv, wv, yv)
+        if bv is not None:
+            out = out + bv.reshape(1, -1)
+        return out
+
+    return dispatch("bilinear_tensor_product", raw, x, y, weight, bias)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    return dispatch("brelu", lambda v: jnp.clip(v, t_min, t_max), x)
+
+
+def continuous_value_model(input, cvm, use_cvm=True, name=None):  # noqa: A002
+    """CVM op (reference cvm_op.cc): with use_cvm the first two columns
+    (show/click) are replaced by the log-transformed cvm input; without
+    it they are stripped."""
+    def raw(xv, cv):
+        if use_cvm:
+            logs = jnp.log(jnp.maximum(cv, 0.0) + 1.0)
+            return jnp.concatenate([logs[:, :2], xv[:, 2:]], axis=1)
+        return xv[:, 2:]
+
+    return dispatch("cvm", raw, input, cvm)
+
+
+def cos_sim(X, Y, name=None):  # noqa: N803
+    from ..nn.functional import cosine_similarity
+    out = cosine_similarity(X, Y, axis=1)
+    from ..tensor.manipulation import reshape
+    return reshape(out, [-1, 1])
+
+
+def filter_by_instag(ins, ins_tag, filter_tag, is_lod=True,
+                     out_val_if_empty=0):
+    """Keep rows whose tag set intersects filter_tag (reference
+    filter_by_instag_op, the ad-ranking instag filter).  Host-side data
+    prep; returns (filtered_rows, kept_index, loss_weight)."""
+    tags = np.asarray(jax.device_get(unwrap(ins_tag))).reshape(len(
+        np.asarray(jax.device_get(unwrap(ins)))), -1)
+    want = set(np.asarray(jax.device_get(unwrap(filter_tag))).reshape(-1)
+               .tolist())
+    keep = [i for i in range(tags.shape[0])
+            if set(tags[i].tolist()) & want]
+    xv = unwrap(ins)
+    if not keep:
+        empty = jnp.full((1,) + xv.shape[1:], out_val_if_empty, xv.dtype)
+        return (Tensor(empty, stop_gradient=True),
+                Tensor(jnp.zeros((1,), jnp.int64), stop_gradient=True),
+                Tensor(jnp.zeros((1, 1), jnp.float32), stop_gradient=True))
+    idx = jnp.asarray(keep, jnp.int32)
+    out = dispatch("filter_by_instag", lambda v: v[idx], ins)
+    return (out, Tensor(idx.astype(jnp.int64), stop_gradient=True),
+            Tensor(jnp.ones((len(keep), 1), jnp.float32),
+                   stop_gradient=True))
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32",
+                    name=None):
+    from ..tensor.random import normal
+    return normal(mean=mean, std=std, shape=list(shape))
+
+
+def _batch_size_like(ref, shape, input_dim_idx, output_dim_idx):
+    shape = list(shape)
+    shape[output_dim_idx] = unwrap(ref).shape[input_dim_idx]
+    return shape
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,  # noqa: A002
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    return gaussian_random(_batch_size_like(input, shape, input_dim_idx,
+                                            output_dim_idx), mean, std,
+                           seed, dtype)
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0,  # noqa: A002
+                   name=None):
+    from ..tensor.random import uniform
+    return uniform(list(shape), dtype=dtype, min=min, max=max, seed=seed)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",  # noqa: A002
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):  # noqa: A002
+    return uniform_random(_batch_size_like(input, shape, input_dim_idx,
+                                           output_dim_idx), dtype, min,
+                          max, seed)
+
+
+def hash(input, hash_size, num_hash=1, name=None):  # noqa: A002
+    """Multiplicative integer hashing into [0, hash_size) with num_hash
+    lanes (reference hash_op uses xxhash; the CONTRACT — deterministic
+    bucketing of int ids — is preserved, the exact hash family is not,
+    as documented)."""
+    primes = jnp.asarray(
+        [2654435761, 2246822519, 3266489917, 668265263, 374761393,
+         2654435789, 2246822579, 3266489989][:num_hash], jnp.uint32)
+
+    def raw(v):
+        v = v.astype(jnp.uint32)
+        out = (v[..., None, :] * primes[:, None]) % jnp.uint32(hash_size)
+        return out.astype(jnp.int64)
+
+    return dispatch("hash", raw, input)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):  # noqa: A002
+    from ..nn.functional import interpolate
+    h, w = unwrap(input).shape[2:]
+    short = min(h, w)
+    scale = out_short_len / short
+    return interpolate(input, size=[int(round(h * scale)),
+                                    int(round(w * scale))],
+                       mode=resample.lower())
+
+
+def resize_linear(input, out_shape=None, scale=None, name=None,  # noqa: A002
+                  actual_shape=None, align_corners=True, align_mode=1,
+                  data_format="NCW"):
+    from ..nn.functional import interpolate
+    return interpolate(input, size=out_shape, scale_factor=scale,
+                       mode="linear", align_corners=align_corners,
+                       data_format=data_format)
+
+
+def inplace_abn(input, act=None, **bn_kwargs):  # noqa: A002
+    """Activated batch norm (reference inplace_abn_op) — XLA fuses the
+    activation into the norm; 'inplace' is a memory-pass concern the
+    donation system owns."""
+    from ..nn.legacy_layers import _apply_act
+    from .layers import batch_norm as _fluid_bn  # noqa: F401
+    raise UnimplementedError(
+        "inplace_abn: use nn.BatchNorm2D + the activation directly — "
+        "XLA fuses them; there is no separate in-place pass to request")
+
+
+def l2_normalize(x, axis, epsilon=1e-12, name=None):
+    from ..nn.functional import normalize
+    return normalize(x, p=2, axis=axis, epsilon=epsilon)
+
+
+def lod_append(x, level):
+    """LoD is subsumed by masked-dense batches — appending a level is a
+    no-op on the dense values (documented passthrough)."""
+    return x
+
+
+def lod_reset(x, y=None, target_lod=None):
+    """See lod_append: segmentation travels as explicit lengths in this
+    repo, the dense values are unchanged."""
+    return x
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None,  # noqa: A002
+        data_format="NCHW"):
+    """Local response normalization (reference lrn_op)."""
+    def raw(x):
+        ch_axis = 1 if data_format.startswith("NC") else -1
+        xt = jnp.moveaxis(x, ch_axis, 1)
+        sq = jnp.square(xt)
+        c = xt.shape[1]
+        half = n // 2
+        pad = jnp.pad(sq, [(0, 0), (half, n - 1 - half)] +
+                      [(0, 0)] * (xt.ndim - 2))
+        acc = sum(pad[:, i:i + c] for i in range(n))
+        out = xt / jnp.power(k + alpha * acc, beta)
+        return jnp.moveaxis(out, 1, ch_axis)
+
+    return dispatch("lrn", raw, input)
+
+
+def merge_selected_rows(x, name=None):
+    """Merge duplicate rows of a RowSparseGrad (reference
+    merge_selected_rows_op over scatter::MergeAdd)."""
+    from ..core.selected_rows import RowSparseGrad
+    from ..optimizer.sparse import merge_rows
+    if not isinstance(x, RowSparseGrad):
+        return x
+    rows, vals = merge_rows(x.rows, x.values, x.dense_shape[0])
+    return RowSparseGrad(rows, vals, x.dense_shape)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """mul_op: flatten both sides to 2-D then matmul."""
+    def raw(xv, yv):
+        xm = xv.reshape((int(np.prod(xv.shape[:x_num_col_dims])), -1))
+        ym = yv.reshape((int(np.prod(yv.shape[:y_num_col_dims])), -1))
+        return xm @ ym
+
+    return dispatch("mul", raw, x, y)
+
+
+def py_func(func, x, out=None, backward_func=None, skip_vars_in_backward_input=None):
+    """Eager python call (reference py_func_op).  Tracing cannot call back
+    into python, so this is the EAGER path only."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res
+
+
+def random_crop(x, shape, seed=None):
+    """Random spatial crop to `shape` (reference random_crop_op) —
+    host-side offset draw, device slice."""
+    xv = unwrap(x)
+    shape = list(shape)
+    nd = len(shape)
+    rng = np.random.RandomState(seed)
+    starts = [0] * (xv.ndim - nd) + [
+        int(rng.randint(0, xv.shape[xv.ndim - nd + i] - shape[i] + 1))
+        for i in range(nd)]
+    sizes = list(xv.shape[:xv.ndim - nd]) + shape
+
+    def raw(v):
+        return jax.lax.dynamic_slice(v, starts, sizes)
+
+    return dispatch("random_crop", raw, x)
+
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    def raw(v):
+        ax = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+        return jnp.all(v, axis=ax, keepdims=keep_dim)
+    return dispatch("reduce_all", raw, input)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):  # noqa: A002
+    def raw(v):
+        ax = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+        return jnp.any(v, axis=ax, keepdims=keep_dim)
+    return dispatch("reduce_any", raw, input)
+
+
+def row_conv(input, future_context_size, weight=None, act=None,  # noqa: A002
+             param_attr=None):
+    """Functional row_conv (reference row_conv_op); weight
+    (future_context_size + 1, D) explicit — the stateful form is
+    legacy_layers.RowConv."""
+    if weight is None:
+        raise InvalidArgumentError(
+            "row_conv: pass `weight` explicitly or use "
+            "nn.legacy_layers.RowConv")
+
+    def raw(xv, wv):
+        t = xv.shape[1]
+        ctx = wv.shape[0]
+        pad = jnp.pad(xv, [(0, 0), (0, ctx - 1), (0, 0)])
+        out = sum(pad[:, i:i + t] * wv[i] for i in range(ctx))
+        return out
+
+    out = dispatch("row_conv", raw, input, weight)
+    from ..nn.legacy_layers import _apply_act
+    return _apply_act(out, act)
+
+
+def similarity_focus(input, axis, indexes, name=None):  # noqa: A002
+    """Similarity-focus mask (reference similarity_focus_op): for each
+    selected channel (via `indexes` on `axis`), mark the per-row/column
+    argmax positions across the other two spatial dims; union over the
+    selected channels, broadcast to all channels."""
+    def raw(x):
+        n, c, a, b = x.shape
+        masks = jnp.zeros((n, a, b), x.dtype)
+        for idx in indexes:
+            if axis == 1:
+                plane = x[:, idx]                      # (N, A, B)
+            elif axis == 2:
+                plane = x[:, :, idx]
+            else:
+                plane = x[:, :, :, idx]
+            row_max = plane == jnp.max(plane, axis=2, keepdims=True)
+            col_max = plane == jnp.max(plane, axis=1, keepdims=True)
+            masks = jnp.maximum(masks,
+                                (row_max | col_max).astype(x.dtype))
+        return jnp.broadcast_to(masks[:, None], x.shape)
+
+    return dispatch("similarity_focus", raw, input)
+
+
+def size(input, name=None):  # noqa: A002
+    from ..tensor.attribute import numel
+    return numel(input)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Functional spectral norm (reference spectral_norm_op): weight
+    divided by its leading singular value via power iteration (fresh u
+    each call — the stateful form is nn.SpectralNorm)."""
+    def raw(wv):
+        w = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+        u = jnp.ones((w.shape[0],), w.dtype)
+        for _ in range(max(power_iters, 1)):
+            v = w.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = w @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        sigma = u @ (w @ v)
+        return wv / jnp.maximum(sigma, eps)
+
+    return dispatch("spectral_norm", raw, weight)
+
+
+def unique_with_counts(x, dtype="int32"):
+    """Eager-only (dynamic output shape): unique values, reconstruction
+    index, counts."""
+    xv = np.asarray(jax.device_get(unwrap(x))).reshape(-1)
+    out, index, counts = np.unique(xv, return_inverse=True,
+                                   return_counts=True)
+    return (Tensor(jnp.asarray(out), stop_gradient=True),
+            Tensor(jnp.asarray(index.astype(dtype)), stop_gradient=True),
+            Tensor(jnp.asarray(counts.astype(dtype)), stop_gradient=True))
+
+
+# --- control flow ---------------------------------------------------------
+
+
+def Assert(cond, data=None, summarize=20, name=None):  # noqa: N802
+    cv = np.asarray(jax.device_get(unwrap(cond)))
+    if not bool(np.all(cv)):
+        from ..core.errors import PreconditionNotMetError
+        payload = [np.asarray(jax.device_get(unwrap(d)))[:summarize]
+                   for d in (data or [])]
+        raise PreconditionNotMetError(
+            f"[Assert] condition is false; data={payload}")
+    return cond
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: N802,A002
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    v = np.asarray(jax.device_get(unwrap(input)))
+    print(f"{message or 'Var'}: shape={v.shape} dtype={v.dtype} "
+          f"values={v.reshape(-1)[:summarize]}")
+    return input
+
+
+class While:
+    """Program-region While (reference control_flow.While).  A python
+    `with` body cannot be conditionally skipped, so the faithful eager
+    form does not exist — use static.nn.while_loop (lax.while_loop) or a
+    plain python loop."""
+
+    def __init__(self, *a, **k):
+        raise UnimplementedError(
+            "While: use fluid.layers.while_loop / static.nn.while_loop "
+            "(lax) or a python loop — program block regions do not exist "
+            "here")
+
+
+class Switch:
+    """See While: use static.nn.case / python if-chains."""
+
+    def __init__(self, *a, **k):
+        raise UnimplementedError(
+            "Switch: use fluid.layers.case / python conditionals — "
+            "program block regions do not exist here")
+
+
+class IfElse:
+    """See While: use static.nn.cond or boolean masking."""
+
+    def __init__(self, *a, **k):
+        raise UnimplementedError(
+            "IfElse: use fluid.layers.cond or jnp.where masking — "
+            "program block regions do not exist here")
+
+
+class DynamicRNN:
+    """Era-compat dynamic RNN builder.  The masked-dense world runs
+    sequence models with nn.RNN / legacy dynamic_lstm-style scans; this
+    class would re-introduce per-timestep LoD shrinking, so it raises
+    with the modern recipe instead of silently mis-running."""
+
+    def __init__(self, *a, **k):
+        raise UnimplementedError(
+            "DynamicRNN: use nn.RNN(cell)(inputs, sequence_length=...) or "
+            "fluid.layers.dynamic_lstm/dynamic_gru over masked-dense "
+            "batches — LoD program regions do not exist here")
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    raise UnimplementedError(
+        "reorder_lod_tensor_by_rank: masked-dense batches need no length "
+        "reordering — feed sequence_length to the RNN layers instead")
+
+
+# --- losses ---------------------------------------------------------------
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,  # noqa: A002
+                  input_length=None, label_length=None):
+    """Levenshtein distance per pair (reference edit_distance_op),
+    host-side numpy (serving/eval metric)."""
+    a = np.asarray(jax.device_get(unwrap(input)))
+    b = np.asarray(jax.device_get(unwrap(label)))
+    if a.ndim == 1:
+        a, b = a[None], b[None]
+    al = (np.asarray(jax.device_get(unwrap(input_length))).reshape(-1)
+          if input_length is not None
+          else np.full(len(a), a.shape[1]))
+    bl = (np.asarray(jax.device_get(unwrap(label_length))).reshape(-1)
+          if label_length is not None
+          else np.full(len(b), b.shape[1]))
+    ignored = set(ignored_tokens or [])
+    out = np.zeros((len(a), 1), np.float32)
+    seq_num = len(a)
+    for i in range(seq_num):
+        s1 = [t for t in a[i][:al[i]].tolist() if t not in ignored]
+        s2 = [t for t in b[i][:bl[i]].tolist() if t not in ignored]
+        d = np.arange(len(s2) + 1, dtype=np.float64)
+        for j, c1 in enumerate(s1, 1):
+            prev = d.copy()
+            d[0] = j
+            for k, c2 in enumerate(s2, 1):
+                d[k] = min(prev[k] + 1, d[k - 1] + 1,
+                           prev[k - 1] + (c1 != c2))
+        dist = d[-1] if len(s1) else len(s2)
+        out[i, 0] = dist / max(len(s2), 1) if normalized else dist
+    return (Tensor(jnp.asarray(out), stop_gradient=True),
+            Tensor(jnp.asarray([seq_num], jnp.int64), stop_gradient=True))
+
+
+def huber_loss(input, label, delta):  # noqa: A002
+    def raw(x, y):
+        d = jnp.abs(x - y)
+        return jnp.where(d <= delta, 0.5 * d * d,
+                         delta * (d - 0.5 * delta))
+    return dispatch("huber_loss", raw, input, label)
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    def raw(lab, l, r):
+        return jnp.maximum(0.0, -lab * (l - r) + margin)
+    return dispatch("margin_rank_loss", raw, label, left, right)
+
+
+def rank_loss(label, left, right, name=None):
+    def raw(lab, l, r):
+        return jnp.log1p(jnp.exp(l - r)) - lab * (l - r)
+    return dispatch("rank_loss", raw, label, left, right)
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits
+                                       =True, use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """Sampled softmax CE (reference sampled_softmax...op): CE over the
+    true class + uniformly sampled negatives instead of the full vocab."""
+    lv = unwrap(logits)
+    lab = unwrap(label).reshape(-1).astype(jnp.int32)
+    n, v = lv.shape
+    rng = np.random.RandomState(seed)
+    neg = jnp.asarray(rng.randint(0, v, (num_samples,)), jnp.int32)
+
+    def raw(lg):
+        cols = jnp.concatenate([lab[:, None], jnp.broadcast_to(
+            neg, (n, num_samples))], axis=1)          # (N, 1+S)
+        picked = jnp.take_along_axis(lg, cols, axis=1)
+        if remove_accidental_hits:
+            hit = cols[:, 1:] == lab[:, None]
+            picked = picked.at[:, 1:].set(
+                jnp.where(hit, -1e20, picked[:, 1:]))
+        lse = jax.nn.logsumexp(picked.astype(jnp.float32), axis=1)
+        return (lse - picked[:, 0].astype(jnp.float32)).reshape(-1, 1)
+
+    return dispatch("sampled_softmax_ce", raw, logits)
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,  # noqa: A002
+            input_length=None, label_length=None):
+    from ..nn.functional import ctc_loss
+    return ctc_loss(input, label, input_length, label_length, blank=blank,
+                    reduction="none")
+
+
+# --- sequence (masked-dense forms) ---------------------------------------
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,  # noqa: A002
+                  padding=True, padding_start=None, weight=None, bias=None,
+                  act=None, **_ignored):
+    """Context-window conv over time (reference sequence_conv_op):
+    weight (filter_size * D, num_filters) explicit."""
+    if weight is None:
+        raise InvalidArgumentError(
+            "sequence_conv: pass `weight` ((filter_size*D, num_filters)) "
+            "explicitly — see nn.functional.fc for the convention")
+    start = (-(filter_size // 2) if padding_start is None
+             else padding_start)
+
+    def raw(xv, wv, bv):
+        b, t, d = xv.shape
+        cols = []
+        for i in range(filter_size):
+            ofs = start + i
+            if ofs < 0:
+                sl = jnp.pad(xv[:, :t + ofs], [(0, 0), (-ofs, 0), (0, 0)])
+            else:
+                sl = jnp.pad(xv[:, ofs:], [(0, 0), (0, ofs), (0, 0)])
+            cols.append(sl)
+        im2col = jnp.concatenate(cols, axis=-1)       # (B, T, fs*D)
+        out = im2col @ wv
+        if bv is not None:
+            out = out + bv.reshape(1, 1, -1)
+        return out
+
+    out = dispatch("sequence_conv", raw, input, weight, bias)
+    from ..nn.legacy_layers import _apply_act
+    return _apply_act(out, act)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None, lengths=None):
+    """Row-expand x by per-row repeat counts (reference
+    sequence_expand_op).  Masked-dense form: `lengths` (or y's row count
+    pattern) gives the repeat count per x row."""
+    if lengths is None:
+        raise InvalidArgumentError(
+            "sequence_expand: pass `lengths` (repeats per row) — the LoD "
+            "of y does not travel with dense tensors")
+    reps = np.asarray(jax.device_get(unwrap(lengths))).reshape(-1)
+    idx = jnp.asarray(np.repeat(np.arange(len(reps)), reps), jnp.int32)
+    return dispatch("sequence_expand", lambda v: v[idx], x)
+
+
+def sequence_reshape(input, new_dim):  # noqa: A002
+    def raw(v):
+        return v.reshape(-1, new_dim)
+    return dispatch("sequence_reshape", raw, input)
+
+
+def sequence_scatter(input, index, updates, name=None):  # noqa: A002
+    def raw(x, i, u):
+        return x.at[i.astype(jnp.int32)].add(u)
+    return dispatch("sequence_scatter", raw, input, index, updates)
+
+
+def sequence_slice(input, offset, length, name=None):  # noqa: A002
+    """Per-sequence slice (reference sequence_slice_op) on (B, T, ...)
+    masked-dense batches."""
+    def raw(x, off, ln):
+        t = x.shape[1]
+        pos = jnp.arange(t)[None, :]
+        keep = (pos >= off.reshape(-1, 1)) & \
+            (pos < (off + ln).reshape(-1, 1))
+        # left-align each kept span
+        order = jnp.argsort(~keep, axis=1, stable=True)
+        gathered = jnp.take_along_axis(
+            x, order[..., None] if x.ndim == 3 else order, axis=1)
+        maxlen = int(jnp.max(ln)) if not isinstance(
+            ln, jax.core.Tracer) else t
+        return gathered[:, :maxlen] * (
+            jnp.arange(gathered.shape[1])[None, :, None]
+            < ln.reshape(-1, 1, 1) if x.ndim == 3 else 1)
+
+    return dispatch("sequence_slice", raw, input, offset, length)
+
+
+# --- tensor builders ------------------------------------------------------
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    from ..core.dtype import convert_dtype
+    return Tensor(jnp.zeros((0,), convert_dtype(dtype)))
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,  # noqa: A002
+                                  input_dim_idx=0, output_dim_idx=0,
+                                  force_cpu=False):
+    from ..compat import fill_constant
+    return fill_constant(_batch_size_like(input, shape, input_dim_idx,
+                                          output_dim_idx), dtype, value)
+
+
+def tensor_array_to_tensor(input, axis=1, name=None,  # noqa: A002
+                           use_stack=False):
+    from ..tensor.manipulation import concat, stack
+    arrs = list(input)
+    out = stack(arrs, axis=axis) if use_stack else concat(arrs, axis=axis)
+    sizes = [unwrap(a).shape[axis] if not use_stack else 1 for a in arrs]
+    return out, Tensor(jnp.asarray(sizes, jnp.int32), stop_gradient=True)
+
+
+# --- io shims -------------------------------------------------------------
+
+
+def double_buffer(reader, place=None, name=None):
+    """Prefetch is the DataLoader's job here (io/dataloader.py device
+    prefetch) — passthrough."""
+    return reader
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    raise UnimplementedError(
+        "py_reader: use paddle.io.DataLoader (worker processes + device "
+        "prefetch) — feed-queue program readers do not exist here")
+
+
+def create_py_reader_by_data(capacity, feed_list, name=None,
+                             use_double_buffer=True):
+    raise UnimplementedError(
+        "create_py_reader_by_data: use paddle.io.DataLoader")
+
+
+def read_file(reader):
+    raise UnimplementedError(
+        "read_file: file readers are python iterables here — iterate the "
+        "DataLoader directly")
+
+
+def load(out, file_path, load_as_fp16=None):
+    from ..framework import load as _load
+    state = _load(file_path, return_numpy=True)
+    if hasattr(out, "_set_data"):
+        first = state if not isinstance(state, dict) else \
+            next(iter(state.values()))
+        out._set_data(jnp.asarray(first))
+    return out

@@ -19,7 +19,10 @@ import numpy as np
 
 from ..core.tensor import Tensor, unwrap
 
-__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree"]
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode", "gather_tree",
+           "DecodeHelper", "TrainingHelper", "GreedyEmbeddingHelper",
+           "SampleEmbeddingHelper", "BasicDecoder", "beam_search",
+           "beam_search_decode"]
 
 _NEG = -1e9
 
@@ -178,6 +181,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
     inputs, states, finished = decoder.initialize(inits)
     step_outputs = []
     time = 0
+    done = np.asarray(unwrap(finished)).astype(bool)
     while True:
         if max_step_num is not None and time >= max_step_num:
             break
@@ -185,18 +189,223 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
             time, inputs, states, **kwargs)
         step_outputs.append(outputs)
         time += 1
-        if bool(np.all(np.asarray(unwrap(finished)))):
+        # OR-accumulate: a helper's per-step finished (e.g. ids==end) may
+        # flip back next step; a lane that finished once STAYS finished
+        # (the reference logical_or's into a global flag)
+        done = done | np.asarray(unwrap(finished)).astype(bool)
+        if bool(np.all(done)):
             break
 
+    # stack through the DISPATCHED op so the tape records it — the
+    # TrainingHelper path trains through the stacked outputs (teacher
+    # forcing), not just reads them
+    from ..tensor.manipulation import stack as _stack
     stacked = jax.tree_util.tree_map(
-        lambda *xs: Tensor(jnp.stack([unwrap(x) for x in xs], axis=0)),
+        lambda *xs: _stack(list(xs), axis=0),
         *step_outputs, is_leaf=lambda x: isinstance(x, Tensor))
     seq_lens = getattr(states, "lengths", None)
     final_outputs, final_states = decoder.finalize(stacked, states, seq_lens)
     if not output_time_major:
+        from ..tensor.manipulation import transpose as _transpose
         final_outputs = jax.tree_util.tree_map(
-            lambda x: Tensor(jnp.swapaxes(unwrap(x), 0, 1)), final_outputs,
-            is_leaf=lambda x: isinstance(x, Tensor))
+            lambda x: _transpose(
+                x, [1, 0] + list(range(2, len(unwrap(x).shape)))),
+            final_outputs, is_leaf=lambda x: isinstance(x, Tensor))
     if return_length:
         return final_outputs, final_states, seq_lens
     return final_outputs, final_states
+
+
+# ---------------------------------------------------------------------------
+# fluid seq2seq helper family (reference fluid/layers/rnn.py:
+# DecodeHelper/TrainingHelper/GreedyEmbeddingHelper/SampleEmbeddingHelper/
+# BasicDecoder) — the sampling strategies era code plugs into
+# dynamic_decode; each helper is a plain callable bundle, no program
+# regions.
+
+
+class DecodeHelper:
+    """initialize() -> (initial_inputs, initial_finished);
+    sample(time, outputs, states) -> sample_ids;
+    next_inputs(time, outputs, states, sample_ids) ->
+        (finished, next_inputs, next_states)."""
+
+    def initialize(self):
+        raise NotImplementedError
+
+    def sample(self, time, outputs, states):
+        raise NotImplementedError
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        raise NotImplementedError
+
+
+class TrainingHelper(DecodeHelper):
+    """Feed ground-truth inputs step by step (teacher forcing); sample is
+    argmax over the cell outputs."""
+
+    def __init__(self, inputs, sequence_length=None, time_major=False):
+        from ..core.tensor import unwrap as _u
+        x = _u(inputs)
+        self._inputs = x if time_major else jnp.swapaxes(x, 0, 1)  # (T,B,.)
+        self._seq_len = (_u(sequence_length)
+                         if sequence_length is not None else None)
+
+    def initialize(self):
+        t0 = self._inputs[0]
+        b = t0.shape[0]
+        finished = (jnp.zeros((b,), bool) if self._seq_len is None
+                    else self._seq_len < 1)
+        return Tensor(t0), Tensor(finished)
+
+    def sample(self, time, outputs, states):
+        return Tensor(jnp.argmax(unwrap(outputs), axis=-1)
+                      .astype(jnp.int32))
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        tt = unwrap(time) + 1
+        nmax = self._inputs.shape[0]
+        idx = jnp.clip(tt, 0, nmax - 1)
+        nxt = self._inputs[idx]
+        if self._seq_len is None:
+            finished = jnp.broadcast_to(tt >= nmax, (nxt.shape[0],))
+        else:
+            finished = tt >= self._seq_len
+        return Tensor(finished), Tensor(nxt), states
+
+
+class GreedyEmbeddingHelper(DecodeHelper):
+    """Feed embedding(argmax) each step (greedy inference)."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token):
+        self._embed = embedding_fn
+        self._start = unwrap(start_tokens).astype(jnp.int32)
+        self._end = int(end_token)
+
+    def initialize(self):
+        b = self._start.shape[0]
+        return (self._embed(Tensor(self._start)),
+                Tensor(jnp.zeros((b,), bool)))
+
+    def sample(self, time, outputs, states):
+        return Tensor(jnp.argmax(unwrap(outputs), axis=-1)
+                      .astype(jnp.int32))
+
+    def next_inputs(self, time, outputs, states, sample_ids):
+        ids = unwrap(sample_ids)
+        return (Tensor(ids == self._end), self._embed(sample_ids), states)
+
+
+class SampleEmbeddingHelper(GreedyEmbeddingHelper):
+    """Feed embedding(multinomial sample) each step."""
+
+    def __init__(self, embedding_fn, start_tokens, end_token,
+                 softmax_temperature=None, seed=None):
+        super().__init__(embedding_fn, start_tokens, end_token)
+        self._temp = softmax_temperature
+        self._seed = seed or 0
+
+    def sample(self, time, outputs, states):
+        logits = unwrap(outputs).astype(jnp.float32)
+        if self._temp is not None:
+            logits = logits / self._temp
+        key = jax.random.fold_in(jax.random.PRNGKey(self._seed),
+                                 unwrap(time))
+        return Tensor(jax.random.categorical(key, logits, axis=-1)
+                      .astype(jnp.int32))
+
+
+class BasicDecoder(Decoder):
+    """cell + helper + optional output layer, driven by dynamic_decode
+    (reference BasicDecoder).  step outputs are
+    (cell_outputs, sample_ids) namedtuples."""
+
+    Output = collections.namedtuple("BasicDecoderOutput",
+                                    ("cell_outputs", "sample_ids"))
+
+    def __init__(self, cell, helper, initial_states=None, output_fn=None):
+        self._cell = cell
+        self._helper = helper
+        self._inits = initial_states
+        self._output_fn = output_fn
+
+    def initialize(self, inits=None):
+        first_inputs, finished = self._helper.initialize()
+        return first_inputs, (inits if inits is not None
+                              else self._inits), finished
+
+    def step(self, time, inputs, states, **kwargs):
+        cell_out, next_states = self._cell(inputs, states)
+        if self._output_fn is not None:
+            cell_out = self._output_fn(cell_out)
+        sample_ids = self._helper.sample(time, cell_out, next_states)
+        finished, next_inputs, next_states = self._helper.next_inputs(
+            time, cell_out, next_states, sample_ids)
+        return (self.Output(cell_out, sample_ids), next_states,
+                next_inputs, finished)
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, is_accumulated=True, return_parent_idx=False,
+                name=None):
+    """One beam-search expansion step (reference fluid/layers/rnn.py
+    beam_search over beam_search_op) on DENSE (batch*beam, V) score rows
+    (the LoD lanes become a fixed beam axis — the repo's convention).
+
+    Returns (selected_ids (B*K, 1), selected_scores (B*K, 1)
+    [, parent_idx (B*K,)]): the top-K (token, beam) pairs per batch
+    element; finished beams (pre_ids == end_id) only propagate end_id."""
+    pid = unwrap(pre_ids).reshape(-1)
+    psc = unwrap(pre_scores).reshape(-1).astype(jnp.float32)
+    sc = unwrap(scores).astype(jnp.float32)
+    bk, v = sc.shape
+    k = beam_size
+    b = bk // k
+    total = sc if is_accumulated else psc[:, None] + jnp.log(
+        jnp.maximum(sc, 1e-20))
+    finished = pid == end_id
+    neg = jnp.full_like(total, -1e9)
+    only_end = neg.at[:, end_id].set(psc)
+    total = jnp.where(finished[:, None], only_end, total)
+    flat = total.reshape(b, k * v)
+    top_s, top_i = jax.lax.top_k(flat, k)                  # (B, K)
+    beam = top_i // v
+    token = top_i % v
+    parent = (beam + jnp.arange(b)[:, None] * k).reshape(-1)
+    out_ids = token.reshape(-1, 1).astype(jnp.int64)
+    out_sc = top_s.reshape(-1, 1)
+    res = (Tensor(out_ids, stop_gradient=True),
+           Tensor(out_sc, stop_gradient=True))
+    if return_parent_idx:
+        res += (Tensor(parent.astype(jnp.int64), stop_gradient=True),)
+    return res
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, parents=None,
+                       name=None):
+    """Backtrack a finished beam search (reference beam_search_decode_op):
+    `ids`/`scores` are per-step stacked (T, B*K) selections with parent
+    pointers resolved via gather_tree.  Accepts LoDTensorArray-style
+    lists of ((B*K, 1) ids, parent_idx) tuples, or stacked arrays with an
+    explicit `parents` (T, B*K) array — beam reordering cannot be
+    reconstructed without the parent pointers, so omitting them errors."""
+    if isinstance(ids, (list, tuple)):
+        id_steps = jnp.stack([unwrap(x).reshape(-1) for x, _ in ids])
+        parents = jnp.stack([unwrap(p).reshape(-1) for _, p in ids])
+        sc_steps = jnp.stack([unwrap(s).reshape(-1) for s in scores])
+    else:
+        if parents is None:
+            from ..core.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                "beam_search_decode: stacked-array input needs `parents` "
+                "(the per-step parent_idx from beam_search) — without it "
+                "the backtrack would silently assume no beam reordering")
+        id_steps = unwrap(ids)
+        parents = unwrap(parents)
+        sc_steps = unwrap(scores)
+    t, bk = id_steps.shape
+    k = beam_size
+    b = bk // k
+    full = gather_tree(Tensor(id_steps.reshape(t, b, k)),
+                       Tensor(parents.reshape(t, b, k).astype(jnp.int32)))
+    return full, Tensor(sc_steps.reshape(t, b, k), stop_gradient=True)

@@ -35,7 +35,7 @@ __all__ = [
     "multiclass_nms_padded", "bipartite_match", "target_assign",
     "collect_fpn_proposals", "density_prior_box", "ssd_loss",
     "detection_output", "psroi_pool", "prroi_pool",
-    "deformable_roi_pooling",
+    "deformable_roi_pooling", "matrix_nms",
 ]
 
 
@@ -1635,3 +1635,88 @@ def deformable_roi_pooling(input, rois, trans, no_trans=False,  # noqa: A002
                     trans if tv is not None else Tensor(
                         jnp.zeros((rv.shape[0], 2, part_h, part_w),
                                   xv.dtype)))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference: fluid/layers/detection.py:3544 over
+    detection/matrix_nms_op.cc — SOLOv2, arXiv:2003.10152): instead of the
+    sequential greedy loop, every candidate's score decays by the worst
+    pairwise decay against all HIGHER-scored candidates of its class:
+      gaussian: exp((iou_max_j^2 - iou_ij^2) * sigma)
+      linear:   (1 - iou_ij) / (1 - iou_max_j)
+    This is embarrassingly parallel — the ideal TPU suppression op; the
+    whole computation is one jittable dense expression per image.
+
+    bboxes (N, M, 4), scores (N, C, M).  Returns (out (N, keep_top_k, 6)
+    rows [label, score, x1, y1, x2, y2] padded with -1, rois_num (N,)
+    [, index (N, keep_top_k) flat class*M+box indices])."""
+    bv = unwrap(bboxes)
+    sv = unwrap(scores)
+    n, c, m = sv.shape
+    topn = min(nms_top_k, m) if nms_top_k and nms_top_k > 0 else m
+    norm = 0.0 if normalized else 1.0
+
+    def one_image(bx, sc):
+        iou = _iou_matrix(bx, bx, norm)                   # (M, M)
+
+        def one_class(srow):
+            valid = srow >= score_threshold
+            key = jnp.where(valid, srow, -jnp.inf)
+            order = jnp.argsort(-key)[:topn]              # (k,)
+            s_sorted = key[order]
+            ok = jnp.isfinite(s_sorted)
+            iou_s = iou[order][:, order]                  # (k, k)
+            k = iou_s.shape[0]
+            upper = jnp.arange(k)[:, None] < jnp.arange(k)[None, :]
+            iou_u = jnp.where(upper, iou_s, 0.0)          # j<i at [j, i]
+            iou_max = jnp.max(iou_u, axis=0)              # per i over j<i
+            iou_max_j = jnp.max(jnp.where(
+                jnp.arange(k)[:, None] > jnp.arange(k)[None, :],
+                iou_s, 0.0), axis=1)                      # per row j
+            if use_gaussian:
+                decay = jnp.exp((iou_max_j[:, None] ** 2 - iou_u ** 2)
+                                * gaussian_sigma)
+            else:
+                decay = (1.0 - iou_u) / jnp.maximum(
+                    1.0 - iou_max_j[:, None], 1e-10)
+            decay = jnp.where(upper, decay, 1.0)
+            dec = jnp.min(decay, axis=0)
+            ds = jnp.where(ok, s_sorted * dec, -jnp.inf)
+            if post_threshold > 0.0:
+                ds = jnp.where(ds >= post_threshold, ds, -jnp.inf)
+            return ds, order
+
+        ds, order = jax.vmap(one_class)(sc)               # (C, k)
+        if 0 <= background_label < c:
+            ds = ds.at[background_label].set(-jnp.inf)
+        flat = ds.reshape(-1)
+        kk = min(keep_top_k, flat.shape[0])
+        top_s, top_i = jax.lax.top_k(flat, kk)
+        cls = (top_i // ds.shape[1]).astype(jnp.float32)
+        box_i = jnp.take_along_axis(
+            order.reshape(-1), top_i, axis=0)
+        valid = jnp.isfinite(top_s)
+        rows = jnp.concatenate(
+            [cls[:, None], jnp.where(valid, top_s, -1.0)[:, None],
+             bx[box_i]], axis=1)
+        rows = jnp.where(valid[:, None], rows, -1.0)
+        if kk < keep_top_k:
+            rows = jnp.concatenate(
+                [rows, jnp.full((keep_top_k - kk, 6), -1.0)], axis=0)
+        flat_idx = jnp.where(valid,
+                             (top_i // ds.shape[1]) * m + box_i, -1)
+        if kk < keep_top_k:
+            flat_idx = jnp.concatenate(
+                [flat_idx, jnp.full((keep_top_k - kk,), -1, jnp.int32)])
+        return rows, jnp.sum(valid.astype(jnp.int32)), flat_idx
+
+    rows, counts, idxs = jax.vmap(one_image)(bv, sv)
+    out = (Tensor(rows, stop_gradient=True),)
+    if return_rois_num:
+        out += (Tensor(counts, stop_gradient=True),)
+    if return_index:
+        out += (Tensor(idxs.astype(jnp.int32), stop_gradient=True),)
+    return out if len(out) > 1 else out[0]

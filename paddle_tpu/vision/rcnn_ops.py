@@ -1,0 +1,646 @@
+"""R-CNN / RetinaNet / EAST detection stragglers.
+
+Reference: python/paddle/fluid/layers/detection.py — rpn_target_assign
+(:311), retinanet_target_assign (:70), generate_proposal_labels (:2594),
+generate_mask_labels (:2746), retinanet_detection_output (:3104),
+locality_aware_nms (:3414), box_decoder_and_assign (:3795),
+roi_perspective_transform (:2502), polygon_box_transform
+(detection/polygon_box_transform_op.cc:15) over the
+rpn_target_assign/generate_proposal_labels/mask_util kernels.
+
+TPU-native split, following the repo's assigner convention
+(vision/ops.py bipartite_match): TRAINING-DATA PREP (sampling, matching,
+mask rasterization) runs host-side in numpy — it is per-epoch data work
+the reference also runs on CPU — while everything that must carry
+gradients (gathers of predictions, bilinear warps of features) runs as
+dispatched device ops so the tape reaches the network outputs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.op import dispatch
+from ..core.tensor import Tensor, unwrap
+
+__all__ = [
+    "rpn_target_assign", "retinanet_target_assign",
+    "generate_proposal_labels", "generate_mask_labels",
+    "retinanet_detection_output", "locality_aware_nms",
+    "box_decoder_and_assign", "roi_perspective_transform",
+    "polygon_box_transform",
+]
+
+
+def _np(x):
+    return np.asarray(jax.device_get(unwrap(x)))
+
+
+def _iou_np(a, b):
+    """(A, 4) x (B, 4) -> (A, B) IoU, numpy."""
+    area_a = np.clip(a[:, 2] - a[:, 0], 0, None) * \
+        np.clip(a[:, 3] - a[:, 1], 0, None)
+    area_b = np.clip(b[:, 2] - b[:, 0], 0, None) * \
+        np.clip(b[:, 3] - b[:, 1], 0, None)
+    x1 = np.maximum(a[:, None, 0], b[None, :, 0])
+    y1 = np.maximum(a[:, None, 1], b[None, :, 1])
+    x2 = np.minimum(a[:, None, 2], b[None, :, 2])
+    y2 = np.minimum(a[:, None, 3], b[None, :, 3])
+    inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter,
+                              1e-10)
+
+
+def _encode_np(anchors, gts, weights=(1.0, 1.0, 1.0, 1.0)):
+    """Center-size delta encode, numpy (box_coder encode semantics)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = anchors[:, 0] + aw / 2
+    acy = anchors[:, 1] + ah / 2
+    gw = gts[:, 2] - gts[:, 0]
+    gh = gts[:, 3] - gts[:, 1]
+    gcx = gts[:, 0] + gw / 2
+    gcy = gts[:, 1] + gh / 2
+    eps = 1e-10
+    d = np.stack([(gcx - acx) / np.maximum(aw, eps) / weights[0],
+                  (gcy - acy) / np.maximum(ah, eps) / weights[1],
+                  np.log(np.maximum(gw, eps) / np.maximum(aw, eps))
+                  / weights[2],
+                  np.log(np.maximum(gh, eps) / np.maximum(ah, eps))
+                  / weights[3]], axis=1)
+    return d.astype(np.float32)
+
+
+def _match_anchors(iou, positive_overlap, negative_overlap):
+    """Anchor labels: 1 fg (iou>=pos or per-gt argmax), 0 bg (max<neg),
+    -1 ignore.  Returns (labels, matched_gt_idx, max_iou)."""
+    n_anchor = iou.shape[0]
+    labels = np.full((n_anchor,), -1, np.int32)
+    if iou.shape[1] == 0:
+        return labels, np.zeros((n_anchor,), np.int64), \
+            np.zeros((n_anchor,), np.float32)
+    max_iou = iou.max(axis=1)
+    argmax_gt = iou.argmax(axis=1)
+    labels[max_iou < negative_overlap] = 0
+    labels[max_iou >= positive_overlap] = 1
+    # per-gt best anchor is always positive (ties included)
+    best_per_gt = iou.max(axis=0)
+    for g in range(iou.shape[1]):
+        if best_per_gt[g] > 0:
+            labels[iou[:, g] >= best_per_gt[g] - 1e-9] = 1
+    return labels, argmax_gt, max_iou.astype(np.float32)
+
+
+def _gather_rows(pred, flat_idx, n_per_im):
+    """Device gather of (N, A, K) predictions at flat (im*A + a) indices —
+    dispatched so grads flow back into the network outputs."""
+    idx = jnp.asarray(flat_idx, jnp.int32)
+
+    def raw(p):
+        return p.reshape((-1,) + p.shape[2:])[idx]
+
+    return dispatch("target_assign_gather", raw, pred)
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True,
+                      gt_count=None, seed=0):
+    """Faster-RCNN RPN sampler (reference detection.py:311 over
+    rpn_target_assign_op).  bbox_pred (N, A, 4), cls_logits (N, A, 1),
+    anchors (A, 4); gt_boxes (N, G, 4) padded dense + gt_count, or a
+    per-image list (LoD analogue).  Returns (pred_scores, pred_loc,
+    target_label, target_bbox, bbox_inside_weight) with the prediction
+    gathers on-device (grads flow); targets are stop-gradient."""
+    anchors = _np(anchor_box).reshape(-1, 4)
+    av = _np(anchor_var).reshape(-1, 4) if anchor_var is not None else None
+    gts, counts = _pad_boxes(gt_boxes, gt_count)
+    n = gts.shape[0]
+    im_infos = _np(im_info) if im_info is not None else None
+    crowd = _np(is_crowd) if is_crowd is not None else None
+    rng = np.random.RandomState(seed)
+
+    idx_all, lab_all, tgt_all = [], [], []
+    for i in range(n):
+        a_mask = np.ones(len(anchors), bool)
+        if rpn_straddle_thresh >= 0 and im_infos is not None:
+            h, w = float(im_infos[i][0]), float(im_infos[i][1])
+            t = rpn_straddle_thresh
+            a_mask = ((anchors[:, 0] >= -t) & (anchors[:, 1] >= -t)
+                      & (anchors[:, 2] < w + t) & (anchors[:, 3] < h + t))
+        gt_i = gts[i, :counts[i]]
+        if crowd is not None:
+            keep = crowd[i, :counts[i]].reshape(-1) == 0
+            gt_i = gt_i[keep]
+        iou = _iou_np(anchors[a_mask], gt_i)
+        labels, argmax_gt, _ = _match_anchors(
+            iou, rpn_positive_overlap, rpn_negative_overlap)
+        fg_idx = np.nonzero(labels == 1)[0]
+        bg_idx = np.nonzero(labels == 0)[0]
+        n_fg = int(rpn_batch_size_per_im * rpn_fg_fraction)
+        if len(fg_idx) > n_fg:
+            fg_idx = (rng.permutation(fg_idx)[:n_fg] if use_random
+                      else fg_idx[:n_fg])
+        n_bg = rpn_batch_size_per_im - len(fg_idx)
+        if len(bg_idx) > n_bg:
+            bg_idx = (rng.permutation(bg_idx)[:n_bg] if use_random
+                      else bg_idx[:n_bg])
+        inside = np.nonzero(a_mask)[0]
+        fg_a = inside[fg_idx]
+        bg_a = inside[bg_idx]
+        sel = np.concatenate([fg_a, bg_a])
+        lab = np.concatenate([np.ones(len(fg_a), np.int32),
+                              np.zeros(len(bg_a), np.int32)])
+        tgt = _encode_np(anchors[fg_a], gt_i[argmax_gt[fg_idx]])
+        if av is not None and len(fg_a):
+            tgt = tgt / av[fg_a]
+        idx_all.append(sel + i * len(anchors))
+        lab_all.append(lab)
+        tgt_all.append(tgt)
+
+    flat = np.concatenate(idx_all) if idx_all else np.zeros(0, np.int64)
+    labels = np.concatenate(lab_all).astype(np.int32)
+    n_fg_total = int((labels == 1).sum())
+    fg_flat = np.concatenate(
+        [ix[:int((lb == 1).sum())] for ix, lb in zip(idx_all, lab_all)]) \
+        if idx_all else np.zeros(0, np.int64)
+    score_pred = _gather_rows(cls_logits, flat, None)
+    loc_pred = _gather_rows(bbox_pred, fg_flat, None)
+    target_bbox = np.concatenate(tgt_all) if tgt_all else \
+        np.zeros((0, 4), np.float32)
+    return (score_pred, loc_pred,
+            Tensor(jnp.asarray(labels.reshape(-1, 1)), stop_gradient=True),
+            Tensor(jnp.asarray(target_bbox), stop_gradient=True),
+            Tensor(jnp.ones((max(n_fg_total, 0), 4), jnp.float32),
+                   stop_gradient=True))
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4,
+                            gt_count=None):
+    """RetinaNet assigner (reference detection.py:70): every fg/bg anchor
+    is kept (focal loss replaces sampling).  Returns (pred_scores,
+    pred_loc, target_label, target_bbox, bbox_inside_weight, fg_num)."""
+    anchors = _np(anchor_box).reshape(-1, 4)
+    av = _np(anchor_var).reshape(-1, 4) if anchor_var is not None else None
+    gts, counts = _pad_boxes(gt_boxes, gt_count)
+    glab = _np(gt_labels)
+    if glab.ndim == 3:
+        glab = glab[..., 0]
+    crowd = _np(is_crowd) if is_crowd is not None else None
+    n = gts.shape[0]
+    idx_all, lab_all, tgt_all, fg_counts = [], [], [], []
+    for i in range(n):
+        gt_i = gts[i, :counts[i]]
+        glab_i = glab[i][:counts[i]]
+        if crowd is not None:
+            keep = crowd[i, :counts[i]].reshape(-1) == 0
+            gt_i = gt_i[keep]
+            glab_i = glab_i[keep]
+        iou = _iou_np(anchors, gt_i)
+        labels, argmax_gt, _ = _match_anchors(
+            iou, positive_overlap, negative_overlap)
+        fg_a = np.nonzero(labels == 1)[0]
+        bg_a = np.nonzero(labels == 0)[0]
+        sel = np.concatenate([fg_a, bg_a])
+        lab = np.concatenate([glab_i[argmax_gt[fg_a]].astype(np.int32),
+                              np.zeros(len(bg_a), np.int32)])
+        tgt = _encode_np(anchors[fg_a], gt_i[argmax_gt[fg_a]])
+        if av is not None and len(fg_a):
+            tgt = tgt / av[fg_a]
+        idx_all.append(sel + i * len(anchors))
+        lab_all.append(lab)
+        tgt_all.append(tgt)
+        fg_counts.append(len(fg_a))
+    flat = np.concatenate(idx_all)
+    fg_flat = np.concatenate(
+        [ix[:c] for ix, c in zip(idx_all, fg_counts)])
+    score_pred = _gather_rows(cls_logits, flat, None)
+    loc_pred = _gather_rows(bbox_pred, fg_flat, None)
+    labels = np.concatenate(lab_all).astype(np.int32)
+    target_bbox = np.concatenate(tgt_all)
+    fg_num = np.asarray([[max(sum(fg_counts), 1)]], np.int32)
+    return (score_pred, loc_pred,
+            Tensor(jnp.asarray(labels.reshape(-1, 1)), stop_gradient=True),
+            Tensor(jnp.asarray(target_bbox.astype(np.float32)),
+                   stop_gradient=True),
+            Tensor(jnp.ones((int(sum(fg_counts)), 4), jnp.float32),
+                   stop_gradient=True),
+            Tensor(jnp.asarray(fg_num), stop_gradient=True))
+
+
+def _pad_boxes(gt_boxes, gt_count):
+    if isinstance(gt_boxes, (list, tuple)):
+        boxes = [_np(b).reshape(-1, 4) for b in gt_boxes]
+        m = max(1, max(len(b) for b in boxes))
+        out = np.zeros((len(boxes), m, 4), np.float32)
+        cnt = np.zeros(len(boxes), np.int64)
+        for i, b in enumerate(boxes):
+            out[i, :len(b)] = b
+            cnt[i] = len(b)
+        return out, cnt
+    gv = _np(gt_boxes).astype(np.float32)
+    if gv.ndim == 2:
+        gv = gv[None]
+    cnt = (_np(gt_count).astype(np.int64).reshape(-1)
+           if gt_count is not None
+           else np.full(gv.shape[0], gv.shape[1], np.int64))
+    return gv, cnt
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info=None, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.25,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False,
+                             rois_num=None, gt_count=None, seed=0,
+                             **_ignored):
+    """Faster-RCNN second-stage sampler (reference detection.py:2594 over
+    generate_proposal_labels_op): sample fg/bg rois against gt, emit
+    class labels + per-class encoded bbox targets with inside/outside
+    weights.  Host-side data prep; all outputs stop-gradient.
+
+    rpn_rois: (R, 4) with rois_num (N,), or a per-image list.
+    Returns (rois, labels_int32, bbox_targets, bbox_inside_weights,
+    bbox_outside_weights, rois_num_out)."""
+    cls_n = int(class_nums or 81)
+    gts, counts = _pad_boxes(gt_boxes, gt_count)
+    gcls = _np(gt_classes)
+    if gcls.ndim == 3:
+        gcls = gcls[..., 0]
+    if isinstance(rpn_rois, (list, tuple)):
+        roi_list = [_np(r).reshape(-1, 4) for r in rpn_rois]
+    else:
+        rv = _np(rpn_rois).reshape(-1, 4)
+        if rois_num is not None:
+            rn = _np(rois_num).astype(np.int64).reshape(-1)
+            ofs = np.concatenate([[0], np.cumsum(rn)])
+            roi_list = [rv[ofs[i]:ofs[i + 1]] for i in range(len(rn))]
+        else:
+            roi_list = [rv]
+    rng = np.random.RandomState(seed)
+
+    out_rois, out_lab, out_tgt, out_in, out_out, out_n = \
+        [], [], [], [], [], []
+    for i, rois in enumerate(roi_list):
+        gt_i = gts[i, :counts[i]]
+        crowd = (_np(is_crowd)[i, :counts[i]].reshape(-1)
+                 if is_crowd is not None else np.zeros(counts[i]))
+        gt_ok = gt_i[crowd == 0]
+        cls_ok = gcls[i][:counts[i]][crowd == 0]
+        if not is_cascade_rcnn:
+            rois = np.concatenate([rois, gt_ok]) if len(gt_ok) else rois
+        iou = _iou_np(rois, gt_ok) if len(gt_ok) else \
+            np.zeros((len(rois), 0))
+        max_iou = iou.max(axis=1) if iou.shape[1] else \
+            np.zeros(len(rois))
+        arg_gt = iou.argmax(axis=1) if iou.shape[1] else \
+            np.zeros(len(rois), np.int64)
+        fg = np.nonzero(max_iou >= fg_thresh)[0]
+        bg = np.nonzero((max_iou < bg_thresh_hi)
+                        & (max_iou >= bg_thresh_lo))[0]
+        n_fg = min(int(batch_size_per_im * fg_fraction), len(fg))
+        if len(fg) > n_fg:
+            fg = rng.permutation(fg)[:n_fg] if use_random else fg[:n_fg]
+        n_bg = min(batch_size_per_im - len(fg), len(bg))
+        if len(bg) > n_bg:
+            bg = rng.permutation(bg)[:n_bg] if use_random else bg[:n_bg]
+        sel = np.concatenate([fg, bg]).astype(np.int64)
+        labels = np.zeros(len(sel), np.int32)
+        labels[:len(fg)] = cls_ok[arg_gt[fg]].astype(np.int32) \
+            if len(fg) else labels[:0]
+        tgt = np.zeros((len(sel), 4 * (1 if is_cls_agnostic else cls_n)),
+                       np.float32)
+        w_in = np.zeros_like(tgt)
+        if len(fg):
+            enc = _encode_np(rois[fg], gt_ok[arg_gt[fg]],
+                             weights=bbox_reg_weights)
+            for j, lab in enumerate(labels[:len(fg)]):
+                c = 1 if is_cls_agnostic else int(lab)
+                tgt[j, 4 * c:4 * c + 4] = enc[j]
+                w_in[j, 4 * c:4 * c + 4] = 1.0
+        out_rois.append(rois[sel])
+        out_lab.append(labels)
+        out_tgt.append(tgt)
+        out_in.append(w_in)
+        out_out.append((w_in > 0).astype(np.float32))
+        out_n.append(len(sel))
+
+    def T(x, dtype=np.float32):  # noqa: N802
+        return Tensor(jnp.asarray(np.concatenate(x).astype(dtype)),
+                      stop_gradient=True)
+
+    return (T(out_rois), T(out_lab, np.int32), T(out_tgt), T(out_in),
+            T(out_out),
+            Tensor(jnp.asarray(np.asarray(out_n, np.int32)),
+                   stop_gradient=True))
+
+
+def _rasterize_polygons(polys, x0, y0, x1, y1, resolution):
+    """Even-odd rasterization of polygons onto a resolution^2 grid over
+    the box [x0, x1] x [y0, y1] (the mask_util.cc polys_to_mask role)."""
+    m = np.zeros((resolution, resolution), np.int32)
+    xs = x0 + (np.arange(resolution) + 0.5) * max(x1 - x0, 1e-6) \
+        / resolution
+    ys = y0 + (np.arange(resolution) + 0.5) * max(y1 - y0, 1e-6) \
+        / resolution
+    gx, gy = np.meshgrid(xs, ys)
+    for poly in polys:
+        p = np.asarray(poly, np.float64).reshape(-1, 2)
+        inside = np.zeros_like(gx, bool)
+        j = len(p) - 1
+        for k in range(len(p)):
+            xi, yi = p[k]
+            xj, yj = p[j]
+            cond = ((yi > gy) != (yj > gy)) & (
+                gx < (xj - xi) * (gy - yi) / (yj - yi + 1e-12) + xi)
+            inside ^= cond
+            j = k
+        m |= inside.astype(np.int32)
+    return m
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution,
+                         rois_num=None, gt_count=None):
+    """Mask-RCNN mask targets (reference detection.py:2746 over
+    mask_util.cc): for each fg roi, rasterize its matched instance's
+    polygons cropped to the roi at resolution^2.
+
+    gt_segms: per-image list of per-instance lists of polygons (the
+    3-level-LoD analogue).  Returns (mask_rois, roi_has_mask_int32,
+    mask_int32) with mask rows flattened to num_classes*res^2 like the
+    reference (one-hot over the fg class)."""
+    if isinstance(rois, (list, tuple)):
+        roi_list = [_np(r).reshape(-1, 4) for r in rois]
+    else:
+        rv = _np(rois).reshape(-1, 4)
+        rn = _np(rois_num).astype(np.int64).reshape(-1) \
+            if rois_num is not None else np.asarray([len(rv)])
+        ofs = np.concatenate([[0], np.cumsum(rn)])
+        roi_list = [rv[ofs[i]:ofs[i + 1]] for i in range(len(rn))]
+    lab = _np(labels_int32).reshape(-1)
+    gts_boxes = None
+    out_rois, out_has, out_masks, pos = [], [], [], 0
+    for i, rois_i in enumerate(roi_list):
+        segms_i = gt_segms[i]
+        # match each fg roi to the gt instance with max IoU of its bbox
+        gt_bboxes = []
+        for inst in segms_i:
+            allp = np.concatenate([np.asarray(p, np.float64).reshape(-1, 2)
+                                   for p in inst]) if inst else \
+                np.zeros((1, 2))
+            gt_bboxes.append([allp[:, 0].min(), allp[:, 1].min(),
+                              allp[:, 0].max(), allp[:, 1].max()])
+        gt_bboxes = np.asarray(gt_bboxes, np.float32).reshape(-1, 4)
+        for r in rois_i:
+            li = int(lab[pos]); pos += 1
+            if li <= 0 or len(segms_i) == 0:
+                continue
+            iou = _iou_np(r[None], gt_bboxes)[0]
+            inst = segms_i[int(iou.argmax())]
+            m = _rasterize_polygons(inst, r[0], r[1], r[2], r[3],
+                                    resolution)
+            flat = np.full((num_classes, resolution, resolution), -1,
+                           np.int32)
+            flat[li] = m
+            out_rois.append(r)
+            out_has.append(1)
+            out_masks.append(flat.reshape(-1))
+    if not out_rois:
+        return (Tensor(jnp.zeros((0, 4), jnp.float32), stop_gradient=True),
+                Tensor(jnp.zeros((0,), jnp.int32), stop_gradient=True),
+                Tensor(jnp.zeros((0, num_classes * resolution ** 2),
+                                 jnp.int32), stop_gradient=True))
+    return (Tensor(jnp.asarray(np.stack(out_rois)), stop_gradient=True),
+            Tensor(jnp.asarray(np.asarray(out_has, np.int32)),
+                   stop_gradient=True),
+            Tensor(jnp.asarray(np.stack(out_masks)), stop_gradient=True))
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info=None,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """RetinaNet serving head (reference detection.py:3104): per FPN level
+    decode the top candidates against that level's anchors, concat levels,
+    then per-image multiclass NMS.  Returns ((B, keep_top_k, 6) padded
+    rows [label, score, x1..y2], valid counts) — the repo's fixed-extent
+    NMS contract."""
+    from .ops import multiclass_nms_padded
+    n = unwrap(bboxes[0]).shape[0]
+    outs, counts = [], []
+    for i in range(n):
+        decoded_all, score_all = [], []
+        for lvl in range(len(bboxes)):
+            deltas = _np(bboxes[lvl])[i]                  # (A, 4)
+            sc = _np(scores[lvl])[i]                      # (A, C)
+            anc = _np(anchors[lvl]).reshape(-1, 4)
+            best = sc.max(axis=1)
+            keep = np.nonzero(best >= score_threshold)[0]
+            keep = keep[np.argsort(-best[keep])][:nms_top_k]
+            aw = anc[keep, 2] - anc[keep, 0]
+            ah = anc[keep, 3] - anc[keep, 1]
+            acx = anc[keep, 0] + aw / 2
+            acy = anc[keep, 1] + ah / 2
+            d = deltas[keep]
+            cx = d[:, 0] * aw + acx
+            cy = d[:, 1] * ah + acy
+            w = np.exp(d[:, 2]) * aw
+            h = np.exp(d[:, 3]) * ah
+            dec = np.stack([cx - w / 2, cy - h / 2, cx + w / 2,
+                            cy + h / 2], axis=1)
+            decoded_all.append(dec)
+            score_all.append(sc[keep])
+        dec = np.concatenate(decoded_all).astype(np.float32)
+        sc = np.concatenate(score_all).astype(np.float32)
+        rows, cnt = multiclass_nms_padded(
+            Tensor(jnp.asarray(dec)), Tensor(jnp.asarray(sc.T)),
+            score_threshold, nms_top_k, keep_top_k,
+            nms_threshold=nms_threshold, background_label=-1)
+        outs.append(unwrap(rows))
+        counts.append(unwrap(cnt))
+    return (Tensor(jnp.stack(outs), stop_gradient=True),
+            Tensor(jnp.stack(counts), stop_gradient=True))
+
+
+def locality_aware_nms(bboxes, scores, score_threshold, nms_top_k,
+                       keep_top_k, nms_threshold=0.3, normalized=True,
+                       nms_eta=1.0, background_label=-1, name=None):
+    """EAST locality-aware NMS (reference detection.py:3414): first merge
+    CONSECUTIVE boxes whose IoU exceeds the threshold by score-weighted
+    averaging, then standard multiclass NMS.  Host-side serving op."""
+    from .ops import multiclass_nms
+    bv = _np(bboxes)
+    sv = _np(scores)
+    if bv.ndim == 3:
+        bv, sv = bv[0], sv[0]
+    c = sv.shape[0]
+    best_cls = sv.argmax(axis=0)
+    best_score = sv.max(axis=0)
+    # merge pass over the box list order (EAST's row-major geometry):
+    # consecutive boxes above the IoU threshold fuse by score-weighted
+    # average, accumulating score (the LANMS trick)
+    out_b, out_s, out_c = [], [], []
+    cur_box, cur_score, cur_cls = None, 0.0, 0
+    for j in range(len(bv)):
+        b, s = bv[j], float(best_score[j])
+        if cur_box is not None and _iou_np(
+                b[None], cur_box[None])[0, 0] > nms_threshold:
+            w = cur_score + s
+            cur_box = (cur_box * cur_score + b * s) / max(w, 1e-10)
+            cur_score = w
+        else:
+            if cur_box is not None:
+                out_b.append(cur_box)
+                out_s.append(cur_score)
+                out_c.append(cur_cls)
+            cur_box, cur_score, cur_cls = b.copy(), s, int(best_cls[j])
+    if cur_box is not None:
+        out_b.append(cur_box)
+        out_s.append(cur_score)
+        out_c.append(cur_cls)
+    mb = np.asarray(out_b, np.float32).reshape(-1, 4)
+    ms = np.clip(np.asarray(out_s, np.float32), 0, 1.0)
+    full_scores = np.zeros((c, len(mb)), np.float32)
+    for j in range(len(mb)):
+        full_scores[out_c[j], j] = ms[j]
+    return multiclass_nms(Tensor(jnp.asarray(mb)),
+                          Tensor(jnp.asarray(full_scores)),
+                          score_threshold, nms_top_k, keep_top_k,
+                          nms_threshold=nms_threshold,
+                          background_label=background_label)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip, name=None):
+    """Cascade-RCNN decode+assign (reference detection.py:3795 over
+    box_decoder_and_assign_op): decode per-class deltas (M, 4C) against
+    the priors, clip, then pick each row's argmax-class box.  Returns
+    (decoded (M, 4C), assigned (M, 4)); fully on-device."""
+    def raw(pb, pv, tb, sc):
+        m = pb.shape[0]
+        c = tb.shape[1] // 4
+        pw = pb[:, 2] - pb[:, 0] + 1.0
+        ph = pb[:, 3] - pb[:, 1] + 1.0
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        d = tb.reshape(m, c, 4) * pv[:, None, :]
+        cx = d[..., 0] * pw[:, None] + pcx[:, None]
+        cy = d[..., 1] * ph[:, None] + pcy[:, None]
+        w = jnp.exp(jnp.minimum(d[..., 2], box_clip)) * pw[:, None]
+        h = jnp.exp(jnp.minimum(d[..., 3], box_clip)) * ph[:, None]
+        dec = jnp.stack([cx - w / 2, cy - h / 2,
+                         cx + w / 2 - 1.0, cy + h / 2 - 1.0], axis=-1)
+        best = jnp.argmax(sc, axis=1)
+        assigned = jnp.take_along_axis(
+            dec, best[:, None, None].astype(jnp.int32).repeat(4, -1),
+            axis=1)[:, 0]
+        return dec.reshape(m, c * 4), assigned
+
+    return dispatch("box_decoder_and_assign", raw, prior_box,
+                    prior_box_var, target_box, box_score)
+
+
+def polygon_box_transform(input, name=None):  # noqa: A002
+    """EAST geometry-map transform (reference
+    polygon_box_transform_op.cc:15): even channels become 4*w - v, odd
+    channels 4*h - v (quad offsets to absolute coords)."""
+    def raw(x):
+        n, c, h, w = x.shape
+        ws = jnp.arange(w, dtype=x.dtype) * 4
+        hs = jnp.arange(h, dtype=x.dtype) * 4
+        even = ws[None, None, None, :] - x
+        odd = hs[None, None, :, None] - x
+        is_even = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+        return jnp.where(is_even, even, odd)
+
+    return dispatch("polygon_box_transform", raw, input)
+
+
+def roi_perspective_transform(input, rois, transformed_height,  # noqa: A002
+                              transformed_width, spatial_scale=1.0,
+                              rois_num=None, name=None):
+    """Perspective-warp quad rois to rectangles (reference
+    detection.py:2502 over roi_perspective_transform_op): per roi an
+    8-point quad (x1..y4) maps to a (th, tw) rectangle via its
+    homography; sampling is bilinear on the feature map.
+
+    TPU-native split: the 3x3 homographies solve host-side (rois are
+    data), the warp gather runs as one dispatched vmapped bilinear sample
+    so gradients flow into `input`.  Returns (out (R, C, th, tw),
+    mask (R, 1, th, tw), transform_matrix (R, 9))."""
+    th, tw = int(transformed_height), int(transformed_width)
+    rv = _np(rois).reshape(-1, 8) * spatial_scale
+    n_roi = rv.shape[0]
+    xv = unwrap(input)
+    _, cch, hgt, wid = xv.shape
+    if rois_num is not None:
+        rn = _np(rois_num).astype(np.int64).reshape(-1)
+        batch_ids = np.repeat(np.arange(len(rn)), rn)
+    else:
+        batch_ids = np.zeros(n_roi, np.int64)
+
+    mats = np.zeros((n_roi, 9), np.float64)
+    for r in range(n_roi):
+        quad = rv[r].reshape(4, 2)  # (x1,y1)..(x4,y4) clockwise from tl
+        dst = np.asarray([[0, 0], [tw - 1, 0], [tw - 1, th - 1],
+                          [0, th - 1]], np.float64)
+        # solve the 8-dof homography dst -> src (so sampling pulls)
+        a = []
+        b = []
+        for (dx, dy), (sx, sy) in zip(dst, quad):
+            a.append([dx, dy, 1, 0, 0, 0, -sx * dx, -sx * dy])
+            b.append(sx)
+            a.append([0, 0, 0, dx, dy, 1, -sy * dx, -sy * dy])
+            b.append(sy)
+        try:
+            sol = np.linalg.solve(np.asarray(a), np.asarray(b))
+        except np.linalg.LinAlgError:
+            sol = np.zeros(8)
+        mats[r] = np.concatenate([sol, [1.0]])
+
+    gx, gy = np.meshgrid(np.arange(tw, dtype=np.float64),
+                         np.arange(th, dtype=np.float64))
+    ones = np.ones_like(gx)
+    grid = np.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # (th*tw, 3)
+    src = np.einsum("rij,pj->rpi", mats.reshape(n_roi, 3, 3), grid)
+    denom = np.where(np.abs(src[..., 2]) < 1e-12, 1e-12, src[..., 2])
+    sx = (src[..., 0] / denom).reshape(n_roi, th, tw)
+    sy = (src[..., 1] / denom).reshape(n_roi, th, tw)
+    valid = ((sx >= 0) & (sx <= wid - 1) & (sy >= 0) & (sy <= hgt - 1))
+
+    sxj = jnp.asarray(np.clip(sx, 0, wid - 1), jnp.float32)
+    syj = jnp.asarray(np.clip(sy, 0, hgt - 1), jnp.float32)
+    bid = jnp.asarray(batch_ids, jnp.int32)
+    vj = jnp.asarray(valid)
+
+    def raw(x):
+        def one(b, fx, fy, ok):
+            x0 = jnp.floor(fx).astype(jnp.int32)
+            y0 = jnp.floor(fy).astype(jnp.int32)
+            x1 = jnp.minimum(x0 + 1, wid - 1)
+            y1 = jnp.minimum(y0 + 1, hgt - 1)
+            lx = fx - x0
+            ly = fy - y0
+            f = x[b]                                      # (C, H, W)
+            v = (f[:, y0, x0] * (1 - ly) * (1 - lx)
+                 + f[:, y0, x1] * (1 - ly) * lx
+                 + f[:, y1, x0] * ly * (1 - lx)
+                 + f[:, y1, x1] * ly * lx)
+            return jnp.where(ok[None], v, 0.0)
+
+        return jax.vmap(one)(bid, sxj, syj, vj)
+
+    out = dispatch("roi_perspective_transform", raw, input)
+    mask = Tensor(jnp.asarray(valid[:, None].astype(np.int32)),
+                  stop_gradient=True)
+    return out, mask, Tensor(jnp.asarray(mats.astype(np.float32)),
+                             stop_gradient=True)

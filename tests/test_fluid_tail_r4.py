@@ -1,0 +1,260 @@
+"""Round-4 fluid.layers long-tail: matrix_nms vs a numpy oracle, the
+RCNN/RetinaNet/EAST stragglers, seq2seq helper family, and spot oracles
+for the layers_extra ops.  Reference: fluid/layers/detection.py:3544
+(matrix_nms_op), :311 (rpn_target_assign), :2594
+(generate_proposal_labels), rnn.py helper family, nn.py/loss.py tails."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fluid import layers as fl
+from paddle_tpu.vision import ops, rcnn_ops
+
+
+def _np_matrix_nms(boxes, scores, score_thresh, topn, use_gaussian, sigma):
+    """Per-class decayed scores, numpy oracle of matrix_nms_op."""
+    out = {}
+    for c in range(scores.shape[0]):
+        s = scores[c]
+        keep = np.nonzero(s >= score_thresh)[0]
+        keep = keep[np.argsort(-s[keep], kind="stable")][:topn]
+        if len(keep) == 0:
+            out[c] = ([], [])
+            continue
+        b = boxes[keep]
+        ious = np.zeros((len(keep), len(keep)))
+        for i in range(len(keep)):
+            for j in range(len(keep)):
+                x1 = max(b[i, 0], b[j, 0]); y1 = max(b[i, 1], b[j, 1])
+                x2 = min(b[i, 2], b[j, 2]); y2 = min(b[i, 3], b[j, 3])
+                inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+                a1 = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+                a2 = (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1])
+                ious[i, j] = inter / max(a1 + a2 - inter, 1e-10)
+        ds = []
+        for i in range(len(keep)):
+            min_decay = 1.0
+            for j in range(i):
+                max_iou_j = max([ious[k, j] for k in range(j)] or [0.0])
+                iou = ious[j, i]
+                if use_gaussian:
+                    decay = np.exp((max_iou_j ** 2 - iou ** 2) * sigma)
+                else:
+                    decay = (1 - iou) / max(1 - max_iou_j, 1e-10)
+                min_decay = min(min_decay, decay)
+            ds.append(s[keep[i]] * min_decay)
+        out[c] = (keep, ds)
+    return out
+
+
+@pytest.mark.parametrize("use_gaussian", [False, True])
+def test_matrix_nms_oracle(use_gaussian):
+    rng = np.random.RandomState(0)
+    m, c = 8, 3
+    boxes = np.sort(rng.rand(m, 4).astype("float32") * 10, axis=1)[None]
+    scores = rng.rand(1, c, m).astype("float32")
+    rows, counts = ops.matrix_nms(
+        paddle.to_tensor(boxes), paddle.to_tensor(scores),
+        score_threshold=0.2, post_threshold=0.0, nms_top_k=8,
+        keep_top_k=10, use_gaussian=use_gaussian, gaussian_sigma=2.0,
+        background_label=0)
+    oracle = _np_matrix_nms(boxes[0], scores[0], 0.2, 8, use_gaussian, 2.0)
+    want = []
+    for cc in (1, 2):  # background_label 0 excluded
+        keep, ds = oracle[cc]
+        want += [(cc, d, k) for d, k in zip(ds, keep)]
+    want.sort(key=lambda t: -t[1])
+    got = rows.numpy()[0]
+    n = int(counts.numpy()[0])
+    assert n == min(len(want), 10)  # keep_top_k caps the output
+    for i, (cc, d, k) in enumerate(want[:n]):
+        assert got[i, 0] == cc
+        np.testing.assert_allclose(got[i, 1], d, rtol=1e-4)
+        np.testing.assert_allclose(got[i, 2:], boxes[0, k], rtol=1e-5)
+    assert (got[n:] == -1).all()
+
+
+def test_rpn_target_assign_samples_and_gathers():
+    rng = np.random.RandomState(0)
+    a = 16
+    anchors = np.zeros((a, 4), "float32")
+    for i in range(a):
+        x, y = (i % 4) * 8, (i // 4) * 8
+        anchors[i] = [x, y, x + 10, y + 10]
+    gt = np.array([[[0, 0, 10, 10], [17, 17, 26, 26]]], "float32")
+    bbox_pred = paddle.to_tensor(rng.randn(1, a, 4).astype("float32"),
+                                 stop_gradient=False)
+    cls_logits = paddle.to_tensor(rng.randn(1, a, 1).astype("float32"),
+                                  stop_gradient=False)
+    scores, loc, labels, tgt, w_in = rcnn_ops.rpn_target_assign(
+        bbox_pred, cls_logits, paddle.to_tensor(anchors), None,
+        paddle.to_tensor(gt), im_info=paddle.to_tensor(
+            np.array([[32.0, 32.0, 1.0]], "float32")),
+        rpn_batch_size_per_im=8, rpn_positive_overlap=0.7,
+        rpn_negative_overlap=0.3, use_random=False)
+    lab = labels.numpy().reshape(-1)
+    n_fg = int((lab == 1).sum())
+    assert n_fg >= 2  # each gt's best anchor is fg
+    assert loc.shape[0] == n_fg and tgt.shape[0] == n_fg
+    assert scores.shape[0] == len(lab)
+    # grads flow through the prediction gathers
+    (scores.sum() + loc.sum()).backward()
+    assert np.abs(cls_logits.grad.numpy()).sum() > 0
+    assert np.abs(bbox_pred.grad.numpy()).sum() > 0
+
+
+def test_generate_proposal_labels_contract():
+    rng = np.random.RandomState(1)
+    rois = np.sort(rng.rand(30, 4).astype("float32") * 30, axis=1)
+    gt = np.array([[[2, 2, 12, 12], [15, 15, 28, 28]]], "float32")
+    cls = np.array([[3, 7]], "int32")
+    out = rcnn_ops.generate_proposal_labels(
+        paddle.to_tensor(rois), paddle.to_tensor(cls), None,
+        paddle.to_tensor(gt), batch_size_per_im=16, fg_fraction=0.5,
+        fg_thresh=0.5, bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+        class_nums=10, use_random=False)
+    s_rois, labels, tgts, w_in, w_out, nums = out
+    n = int(nums.numpy()[0])
+    assert s_rois.shape[0] == n == labels.shape[0]
+    lab = labels.numpy()
+    assert set(np.unique(lab)).issubset({0, 3, 7})
+    # fg rows put their targets in the 4*label slot
+    for i in range(n):
+        if lab[i] > 0:
+            c = int(lab[i])
+            assert np.abs(w_in.numpy()[i, 4 * c:4 * c + 4] - 1).sum() == 0
+
+
+def test_polygon_box_transform_oracle():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 4, 2, 3).astype("float32")
+    out = rcnn_ops.polygon_box_transform(paddle.to_tensor(x)).numpy()
+    for cch in range(4):
+        for h in range(2):
+            for w in range(3):
+                want = (w * 4 - x[0, cch, h, w] if cch % 2 == 0
+                        else h * 4 - x[0, cch, h, w])
+                np.testing.assert_allclose(out[0, cch, h, w], want,
+                                           rtol=1e-6)
+
+
+def test_roi_perspective_transform_identity():
+    # an axis-aligned quad equal to the target rectangle = plain crop
+    rng = np.random.RandomState(3)
+    feat = rng.randn(1, 2, 8, 8).astype("float32")
+    quad = np.array([[1, 1, 4, 1, 4, 4, 1, 4]], "float32")  # tl tr br bl
+    x = paddle.to_tensor(feat, stop_gradient=False)
+    out, mask, mats = rcnn_ops.roi_perspective_transform(x, quad, 4, 4)
+    np.testing.assert_allclose(out.numpy()[0, :, 0, 0], feat[0, :, 1, 1],
+                               rtol=1e-4)
+    np.testing.assert_allclose(out.numpy()[0, :, 3, 3], feat[0, :, 4, 4],
+                               rtol=1e-4)
+    assert mask.numpy().all()
+    out.sum().backward()
+    assert np.abs(x.grad.numpy()).sum() > 0
+
+
+def test_box_decoder_and_assign_picks_argmax_class():
+    prior = np.array([[0, 0, 10, 10]], "float32")
+    var = np.full((1, 4), 0.1, "float32")
+    deltas = np.zeros((1, 8), "float32")
+    deltas[0, 4:] = [1.0, 0.0, 0.0, 0.0]  # class-1 box shifted in x
+    score = np.array([[0.2, 0.8]], "float32")
+    dec, assigned = rcnn_ops.box_decoder_and_assign(
+        paddle.to_tensor(prior), paddle.to_tensor(var),
+        paddle.to_tensor(deltas), paddle.to_tensor(score), box_clip=4.0)
+    assert list(dec.shape) == [1, 8]
+    np.testing.assert_allclose(assigned.numpy(), dec.numpy()[:, 4:8])
+
+
+def test_seq2seq_helper_family():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.decode import (BasicDecoder, TrainingHelper,
+                                      GreedyEmbeddingHelper, dynamic_decode)
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    cell = nn.GRUCell(4, 4)
+    proj = nn.Linear(4, 6)
+    inputs = paddle.to_tensor(rng.randn(2, 5, 4).astype("float32"))
+    helper = TrainingHelper(inputs, sequence_length=paddle.to_tensor(
+        np.array([5, 3], "int64")))
+    dec = BasicDecoder(cell, helper,
+                       initial_states=paddle.to_tensor(
+                           np.zeros((2, 4), "float32")),
+                       output_fn=proj)
+    outs, states = dynamic_decode(dec, max_step_num=5)
+    # batch-major contract: (B, T, vocab)
+    assert list(outs.cell_outputs.shape) == [2, 5, 6]
+    assert list(outs.sample_ids.shape) == [2, 5]
+    # greedy embedding helper runs a short free decode
+    emb = nn.Embedding(6, 4)
+    helper2 = GreedyEmbeddingHelper(lambda ids: emb(ids),
+                                    paddle.to_tensor(
+                                        np.zeros(2, "int64")), end_token=5)
+    dec2 = BasicDecoder(cell, helper2,
+                        initial_states=paddle.to_tensor(
+                            np.zeros((2, 4), "float32")),
+                        output_fn=proj)
+    outs2, _ = dynamic_decode(dec2, max_step_num=4)
+    assert np.asarray(outs2.sample_ids.numpy()).ndim == 2
+
+
+def test_beam_search_step_and_decode():
+    from paddle_tpu.nn.decode import beam_search
+    b, k, v = 1, 2, 5
+    pre_ids = paddle.to_tensor(np.array([[1], [2]], "int64"))
+    pre_scores = paddle.to_tensor(np.array([[0.0], [-1.0]], "float32"))
+    scores = paddle.to_tensor(np.log(np.array(
+        [[.05, .05, .6, .2, .1], [.1, .1, .2, .3, .3]], "float32")))
+    ids, sc, parent = beam_search(pre_ids, pre_scores, None, scores,
+                                  beam_size=k, end_id=0,
+                                  return_parent_idx=True)
+    assert list(ids.shape) == [2, 1]
+    # best expansion is beam 0 token 2
+    assert int(ids.numpy()[0, 0]) == 2 and int(parent.numpy()[0]) == 0
+
+
+def test_layers_extra_spot_oracles():
+    rng = np.random.RandomState(4)
+    # lrn matches a direct numpy evaluation
+    x = rng.rand(1, 6, 2, 2).astype("float32")
+    got = fl.lrn(paddle.to_tensor(x), n=3, k=1.0, alpha=0.1,
+                 beta=0.75).numpy()
+    sq = x ** 2
+    for c in range(6):
+        lo, hi = max(0, c - 1), min(6, c + 2)
+        acc = sq[:, lo:hi].sum(axis=1)
+        np.testing.assert_allclose(
+            got[:, c], x[:, c] / (1.0 + 0.1 * acc) ** 0.75, rtol=1e-4)
+    # huber
+    h = fl.huber_loss(paddle.to_tensor(np.array([0.0, 3.0], "float32")),
+                      paddle.to_tensor(np.array([0.5, 0.0], "float32")),
+                      delta=1.0).numpy()
+    np.testing.assert_allclose(h, [0.125, 2.5], rtol=1e-6)
+    # edit distance
+    d, num = fl.edit_distance(
+        paddle.to_tensor(np.array([[1, 2, 3]], "int64")),
+        paddle.to_tensor(np.array([[1, 3, 3]], "int64")), normalized=False)
+    assert float(d.numpy()[0, 0]) == 1.0
+    # hash is deterministic and in range
+    hh = fl.hash(paddle.to_tensor(np.array([[7], [7]], "int64")),
+                 hash_size=100, num_hash=2).numpy()
+    assert (hh >= 0).all() and (hh < 100).all()
+    assert (hh[0] == hh[1]).all()
+    # mul flattens
+    m = fl.mul(paddle.to_tensor(rng.randn(2, 3, 4).astype("float32")),
+               paddle.to_tensor(rng.randn(12, 5).astype("float32")),
+               x_num_col_dims=1).numpy()
+    assert m.shape == (2, 5)
+    # sequence_conv context window
+    w = rng.randn(3 * 4, 2).astype("float32")
+    sx = rng.randn(1, 5, 4).astype("float32")
+    sc = fl.sequence_conv(paddle.to_tensor(sx), 2, filter_size=3,
+                          weight=paddle.to_tensor(w)).numpy()
+    pad = np.pad(sx, [(0, 0), (1, 1), (0, 0)])
+    cols = np.concatenate([pad[:, 0:5], pad[:, 1:6], pad[:, 2:7]], -1)
+    np.testing.assert_allclose(sc, cols @ w, rtol=1e-4, atol=1e-5)
+    # program-region constructs fail loudly with guidance
+    for ctor in (fl.While, fl.Switch, fl.IfElse, fl.DynamicRNN):
+        with pytest.raises(NotImplementedError):
+            ctor(None)
