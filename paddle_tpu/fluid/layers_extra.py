@@ -167,9 +167,13 @@ def hash(input, hash_size, num_hash=1, name=None):  # noqa: A002
     lanes (reference hash_op uses xxhash; the CONTRACT — deterministic
     bucketing of int ids — is preserved, the exact hash family is not,
     as documented)."""
-    primes = jnp.asarray(
-        [2654435761, 2246822519, 3266489917, 668265263, 374761393,
-         2654435789, 2246822579, 3266489989][:num_hash], jnp.uint32)
+    base = [2654435761, 2246822519, 3266489917, 668265263, 374761393,
+            2654435789, 2246822579, 3266489989]
+    # extend deterministically past 8 lanes (odd multipliers stay odd)
+    mults = [base[i] if i < len(base)
+             else (base[i % len(base)] + 2 * (i // len(base)) * 104729)
+             for i in range(num_hash)]
+    primes = jnp.asarray(mults, jnp.uint32)
 
     def raw(v):
         v = v.astype(jnp.uint32)
@@ -202,8 +206,6 @@ def inplace_abn(input, act=None, **bn_kwargs):  # noqa: A002
     """Activated batch norm (reference inplace_abn_op) — XLA fuses the
     activation into the norm; 'inplace' is a memory-pass concern the
     donation system owns."""
-    from ..nn.legacy_layers import _apply_act
-    from .layers import batch_norm as _fluid_bn  # noqa: F401
     raise UnimplementedError(
         "inplace_abn: use nn.BatchNorm2D + the activation directly — "
         "XLA fuses them; there is no separate in-place pass to request")
@@ -625,9 +627,12 @@ def sequence_slice(input, offset, length, name=None):  # noqa: A002
             x, order[..., None] if x.ndim == 3 else order, axis=1)
         maxlen = int(jnp.max(ln)) if not isinstance(
             ln, jax.core.Tracer) else t
-        return gathered[:, :maxlen] * (
-            jnp.arange(gathered.shape[1])[None, :, None]
-            < ln.reshape(-1, 1, 1) if x.ndim == 3 else 1)
+        gathered = gathered[:, :maxlen]
+        pos = jnp.arange(gathered.shape[1])[None, :]
+        mask = pos < ln.reshape(-1, 1)          # zero PAST each row's length
+        if x.ndim == 3:
+            mask = mask[..., None]
+        return gathered * mask
 
     return dispatch("sequence_slice", raw, input, offset, length)
 

@@ -406,6 +406,15 @@ def beam_search_decode(ids, scores, beam_size, end_id, parents=None,
     t, bk = id_steps.shape
     k = beam_size
     b = bk // k
-    full = gather_tree(Tensor(id_steps.reshape(t, b, k)),
-                       Tensor(parents.reshape(t, b, k).astype(jnp.int32)))
-    return full, Tensor(sc_steps.reshape(t, b, k), stop_gradient=True)
+    # beam_search emits FLAT parent rows (beam + batch*k, right for state
+    # gathering); gather_tree wants per-batch beam slots in [0, k)
+    par = (parents % k).reshape(t, b, k).astype(jnp.int32)
+    full = gather_tree(Tensor(id_steps.reshape(t, b, k)), Tensor(par))
+    # backtrack the SCORES through the same ancestry: gather_tree over the
+    # per-step slot indices yields, for each final lane, which slot its
+    # ancestor occupied at time t — then index the raw scores with it
+    slots = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, None, :],
+                             (t, b, k))
+    anc = unwrap(gather_tree(Tensor(slots), Tensor(par)))
+    sc = jnp.take_along_axis(sc_steps.reshape(t, b, k), anc, axis=2)
+    return full, Tensor(sc, stop_gradient=True)

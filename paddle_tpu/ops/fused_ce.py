@@ -91,7 +91,7 @@ def _flce_bwd(chunk, res, ct):
     return dh.reshape(t, hid).astype(h.dtype), dw.astype(w.dtype), None
 
 
-def fused_linear_cross_entropy(h, weight, labels, chunk_size=2048,
+def fused_linear_cross_entropy(h, weight, labels, chunk_size=None,
                                name=None):
     """Per-token CE of (h @ weight^T) vs labels WITHOUT materializing the
     (tokens, vocab) logits between forward and backward.
@@ -99,6 +99,9 @@ def fused_linear_cross_entropy(h, weight, labels, chunk_size=2048,
     h (..., H) hidden states, weight (V, H) (the tied embedding layout),
     labels (...) int.  Returns per-token losses shaped like labels.
     """
+    if chunk_size is None:
+        import os
+        chunk_size = int(os.environ.get("PDTPU_FUSEDCE_CHUNK", "2048"))
     lead = unwrap(labels).shape
 
     def raw(hv, wv, lv):

@@ -37,6 +37,18 @@ def _np(x):
     return np.asarray(jax.device_get(unwrap(x)))
 
 
+# module-persistent sampler: a FRESH RandomState per call would resample
+# the identical fg/bg subset for the same proposals every step, defeating
+# use_random (the reference op draws fresh randomness each step).
+# Deterministic across runs, varying across calls; pass seed= for exact
+# reproducibility of a single call.
+_SAMPLER = np.random.RandomState(0)
+
+
+def _rng_for(seed):
+    return np.random.RandomState(seed) if seed is not None else _SAMPLER
+
+
 def _iou_np(a, b):
     """(A, 4) x (B, 4) -> (A, B) IoU, numpy."""
     area_a = np.clip(a[:, 2] - a[:, 0], 0, None) * \
@@ -108,7 +120,7 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
                       rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
                       rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
                       rpn_negative_overlap=0.3, use_random=True,
-                      gt_count=None, seed=0):
+                      gt_count=None, seed=None):
     """Faster-RCNN RPN sampler (reference detection.py:311 over
     rpn_target_assign_op).  bbox_pred (N, A, 4), cls_logits (N, A, 1),
     anchors (A, 4); gt_boxes (N, G, 4) padded dense + gt_count, or a
@@ -121,7 +133,7 @@ def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
     n = gts.shape[0]
     im_infos = _np(im_info) if im_info is not None else None
     crowd = _np(is_crowd) if is_crowd is not None else None
-    rng = np.random.RandomState(seed)
+    rng = _rng_for(seed)
 
     idx_all, lab_all, tgt_all = [], [], []
     for i in range(n):
@@ -260,7 +272,7 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
                              bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
                              class_nums=None, use_random=True,
                              is_cls_agnostic=False, is_cascade_rcnn=False,
-                             rois_num=None, gt_count=None, seed=0,
+                             rois_num=None, gt_count=None, seed=None,
                              **_ignored):
     """Faster-RCNN second-stage sampler (reference detection.py:2594 over
     generate_proposal_labels_op): sample fg/bg rois against gt, emit
@@ -285,7 +297,7 @@ def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
             roi_list = [rv[ofs[i]:ofs[i + 1]] for i in range(len(rn))]
         else:
             roi_list = [rv]
-    rng = np.random.RandomState(seed)
+    rng = _rng_for(seed)
 
     out_rois, out_lab, out_tgt, out_in, out_out, out_n = \
         [], [], [], [], [], []

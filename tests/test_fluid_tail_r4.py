@@ -167,6 +167,7 @@ def test_box_decoder_and_assign_picks_argmax_class():
     np.testing.assert_allclose(assigned.numpy(), dec.numpy()[:, 4:8])
 
 
+@pytest.mark.slow
 def test_seq2seq_helper_family():
     import paddle_tpu.nn as nn
     from paddle_tpu.nn.decode import (BasicDecoder, TrainingHelper,
@@ -212,6 +213,29 @@ def test_beam_search_step_and_decode():
     assert list(ids.shape) == [2, 1]
     # best expansion is beam 0 token 2
     assert int(ids.numpy()[0, 0]) == 2 and int(parent.numpy()[0]) == 0
+
+
+def test_beam_search_decode_multibatch_backtrack():
+    """Regression (r4 review): flat parent rows from beam_search must be
+    reduced to per-batch beam slots before gather_tree, and scores must be
+    backtracked through the same ancestry — batch element 1 exposes both."""
+    from paddle_tpu.nn.decode import beam_search_decode
+    k = 2
+    # T=2, B=2: at t=1 batch 1's lanes BOTH come from its beam 1 (flat
+    # parent rows 3, 3); batch 0 keeps identity parents (rows 0, 1)
+    ids = np.array([[10, 11, 20, 21], [12, 13, 22, 23]], "int64")
+    parents = np.array([[0, 1, 2, 3], [0, 1, 3, 3]], "int64")
+    scores = np.array([[0.1, 0.2, 0.3, 0.4], [0.5, 0.6, 0.7, 0.8]],
+                      "float32")
+    full, sc = beam_search_decode(
+        paddle.to_tensor(ids), paddle.to_tensor(scores), beam_size=k,
+        end_id=0, parents=paddle.to_tensor(parents))
+    fv, sv = full.numpy(), sc.numpy()
+    # batch 1 lane 0 ancestry: t=1 token 22 came from beam 1 -> t=0 is 21
+    assert fv[0, 1, 0] == 21 and fv[1, 1, 0] == 22
+    np.testing.assert_allclose(sv[0, 1, 0], 0.4)  # t=0 score of beam 1
+    # batch 0 is identity — untouched
+    assert fv[0, 0].tolist() == [10, 11]
 
 
 def test_layers_extra_spot_oracles():

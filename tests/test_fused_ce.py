@@ -38,6 +38,7 @@ def test_fused_ce_matches_reference_fwd_bwd():
     np.testing.assert_allclose(gw_f, wt2.grad.numpy(), rtol=5e-2, atol=2e-2)
 
 
+@pytest.mark.slow
 def test_gpt_forward_labels_path_trains():
     paddle.seed(0)
     cfg = models.GPTConfig(vocab_size=64, hidden_size=32,

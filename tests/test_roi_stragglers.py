@@ -33,6 +33,7 @@ def _np_psroi(x, rois, ids, out_c, scale, ph_n, pw_n):
     return out
 
 
+@pytest.mark.slow
 def test_psroi_pool_oracle():
     rng = np.random.RandomState(0)
     x = rng.randn(2, 2 * 2 * 2, 8, 8).astype("float32")
@@ -46,6 +47,7 @@ def test_psroi_pool_oracle():
     np.testing.assert_allclose(got.numpy(), want, rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_prroi_pool_exact_cases():
     # constant feature: exact integral average must be that constant
     x = np.full((1, 1, 6, 6), 3.5, "float32")
@@ -146,6 +148,7 @@ def test_deformable_roi_pooling_oracle():
     assert np.abs(tt.grad.numpy()).sum() > 0
 
 
+@pytest.mark.slow
 def test_deformable_position_sensitive():
     rng = np.random.RandomState(2)
     ph = pw = 2

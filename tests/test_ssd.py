@@ -100,6 +100,7 @@ def _np_ssd_loss(loc, conf, gtb, gtl, pb, pbv, neg_pos_ratio=3.0,
     return total / max(matched.sum(), 1)
 
 
+@pytest.mark.slow
 def test_ssd_loss_matches_numpy_oracle():
     rng = np.random.RandomState(3)
     n_prior, n_cls, m = 12, 4, 2
@@ -127,6 +128,7 @@ def test_ssd_loss_matches_numpy_oracle():
     np.testing.assert_allclose(got.ravel()[0], want, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_multi_box_head_shapes_and_priors():
     paddle.seed(0)
     head = vmodels.MultiBoxHead(
