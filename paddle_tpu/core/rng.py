@@ -48,10 +48,21 @@ class Generator:
 
 _default_generator = Generator(0)
 
+# host-side numpy samplers (e.g. the RCNN fg/bg assigners) register here so
+# paddle.seed() also resets them — keeping the reproducibility contract
+# without giving every call a fresh identical RandomState
+_seed_listeners = []
+
+
+def register_seed_listener(fn):
+    _seed_listeners.append(fn)
+
 
 def seed(s: int):
     """Set the global random seed (paddle.seed)."""
     _default_generator.manual_seed(s)
+    for fn in _seed_listeners:
+        fn(int(s))
     return _default_generator
 
 

@@ -39,10 +39,18 @@ def _np(x):
 
 # module-persistent sampler: a FRESH RandomState per call would resample
 # the identical fg/bg subset for the same proposals every step, defeating
-# use_random (the reference op draws fresh randomness each step).
-# Deterministic across runs, varying across calls; pass seed= for exact
-# reproducibility of a single call.
+# use_random (the reference op draws fresh randomness each step).  It
+# reseeds with paddle.seed() (core.rng listener) so seeded runs stay
+# reproducible; pass seed= for exact reproducibility of a single call.
 _SAMPLER = np.random.RandomState(0)
+
+
+def _reseed_sampler(s):
+    _SAMPLER.seed(s)
+
+
+from ..core import rng as _core_rng  # noqa: E402
+_core_rng.register_seed_listener(_reseed_sampler)
 
 
 def _rng_for(seed):
