@@ -5,6 +5,6 @@ module (the distributed reader role is DataLoader + DistributedBatchSampler)
 and checkpoint re-exports the auto-checkpoint machinery; the PS-only
 data_generator/fleet halves are scoped out per SURVEY §2.3."""
 from .. import io as reader  # noqa: F401
-from ..distributed import checkpoint  # noqa: F401
+from . import checkpoint  # noqa: F401
 
 __all__ = ["reader", "checkpoint"]

@@ -1,0 +1,17 @@
+"""paddle.incubate.checkpoint (reference:
+python/paddle/fluid/incubate/checkpoint/) — the auto-checkpoint package.
+The sharded machinery lives in distributed.checkpoint and EVERY public
+name there stays reachable here (module passthrough via __getattr__, so
+pre-existing incubate.checkpoint.save_sharded/... calls keep working);
+auto_checkpoint mirrors the reference acp module's env-driven entry."""
+from ...distributed import checkpoint as _dck
+from . import auto_checkpoint  # noqa: F401
+from .auto_checkpoint import train_epoch_range  # noqa: F401
+
+
+def __getattr__(name):
+    return getattr(_dck, name)
+
+
+def __dir__():
+    return sorted(set(dir(_dck)) | {"auto_checkpoint", "train_epoch_range"})

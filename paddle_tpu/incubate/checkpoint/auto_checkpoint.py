@@ -1,0 +1,68 @@
+"""acp-compatible auto checkpoint (reference:
+fluid/incubate/checkpoint/auto_checkpoint.py:598 train_epoch_range and its
+EDL env contract).
+
+Reference env contract honored here:
+  PADDLE_RUNNING_ENV=PADDLE_EDL_AUTO_CHECKPOINT   enables auto checkpoint
+  PADDLE_EDL_HDFS_CHECKPOINT_PATH                 checkpoint directory
+  PADDLE_JOB_ID / PADDLE_EDL_ONLY_FOR_CE_TEST     job namespacing
+Outside that env the iterator degrades to a plain epoch range exactly like
+the reference (which warns and `_normal_yield`s).  The save side is the
+TrainStep/CheckpointManager machinery (distributed/checkpoint.py) — pass
+`manager=` to bind one explicitly, or let the env build it.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+from ...distributed import checkpoint as _ck
+
+CONST_ACP_ENV = "PADDLE_RUNNING_ENV"
+CONST_ACP_VALUE = "PADDLE_EDL_AUTO_CHECKPOINT"
+CONST_CHECKPOINT_PATH = "PADDLE_EDL_HDFS_CHECKPOINT_PATH"
+CONST_JOB_ID = "PADDLE_JOB_ID"
+
+
+def _enabled() -> bool:
+    return os.environ.get(CONST_ACP_ENV, "") == CONST_ACP_VALUE
+
+
+def _env_manager():
+    base = os.environ.get(CONST_CHECKPOINT_PATH)
+    if not base:
+        from ...core.errors import PreconditionNotMetError
+        raise PreconditionNotMetError(
+            f"[PreconditionNotMet] {CONST_ACP_ENV}={CONST_ACP_VALUE} is "
+            f"set but {CONST_CHECKPOINT_PATH} is not — a cwd-relative "
+            "fallback would silently lose checkpoints when the rescheduled "
+            "job starts elsewhere (the reference requires the path too)")
+    job = os.environ.get(CONST_JOB_ID, "default_job")
+    return _ck.CheckpointManager(os.path.join(base, job))
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=None,
+                      manager=None):
+    """Resume-aware epoch iterator with the reference signature
+    (auto_checkpoint.py:598).  With the EDL env set (or an explicit
+    `manager` — also accepted as the second positional for continuity
+    with the pre-r4 (n_epochs, manager) form), already-completed epochs —
+    per the newest checkpoint's {"epoch": e} extra metadata — are
+    skipped; otherwise yields the plain range like the reference's
+    fallback.
+
+    Env/manager resolution happens EAGERLY at call time (this is a plain
+    function returning a generator), so misconfiguration warns/raises
+    where the call is, not at first iteration."""
+    if isinstance(save_checkpoint_inter, _ck.CheckpointManager):
+        manager = save_checkpoint_inter  # pre-r4 positional form
+    if manager is None:
+        if not _enabled():
+            warnings.warn(
+                "auto checkpoint is OFF (set "
+                f"{CONST_ACP_ENV}={CONST_ACP_VALUE} and "
+                f"{CONST_CHECKPOINT_PATH}, or pass manager=): yielding a "
+                "plain epoch range", stacklevel=2)
+            return iter(range(max_epoch_num))
+        manager = _env_manager()
+    return _ck.train_epoch_range(max_epoch_num, manager)

@@ -282,3 +282,82 @@ def test_layers_extra_spot_oracles():
     for ctor in (fl.While, fl.Switch, fl.IfElse, fl.DynamicRNN):
         with pytest.raises(NotImplementedError):
             ctor(None)
+
+
+def test_retinanet_detection_output_and_lanms():
+    rng = np.random.RandomState(5)
+    # one FPN level, 6 anchors; deltas zero -> decoded == anchors
+    anchors = np.stack([np.array([i * 10, i * 10, i * 10 + 8, i * 10 + 8],
+                                 "float32") for i in range(6)])
+    deltas = np.zeros((1, 6, 4), "float32")
+    scores = np.zeros((1, 6, 3), "float32")
+    scores[0, 1, 2] = 0.9
+    scores[0, 4, 0] = 0.7
+    out, counts = rcnn_ops.retinanet_detection_output(
+        [paddle.to_tensor(deltas)], [paddle.to_tensor(scores)],
+        [paddle.to_tensor(anchors)], score_threshold=0.5, keep_top_k=4)
+    on = out.numpy()
+    n = int(counts.numpy()[0])
+    assert n == 2
+    # top row: class 2 score 0.9 at anchor 1's box
+    assert on[0, 0, 0] == 2 and abs(on[0, 0, 1] - 0.9) < 1e-5
+    np.testing.assert_allclose(on[0, 0, 2:], anchors[1], rtol=1e-5)
+
+    # locality-aware NMS merges the two overlapping consecutive boxes
+    bb = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                  "float32")
+    sc = np.zeros((2, 3), "float32")
+    sc[1] = [0.8, 0.4, 0.9]
+    rows, cnt = rcnn_ops.locality_aware_nms(
+        paddle.to_tensor(bb), paddle.to_tensor(sc), score_threshold=0.1,
+        nms_top_k=10, keep_top_k=5, nms_threshold=0.5)
+    got = rows.numpy()
+    assert cnt == 2
+    # merged box = score-weighted average of the first two
+    w0, w1 = 0.8, 0.4
+    merged = (bb[0] * w0 + bb[1] * w1) / (w0 + w1)
+    row = got[got[:, 1] > 0.99][0]   # accumulated score clipped to 1.0
+    np.testing.assert_allclose(row[2:], merged, rtol=1e-5)
+
+
+def test_generate_mask_labels_rasterizes():
+    # one image, one fg roi matched to a square polygon instance
+    rois = np.array([[0.0, 0.0, 8.0, 8.0]], "float32")
+    labels = np.array([2], "int32")
+    # square covering the left half of the roi
+    segms = [[[[0.0, 0.0, 4.0, 0.0, 4.0, 8.0, 0.0, 8.0]]]][0]
+    mask_rois, has_mask, masks = rcnn_ops.generate_mask_labels(
+        None, None, None, [segms], paddle.to_tensor(rois),
+        paddle.to_tensor(labels), num_classes=4, resolution=8)
+    assert list(mask_rois.shape) == [1, 4]
+    assert has_mask.numpy().tolist() == [1]
+    m = masks.numpy().reshape(1, 4, 8, 8)
+    assert (m[0, 0] == -1).all() and (m[0, 1] == -1).all()
+    cls2 = m[0, 2]
+    # left half of the 8x8 grid covered, right half empty
+    assert cls2[:, :4].mean() == 1.0 and cls2[:, 4:].mean() == 0.0
+
+
+def test_incubate_auto_checkpoint_env_contract(tmp_path, monkeypatch):
+    """reference acp env contract (auto_checkpoint.py:598): OFF -> plain
+    range + warning; EDL env ON -> completed epochs skipped on resume."""
+    import warnings
+    from paddle_tpu import incubate
+    from paddle_tpu.incubate.checkpoint import auto_checkpoint as acp
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert list(incubate.checkpoint.train_epoch_range(3)) == [0, 1, 2]
+        assert any("auto checkpoint is OFF" in str(x.message) for x in w)
+    monkeypatch.setenv(acp.CONST_ACP_ENV, acp.CONST_ACP_VALUE)
+    monkeypatch.setenv(acp.CONST_CHECKPOINT_PATH, str(tmp_path))
+    monkeypatch.setenv(acp.CONST_JOB_ID, "job0")
+    mgr = acp._env_manager()
+    seen = []
+    for e in incubate.checkpoint.train_epoch_range(3):
+        seen.append(e)
+        mgr.save({"w": paddle.to_tensor(np.ones(2, "float32"))._data},
+                 step=e, extra_meta={"epoch": e})
+        if e == 1:
+            break  # simulate preemption after epoch 1's checkpoint
+    assert seen == [0, 1]
+    assert list(incubate.checkpoint.train_epoch_range(3)) == [2]
