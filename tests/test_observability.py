@@ -31,6 +31,7 @@ def test_check_nan_inf_flag_catches_and_names_op():
     assert np.isinf(np.asarray(bad.numpy())).all()
 
 
+@pytest.mark.slow
 def test_summary_reports_layers_params_flops():
     from paddle_tpu.vision.models import LeNet
     info = paddle.summary(LeNet(), (1, 1, 28, 28))

@@ -349,6 +349,7 @@ def test_hapi_fit_sparse_with_metrics():
     assert mets and np.isfinite(mets[0])
 
 
+@pytest.mark.slow
 def test_onehot_embedding_bwd_trajectory_matches_scatter():
     """r3 perf fix guardrail: under AMP the embedding backward runs as a
     bf16 one-hot MXU matmul instead of XLA's scatter; the bf16 rounding
