@@ -108,12 +108,19 @@ class SparseGradContext:
     trace the same forward.
     """
 
-    def __init__(self, mode: str, zeros: Optional[Dict] = None):
+    def __init__(self, mode: str, zeros: Optional[Dict] = None, deny=()):
         self.mode = mode
         self.zeros = zeros or {}
+        # param names DEMOTED to dense grads (tied weights — see
+        # TrainStep.__init__): F.embedding skips the sparse channel for
+        # these and lets the weight stay in the differentiated set
+        self.deny = frozenset(deny)
         self.specs: Dict[str, tuple] = {}
         self.ids: Dict[str, jax.Array] = {}
         self._counts: Dict[str, int] = {}
+
+    def wants(self, name: str) -> bool:
+        return name not in self.deny
 
     def key_for(self, name: str) -> str:
         i = self._counts.get(name, 0)
@@ -178,19 +185,34 @@ def ctx_embedding(ctx: SparseGradContext, x, weight, padding_idx=None):
 # train-step build probes the traced forward and hard-errors instead.
 
 
-def check_embedding_only_use(probe_fn, sparse_vals: Dict[str, jax.Array]):
-    """Raise ValueError if any sparse param feeds an op other than the
-    stop_gradient that ctx_embedding wraps it in (e.g. a tied LM head).
+def dense_consumed_uses(probe_fn, sparse_vals: Dict[str, jax.Array]):
+    """Return (state_key, primitive_name) pairs for every sparse param the
+    traced forward consumes OUTSIDE the sanctioned ctx_embedding
+    stop_gradient path (e.g. a tied LM head).  Conservative: unrecognized
+    call-like primitives consuming a sparse weight also count.
 
     probe_fn(sparse_vals_dict) must run the forward with an apply-mode
-    SparseGradContext active.  Conservative: unrecognized call-like
-    primitives consuming a sparse weight also error.
+    SparseGradContext active.
     """
     closed = jax.make_jaxpr(probe_fn)(sparse_vals)
     leaves, _ = jax.tree_util.tree_flatten(sparse_vals)
     keys = sorted(sparse_vals)
     tracked = {v: k for v, k in zip(closed.jaxpr.invars[:len(leaves)], keys)}
-    bad = _find_dense_consumers(closed.jaxpr, tracked)
+    return _find_dense_consumers(closed.jaxpr, tracked)
+
+
+def dense_consumed_keys(probe_fn, sparse_vals: Dict[str, jax.Array]):
+    """Just the offending state keys (TrainStep's demotion wants a set)."""
+    return {k for k, _ in dense_consumed_uses(probe_fn, sparse_vals)}
+
+
+def check_embedding_only_use(probe_fn, sparse_vals: Dict[str, jax.Array]):
+    """Raise ValueError if any sparse param feeds an op other than the
+    stop_gradient that ctx_embedding wraps it in (e.g. a tied LM head).
+    TrainStep no longer uses this (it demotes such weights to dense grads
+    with a warning); kept for direct callers who want the hard guard.
+    """
+    bad = dense_consumed_uses(probe_fn, sparse_vals)
     if bad:
         uses = ", ".join(sorted({f"'{k}' used by {p}" for k, p in bad}))
         raise ValueError(

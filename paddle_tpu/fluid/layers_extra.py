@@ -330,27 +330,52 @@ def row_conv(input, future_context_size, weight=None, act=None,  # noqa: A002
 
 
 def similarity_focus(input, axis, indexes, name=None):  # noqa: A002
-    """Similarity-focus mask (reference similarity_focus_op): for each
-    selected channel (via `indexes` on `axis`), mark the per-row/column
-    argmax positions across the other two spatial dims; union over the
-    selected channels, broadcast to all channels."""
-    def raw(x):
-        n, c, a, b = x.shape
-        masks = jnp.zeros((n, a, b), x.dtype)
+    """Similarity-focus mask (reference similarity_focus_op.h): for each
+    selected channel (via `indexes` on `axis`), sort the 2-D plane over
+    the other two dims descending and GREEDILY pick positions whose row
+    and column are both still untagged — min(A, B) mutually-exclusive
+    positions per channel — then set 1 at those positions across the whole
+    `axis` dim (union over the selected channels).  Host-side numpy: the
+    greedy sequential assignment is an eager-compat op, not a hot path."""
+    xv = unwrap(input)
+    if isinstance(xv, jax.core.Tracer):
+        raise UnimplementedError(
+            "similarity_focus is eager-only (greedy sequential assignment "
+            "runs host-side) — call it outside jit/TrainStep")
+    xv = np.asarray(jax.device_get(xv))
+    if xv.ndim != 4:
+        raise InvalidArgumentError(
+            f"similarity_focus: input must be 4-D, got {xv.ndim}-D")
+    if axis not in (1, 2, 3):
+        raise InvalidArgumentError(
+            f"similarity_focus: axis must be 1, 2 or 3, got {axis}")
+    out = np.zeros_like(xv)
+    other = [d for d in (1, 2, 3) if d != axis]
+    a_dim, b_dim = xv.shape[other[0]], xv.shape[other[1]]
+    limit = min(a_dim, b_dim)
+    for i in range(xv.shape[0]):
         for idx in indexes:
-            if axis == 1:
-                plane = x[:, idx]                      # (N, A, B)
-            elif axis == 2:
-                plane = x[:, :, idx]
-            else:
-                plane = x[:, :, :, idx]
-            row_max = plane == jnp.max(plane, axis=2, keepdims=True)
-            col_max = plane == jnp.max(plane, axis=1, keepdims=True)
-            masks = jnp.maximum(masks,
-                                (row_max | col_max).astype(x.dtype))
-        return jnp.broadcast_to(masks[:, None], x.shape)
-
-    return dispatch("similarity_focus", raw, input)
+            if not 0 <= idx < xv.shape[axis]:
+                raise InvalidArgumentError(
+                    f"similarity_focus: index {idx} out of range for "
+                    f"axis {axis} (size {xv.shape[axis]})")
+            plane = np.take(xv[i], idx, axis=axis - 1)      # (A, B)
+            order = np.argsort(-plane, axis=None, kind="stable")
+            tag_a = np.zeros(a_dim, bool)
+            tag_b = np.zeros(b_dim, bool)
+            picked = 0
+            for pos in order:
+                ia, ib = divmod(int(pos), b_dim)
+                if tag_a[ia] or tag_b[ib]:
+                    continue
+                tag_a[ia] = tag_b[ib] = True
+                picked += 1
+                sel = [i, slice(None), slice(None), slice(None)]
+                sel[other[0]], sel[other[1]] = ia, ib
+                out[tuple(sel)] = 1
+                if picked == limit:
+                    break
+    return Tensor(jnp.asarray(out), stop_gradient=True)
 
 
 def size(input, name=None):  # noqa: A002
@@ -524,12 +549,22 @@ def sampled_softmax_with_cross_entropy(logits, label, num_samples,
                                        customized_probabilities=None,
                                        seed=0):
     """Sampled softmax CE (reference sampled_softmax...op): CE over the
-    true class + uniformly sampled negatives instead of the full vocab."""
+    true class + uniformly sampled negatives instead of the full vocab.
+    seed=0 (the reference's "nondeterministic" sentinel) draws FRESH
+    negatives each call via core.rng.next_key() — the dropout pattern, so
+    inside a TrainStep trace the draw rides the per-step traced key
+    instead of being baked in as a constant, and paddle.seed() keeps
+    eager runs reproducible.  A nonzero seed pins a single call exactly."""
     lv = unwrap(logits)
     lab = unwrap(label).reshape(-1).astype(jnp.int32)
     n, v = lv.shape
-    rng = np.random.RandomState(seed)
-    neg = jnp.asarray(rng.randint(0, v, (num_samples,)), jnp.int32)
+    if seed:
+        host_rng = np.random.RandomState(seed)
+        neg = jnp.asarray(host_rng.randint(0, v, (num_samples,)), jnp.int32)
+    else:
+        from ..core import rng as _core_rng
+        neg = jax.random.randint(_core_rng.next_key(), (num_samples,),
+                                 0, v, dtype=jnp.int32)
 
     def raw(lg):
         cols = jnp.concatenate([lab[:, None], jnp.broadcast_to(

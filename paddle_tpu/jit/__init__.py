@@ -240,17 +240,25 @@ class TrainStep:
                         if getattr(v, "sparse_grad", False)}
         self._sig_cache = {}
         self._sparse_checked = False
+        # param names demoted to DENSE grads (tied weights): sparse grads
+        # would drop the other uses' gradients, so fall back instead of
+        # erroring (the reference's lazy-mode Adam likewise densifies when
+        # the lookup table is shared)
+        self._sparse_deny = set()
         if self._sparse:
             by_obj = {}
             for k, v in model.state_dict().items():
-                by_obj.setdefault(id(v), []).append(k)
-            for names in by_obj.values():
+                by_obj.setdefault(id(v), (v, []))[1].append(k)
+            for v, names in by_obj.values():
                 if len(names) > 1 and self._sparse.intersection(names):
-                    raise ValueError(
+                    import warnings
+                    warnings.warn(
                         f"Embedding(sparse=True) weight registered under "
-                        f"multiple names {names} (tied weight) — sparse "
-                        "grads would drop the other uses' gradients; use "
-                        "sparse=False")
+                        f"multiple names {names} (tied weight) — falling "
+                        "back to a dense gradient for it so the other "
+                        "uses' gradients are kept", UserWarning)
+                    self._sparse_deny.add(
+                        getattr(v, "name", None) or names[0])
         self._compiled = None
         self._compiled_multi = None
         self._opt_state = None
@@ -263,35 +271,51 @@ class TrainStep:
     def _sparse_setup(self, example_state, example_batch):
         """Shared sparse-grad preamble for the single- and multi-step
         builds: shape-probe each sparse lookup's (n, width, dtype), map ctx
-        keys back to state keys, and run the embedding-only misuse guard
-        once (its verdict is shape-independent)."""
+        keys back to state keys, and run the dense-consumption guard once
+        (its verdict is shape-independent).  A sparse weight the traced
+        forward ALSO consumes densely (tied LM head) is demoted to dense
+        grads with a one-time warning — erroring would reject the
+        era-typical tied-embedding config."""
         from ..core import selected_rows as sr
-        rec = sr.SparseGradContext("record")
-        with sr.use_ctx(rec):
-            jax.eval_shape(
-                lambda s, b: self._forward_loss(s, b, jax.random.PRNGKey(0)),
-                example_state, example_batch)
-        sparse_specs = rec.specs
         # ctx keys carry the param's unique .name; map back to state keys
         name_to_key = {getattr(v, "name", None) or k: k
                        for k, v in self.model.state_dict().items()}
-        sparse_names = {name_to_key[sr.param_name(k)] for k in sparse_specs}
+        while True:
+            rec = sr.SparseGradContext("record", deny=self._sparse_deny)
+            with sr.use_ctx(rec):
+                jax.eval_shape(
+                    lambda s, b: self._forward_loss(
+                        s, b, jax.random.PRNGKey(0)),
+                    example_state, example_batch)
+            sparse_specs = rec.specs
+            sparse_names = {name_to_key[sr.param_name(k)]
+                            for k in sparse_specs}
+            if self._sparse_checked or not sparse_specs:
+                break
 
-        # misuse guard: error out (rather than silently drop grads) if a
-        # sparse weight is also consumed densely, e.g. by a tied LM head
-        if not self._sparse_checked:
             def probe(sparse_vals):
                 zs = {k: jnp.zeros((n, w), dt)
                       for k, (n, w, dt) in sparse_specs.items()}
                 full = dict(example_state)
                 full.update(sparse_vals)
-                ctx = sr.SparseGradContext("apply", zeros=zs)
+                ctx = sr.SparseGradContext("apply", zeros=zs,
+                                           deny=self._sparse_deny)
                 with sr.use_ctx(ctx):
                     return self._forward_loss(full, example_batch,
                                               jax.random.PRNGKey(0))
-            sr.check_embedding_only_use(
+            bad = sr.dense_consumed_keys(
                 probe, {k: example_state[k] for k in sparse_names})
-            self._sparse_checked = True
+            if not bad:
+                break
+            import warnings
+            warnings.warn(
+                f"Embedding(sparse=True) weights {sorted(bad)} are also "
+                "consumed densely (tied head) — falling back to dense "
+                "gradients for them so those uses' gradients are kept",
+                UserWarning)
+            key_to_name = {v: k for k, v in name_to_key.items()}
+            self._sparse_deny.update(key_to_name[k] for k in bad)
+        self._sparse_checked = True
         return sparse_specs, name_to_key, sparse_names
 
     @staticmethod
@@ -350,7 +374,8 @@ class TrainStep:
             def loss_of(train_params, zvals):
                 full = dict(params)
                 full.update(train_params)
-                ctx = sr.SparseGradContext("apply", zeros=zvals)
+                ctx = sr.SparseGradContext("apply", zeros=zvals,
+                                           deny=self._sparse_deny)
                 with sr.use_ctx(ctx):
                     if with_outputs:
                         loss, outs = forward_loss(
@@ -444,7 +469,8 @@ class TrainStep:
                 def loss_of(train_params, zvals):
                     full = dict(params)
                     full.update(train_params)
-                    ctx = sr.SparseGradContext("apply", zeros=zvals)
+                    ctx = sr.SparseGradContext("apply", zeros=zvals,
+                                               deny=self._sparse_deny)
                     with sr.use_ctx(ctx):
                         loss = self._forward_loss(full, xs, key)
                     return loss, ctx.ids

@@ -87,8 +87,11 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
         from ...core.tensor import is_grad_enabled
         ctx = sr.current_ctx()
         if ctx is not None:  # inside a TrainStep trace collecting sparse grads
-            return sr.ctx_embedding(ctx, x, weight, padding_idx)
-        if (isinstance(weight, Tensor) and is_grad_enabled()
+            if ctx.wants(getattr(weight, "name", None) or "embedding"):
+                return sr.ctx_embedding(ctx, x, weight, padding_idx)
+            # tied weight demoted to dense grads (TrainStep warned once):
+            # fall through to the ordinary differentiable lookup below
+        elif (isinstance(weight, Tensor) and is_grad_enabled()
                 and not weight.stop_gradient):
             return sr.eager_sparse_embedding(x, weight, padding_idx)
 

@@ -200,11 +200,11 @@ class SSDMobileNetV1(nn.Layer):
 
     def postprocess(self, locs, confs, boxes, vars_, score_threshold=0.01,
                     nms_threshold=0.45, keep_top_k=200, nms_top_k=400):
-        """Serve: softmax confidences + detection_output (decode + padded
-        multiclass NMS, fully on device)."""
-        from ...nn.functional import softmax
+        """Serve: detection_output (softmax + decode + padded multiclass
+        NMS, fully on device — the softmax lives inside detection_output,
+        matching the reference contract, detection.py:721)."""
         return vops.detection_output(
-            locs, softmax(confs, axis=-1), boxes, vars_,
+            locs, confs, boxes, vars_,
             background_label=0, nms_threshold=nms_threshold,
             nms_top_k=nms_top_k, keep_top_k=keep_top_k,
             score_threshold=score_threshold)

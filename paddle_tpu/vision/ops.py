@@ -984,6 +984,42 @@ def nms_padded(boxes, scores=None, iou_threshold=0.3, max_out=None,
             Tensor(count, stop_gradient=True))
 
 
+def _nms_padded_raw(bv, sv, score_threshold, nms_top_k, keep_top_k,
+                    nms_threshold, background_label):
+    """Single-image padded multiclass NMS body: pure jnp over (N, 4) boxes
+    and (C, N) scores so `detection_output` can `jax.vmap` it over the
+    batch (one compiled program regardless of B)."""
+    c, n = sv.shape
+    iou = _iou_matrix(bv, bv)
+    topn = min(nms_top_k, n) if nms_top_k and nms_top_k > 0 else n
+
+    def per_class(srow):
+        svm = jnp.where(srow >= score_threshold, srow, -jnp.inf)
+        order = jnp.argsort(-svm)
+        valid_sorted = jnp.isfinite(svm[order]) & (jnp.arange(n) < topn)
+        iou_o = iou[order][:, order]
+        keep = _greedy_suppress(iou_o, valid_sorted, nms_threshold)
+        return jnp.zeros((n,), bool).at[order].set(keep)
+
+    keep_cn = jax.vmap(per_class)(sv)          # (C, N)
+    if 0 <= background_label < c:
+        keep_cn = keep_cn.at[background_label].set(False)
+    flat = jnp.where(keep_cn, sv, -jnp.inf).reshape(-1)
+    k = min(keep_top_k, c * n)
+    top_s, top_i = jax.lax.top_k(flat, k)
+    cls = (top_i // n).astype(jnp.float32)
+    bix = top_i % n
+    valid = jnp.isfinite(top_s)
+    rows = jnp.concatenate(
+        [cls[:, None], jnp.where(valid, top_s, -1.0)[:, None],
+         bv[bix]], axis=1)
+    rows = jnp.where(valid[:, None], rows, -1.0)
+    if k < keep_top_k:
+        rows = jnp.concatenate(
+            [rows, jnp.full((keep_top_k - k, 6), -1.0)], axis=0)
+    return rows, jnp.sum(valid.astype(jnp.int32))
+
+
 def multiclass_nms_padded(bboxes, scores, score_threshold, nms_top_k,
                           keep_top_k, nms_threshold=0.3,
                           background_label=-1, name=None):
@@ -992,41 +1028,9 @@ def multiclass_nms_padded(bboxes, scores, score_threshold, nms_top_k,
     -1 rows + valid count.  Same selection semantics as `multiclass_nms`
     (threshold -> per-class top nms_top_k -> NMS -> global top keep_top_k)
     but with static shapes throughout (the TPU-native serving variant)."""
-    bv = unwrap(bboxes)
-    sv = unwrap(scores)
-    c, n = sv.shape
-
-    def raw(bv, sv):
-        iou = _iou_matrix(bv, bv)
-        topn = min(nms_top_k, n) if nms_top_k and nms_top_k > 0 else n
-
-        def per_class(srow):
-            svm = jnp.where(srow >= score_threshold, srow, -jnp.inf)
-            order = jnp.argsort(-svm)
-            valid_sorted = jnp.isfinite(svm[order]) & (jnp.arange(n) < topn)
-            iou_o = iou[order][:, order]
-            keep = _greedy_suppress(iou_o, valid_sorted, nms_threshold)
-            return jnp.zeros((n,), bool).at[order].set(keep)
-
-        keep_cn = jax.vmap(per_class)(sv)          # (C, N)
-        if 0 <= background_label < c:
-            keep_cn = keep_cn.at[background_label].set(False)
-        flat = jnp.where(keep_cn, sv, -jnp.inf).reshape(-1)
-        k = min(keep_top_k, c * n)
-        top_s, top_i = jax.lax.top_k(flat, k)
-        cls = (top_i // n).astype(jnp.float32)
-        bix = top_i % n
-        valid = jnp.isfinite(top_s)
-        rows = jnp.concatenate(
-            [cls[:, None], jnp.where(valid, top_s, -1.0)[:, None],
-             bv[bix]], axis=1)
-        rows = jnp.where(valid[:, None], rows, -1.0)
-        if k < keep_top_k:
-            rows = jnp.concatenate(
-                [rows, jnp.full((keep_top_k - k, 6), -1.0)], axis=0)
-        return rows, jnp.sum(valid.astype(jnp.int32))
-
-    rows, count = raw(bv, sv)
+    rows, count = _nms_padded_raw(
+        unwrap(bboxes), unwrap(scores), score_threshold, nms_top_k,
+        keep_top_k, nms_threshold, background_label)
     return (Tensor(rows, stop_gradient=True),
             Tensor(count, stop_gradient=True))
 
@@ -1371,8 +1375,11 @@ def detection_output(loc, scores, prior_box, prior_box_var,  # noqa: A002
     TPU-native contract: FIXED output extents instead of LoD — returns
     (out (B, keep_top_k, 6) rows [label, score, x1, y1, x2, y2] padded
     with -1, valid counts (B,)), plus flat prior indices (B, keep_top_k)
-    when return_index.  Decode + NMS run on device (multiclass_nms_padded),
-    so the whole path jits for serving."""
+    when return_index.  Scores are raw confidences — softmax is applied
+    internally like the reference (detection.py:721), and the batch NMS is
+    a single `jax.vmap` program (the reference multiclass_nms op is
+    batched), so the whole path jits for serving with a B-independent
+    trace."""
     lv = unwrap(loc)
     sv = unwrap(scores)
     pb = unwrap(prior_box).reshape(-1, 4)
@@ -1383,16 +1390,18 @@ def detection_output(loc, scores, prior_box, prior_box_var,  # noqa: A002
         Tensor(pb), Tensor(pbv) if pbv is not None else None, Tensor(lv),
         code_type="decode_center_size", axis=1))                # (B, Np, 4)
 
-    outs, counts = [], []
-    for i in range(decoded.shape[0]):
-        rows, cnt = multiclass_nms_padded(
-            Tensor(decoded[i]), Tensor(sv[i].T), score_threshold,
-            nms_top_k, keep_top_k, nms_threshold=nms_threshold,
-            background_label=background_label)
-        outs.append(unwrap(rows))
-        counts.append(unwrap(cnt))
-    out = Tensor(jnp.stack(outs), stop_gradient=True)
-    cnts = Tensor(jnp.stack(counts), stop_gradient=True)
+    def raw(decoded, sv):
+        probs = jax.nn.softmax(sv.astype(jnp.float32), axis=-1)
+        probs_t = jnp.swapaxes(probs, 1, 2)                    # (B, C, Np)
+        rows, cnts = jax.vmap(
+            lambda d, s: _nms_padded_raw(
+                d, s, score_threshold, nms_top_k, keep_top_k,
+                nms_threshold, background_label))(decoded, probs_t)
+        return rows, cnts
+
+    rows, cnts = raw(decoded, sv)
+    out = Tensor(rows, stop_gradient=True)
+    cnts = Tensor(cnts, stop_gradient=True)
     if return_index:
         # index = argmax over priors of IoU with the kept box (exact match)
         def row_index(dec, rows):
@@ -1400,7 +1409,7 @@ def detection_output(loc, scores, prior_box, prior_box_var,  # noqa: A002
                 lambda r: _iou_matrix(r[None, 2:6], dec)[0])(rows)
             return jnp.where(rows[:, 0] >= 0,
                              jnp.argmax(ious, axis=1), -1).astype(jnp.int32)
-        flat = jax.vmap(row_index)(decoded, jnp.stack(outs))
+        flat = jax.vmap(row_index)(decoded, unwrap(out))
         return out, cnts, Tensor(flat, stop_gradient=True)
     return out, cnts
 

@@ -47,6 +47,46 @@ def test_density_prior_box_oracle():
     np.testing.assert_allclose(b2.numpy(), bn.reshape(-1, 4))
 
 
+def test_detection_output_softmax_contract_and_batched_trace(monkeypatch):
+    """detection_output takes RAW confidences and softmaxes internally
+    (reference detection.py:721), and the batch NMS is one vmapped trace
+    — `_nms_padded_raw` is traced exactly once per call regardless of B
+    (previously: a per-image Python loop, B traces)."""
+    rng = np.random.RandomState(7)
+    n_prior, n_cls = 8, 3
+    pb = np.zeros((n_prior, 4), "float32")
+    for i in range(n_prior):
+        x, y = (i % 4) * 0.25, (i // 4) * 0.5
+        pb[i] = [x, y, x + 0.2, y + 0.4]
+    pbv = np.full((n_prior, 4), 0.1, "float32")
+
+    calls = []
+    orig = ops._nms_padded_raw
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(ops, "_nms_padded_raw", counting)
+    for bsz in (1, 4):
+        loc = np.zeros((bsz, n_prior, 4), "float32")
+        logits = (rng.randn(bsz, n_prior, n_cls) * 3).astype("float32")
+        before = len(calls)
+        out, cnts = ops.detection_output(
+            paddle.to_tensor(loc), paddle.to_tensor(logits),
+            paddle.to_tensor(pb), paddle.to_tensor(pbv),
+            score_threshold=0.0, nms_threshold=0.45,
+            nms_top_k=8, keep_top_k=6)
+        assert len(calls) - before == 1, "NMS must trace once per call"
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        on, cn = out.numpy(), cnts.numpy()
+        for b in range(bsz):
+            assert cn[b] >= 1
+            # top row's score is the softmax prob of the best non-bg class
+            np.testing.assert_allclose(
+                on[b, 0, 1], probs[b, :, 1:].max(), rtol=1e-5)
+
+
 def _np_ssd_loss(loc, conf, gtb, gtl, pb, pbv, neg_pos_ratio=3.0,
                  neg_overlap=0.5, overlap_threshold=0.5):
     """Independent numpy build of the SSD loss definition (reference
