@@ -18,6 +18,7 @@ from ..utils.checkpoint import (  # noqa: F401
 )
 from ..distributed.parallel_layer import DataParallel  # noqa: F401
 from ..jit import to_static as jit_to_static  # noqa: F401
+from ..jit import TracedLayer  # noqa: F401
 
 
 @contextlib.contextmanager

@@ -83,6 +83,24 @@ from .layers_extra import *  # noqa: F401,F403,E402  (nn/control_flow/loss/
 # kept OUT of layers_extra so its internal loops keep the builtin range
 from ..tensor.creation import arange as range  # noqa: F401,E402,A004
 
+# --- metrics (reference fluid/layers/metric_op.py __all__) --------------
+from ..metric import accuracy, auc  # noqa: F401,E402
+
+# --- LR decay functional family (reference learning_rate_scheduler.py) --
+from . import learning_rate_scheduler  # noqa: F401,E402
+from .learning_rate_scheduler import (  # noqa: F401,E402
+    exponential_decay, natural_exp_decay, inverse_time_decay,
+    polynomial_decay, piecewise_decay, noam_decay, cosine_decay,
+    linear_lr_warmup,
+)
+
+
+def hard_shrink(x, threshold=None):
+    """fluid.layers.hard_shrink (reference fluid/layers/ops.py:449, a
+    generate_layer_fn over hard_shrink_op; threshold defaults to 0.5)."""
+    from ..nn.functional import hardshrink
+    return hardshrink(x, 0.5 if threshold is None else threshold)
+
 
 def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
                    head=None, **kwargs):

@@ -1,0 +1,92 @@
+"""fluid.layers learning-rate decay functional family (reference:
+python/paddle/fluid/layers/learning_rate_scheduler.py __all__ =
+exponential_decay, natural_exp_decay, inverse_time_decay,
+polynomial_decay, piecewise_decay, noam_decay, cosine_decay,
+linear_lr_warmup).
+
+Era contract: each returns a decayed learning rate driven by the global
+step counter (@LR_DECAY_COUNTER@).  TPU-native: each returns an
+`optimizer.lr.LRScheduler` implementing the exact reference formula —
+the object plugs into `paddle.optimizer.*(learning_rate=...)` the way the
+reference's Variable plugged into fluid optimizers, and `scheduler.step()`
+is the step counter.
+"""
+from __future__ import annotations
+
+import math
+
+from ..optimizer import lr as _lr
+
+__all__ = [
+    "exponential_decay", "natural_exp_decay", "inverse_time_decay",
+    "polynomial_decay", "piecewise_decay", "noam_decay", "cosine_decay",
+    "linear_lr_warmup",
+]
+
+
+def noam_decay(d_model, warmup_steps, learning_rate=1.0):
+    """lr * d_model^-0.5 * min(step^-0.5, step * warmup^-1.5)
+    (learning_rate_scheduler.py:53)."""
+    return _lr.NoamDecay(d_model, warmup_steps, learning_rate=learning_rate)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * decay_rate^(step/decay_steps), floored when staircase
+    (learning_rate_scheduler.py:113)."""
+    def lam(step):
+        div = step / float(decay_steps)
+        return decay_rate ** (math.floor(div) if staircase else div)
+    return _lr.LambdaDecay(learning_rate, lam)
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate,
+                      staircase=False):
+    """lr * exp(-decay_rate * step/decay_steps)
+    (learning_rate_scheduler.py:174)."""
+    def lam(step):
+        div = step / float(decay_steps)
+        return math.exp(-decay_rate * (math.floor(div) if staircase
+                                       else div))
+    return _lr.LambdaDecay(learning_rate, lam)
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate,
+                       staircase=False):
+    """lr / (1 + decay_rate * step/decay_steps)
+    (learning_rate_scheduler.py:235)."""
+    def lam(step):
+        div = step / float(decay_steps)
+        return 1.0 / (1.0 + decay_rate * (math.floor(div) if staircase
+                                          else div))
+    return _lr.LambdaDecay(learning_rate, lam)
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    """(lr - end) * (1 - step/decay_steps)^power + end
+    (learning_rate_scheduler.py:296)."""
+    return _lr.PolynomialDecay(learning_rate, decay_steps,
+                               end_lr=end_learning_rate, power=power,
+                               cycle=cycle)
+
+
+def piecewise_decay(boundaries, values):
+    """Step function over step-count boundaries
+    (learning_rate_scheduler.py:378)."""
+    return _lr.PiecewiseDecay(boundaries, values)
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    """lr * 0.5 * (cos(floor(step/step_each_epoch) * pi / epochs) + 1)
+    (learning_rate_scheduler.py:444)."""
+    def lam(step):
+        cur_epoch = math.floor(step / float(step_each_epoch))
+        return 0.5 * (math.cos(cur_epoch * math.pi / epochs) + 1)
+    return _lr.LambdaDecay(learning_rate, lam)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    """start_lr -> end_lr over warmup_steps, then learning_rate (float or
+    another scheduler) (learning_rate_scheduler.py:490)."""
+    return _lr.LinearWarmup(learning_rate, warmup_steps, start_lr, end_lr)

@@ -729,3 +729,89 @@ class ProgramTranslator:
 
     def enable(self, flag):
         pass
+
+
+class TracedLayer:
+    """Trace a dygraph Layer once into a compiled static callable
+    (reference: fluid/dygraph/jit.py:1046 — a Program + Executor sharing
+    the layer's parameters).  TPU-native: the "program" is the
+    StaticFunction jit of the layer's forward; `save_inference_model`
+    serializes the StableHLO artifact via `jit.save` with specs taken
+    from the traced example inputs.
+
+    Use `TracedLayer.trace(layer, inputs)`, not the constructor.
+    """
+
+    def __init__(self, layer, example_inputs, outputs):
+        self._layer = layer
+        self._static = StaticFunction(layer.forward, layer=layer)
+        self._example = tuple(example_inputs)
+        self._n_outputs = (len(outputs)
+                           if isinstance(outputs, (list, tuple)) else 1)
+
+    @staticmethod
+    def trace(layer, inputs):
+        """Returns (dygraph outputs, TracedLayer) like the reference."""
+        ins = tuple(inputs)
+        out = layer(*_wrap_args(ins))
+        return out, TracedLayer(layer, ins, out)
+
+    def __call__(self, inputs):
+        """Run the compiled program on a LIST of inputs; returns the
+        outputs as a list (the reference fetch-list convention)."""
+        out = self._static(*inputs)
+        return list(out) if isinstance(out, (list, tuple)) else [out]
+
+    def set_strategy(self, build_strategy=None, exec_strategy=None):
+        """No-op: XLA owns build/exec strategy (the reference attaches
+        BuildStrategy/ExecutionStrategy to its CompiledProgram)."""
+
+    def save_inference_model(self, path, feed=None, fetch=None, **config):
+        specs = [InputSpec(tuple(unwrap(a).shape), str(unwrap(a).dtype))
+                 for a in self._example]
+        if feed is not None and sorted(feed) != list(range(len(specs))):
+            raise NotImplementedError(
+                "TracedLayer.save_inference_model: feed must cover all "
+                "traced inputs (input subsetting would change the traced "
+                "program)")
+        if fetch is not None and sorted(fetch) != list(
+                range(self._n_outputs)):
+            raise NotImplementedError(
+                "TracedLayer.save_inference_model: fetch must cover all "
+                "traced outputs")
+        save(self._layer, path, input_spec=specs, **config)
+
+
+# dy2static debug logging (reference: fluid/dygraph/dygraph_to_static/
+# logging_utils.py:182,221, re-exported from paddle.jit).  There is no
+# source transform here (tracing IS program capture), so the knobs gate
+# how loudly jit builds report: level >= 1 turns on jax compilation logs.
+_VERBOSITY = 0
+_CODE_LEVEL = -1
+_PREV_JAX_LOG_LEVEL = None
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _VERBOSITY, _PREV_JAX_LOG_LEVEL
+    import logging
+    logger = logging.getLogger("jax")
+    new = int(level)
+    if new >= 1 and _VERBOSITY < 1:
+        _PREV_JAX_LOG_LEVEL = logger.level  # restore on lowering
+        logger.setLevel(logging.DEBUG)
+    elif new < 1 and _VERBOSITY >= 1:
+        logger.setLevel(_PREV_JAX_LOG_LEVEL or logging.WARNING)
+    _VERBOSITY = new
+
+
+def get_verbosity():
+    return _VERBOSITY
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    global _CODE_LEVEL
+    _CODE_LEVEL = int(level)
+
+
+def get_code_level():
+    return _CODE_LEVEL

@@ -178,6 +178,58 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):  # noqa: A
     return dispatch("accuracy", raw, input, label)
 
 
+# one accumulator per (curve, num_thresholds): the reference's
+# fluid.layers.auc binds persistent stat variables to the single auc op in
+# the program, accumulated across exe.run calls — the eager analogue is a
+# module-level stream per config (use metric.Auc for independent streams)
+_AUC_STREAMS = {}
+
+
+def auc(input, label, curve="ROC", num_thresholds=2 ** 12 - 1,  # noqa: A002
+        topk=1, slide_steps=1, name=None):
+    """Functional streaming AUC (reference: layers/metric_op.py:111 over
+    operators/metrics/auc_op).  Returns (accumulated auc, batch auc,
+    [batch_stat_pos, batch_stat_neg, stat_pos, stat_neg]) like the
+    reference's (auc_out, batch_auc_out, state list).
+
+    Deviations from the reference, stated rather than silent: the batch
+    statistic is the exact CURRENT batch (not a slide_steps window), and a
+    new eval stream needs `metric.auc.reset()` (the reference binds fresh
+    stat variables per program; use `metric.Auc` for independent
+    streams)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    from ..core.errors import UnimplementedError
+    if topk != 1:
+        raise UnimplementedError(
+            "auc: topk != 1 is not supported (the reference only consumes "
+            "the positive-class column as well — pass 2-column preds)")
+    key = (curve, int(num_thresholds))
+    stream = _AUC_STREAMS.get(key)
+    if stream is None:
+        stream = _AUC_STREAMS[key] = Auc(curve=curve,
+                                         num_thresholds=num_thresholds)
+    batch = Auc(curve=curve, num_thresholds=num_thresholds)
+    batch.update(input, label)
+    stream._stat_pos += batch._stat_pos
+    stream._stat_neg += batch._stat_neg
+    stats = [batch._stat_pos, batch._stat_neg,
+             stream._stat_pos.copy(), stream._stat_neg.copy()]
+    return (Tensor(jnp.asarray(stream.accumulate(), jnp.float32),
+                   stop_gradient=True),
+            Tensor(jnp.asarray(batch.accumulate(), jnp.float32),
+                   stop_gradient=True),
+            [Tensor(jnp.asarray(s), stop_gradient=True) for s in stats])
+
+
+def _auc_reset():
+    """Clear all functional-auc accumulation streams (fresh eval run)."""
+    _AUC_STREAMS.clear()
+
+
+auc.reset = _auc_reset
+
+
 # ---------------------------------------------------------------------------
 # functional metric ops (reference: python/paddle/metric/metrics.py exposes
 # accuracy + the fluid ops mean_iou / chunk_eval)

@@ -42,6 +42,11 @@ from .layer.moe import MoELayer  # noqa: F401
 from .decode import (Decoder, BeamSearchDecoder, dynamic_decode,  # noqa: F401
                      gather_tree)
 from . import utils  # noqa: F401,E402
+# era-importable submodule aliases (reference nn/__init__.py:18-21 +
+# 158-160 binds layer.{norm,common,rnn,loss,conv,vision} and
+# functional.extension as paddle.nn attributes)
+from .layer import common, conv, loss, norm, rnn, vision  # noqa: F401,E402
+from .functional import extension  # noqa: F401,E402
 from .legacy_layers import (HSigmoidLoss, NCELoss, RowConv, Pool2D,  # noqa: F401,E402
                             StaticRNN, BilinearTensorProduct,
                             ctc_greedy_decoder, clip_by_norm, nce)

@@ -262,3 +262,11 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     from ...vision.ops import ssd_loss as _impl
     return _impl(location, confidence, gt_box, gt_label, prior_box,
                  prior_box_var, **kwargs)
+
+
+# era spellings surfaced under nn.functional (reference
+# nn/functional/__init__.py:71 `from .common import assign` and :97
+# `from .extension import diag_embed`)
+from ...tensor.creation import assign  # noqa: F401,E402
+from ...tensor.manipulation import diag_embed  # noqa: F401,E402
+from . import extension  # noqa: F401,E402

@@ -1,0 +1,16 @@
+"""paddle.nn.functional.extension (reference: python/paddle/nn/functional/
+extension.py, __all__ = ['diag_embed', 'row_conv'], surfaced as
+`paddle.nn.extension` via nn/__init__.py:19)."""
+from ...tensor.manipulation import diag_embed  # noqa: F401
+
+__all__ = ["diag_embed", "row_conv"]
+
+
+def row_conv(input, future_context_size, weight=None, act=None,  # noqa: A002
+             param_attr=None):
+    """Lookahead row convolution (reference row_conv_op).  Lazy import:
+    the implementation lives in fluid.layers_extra, which itself imports
+    nn.functional at module load."""
+    from ...fluid.layers_extra import row_conv as _impl
+    return _impl(input, future_context_size, weight=weight, act=act,
+                 param_attr=param_attr)

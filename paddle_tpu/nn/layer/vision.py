@@ -1,0 +1,6 @@
+"""paddle.nn.vision submodule alias (reference: python/paddle/nn/layer/
+vision.py, __all__ = ['PixelShuffle'], surfaced as `paddle.nn.vision`
+via nn/__init__.py:160)."""
+from .common import PixelShuffle  # noqa: F401
+
+__all__ = ["PixelShuffle"]

@@ -1,0 +1,167 @@
+"""Round-5 API closure (VERDICT r4 missing #1-3): jit.TracedLayer +
+dy2static logging knobs, fluid.layers.accuracy/auc, the fluid LR-decay
+functional family, hard_shrink, paddle.nn submodule aliases, and
+F.assign/F.diag_embed."""
+import math
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.fluid import layers as fl
+
+
+class _Small(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 3)
+
+    def forward(self, x):
+        return F.relu(self.fc(x))
+
+
+def test_traced_layer_trace_call_and_save():
+    paddle.seed(0)
+    layer = _Small()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 4).astype("float32"))
+    out, traced = paddle.jit.TracedLayer.trace(layer, inputs=[x])
+    # static call parity (list-in/list-out fetch convention)
+    got = traced([x])
+    assert isinstance(got, list) and len(got) == 1
+    np.testing.assert_allclose(got[0].numpy(), out.numpy(), rtol=1e-6)
+    traced.set_strategy()  # no-op, must exist
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "infer")
+        traced.save_inference_model(p)
+        loaded = paddle.jit.load(p)
+        np.testing.assert_allclose(loaded(x).numpy(), out.numpy(),
+                                   rtol=1e-5)
+        with pytest.raises(NotImplementedError):
+            traced.save_inference_model(p, fetch=[])
+
+
+def test_dy2static_logging_knobs():
+    paddle.jit.set_verbosity(1)
+    assert paddle.jit.get_verbosity() == 1
+    paddle.jit.set_verbosity(0)
+    paddle.jit.set_code_level(50)
+    assert paddle.jit.get_code_level() == 50
+    # also reachable via fluid.dygraph (reference re-export)
+    from paddle_tpu.fluid import dygraph
+    assert dygraph.TracedLayer is paddle.jit.TracedLayer
+
+
+def test_fluid_accuracy():
+    scores = paddle.to_tensor(np.array(
+        [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], "float32"))
+    label = paddle.to_tensor(np.array([[1], [1], [1]], "int64"))
+    acc = fl.accuracy(scores, label, k=1)
+    np.testing.assert_allclose(float(acc), 2.0 / 3.0, rtol=1e-6)
+
+
+def test_fluid_auc_batch_and_accumulation():
+    # bin-exact preds (multiples of 1/32, num_thresholds 1023 keeps one
+    # sample per bin) -> histogram-trapezoid AUC == rank-statistic AUC
+    def rank_auc(p, y):
+        order = np.argsort(p)
+        ranks = np.empty(len(p))
+        ranks[order] = np.arange(1, len(p) + 1)
+        npos, nneg = int(y.sum()), int((1 - y).sum())
+        return (ranks[y == 1].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+
+    p1 = np.array([1, 5, 9, 13, 17, 21], "float64") / 32.0
+    y1 = np.array([0, 1, 0, 1, 1, 0])
+    p2 = np.array([3, 7, 11, 25, 29], "float64") / 32.0
+    y2 = np.array([1, 0, 0, 1, 0])
+
+    g1, b1, stats = fl.auc(
+        paddle.to_tensor(p1.astype("float32").reshape(-1, 1)),
+        paddle.to_tensor(y1.astype("int64")), num_thresholds=1023)
+    np.testing.assert_allclose(float(b1), rank_auc(p1, y1), rtol=1e-6)
+    np.testing.assert_allclose(float(g1), rank_auc(p1, y1), rtol=1e-6)
+    assert len(stats) == 4
+
+    g2, b2, _ = fl.auc(
+        paddle.to_tensor(p2.astype("float32").reshape(-1, 1)),
+        paddle.to_tensor(y2.astype("int64")), num_thresholds=1023)
+    np.testing.assert_allclose(float(b2), rank_auc(p2, y2), rtol=1e-6)
+    # accumulated over both batches
+    np.testing.assert_allclose(
+        float(g2), rank_auc(np.concatenate([p1, p2]),
+                            np.concatenate([y1, y2])), rtol=1e-6)
+
+    # reset clears the stream; unsupported topk errors instead of lying
+    fl.auc.reset()
+    g3, _, _ = fl.auc(
+        paddle.to_tensor(p1.astype("float32").reshape(-1, 1)),
+        paddle.to_tensor(y1.astype("int64")), num_thresholds=1023)
+    np.testing.assert_allclose(float(g3), rank_auc(p1, y1), rtol=1e-6)
+    with pytest.raises(Exception, match="topk"):
+        fl.auc(paddle.to_tensor(p1.astype("float32").reshape(-1, 1)),
+               paddle.to_tensor(y1.astype("int64")), topk=2)
+
+
+def _lr_at(sched, n):
+    for _ in range(n):
+        sched.step()
+    return sched()
+
+
+def test_lr_decay_functional_family():
+    assert math.isclose(_lr_at(fl.exponential_decay(0.1, 10, 0.5), 5),
+                        0.1 * 0.5 ** 0.5)
+    assert math.isclose(
+        _lr_at(fl.exponential_decay(0.1, 10, 0.5, staircase=True), 5), 0.1)
+    assert math.isclose(_lr_at(fl.natural_exp_decay(0.1, 10, 0.5), 5),
+                        0.1 * math.exp(-0.5 * 0.5))
+    assert math.isclose(_lr_at(fl.inverse_time_decay(0.1, 10, 0.5), 5),
+                        0.1 / (1 + 0.5 * 0.5))
+    assert math.isclose(
+        _lr_at(fl.polynomial_decay(0.1, 10, end_learning_rate=0.01,
+                                   power=1.0), 5), 0.055)
+    pw = fl.piecewise_decay([3, 6], [0.1, 0.05, 0.01])
+    assert math.isclose(pw(), 0.1)
+    assert math.isclose(_lr_at(pw, 4), 0.05)
+    assert math.isclose(_lr_at(pw, 3), 0.01)
+    assert math.isclose(
+        _lr_at(fl.noam_decay(64, 100, learning_rate=2.0), 5),
+        2.0 * 64 ** -0.5 * min(5 ** -0.5, 5 * 100 ** -1.5))
+    assert math.isclose(
+        _lr_at(fl.cosine_decay(0.1, step_each_epoch=10, epochs=4), 15),
+        0.1 * 0.5 * (math.cos(math.pi / 4) + 1))
+    warm = fl.linear_lr_warmup(0.1, 10, 0.0, 0.1)
+    assert math.isclose(_lr_at(warm, 5), 0.05)
+    assert math.isclose(_lr_at(warm, 7), 0.1)
+    # module spelling exists too (reference learning_rate_scheduler module)
+    assert fl.learning_rate_scheduler.noam_decay is fl.noam_decay
+
+
+def test_hard_shrink():
+    x = paddle.to_tensor(np.array([-1.0, -0.3, 0.0, 0.4, 2.0], "float32"))
+    np.testing.assert_allclose(fl.hard_shrink(x).numpy(),
+                               [-1.0, 0.0, 0.0, 0.0, 2.0])
+    np.testing.assert_allclose(fl.hard_shrink(x, threshold=1.5).numpy(),
+                               [0.0, 0.0, 0.0, 0.0, 2.0])
+
+
+def test_nn_submodule_aliases():
+    assert paddle.nn.common.Linear is paddle.nn.Linear
+    assert paddle.nn.conv.Conv2D is paddle.nn.Conv2D
+    assert paddle.nn.loss.CrossEntropyLoss is paddle.nn.CrossEntropyLoss
+    assert paddle.nn.norm.LayerNorm is paddle.nn.LayerNorm
+    assert paddle.nn.rnn.LSTM is paddle.nn.LSTM
+    assert paddle.nn.vision.PixelShuffle is paddle.nn.PixelShuffle
+    assert callable(paddle.nn.extension.diag_embed)
+    assert callable(paddle.nn.extension.row_conv)
+
+
+def test_functional_assign_and_diag_embed():
+    x = np.array([[1.0, 2.0]], "float32")
+    np.testing.assert_allclose(F.assign(paddle.to_tensor(x)).numpy(), x)
+    d = F.diag_embed(paddle.to_tensor(np.array([1.0, 2.0], "float32")))
+    np.testing.assert_allclose(d.numpy(), [[1.0, 0.0], [0.0, 2.0]])
