@@ -74,6 +74,51 @@ def _rep_stats(rep_ms):
             "step_ms_spread": round((max(rep_ms) - min(rep_ms)) / 2, 2)}
 
 
+# slot qualification (r4 verdict #1): the pool hands out variable-quality
+# chips; a 5-second fixed-matmul microbench qualifies the slot BEFORE the
+# expensive model leg.  Good v5e slots measure 185-190 TF/s net (96% of
+# the 197 bf16 peak, measured r5); below SLOT_MIN_TF_S the leg bails fast
+# and the orchestrator re-rolls the chip in a new subprocess.
+SLOT_EXPECT_TF_S = 186.0
+SLOT_MIN_TF_S = 160.0
+
+
+def slot_calibration(n=8192, k_long=18, k_short=2):
+    """bf16 matmul rate NET of the tunnel roundtrip: time k_long vs
+    k_short independent (n,n)@(n,n) dots in one jit each and difference
+    them — the fixed dispatch+sync latency (~60-110 ms through the axon
+    tunnel, measured r5) cancels.  Chained same-weight matmul forms
+    over-read (~265 'TF/s' on a 197-peak chip, r5 measurement) — the
+    independent-products difference form reads 186-189 on a good slot."""
+    import jax
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(n, n) * 0.05, jnp.bfloat16)
+    b = jnp.asarray(rng.randn(n, n) * 0.05, jnp.bfloat16)
+
+    def make(k):
+        @jax.jit
+        def f(a, b):
+            y = jnp.float32(0)
+            for i in range(k):
+                y = y + jnp.sum(
+                    ((a * jnp.bfloat16(1 + i)) @ b).astype(jnp.float32))
+            return y
+        return f
+
+    t = {}
+    for k in (k_short, k_long):
+        fk = make(k)
+        float(fk(a, b))  # compile + sync
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(fk(a, b))
+            reps.append(time.perf_counter() - t0)
+        t[k] = min(reps)
+    return (k_long - k_short) * 2 * n ** 3 / (t[k_long] - t[k_short]) / 1e12
+
+
 def measure_bert(on_tpu):
     import paddle_tpu as paddle
     from paddle_tpu import models
@@ -158,6 +203,24 @@ def measure_bert(on_tpu):
                        f"{calls_per_rep}x{k_per_call} steps, sync per rep",
         "loss": final_loss,
     }
+    if on_tpu:
+        # r5 head-component table (probes/bert_head_probe.py, solo
+        # processes, same-day slots; encsum reproduced 89.2/88.9 across
+        # the series): the 30k-vocab MLM head is ALREADY at its component
+        # floor — head matmuls cost exactly their FLOP share at the
+        # practical dense rate, and the CE cost is implementation-
+        # independent (generic f32 / bf16 / fused-chunked 1024+2048 /
+        # closed-form custom-vjp all within ±1.5 ms).  The ERNIE gap is
+        # vocab size (18k vs 30.5k), not a BERT scheduling defect.
+        out["head_components"] = {
+            "encoder_only_ms": 89.2, "head_matmul_ms": 5.6,
+            "ce_ms": 9.0,
+            "head_matmul_flop_share_ms": 6.0,
+            "ce_impl_sweep_ms": {"generic_f32": 103.8, "bf16": 102.2,
+                                 "fused_c2048": 106.3, "fused_c1024": 103.2,
+                                 "fast_custom_vjp": 102.9},
+            "basis": "probes/bert_head_probe.py r5; baseline slot that "
+                     "day 103-104 ms (chip lottery; r4 98.6)"}
     out.update(_rep_stats(reps))
     return out
 
@@ -169,19 +232,33 @@ def _run_tpu_probe(script, tag, timeout, smoke=False):
     r3 resnet 39ms-probe vs 50.45ms-bench discrepancy, reproduced and
     closed in r4) — so every secondary config is measured solo.
 
+    Slot qualification (r4 verdict #1): each subprocess first runs the
+    5-second `slot_calibration` matmul; a slot under SLOT_MIN_TF_S bails
+    BEFORE the model compile and this orchestrator re-rolls the chip with
+    a PER-CONFIG retry budget.  The published contract: every config's
+    step_ms must land within 5% of its solo-probe expectation
+    (_EXPECT_STEP_MS) or carry an explicit slot_degraded flag; slot_tf_s
+    rides in every config's detail.
+
     smoke=True runs the SAME script at tiny shapes on CPU, so script-string
     breakage surfaces off-TPU instead of minutes into a remote compile."""
     env = dict(os.environ)
+    env["PDTPU_BENCH_TAG"] = tag
     if smoke:
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env["JAX_PLATFORMS"] = "cpu"
         env["PDTPU_BENCH_SMOKE"] = "1"
 
-    def once():
+    def once(force_slot=False):
+        e = dict(env)
+        if force_slot:
+            # last attempt: measure even on a bad slot (a flagged number
+            # beats no number) — slot_degraded marks it below
+            e["PDTPU_IGNORE_SLOT"] = "1"
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", script], capture_output=True,
-                text=True, timeout=timeout, env=env,
+                text=True, timeout=timeout, env=e,
                 cwd=os.path.dirname(os.path.abspath(__file__)))
         except subprocess.TimeoutExpired:
             return {"error": f"probe timed out after {timeout}s"}
@@ -190,44 +267,73 @@ def _run_tpu_probe(script, tag, timeout, smoke=False):
                 return json.loads(line[len(tag):])
         return {"error": (proc.stderr or proc.stdout)[-400:]}
 
-    out = once()
-    # the tunnel pool hands each process a chip, and a bad slot shows up
-    # as an outright error/timeout, as high rep spread (117 ms solo vs
-    # 156 ms ± 12 measured r4), or as a UNIFORMLY slow run the spread
-    # can't catch — so also retry when the mean exceeds the recorded solo
-    # expectation by >12%.  Retries are budgeted bench-wide; the faster
-    # run wins, the discarded number stays visible.
-    global _RETRY_BUDGET
-    if not smoke and isinstance(out, dict):
-        if "error" in out and _RETRY_BUDGET > 0:
-            _RETRY_BUDGET -= 1
-            again = once()
-            if "error" not in again:
-                again["first_attempt_error"] = str(out["error"])[:120]
-                return again
-            return out
-        spread = out.get("step_ms_spread", 0) or 0
-        mean = out.get("step_ms", 0) or 0
-        expect = _EXPECT_STEP_MS.get(tag)
-        noisy = mean and (spread / mean > 0.04
-                          or (expect and mean > 1.12 * expect))
-        if noisy and _RETRY_BUDGET > 0:
-            _RETRY_BUDGET -= 1
-            again = once()
-            if ("error" not in again
-                    and again.get("step_ms", 1e9) < mean):
-                again["discarded_noisy_run_step_ms"] = mean
-                return again
-            out["retry_step_ms"] = again.get(
-                "step_ms", str(again.get("error", "?"))[:120])
+    if smoke:
+        return once()
+
+    expect = _EXPECT_STEP_MS.get(tag)
+
+    def run_ok(o):
+        mean = o.get("step_ms") or 0
+        spread = o.get("step_ms_spread", 0) or 0
+        return bool(mean) and spread / mean <= 0.04 \
+            and (not expect or mean <= 1.05 * expect) \
+            and (o.get("slot_tf_s") or SLOT_EXPECT_TF_S) >= SLOT_MIN_TF_S
+
+    best, best_ms, history = None, float("inf"), []
+    budget = _RETRY_BUDGET_PER_CONFIG
+    while True:
+        last = budget <= 0
+        out = once(force_slot=last)
+        if not isinstance(out, dict):
+            out = {"error": str(out)[:200]}
+        if out.get("slot_bailed"):
+            history.append({"slot_bailed_tf_s": out.get("slot_tf_s")})
+            budget -= 1
+            continue
+        if "error" in out:
+            history.append({"error": str(out["error"])[:120]})
+            if last:
+                break
+            budget -= 1
+            continue
+        mean = out.get("step_ms") or 0
+        if mean and mean < best_ms:
+            best, best_ms = out, mean
+        if run_ok(out) or last:
+            break
+        history.append({"retry_step_ms": mean,
+                        "slot_tf_s": out.get("slot_tf_s")})
+        budget -= 1
+    # publish a QUALIFYING run when one exists; a disqualified-but-faster
+    # attempt must never displace it (it is visible in `attempts`).  Only
+    # when no attempt qualified does the fastest measured run win — and
+    # then it carries the slot_degraded flag below.
+    if "error" in out and best is not None:
+        out = best
+    elif not run_ok(out) and best is not None and best_ms < (
+            out.get("step_ms") or float("inf")):
+        out = best
+    if history:
+        out["attempts"] = history
+    if out.get("step_ms"):
+        if expect:
+            out["expect_step_ms"] = expect
+            out["within_expectation"] = bool(
+                out["step_ms"] <= 1.05 * expect)
+        # the published contract: ANY disqualifier on the winning run —
+        # over-expectation mean, >4% rep spread, or an under-par slot —
+        # flags the number explicitly
+        if not run_ok(out):
+            out["slot_degraded"] = True
     return out
 
 
-# solo-process expectations from the r4 probe sweeps (the retry trigger
-# for uniformly-slow pool slots); 2 retries bench-wide bound wall time
+# solo-process expectations from the r4/r5 probe sweeps — the PUBLISHED
+# CONTRACT (r4 verdict #1): a config whose mean exceeds expectation by
+# >5% after the per-config retry budget is flagged slot_degraded
 _EXPECT_STEP_MS = {"BERT": 99.0, "RESNET": 122.0, "GPT2": 118.0,
                    "ERNIE": 86.0}
-_RETRY_BUDGET = 2
+_RETRY_BUDGET_PER_CONFIG = 2
 
 
 def run_reps(step, args, k, warmup=2, reps=3):
@@ -253,13 +359,24 @@ jax.config.update("jax_default_prng_impl", "rbg")
 import paddle_tpu as paddle
 from paddle_tpu.jit import TrainStep
 from bench import (run_reps, _rep_stats as rep_stats, detect_peak_tflops,
-                   bert_train_flops, gpt_train_flops,
-                   RESNET50_TRAIN_FLOPS_PER_IMG)
+                   bert_train_flops, gpt_train_flops, slot_calibration,
+                   SLOT_MIN_TF_S, RESNET50_TRAIN_FLOPS_PER_IMG)
 
 # PDTPU_BENCH_SMOKE=1: tiny shapes on CPU so the script strings stay
 # executable off-TPU (a NameError must not wait for the remote compile)
 SMOKE = os.environ.get("PDTPU_BENCH_SMOKE") == "1"
 PEAK = detect_peak_tflops() * 1e12
+
+# slot qualification BEFORE the expensive model compile: a below-par pool
+# chip bails fast so the orchestrator can re-roll it (r4 verdict #1)
+SLOT_TF_S = None
+if not SMOKE:
+    SLOT_TF_S = round(slot_calibration(), 1)
+    if (SLOT_TF_S < SLOT_MIN_TF_S
+            and os.environ.get("PDTPU_IGNORE_SLOT") != "1"):
+        print(os.environ.get("PDTPU_BENCH_TAG", "") + json.dumps(
+            {"slot_bailed": True, "slot_tf_s": SLOT_TF_S}), flush=True)
+        raise SystemExit(0)
 """
 
 
@@ -303,7 +420,8 @@ out = {"samples_per_sec_per_chip": round(sps, 1),
        "config": f"resnet50-b{batch}-{hw}-O2" if not SMOKE
        else "resnet18-cpu-smoke",
        "methodology": f"solo process, warmup 2x{k} steps, 3 reps of "
-                      f"{k} steps, sync per rep"}
+                      f"{k} steps, sync per rep",
+       "slot_tf_s": SLOT_TF_S}
 out.update(rep_stats(reps))
 print("RESNET" + json.dumps(out), flush=True)
 """
@@ -357,9 +475,28 @@ out = {"tokens_per_sec_per_chip": round(batch * seq / dt, 1),
        "config": ("gpt2-medium-1024" if not SMOKE
                   else "gpt2-tiny-cpu-smoke"),
        "methodology": "solo process, warmup 2x5 steps, 3 reps of 5 steps",
-       "ceiling_note": "attention (d=64) ~16 TF/s row-rate-bound + dense "
-                       "~128 TF/s -> ~44% component ceiling; see script "
-                       "comment for the full r4 sweep table"}
+       "slot_tf_s": SLOT_TF_S}
+if not SMOKE:
+    # the measured shape-ceiling, published IN the artifact (r4 verdict
+    # #4): dense matmuls at the measured practical rate + d=64 attention
+    # at the MXU row-rate bound give the component floor this config
+    # cannot beat without a model change (bigger heads / seq split)
+    dense_tf, dense_rate = 8.7, 128.0
+    attn_tf, attn_rate = 0.63, 16.0
+    floor_ms = (dense_tf / dense_rate + attn_tf / attn_rate) * 1e3
+    out["ceiling"] = {
+        "floor_ms": round(floor_ms, 1),
+        "dense_tf": dense_tf, "dense_rate_tf_s": dense_rate,
+        "attn_tf": attn_tf, "attn_rate_tf_s": attn_rate,
+        "ceiling_mfu_pct": round(flops / (floor_ms / 1e3) / PEAK * 100.0,
+                                 1),
+        "achieved_pct_of_floor": round(floor_ms / (dt * 1e3) * 100.0, 1),
+        "basis": "dense rate = measured pure-matmul chain at these "
+                 "shapes (bench BERT r3 notes); attn rate = measured "
+                 "(512,512,64) per-head dot bound, kernel-independent "
+                 "at d=64 (r2 flash sweep); r4 sweep: fused CE/blk256/"
+                 "b6/b8 all measured worse (probes/gpt2_probe_results"
+                 ".txt)"}
 out.update(rep_stats(reps))
 print("GPT2" + json.dumps(out), flush=True)
 """
@@ -400,7 +537,8 @@ out = {"tokens_per_sec_per_chip": round(batch * seq / dt, 1),
        "mfu": round(flops / dt / PEAK * 100.0, 2) if not SMOKE else None,
        "config": ("ernie-large-512" if not SMOKE
                   else "ernie-tiny-cpu-smoke"),
-       "methodology": "solo process, warmup 2x20 steps, 3 reps of 20 steps"}
+       "methodology": "solo process, warmup 2x20 steps, 3 reps of 20 steps",
+       "slot_tf_s": SLOT_TF_S}
 out.update(rep_stats(reps))
 print("ERNIE" + json.dumps(out), flush=True)
 """
@@ -574,12 +712,19 @@ def measure_pipeline_ratio():
 
 
 _BERT_TPU_SCRIPT = r"""
-import jax, json
+import jax, json, os
 # TPU HW RNG for dropout masks: XLA's threefry lowering burns VPU int
 # ops (~16 ms/step measured standalone); rbg uses the on-chip generator.
 jax.config.update("jax_default_prng_impl", "rbg")
-from bench import measure_bert
-print("BERT" + json.dumps(measure_bert(True)), flush=True)
+from bench import measure_bert, slot_calibration, SLOT_MIN_TF_S
+slot = round(slot_calibration(), 1)
+if slot < SLOT_MIN_TF_S and os.environ.get("PDTPU_IGNORE_SLOT") != "1":
+    print("BERT" + json.dumps({"slot_bailed": True, "slot_tf_s": slot}),
+          flush=True)
+    raise SystemExit(0)
+out = measure_bert(True)
+out["slot_tf_s"] = slot
+print("BERT" + json.dumps(out), flush=True)
 """
 
 

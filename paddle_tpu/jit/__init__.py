@@ -800,7 +800,11 @@ def set_verbosity(level=0, also_to_stdout=False):
         _PREV_JAX_LOG_LEVEL = logger.level  # restore on lowering
         logger.setLevel(logging.DEBUG)
     elif new < 1 and _VERBOSITY >= 1:
-        logger.setLevel(_PREV_JAX_LOG_LEVEL or logging.WARNING)
+        # restore the exact saved level — 0 (NOTSET) is a valid level and
+        # must round-trip, so test against None, not falsiness
+        logger.setLevel(logging.WARNING if _PREV_JAX_LOG_LEVEL is None
+                        else _PREV_JAX_LOG_LEVEL)
+        _PREV_JAX_LOG_LEVEL = None
     _VERBOSITY = new
 
 

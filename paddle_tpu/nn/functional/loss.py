@@ -19,11 +19,74 @@ def _reduce(loss, reduction, weight_sum=None):
     return jnp.mean(loss)
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _softmax_ce_fast(logits, lbl, ignore_index):
+    loss, _ = _softmax_ce_fast_fwd(logits, lbl, ignore_index)
+    return loss
+
+
+def _softmax_ce_fast_fwd(logits, lbl, ignore_index):
+    lf = logits.astype(jnp.float32)
+    lse = jax.nn.logsumexp(lf, axis=-1)                      # (...,)
+    safe = jnp.where(lbl == ignore_index, 0, lbl)
+    picked = jnp.take_along_axis(lf, safe[..., None], axis=-1)[..., 0]
+    loss = jnp.where(lbl == ignore_index, 0.0, lse - picked)
+    return loss, (logits, lbl, lse)
+
+
+def _softmax_ce_fast_bwd(ignore_index, res, ct):
+    logits, lbl, lse = res
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    scale = (ct * valid.astype(jnp.float32))[..., None]
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    onehot = jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, logits.ndim - 1) == safe[..., None]
+    d = (p - onehot.astype(jnp.float32)) * scale
+    return d.astype(logits.dtype), None
+
+
+_softmax_ce_fast.defvjp(_softmax_ce_fast_fwd, _softmax_ce_fast_bwd)
+
+
 def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
                   reduction="mean", soft_label=False, axis=-1,
                   use_softmax=True, label_smoothing=0.0, name=None):
     """Fused softmax+CE (reference: operators/softmax_with_cross_entropy_op.cc).
-    XLA fuses log_softmax+gather; numerically stable."""
+
+    The common case (hard int labels, no class weights, no smoothing, last
+    axis) takes a custom-vjp FAST PATH: per-token loss = logsumexp - picked
+    with a closed-form backward (softmax - onehot, onehot built from a
+    fused iota compare).  Two wins measured on v5e (r5 BERT head probe):
+    the generic path's take_along_axis GRADIENT lowers to a serialized
+    scatter over the (tokens, vocab) logits, and the AMP black-list cast
+    materializes an f32 logits copy — the fast path dispatches under its
+    own un-black-listed name, reads bf16 logits directly and does all
+    reduction math in f32 in-register (numerics identical to the f32
+    path)."""
+    lv = unwrap(input)
+    lab_v = unwrap(label)
+    fast = (use_softmax and not soft_label and weight is None
+            and label_smoothing == 0.0 and axis in (-1, lv.ndim - 1)
+            and jnp.issubdtype(lab_v.dtype, jnp.integer)
+            and lv.ndim >= 1)
+
+    if fast:
+        def raw_fast(logits, lbl):
+            lbl = lbl.astype(jnp.int32)
+            if lbl.ndim == logits.ndim and lbl.shape[-1] == 1:
+                lbl = jnp.squeeze(lbl, -1)
+            loss = _softmax_ce_fast(logits, lbl, ignore_index)
+            if reduction == "mean":
+                denom = jnp.maximum(jnp.sum(
+                    (lbl != ignore_index).astype(jnp.float32)), 1.0)
+                return jnp.sum(loss) / denom
+            return _reduce(loss, reduction)
+        return dispatch("softmax_ce_fast", raw_fast, input, label)
+
     def raw(logits, label, w):
         if use_softmax:
             logp = jax.nn.log_softmax(logits, axis=axis)

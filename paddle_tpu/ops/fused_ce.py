@@ -32,49 +32,58 @@ def _chunk_of(t: int, want: int) -> int:
     return want
 
 
-def _chunk_losses(hc, w, lc):
-    """One chunk: (c, H) x (V, H) -> per-token CE, logits never escape."""
+def _chunk_losses(hc, w, b, lc, valid):
+    """One chunk: (c, H) x (V, H) [+ bias] -> per-token CE, logits never
+    escape.  `valid` zeroes ignored (e.g. unmasked-MLM) positions."""
     logits = jax.lax.dot_general(
         hc.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
         (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)          # (c, V) f32
+    if b is not None:
+        logits = logits + b.astype(jnp.float32)
     lse = jax.nn.logsumexp(logits, axis=-1)
     picked = jnp.take_along_axis(
         logits, lc[:, None].astype(jnp.int32), axis=-1)[:, 0]
-    return lse - picked
+    return jnp.where(valid, lse - picked, 0.0)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
-def _flce(h, w, labels, chunk):
-    losses, _ = _flce_fwd(h, w, labels, chunk)
+@partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _flce(h, w, b, labels, valid, chunk):
+    losses, _ = _flce_fwd(h, w, b, labels, valid, chunk)
     return losses
 
 
-def _flce_fwd(h, w, labels, chunk):
+def _flce_fwd(h, w, b, labels, valid, chunk):
     t, hid = h.shape
     c = _chunk_of(t, chunk)
     hs = h.reshape(t // c, c, hid)
     ls = labels.reshape(t // c, c)
+    vs = valid.reshape(t // c, c)
     _, losses = jax.lax.scan(
-        lambda _, xs: (None, _chunk_losses(xs[0], w, xs[1])), None, (hs, ls))
-    return losses.reshape(t), (h, w, labels)
+        lambda _, xs: (None, _chunk_losses(xs[0], w, b, xs[1], xs[2])),
+        None, (hs, ls, vs))
+    return losses.reshape(t), (h, w, b, labels, valid)
 
 
 def _flce_bwd(chunk, res, ct):
-    h, w, labels = res
+    h, w, b, labels, valid = res
     t, hid = h.shape
     c = _chunk_of(t, chunk)
     n = t // c
+    with_bias = b is not None
 
-    def body(dw, xs):
-        hc, lc, ctc = xs
+    def body(carry, xs):
+        dw, db = carry
+        hc, lc, vc, ctc = xs
         logits = jax.lax.dot_general(
             hc.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
+        if with_bias:
+            logits = logits + b.astype(jnp.float32)
         p = jax.nn.softmax(logits, axis=-1)
         g = p.at[jnp.arange(c), lc.astype(jnp.int32)].add(-1.0)
-        g = g * ctc[:, None]                          # (c, V) f32
+        g = g * (ctc * vc)[:, None]                   # (c, V) f32
         gb = g.astype(jnp.bfloat16)
         dh_c = jax.lax.dot_general(
             gb, w.astype(jnp.bfloat16), (((1,), (0,)), ((), ())),
@@ -82,33 +91,49 @@ def _flce_bwd(chunk, res, ct):
         dw = dw + jax.lax.dot_general(
             gb, hc.astype(jnp.bfloat16), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)       # (V, H)
-        return dw, dh_c
+        if with_bias:
+            db = db + jnp.sum(g, axis=0)
+        return (dw, db), dh_c
 
     dw0 = jnp.zeros(w.shape, jnp.float32)
-    dw, dh = jax.lax.scan(
-        body, dw0, (h.reshape(n, c, hid), labels.reshape(n, c),
-                    ct.reshape(n, c)))
-    return dh.reshape(t, hid).astype(h.dtype), dw.astype(w.dtype), None
+    db0 = jnp.zeros(w.shape[:1], jnp.float32) if with_bias else jnp.float32(0)
+    vf = valid.astype(jnp.float32)
+    (dw, db), dh = jax.lax.scan(
+        body, (dw0, db0), (h.reshape(n, c, hid), labels.reshape(n, c),
+                           vf.reshape(n, c), ct.reshape(n, c)))
+    return (dh.reshape(t, hid).astype(h.dtype), dw.astype(w.dtype),
+            db.astype(b.dtype) if with_bias else None, None, None)
 
 
 def fused_linear_cross_entropy(h, weight, labels, chunk_size=None,
-                               name=None):
-    """Per-token CE of (h @ weight^T) vs labels WITHOUT materializing the
-    (tokens, vocab) logits between forward and backward.
+                               bias=None, ignore_index=None, name=None):
+    """Per-token CE of (h @ weight^T [+ bias]) vs labels WITHOUT
+    materializing the (tokens, vocab) logits between forward and backward.
 
     h (..., H) hidden states, weight (V, H) (the tied embedding layout),
-    labels (...) int.  Returns per-token losses shaped like labels.
-    """
+    labels (...) int.  Returns per-token losses shaped like labels;
+    positions where labels == ignore_index get loss 0 and contribute no
+    gradient (the BERT MLM ignore_index=-100 contract — divide by the
+    valid count yourself for the mean)."""
     if chunk_size is None:
         import os
         chunk_size = int(os.environ.get("PDTPU_FUSEDCE_CHUNK", "2048"))
     lead = unwrap(labels).shape
 
-    def raw(hv, wv, lv):
-        flat = _flce(hv.reshape(-1, hv.shape[-1]), wv,
-                     lv.reshape(-1), chunk_size)
+    def raw(hv, wv, lv, bv=None):
+        flat_l = lv.reshape(-1)
+        if ignore_index is not None:
+            valid = flat_l != ignore_index
+            flat_l = jnp.where(valid, flat_l, 0)
+        else:
+            valid = jnp.ones(flat_l.shape, bool)
+        flat = _flce(hv.reshape(-1, hv.shape[-1]), wv, bv, flat_l,
+                     valid, chunk_size)
         return flat.reshape(lead)
 
+    if bias is not None:
+        return dispatch("fused_linear_cross_entropy", raw, h, weight,
+                        labels, bias)
     return dispatch("fused_linear_cross_entropy", raw, h, weight, labels)
 
 _flce.defvjp(_flce_fwd, _flce_bwd)

@@ -1,0 +1,122 @@
+"""BERT-large MLM-head component profile (VERDICT r5 #2).
+
+ERNIE-large (18k vocab) runs 52.2% MFU vs BERT-large (30.5k) 45.4% at the
+same encoder shape — ~13 ms of BERT's ~99 ms step is head cost beyond its
+FLOP share.  Each mode runs in its OWN process (bench rule: two models in
+one TPU process cross-contaminate).
+
+    python probes/bert_head_probe.py <mode>
+
+Modes:
+  baseline  full BertForPretraining + criterion (the bench config)
+  encsum    encoder only, loss = scaled sum of squares (no MLM/NSP head)
+  headsq    encoder + full head, loss = sum(logits^2) (head matmuls incl.
+            real dense-cotangent bwd, no CE)
+  ce_bf16   baseline but cross_entropy/log_softmax allowed in bf16
+  fused     transform+LN then fused_linear_cross_entropy (chunked, logits
+            never materialized); PDTPU_FUSEDCE_CHUNK sweeps the chunk
+Prints one line:  PROBE <mode> <ms_per_step> mfu=<x> reps=<...>
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_default_prng_impl", "rbg")
+
+
+def main():
+    mode = sys.argv[1]
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu import models
+    from paddle_tpu.jit import TrainStep
+    from bench import bert_train_flops, detect_peak_tflops, run_reps
+
+    if os.environ.get("PDTPU_BENCH_SMOKE") == "1":
+        cfg = models.BertConfig(vocab_size=1024, hidden_size=64,
+                                num_hidden_layers=2, num_attention_heads=4,
+                                intermediate_size=256,
+                                max_position_embeddings=64)
+        batch, seq, k = 2, 64, 2
+    else:
+        cfg = models.bert_large_config(vocab_size=30528,
+                                       max_position_embeddings=512)
+        batch, seq, k = 8, 512, 20
+    paddle.seed(0)
+
+    if mode == "ce_bf16":
+        from paddle_tpu import amp as amp_mod
+        for op in ("cross_entropy", "log_softmax", "logsumexp"):
+            amp_mod.BLACK_LIST.discard(op)
+
+    if mode == "encsum":
+        class EncOnly(models.bert.BertModel):
+            def forward(self, ids):
+                seq_out, pooled = super().forward(ids)
+                return seq_out
+        model = EncOnly(cfg)
+        loss_fn = lambda seq_out, label: (  # noqa: E731
+            seq_out.astype("float32") ** 2).sum() * 1e-6
+    elif mode == "headsq":
+        class HeadSq(models.BertForPretraining):
+            def forward(self, ids):
+                logits, nsp = super().forward(ids)
+                return logits
+        model = HeadSq(cfg)
+        loss_fn = lambda logits, label: (  # noqa: E731
+            logits.astype("float32") ** 2).sum() * 1e-9
+    elif mode == "fused":
+        from paddle_tpu.ops.fused_ce import fused_linear_cross_entropy
+
+        class FusedBert(models.BertForPretraining):
+            def forward(self, ids, labels):
+                seq_out, pooled = self.bert(ids)
+                c = self.cls
+                h = c.layer_norm(getattr(F, c.act)(c.transform(seq_out)))
+                per_tok = fused_linear_cross_entropy(
+                    h, c.decoder_weight, labels, bias=c.decoder_bias,
+                    ignore_index=-100)
+                return per_tok, self.nsp(pooled)
+
+        model = FusedBert(cfg)
+
+        def loss_fn(per_tok, nsp, label):
+            n = (label != -100).astype("float32").sum()
+            return per_tok.sum() / paddle.maximum(
+                n, paddle.to_tensor(1.0))
+    else:  # baseline / ce_bf16
+        model = models.BertForPretraining(cfg)
+        crit = models.BertPretrainingCriterion()
+        loss_fn = lambda logits, nsp, label: crit(  # noqa: E731
+            logits, nsp, label)
+
+    opt = paddle.optimizer.AdamW(
+        learning_rate=1e-4, parameters=model.parameters(),
+        apply_decay_param_fun=lambda n: "bias" not in n and "norm" not in n)
+    step = TrainStep(model, loss_fn, opt, amp_level="O1",
+                     amp_dtype="bfloat16", remat=False)
+
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(
+        0, cfg.vocab_size, (k, batch, seq)).astype("int32"))
+    labels = paddle.to_tensor(rng.randint(
+        0, cfg.vocab_size, (k, batch, seq)).astype("int32"))
+    args = (ids, labels, labels) if mode == "fused" else (ids, labels)
+    reps = run_reps(step, args, k)
+    dt = sum(reps) / len(reps) / 1e3
+    flops = bert_train_flops(batch, seq, cfg)
+    mfu = flops / dt / (detect_peak_tflops() * 1e12) * 100.0
+    print(f"PROBE {mode} {dt * 1e3:.2f} mfu={mfu:.2f} "
+          f"reps={','.join(f'{r:.1f}' for r in reps)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -197,6 +197,90 @@ def mode_convtower(batch, layout="NCHW", with_bwd=True):
     return dt, tfs, flops * mult
 
 
+def mode_convtower_grouped(batch, layout="NCHW", n_groups=8):
+    """Conv ceiling at the REAL operating batch (VERDICT r5 #3): the r4
+    monolithic tower OOM'd at b256 (5.5 GB inputs + 5.7 GB outputs + grad
+    stash > 16 GB HBM — why probes/resnet_probe_results2.txt's b256
+    sections are empty).  This version (a) splits the 53 convs into
+    contiguous groups so only one group's arrays are resident, (b) makes
+    inputs ON DEVICE (jax.random, no tunnel transfer), and (c) times each
+    group by the k-difference form (2 vs 10 queued iters, one sync each)
+    so the ~60-110 ms tunnel roundtrip cancels per group."""
+    import jax
+    import jax.numpy as jnp
+    convs = _conv_list()
+    dn = ("NCHW", "OIHW", "NCHW") if layout == "NCHW" else \
+         ("NHWC", "HWIO", "NHWC")
+    per = (len(convs) + n_groups - 1) // n_groups
+    total_flops, total_dt, rows = 0.0, 0.0, []
+    key = jax.random.key(0)
+    for gi in range(0, len(convs), per):
+        sub = convs[gi:gi + per]
+        ws, xs, flops = [], [], 0.0
+        for cin, cout, kk, s, hw in sub:
+            key, k1, k2 = jax.random.split(key, 3)
+            wshape = ((cout, cin, kk, kk) if layout == "NCHW"
+                      else (kk, kk, cin, cout))
+            xshape = ((batch, cin, hw, hw) if layout == "NCHW"
+                      else (batch, hw, hw, cin))
+            ws.append(jax.random.normal(k1, wshape, jnp.bfloat16) * 0.05)
+            xs.append(jax.random.normal(k2, xshape, jnp.bfloat16) * 0.05)
+            flops += 2.0 * batch * (hw // s) ** 2 * cin * cout * kk * kk
+
+        def run(ws, xs, sub=sub):
+            # sum of SQUARES: a loss linear in the conv outputs has an
+            # all-ones cotangent and XLA strength-reduces both the
+            # backward convs AND the forward (group rates > peak were the
+            # tell); o^2 makes every cotangent data-dependent
+            acc = jnp.float32(0)
+            for (cin, cout, kk, s, hw), w, x in zip(sub, ws, xs):
+                pad = [(kk // 2, kk // 2)] * 2
+                o = jax.lax.conv_general_dilated(
+                    x, w, window_strides=(s, s), padding=pad,
+                    dimension_numbers=dn)
+                # square in the conv dtype, accumulate f32 IN the reduce:
+                # .astype(f32)**2 materialized multi-GB f32 copies of the
+                # big early activations (stem alone: 3.2 GB at b256) and
+                # HBM-thrashed the probe to ~5 TF/s
+                acc = acc + jnp.sum(o * o, dtype=jnp.float32) * 1e-12
+            return acc
+
+        # grad wrt ALL weights AND inputs, summed over every leaf — taking
+        # [0] lets XLA dead-code-eliminate every other conv entirely (the
+        # r4 tower numbers had exactly that bug: 26-30 "TF/s" was one conv
+        # per group, not the tower)
+        def g_all(ws, xs, run=run):
+            gws, gxs = jax.grad(
+                lambda a, b: run(a, b), argnums=(0, 1))(ws, xs)
+            tot = jnp.float32(0)
+            for t in list(gws) + list(gxs):
+                tot = tot + jnp.sum(t.astype(jnp.float32))
+            return tot
+
+        g = jax.jit(g_all)
+
+        def timed_n(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = g(ws, xs)
+            _sync(out)
+            return time.perf_counter() - t0
+
+        _sync(g(ws, xs))  # compile + warm
+        t2, t18 = timed_n(2), timed_n(18)
+        net = (t18 - t2) / 16
+        mult = 3.0  # fwd + grad_w + grad_x (the train-step accounting)
+        rows.append((gi, len(sub), net * 1e3,
+                     flops * mult / net / 1e12))
+        total_flops += flops * mult
+        total_dt += net
+        del ws, xs
+    for gi, n, ms, tfs in rows:
+        print(f"  group@{gi} ({n} convs): {ms:.1f} ms  {tfs:.1f} TF/s",
+              flush=True)
+    return total_dt, total_flops / total_dt / 1e12, total_flops
+
+
 def main():
     mode = sys.argv[1]
     batch = int(sys.argv[2]) if len(sys.argv) > 2 else 64
@@ -225,6 +309,12 @@ def main():
     elif mode in ("convfwd", "convfwd_nhwc"):
         layout = "NHWC" if mode.endswith("nhwc") else "NCHW"
         dt, tfs, fl = mode_convtower(batch, layout=layout, with_bwd=False)
+        print(f"PROBE {mode} {batch} {dt*1e3:.2f} tf_s={tfs:.1f} "
+              f"flops={fl:.3e}", flush=True)
+        return
+    elif mode in ("convtower2", "convtower2_nhwc"):
+        layout = "NHWC" if mode.endswith("nhwc") else "NCHW"
+        dt, tfs, fl = mode_convtower_grouped(batch, layout=layout)
         print(f"PROBE {mode} {batch} {dt*1e3:.2f} tf_s={tfs:.1f} "
               f"flops={fl:.3e}", flush=True)
         return

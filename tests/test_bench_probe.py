@@ -1,0 +1,83 @@
+"""bench._run_tpu_probe slot-qualification logic (VERDICT r4 #1): a
+disqualified-but-faster attempt must never displace a qualifying run, and
+a forced bad-slot number must carry slot_degraded.  Uses fake probe
+scripts (no TPU, no model)."""
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+
+def _with_counter(fn):
+    """Give the fake script a cross-attempt counter file."""
+    fd, path = tempfile.mkstemp()
+    os.close(fd)
+    os.environ["FAKE_PROBE_COUNTER"] = path
+    try:
+        return fn()
+    finally:
+        os.environ.pop("FAKE_PROBE_COUNTER", None)
+        os.unlink(path)
+
+
+_BAD_SLOT_SCRIPT = r"""
+import json, os
+if os.environ.get("PDTPU_IGNORE_SLOT") == "1":
+    print("BERT" + json.dumps(
+        {"step_ms": 90.0, "step_ms_spread": 0.5, "slot_tf_s": 150.0}))
+else:
+    print("BERT" + json.dumps({"slot_bailed": True, "slot_tf_s": 150.0}))
+"""
+
+
+def test_forced_bad_slot_run_is_flagged():
+    out = bench._run_tpu_probe(_BAD_SLOT_SCRIPT, "BERT", timeout=60)
+    # within expectation (90 <= 1.05*99) yet the slot is under par:
+    # the contract demands an explicit flag
+    assert out["step_ms"] == 90.0
+    assert out["slot_degraded"] is True
+    assert out["within_expectation"] is True
+    assert len(out["attempts"]) == bench._RETRY_BUDGET_PER_CONFIG
+
+
+_NOISY_THEN_CLEAN_SCRIPT = r"""
+import json, os
+path = os.environ["FAKE_PROBE_COUNTER"]
+with open(path, "r+") as f:
+    n = int(f.read() or 0)
+    f.seek(0)
+    f.write(str(n + 1))
+if n == 0:  # first attempt: FASTER but noisy (spread > 4%)
+    print("BERT" + json.dumps(
+        {"step_ms": 95.0, "step_ms_spread": 8.0, "slot_tf_s": 186.0}))
+else:       # retry: slower but clean
+    print("BERT" + json.dumps(
+        {"step_ms": 100.0, "step_ms_spread": 1.0, "slot_tf_s": 186.0}))
+"""
+
+
+def test_noisy_faster_attempt_never_displaces_clean_run():
+    out = _with_counter(lambda: bench._run_tpu_probe(
+        _NOISY_THEN_CLEAN_SCRIPT, "BERT", timeout=60))
+    assert out["step_ms"] == 100.0, "the qualifying run must win"
+    assert "slot_degraded" not in out
+    assert out["within_expectation"] is True
+    assert out["attempts"][0]["retry_step_ms"] == 95.0
+
+
+_ALL_BAD_SCRIPT = r"""
+import json
+print("BERT" + json.dumps(
+    {"step_ms": 120.0, "step_ms_spread": 1.0, "slot_tf_s": 186.0}))
+"""
+
+
+def test_over_expectation_after_budget_is_flagged():
+    out = bench._run_tpu_probe(_ALL_BAD_SCRIPT, "BERT", timeout=60)
+    assert out["step_ms"] == 120.0
+    assert out["within_expectation"] is False
+    assert out["slot_degraded"] is True

@@ -38,6 +38,44 @@ def test_fused_ce_matches_reference_fwd_bwd():
     np.testing.assert_allclose(gw_f, wt2.grad.numpy(), rtol=5e-2, atol=2e-2)
 
 
+def test_fused_ce_bias_and_ignore_index():
+    """BERT-head form: decoder bias + ignore_index=-100 masking (r5: the
+    fused CE is offered to the BERT MLM head too)."""
+    rng = np.random.RandomState(1)
+    t, h, v = 12, 8, 30
+    hv = rng.randn(t, h).astype("float32")
+    wv = (rng.randn(v, h) * 0.2).astype("float32")
+    bv = (rng.randn(v) * 0.1).astype("float32")
+    lab = rng.randint(0, v, (t,)).astype("int64")
+    lab[::3] = -100  # ignored positions
+
+    ht = paddle.to_tensor(hv, stop_gradient=False)
+    wt = paddle.to_tensor(wv, stop_gradient=False)
+    bt = paddle.to_tensor(bv, stop_gradient=False)
+    fused = fused_linear_cross_entropy(
+        ht, wt, paddle.to_tensor(lab), chunk_size=4, bias=bt,
+        ignore_index=-100)
+    assert np.all(fused.numpy()[::3] == 0.0)
+    n_valid = float((lab != -100).sum())
+    (fused.sum() / n_valid).backward()
+
+    ht2 = paddle.to_tensor(hv, stop_gradient=False)
+    wt2 = paddle.to_tensor(wv, stop_gradient=False)
+    bt2 = paddle.to_tensor(bv, stop_gradient=False)
+    logits = paddle.matmul(ht2, wt2, transpose_y=True) + bt2
+    ref = F.cross_entropy(logits, paddle.to_tensor(lab),
+                          ignore_index=-100, reduction="mean")
+    np.testing.assert_allclose(
+        float(fused.sum() / n_valid), float(ref), rtol=2e-2, atol=2e-2)
+    ref.backward()
+    np.testing.assert_allclose(ht.grad.numpy(), ht2.grad.numpy(),
+                               rtol=5e-2, atol=2e-2)
+    np.testing.assert_allclose(wt.grad.numpy(), wt2.grad.numpy(),
+                               rtol=5e-2, atol=2e-2)
+    np.testing.assert_allclose(bt.grad.numpy(), bt2.grad.numpy(),
+                               rtol=5e-2, atol=2e-2)
+
+
 @pytest.mark.slow
 def test_gpt_forward_labels_path_trains():
     paddle.seed(0)
