@@ -67,12 +67,17 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,  # noqa: A002
     own un-black-listed name, reads bf16 logits directly and does all
     reduction math in f32 in-register (numerics identical to the f32
     path)."""
+    import os
     lv = unwrap(input)
     lab_v = unwrap(label)
+    # PDTPU_CE_GENERIC=1 forces the generic log_softmax path (perf-probe
+    # escape hatch: probes/bert_head_probe.py re-measures the pre-r5
+    # implementations against the fast path)
     fast = (use_softmax and not soft_label and weight is None
             and label_smoothing == 0.0 and axis in (-1, lv.ndim - 1)
             and jnp.issubdtype(lab_v.dtype, jnp.integer)
-            and lv.ndim >= 1)
+            and lv.ndim >= 1
+            and os.environ.get("PDTPU_CE_GENERIC") != "1")
 
     if fast:
         def raw_fast(logits, lbl):

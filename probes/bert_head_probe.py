@@ -8,11 +8,14 @@ one TPU process cross-contaminate).
     python probes/bert_head_probe.py <mode>
 
 Modes:
-  baseline  full BertForPretraining + criterion (the bench config)
+  baseline  full BertForPretraining + criterion (the bench config; since
+            r5 this takes the cross_entropy custom-vjp FAST path)
+  ce_generic baseline forced onto the pre-r5 generic log_softmax CE path
+            (PDTPU_CE_GENERIC=1 — the sweep's "generic_f32" row)
   encsum    encoder only, loss = scaled sum of squares (no MLM/NSP head)
   headsq    encoder + full head, loss = sum(logits^2) (head matmuls incl.
             real dense-cotangent bwd, no CE)
-  ce_bf16   baseline but cross_entropy/log_softmax allowed in bf16
+  ce_bf16   ce_generic with cross_entropy/log_softmax allowed in bf16
   fused     transform+LN then fused_linear_cross_entropy (chunked, logits
             never materialized); PDTPU_FUSEDCE_CHUNK sweeps the chunk
 Prints one line:  PROBE <mode> <ms_per_step> mfu=<x> reps=<...>
@@ -52,6 +55,10 @@ def main():
         batch, seq, k = 8, 512, 20
     paddle.seed(0)
 
+    if mode in ("ce_generic", "ce_bf16"):
+        # the r5 fast path would otherwise swallow both modes (it ignores
+        # the AMP black list entirely)
+        os.environ["PDTPU_CE_GENERIC"] = "1"
     if mode == "ce_bf16":
         from paddle_tpu import amp as amp_mod
         for op in ("cross_entropy", "log_softmax", "logsumexp"):

@@ -1,40 +1,21 @@
-"""Slot-quality calibration microbench: a fixed bf16 matmul chain.
-Prints: SLOT <tf_s> <ms_per_call>
-Used to qualify the pool chip before each bench leg (VERDICT r5 #1)."""
+"""Slot-quality calibration probe: prints SLOT <tf_s>.
+
+Thin CLI over bench.slot_calibration — the k-difference independent-
+products form (chained same-weight matmuls over-read ~265 'TF/s' on a
+197-peak chip; see slot_calibration's docstring).  Good v5e slots read
+186-189 TF/s; bench legs bail below SLOT_MIN_TF_S."""
 import os
 import sys
-import time
-
-import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from bench import SLOT_MIN_TF_S, slot_calibration  # noqa: E402
+
 
 def main():
-    import jax
-    import jax.numpy as jnp
-    n, chain = 4096, 20
-    rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.randn(n, n) * 0.05, jnp.bfloat16)
-    w = jnp.asarray(rng.randn(n, n) * 0.05, jnp.bfloat16)
-
-    @jax.jit
-    def f(x, w):
-        y = x
-        for _ in range(chain):
-            y = y @ w
-        return jnp.float32(jnp.sum(y.astype(jnp.float32)))
-
-    float(f(x, w))  # compile + sync
-    reps = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = f(x, w)
-        float(out)
-        reps.append(time.perf_counter() - t0)
-    dt = min(reps)
-    tf_s = chain * 2 * n ** 3 / dt / 1e12
-    print(f"SLOT {tf_s:.1f} {dt * 1e3:.2f}", flush=True)
+    tf_s = slot_calibration()
+    verdict = "ok" if tf_s >= SLOT_MIN_TF_S else "DEGRADED"
+    print(f"SLOT {tf_s:.1f} {verdict} (min {SLOT_MIN_TF_S})", flush=True)
 
 
 if __name__ == "__main__":
