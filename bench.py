@@ -106,17 +106,27 @@ def slot_calibration(n=8192, k_long=18, k_short=2):
             return y
         return f
 
-    t = {}
-    for k in (k_short, k_long):
-        fk = make(k)
-        float(fk(a, b))  # compile + sync
-        reps = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            float(fk(a, b))
-            reps.append(time.perf_counter() - t0)
-        t[k] = min(reps)
-    return (k_long - k_short) * 2 * n ** 3 / (t[k_long] - t[k_short]) / 1e12
+    f_s, f_l = make(k_short), make(k_long)
+    float(f_s(a, b))
+    float(f_l(a, b))  # compile + warm both
+    # MEDIAN of interleaved paired differences: independently-minimized
+    # t_short/t_long can pair a lucky long with an unlucky short and
+    # over-read wildly (observed 377 "TF/s" on a 197-peak chip via the
+    # min-of-3 form); a paired median is robust to single roundtrip
+    # outliers, and a non-positive median reads as 0 -> slot bails ->
+    # the orchestrator re-rolls
+    diffs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(f_s(a, b))
+        ts = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(f_l(a, b))
+        diffs.append(time.perf_counter() - t0 - ts)
+    med = sorted(diffs)[1]
+    if med <= 0:
+        return 0.0
+    return (k_long - k_short) * 2 * n ** 3 / med / 1e12
 
 
 def measure_bert(on_tpu):
@@ -335,7 +345,7 @@ def _run_tpu_probe(script, tag, timeout, smoke=False):
 # solo-process expectations from the r4/r5 probe sweeps — the PUBLISHED
 # CONTRACT (r4 verdict #1): a config whose mean exceeds expectation by
 # >5% after the per-config retry budget is flagged slot_degraded
-_EXPECT_STEP_MS = {"BERT": 99.0, "RESNET": 122.0, "GPT2": 118.0,
+_EXPECT_STEP_MS = {"BERT": 99.0, "RESNET": 122.0, "GPT2": 115.0,
                    "ERNIE": 86.0}
 _RETRY_BUDGET_PER_CONFIG = 2
 
@@ -478,7 +488,11 @@ if SMOKE:
     batch, seq, k = 2, 32, 2
 else:
     cfg = models.gpt2_medium_config()
-    batch, seq, k = 4, 1024, 5
+    # k=20 steps per compiled call (r5): run_reps syncs once per call, and
+    # the ~60-110 ms tunnel roundtrip over only k=5 steps inflated every
+    # step by 12-22 ms — the r4 "bad slot" 135 ms GPT-2 numbers vs the
+    # probe's 117 ms were THIS (the probe queued 4 calls per sync)
+    batch, seq, k = 4, 1024, 20
 model = models.GPTForPretraining(cfg)
 crit = models.GPTPretrainingCriterion()
 opt = paddle.optimizer.AdamW(learning_rate=1e-4,
@@ -497,7 +511,8 @@ out = {"tokens_per_sec_per_chip": round(batch * seq / dt, 1),
        "mfu": round(flops / dt / PEAK * 100.0, 2) if not SMOKE else None,
        "config": ("gpt2-medium-1024" if not SMOKE
                   else "gpt2-tiny-cpu-smoke"),
-       "methodology": "solo process, warmup 2x5 steps, 3 reps of 5 steps",
+       "methodology": f"solo process, warmup 2x{k} steps, 3 reps of "
+                      f"{k} steps",
        "slot_tf_s": SLOT_TF_S}
 if not SMOKE:
     # the measured shape-ceiling, published IN the artifact (r4 verdict
@@ -560,7 +575,8 @@ out = {"tokens_per_sec_per_chip": round(batch * seq / dt, 1),
        "mfu": round(flops / dt / PEAK * 100.0, 2) if not SMOKE else None,
        "config": ("ernie-large-512" if not SMOKE
                   else "ernie-tiny-cpu-smoke"),
-       "methodology": "solo process, warmup 2x20 steps, 3 reps of 20 steps",
+       "methodology": f"solo process, warmup 2x{k} steps, 3 reps of "
+                      f"{k} steps",
        "slot_tf_s": SLOT_TF_S}
 out.update(rep_stats(reps))
 print("ERNIE" + json.dumps(out), flush=True)

@@ -16,6 +16,7 @@ Modes:
   headsq    encoder + full head, loss = sum(logits^2) (head matmuls incl.
             real dense-cotangent bwd, no CE)
   ce_bf16   ce_generic with cross_entropy/log_softmax allowed in bf16
+  ln_bf16   all 48 LayerNorms in bf16 (sizes the f32-LN cast traffic)
   fused     transform+LN then fused_linear_cross_entropy (chunked, logits
             never materialized); PDTPU_FUSEDCE_CHUNK sweeps the chunk
 Prints one line:  PROBE <mode> <ms_per_step> mfu=<x> reps=<...>
@@ -35,8 +36,16 @@ import jax  # noqa: E402
 jax.config.update("jax_default_prng_impl", "rbg")
 
 
+MODES = ("baseline", "ce_generic", "encsum", "headsq", "ce_bf16",
+         "ln_bf16", "fused")
+
+
 def main():
     mode = sys.argv[1]
+    if mode not in MODES:
+        raise SystemExit(
+            f"unknown mode {mode!r} — a typo would silently measure "
+            f"baseline under a wrong label; modes: {', '.join(MODES)}")
     import paddle_tpu as paddle
     import paddle_tpu.nn.functional as F
     from paddle_tpu import models
@@ -63,6 +72,13 @@ def main():
         from paddle_tpu import amp as amp_mod
         for op in ("cross_entropy", "log_softmax", "logsumexp"):
             amp_mod.BLACK_LIST.discard(op)
+    if mode == "ln_bf16":
+        # size the f32-LayerNorm traffic: run the 48 LNs (and their
+        # casts) in bf16 end-to-end.  NOT a shippable config (bf16 batch
+        # stats) — an upper bound on what a fused bf16-I/O/f32-stats LN
+        # kernel could recover.
+        from paddle_tpu import amp as amp_mod
+        amp_mod.BLACK_LIST.discard("layer_norm")
 
     if mode == "encsum":
         class EncOnly(models.bert.BertModel):

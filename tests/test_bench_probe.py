@@ -2,14 +2,18 @@
 disqualified-but-faster attempt must never displace a qualifying run, and
 a forced bad-slot number must carry slot_degraded.  Uses fake probe
 scripts (no TPU, no model)."""
-import json
 import os
 import sys
 import tempfile
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import bench  # noqa: E402
+
+# each test spawns fresh-interpreter subprocesses (~7-9 s apiece): slow tier
+pytestmark = pytest.mark.slow
 
 
 def _with_counter(fn):
@@ -42,6 +46,22 @@ def test_forced_bad_slot_run_is_flagged():
     assert out["slot_degraded"] is True
     assert out["within_expectation"] is True
     assert len(out["attempts"]) == bench._RETRY_BUDGET_PER_CONFIG
+
+
+_ALWAYS_BAILS_SCRIPT = r"""
+import json
+print("BERT" + json.dumps({"slot_bailed": True, "slot_tf_s": 10.0}))
+"""
+
+
+def test_script_ignoring_force_flag_terminates_with_error():
+    """A script that ignores PDTPU_IGNORE_SLOT (prints slot_bailed even on
+    the forced last attempt) must TERMINATE with an error dict — not loop
+    spawning subprocesses forever (bench.py slot_bailed last-attempt
+    guard)."""
+    out = bench._run_tpu_probe(_ALWAYS_BAILS_SCRIPT, "BERT", timeout=60)
+    assert "error" in out and "slot_bailed" in out["error"]
+    assert out["slot_tf_s"] == 10.0
 
 
 _NOISY_THEN_CLEAN_SCRIPT = r"""
