@@ -132,3 +132,95 @@ def distributed_train_step(model, loss_fn, optimizer, strategy=None):
 
 def get_strategy() -> Optional[DistributedStrategy]:
     return _strategy
+
+
+class Role:
+    """Worker/server role constants (reference: fleet/base/role_maker.py:26).
+    The PS roles exist for API parity; collective (WORKER-only) is the TPU
+    execution model."""
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class UtilBase:
+    """fleet.util (reference: fleet/base/util_factory.py UtilBase) — the
+    cross-worker helper surface over XLA collectives instead of Gloo."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):  # noqa: A002
+        import jax.numpy as jnp
+        import numpy as np
+        from .. import collective as c
+        from ...core.tensor import Tensor
+        t = input if isinstance(input, Tensor) else Tensor(
+            jnp.asarray(input))
+        op = {"sum": c.ReduceOp.SUM, "min": c.ReduceOp.MIN,
+              "max": c.ReduceOp.MAX}[mode]
+        c.all_reduce(t, op=op)
+        return np.asarray(t.numpy())
+
+    def barrier(self, comm_world="worker"):
+        barrier_worker()
+
+    def all_gather(self, input, comm_world="worker"):  # noqa: A002
+        from .. import collective as c
+        from ...core.tensor import Tensor
+        import jax.numpy as jnp
+        out = []
+        c.all_gather(out, Tensor(jnp.asarray(input)))
+        return [o.numpy() for o in out]
+
+    def get_file_shard(self, files):
+        """Split a file list across workers (reference util_factory:
+        contiguous blocks, remainder to the first workers)."""
+        n, rank = worker_num(), worker_index()
+        per, rem = divmod(len(files), n)
+        start = rank * per + min(rank, rem)
+        return list(files[start:start + per + (1 if rank < rem else 0)])
+
+    def print_on_rank(self, message, rank_id=0):
+        if worker_index() == rank_id:
+            print(message, flush=True)
+
+
+class Fleet:
+    """Class form of the module-level facade (reference fleet_base.py:62
+    Fleet; `paddle.distributed.fleet.fleet` is its singleton).  Methods
+    delegate to the module functions so both spellings stay in sync."""
+
+    util = UtilBase()
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        return init(role_maker, is_collective, strategy)
+
+    def is_first_worker(self):
+        return is_first_worker()
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_worker(self):
+        return True  # collective mode: every process is a worker
+
+    def is_server(self):
+        return False  # PS scoped out (SURVEY §2.3)
+
+    def barrier_worker(self):
+        barrier_worker()
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return distributed_optimizer(optimizer, strategy)
+
+    def distributed_model(self, model):
+        return distributed_model(model)
+
+
+fleet = Fleet()
+
+from .data_generator import (  # noqa: F401,E402
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)

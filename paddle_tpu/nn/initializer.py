@@ -163,13 +163,50 @@ class Orthogonal(Initializer):
         return (self.gain * q[:rows, :cols].reshape(shape)).astype(dtype)
 
 
-# paddle.nn.initializer default (reference initializer.py: Xavier default for
-# weights, Constant(0) for bias)
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for transposed convs (reference:
+    fluid/initializer.py BilinearInitializer — weight[..., y, x] =
+    (1-|x/f - c|)(1-|y/f - c|) with f = ceil(K/2), c = (2f-1-f%2)/(2f),
+    identical across in/out channels)."""
+
+    def _build(self, shape, dtype):
+        def axis_weights(size):
+            factor = float(np.ceil(size / 2.0))
+            center = (2 * factor - 1 - factor % 2) / (2.0 * factor)
+            idx = np.arange(size, dtype=np.float64)
+            return 1 - np.abs(idx / factor - center)
+        # rectangular kernels: y over shape[-2], x over shape[-1] (the
+        # reference indexes x by shape[3] and y by shape[2])
+        kernel = np.outer(axis_weights(shape[-2]), axis_weights(shape[-1]))
+        out = np.broadcast_to(kernel, shape)
+        return jnp.asarray(out, dtype)
+
+
+# paddle.nn.initializer default (reference initializer.py: Xavier default
+# for weights, Constant(0) for bias).  set_global_initializer (reference
+# fluid/initializer.py:1027) overrides these framework-wide for every
+# parameter created WITHOUT an explicit initializer.
+_global_weight_init = None
+_global_bias_init = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Override the default weight (and optionally bias) initializer for
+    all subsequently-created parameters; pass None to reset."""
+    global _global_weight_init, _global_bias_init
+    _global_weight_init = weight_init
+    _global_bias_init = bias_init
+
+
 def default_weight_init():
+    if _global_weight_init is not None:
+        return _global_weight_init
     return XavierNormal()
 
 
 def default_bias_init():
+    if _global_bias_init is not None:
+        return _global_bias_init
     return Constant(0.0)
 
 

@@ -63,17 +63,31 @@ class Layer:
         learning_rate, regularizer, trainable.
         """
         dtype = _dt.convert_dtype(dtype) if dtype is not None else self._dtype
-        init = default_initializer
         name = None
         trainable = True
         if attr is False:
             return None
+        attr_init = None
         if attr is not None and not isinstance(attr, bool):
-            init = getattr(attr, "initializer", None) or init
+            attr_init = getattr(attr, "initializer", None)
             name = getattr(attr, "name", None)
             trainable = getattr(attr, "trainable", True)
-        if init is None:
-            init = I.default_bias_init() if is_bias else I.default_weight_init()
+        # precedence (reference set_global_initializer contract,
+        # fluid/initializer.py:1027): an attr-specified initializer always
+        # wins; otherwise a set_global_initializer override beats the
+        # layer's built-in default, which beats the framework default
+        if attr_init is not None:
+            init = attr_init
+        else:
+            global_init = (I._global_bias_init if is_bias
+                           else I._global_weight_init)
+            if global_init is not None:
+                init = global_init
+            elif default_initializer is not None:
+                init = default_initializer
+            else:
+                init = (I.default_bias_init() if is_bias
+                        else I.default_weight_init())
         data = init._build(tuple(int(s) for s in shape), dtype)
         p = Parameter(data, name=name, trainable=trainable)
         if attr is not None and not isinstance(attr, bool):

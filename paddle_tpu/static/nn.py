@@ -224,3 +224,104 @@ def array_length(array):
     from ..core.tensor import Tensor
     import jax.numpy as jnp
     return Tensor(jnp.asarray(len(array), jnp.int64), stop_gradient=True)
+
+
+# ---------------------------------------------------------------------------
+# static-graph layer builders (reference static/nn/__init__.py __all__):
+# era spellings over the 2.0 homes.  LAZY delegation — fluid.layers imports
+# THIS module at load (while_loop/cond/TensorArray verbs), so importing it
+# back eagerly would cycle.
+
+
+def _lazy(module_path, name):
+    def f(*args, **kwargs):
+        import importlib
+        mod = importlib.import_module(module_path, __package__)
+        return getattr(mod, name)(*args, **kwargs)
+    f.__name__ = name
+    f.__qualname__ = name
+    f.__doc__ = f"static.nn.{name}: era alias of {module_path}.{name}"
+    return f
+
+
+fc = _lazy("..fluid.layers", "fc")
+embedding = _lazy("..fluid.layers", "embedding")
+bilinear_tensor_product = _lazy("..fluid.layers", "bilinear_tensor_product")
+crf_decoding = _lazy("..fluid.layers", "crf_decoding")
+data_norm = _lazy("..fluid.layers", "data_norm")
+multi_box_head = _lazy("..fluid.layers", "multi_box_head")
+nce = _lazy("..fluid.layers", "nce")
+row_conv = _lazy("..fluid.layers", "row_conv")
+spectral_norm = _lazy("..fluid.layers", "spectral_norm")
+py_func = _lazy("..fluid.layers", "py_func")
+group_norm = _lazy("..nn.functional", "group_norm")
+instance_norm = _lazy("..nn.functional", "instance_norm")
+layer_norm = _lazy("..nn.functional", "layer_norm")
+prelu = _lazy("..nn.functional", "prelu")
+
+
+def _conv_builder(fname, ndim):
+    """Era static-graph conv builders (reference static/nn: conv2d(input,
+    num_filters, filter_size, ...) creates its weight via LayerHelper).
+    There is no program-scope parameter store here, so the era signature
+    is accepted but the weight must be passed explicitly (the repo's
+    documented convention for LayerHelper-created parameters — see
+    fluid.layers.multi_box_head) or use the stateful nn.Conv*D layer."""
+    def f(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
+          dilation=1, groups=1, param_attr=None, bias_attr=None,
+          use_cudnn=True, act=None, name=None, data_format="NCHW",
+          weight=None, bias=None, output_size=None):
+        if weight is None:
+            from ..core.errors import InvalidArgumentError
+            raise InvalidArgumentError(
+                f"static.nn.{fname}: pass `weight` (and optional `bias`) "
+                f"explicitly — there is no LayerHelper parameter store; "
+                f"or build an nn.{fname.replace('conv', 'Conv').replace('_transpose', 'Transpose')}-style layer")
+        import importlib
+        F = importlib.import_module("..nn.functional", __package__)
+        kw = dict(stride=stride, padding=padding, dilation=dilation,
+                  groups=groups, data_format=data_format)
+        if fname.endswith("_transpose") and output_size is not None:
+            kw["output_size"] = output_size
+        out = getattr(F, fname)(input, weight, bias, **kw)
+        if act is not None:
+            out = getattr(F, act)(out)
+        return out
+    f.__name__ = fname
+    f.__doc__ = _conv_builder.__doc__
+    return f
+
+
+conv2d = _conv_builder("conv2d", 2)
+conv2d_transpose = _conv_builder("conv2d_transpose", 2)
+conv3d = _conv_builder("conv3d", 3)
+conv3d_transpose = _conv_builder("conv3d_transpose", 3)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,  # noqa: A002
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", in_place=False, name=None,
+               moving_mean_name=None, moving_variance_name=None,
+               do_model_average_for_mean_and_var=True, use_global_stats=False,
+               weight=None, bias=None, running_mean=None, running_var=None):
+    """Era static.nn.batch_norm: creates scale/shift/moving stats via
+    LayerHelper in the reference.  Here the four state tensors must be
+    passed explicitly (or use the stateful nn.BatchNorm layer, which owns
+    them)."""
+    if running_mean is None or running_var is None:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            "static.nn.batch_norm: pass running_mean/running_var (and "
+            "optional weight/bias) explicitly — there is no LayerHelper "
+            "parameter store; or use the stateful nn.BatchNorm layer")
+    import importlib
+    F = importlib.import_module("..nn.functional", __package__)
+    out = F.batch_norm(input, running_mean, running_var, weight, bias,
+                       training=not (is_test or use_global_stats),
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+deform_conv2d = _lazy("..vision.ops", "deform_conv2d")
+create_parameter = _lazy("..tensor.creation", "create_parameter")

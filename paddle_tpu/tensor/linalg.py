@@ -231,3 +231,9 @@ def lstsq(x, y, rcond=None, driver=None, name=None):
         sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
         return sol, res, rank, sv
     return dispatch("lstsq", raw, x, y)
+
+
+# era spellings surfaced under tensor.linalg (reference tensor/linalg.py
+# __all__ lists these alongside matmul/norm/dist/...)
+from .math import dot, cross  # noqa: F401,E402
+from .manipulation import transpose, t  # noqa: F401,E402

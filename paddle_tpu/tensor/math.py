@@ -394,3 +394,17 @@ def add_n(inputs, name=None):
             out = out + x
         return out
     return dispatch("add_n", raw, *inputs)
+
+
+# era spellings surfaced under tensor.math (reference tensor/math.py
+# __all__ lists mul/mm/broadcast_shape)
+from .linalg import mm  # noqa: F401,E402
+from .manipulation import broadcast_shape  # noqa: F401,E402
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1, name=None):
+    """The era mul_op is flattened MATRIX multiplication (reference
+    fluid/layers/nn.py:12441), NOT elementwise (that is elementwise_mul /
+    multiply) — implementation in fluid.layers_extra."""
+    from ..fluid.layers_extra import mul as _impl
+    return _impl(x, y, x_num_col_dims, y_num_col_dims, name)

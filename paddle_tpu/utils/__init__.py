@@ -2,6 +2,20 @@
 from . import checkpoint, flags, profiler  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
 
+
+def dump_config(path=None):
+    """paddle.utils.dump_config — the reference lists this in
+    utils/__init__.py:28 __all__ without ever defining it (a phantom of
+    the era).  Here it does what the name promises: dump the live FLAGS
+    registry as JSON to `path`, or return the dict."""
+    import json
+    snapshot = dict(flags._FLAGS)
+    if path is not None:
+        with open(path, "w") as f:
+            json.dump(snapshot, f, indent=2, default=str)
+        return path
+    return snapshot
+
 def try_import(name):
     import importlib
     return importlib.import_module(name)
