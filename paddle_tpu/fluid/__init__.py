@@ -24,8 +24,6 @@ from ..core.device import (  # noqa: F401
     device_count as core_device_count,
 )
 from ..nn.layer_base import ParamAttr  # noqa: F401
-from ..nn import initializer  # noqa: F401
-from .. import regularizer  # noqa: F401
 from ..core.tensor import Tensor  # noqa: F401
 from ..utils.checkpoint import save as save_dygraph, load as load_dygraph  # noqa: F401
 
@@ -34,6 +32,13 @@ from . import dygraph  # noqa: F401
 from . import io  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import core  # noqa: F401
+# era submodules with the fluid-era spellings (Xavier/MSRA factories,
+# *Regularizer/*Initializer aliases, set_gradient_clip, the numpy
+# metric accumulators)
+from . import initializer  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import metrics  # noqa: F401
 
 # fluid.embedding / one_hot live at the package top level too
 from .layers import embedding, one_hot  # noqa: F401

@@ -1,0 +1,13 @@
+"""fluid.clip alias module (reference: python/paddle/fluid/clip.py
+__all__): era spellings over nn.clip."""
+from ..nn.clip import (  # noqa: F401
+    ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+    ErrorClipByValue, set_gradient_clip,
+)
+
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+__all__ = ["set_gradient_clip", "ErrorClipByValue", "ClipGradByValue",
+           "ClipGradByNorm", "ClipGradByGlobalNorm"]

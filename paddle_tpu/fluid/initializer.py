@@ -1,0 +1,44 @@
+"""fluid.initializer alias module (reference: python/paddle/fluid/
+initializer.py __all__): era spellings over nn.initializer.  Xavier/MSRA
+take the era `uniform` flag and resolve to the Normal/Uniform 2.0 pair."""
+from __future__ import annotations
+
+from ..nn.initializer import (  # noqa: F401
+    Assign, Bilinear, Constant, Normal, TruncatedNormal, Uniform,
+    XavierNormal, XavierUniform, KaimingNormal, KaimingUniform,
+    set_global_initializer,
+)
+
+__all__ = [
+    "Constant", "Uniform", "Normal", "TruncatedNormal", "Xavier",
+    "Bilinear", "MSRA", "ConstantInitializer", "UniformInitializer",
+    "NormalInitializer", "TruncatedNormalInitializer", "XavierInitializer",
+    "BilinearInitializer", "MSRAInitializer", "NumpyArrayInitializer",
+    "set_global_initializer",
+]
+
+
+def Xavier(uniform=True, fan_in=None, fan_out=None, seed=0):  # noqa: N802
+    """Era factory (reference initializer.py XavierInitializer: one class
+    with a uniform flag; 2.0 split it into XavierUniform/XavierNormal)."""
+    cls = XavierUniform if uniform else XavierNormal
+    return cls(fan_in=fan_in, fan_out=fan_out)
+
+
+def MSRA(uniform=True, fan_in=None, seed=0):  # noqa: N802
+    """Era factory (reference MSRAInitializer -> Kaiming pair)."""
+    cls = KaimingUniform if uniform else KaimingNormal
+    try:
+        return cls(fan_in=fan_in)
+    except TypeError:
+        return cls()
+
+
+ConstantInitializer = Constant
+UniformInitializer = Uniform
+NormalInitializer = Normal
+TruncatedNormalInitializer = TruncatedNormal
+XavierInitializer = Xavier
+BilinearInitializer = Bilinear
+MSRAInitializer = MSRA
+NumpyArrayInitializer = Assign

@@ -1,0 +1,265 @@
+"""fluid.metrics alias module (reference: python/paddle/fluid/metrics.py
+__all__ = MetricBase, CompositeMetric, Precision, Recall, Accuracy,
+ChunkEvaluator, EditDistance, DetectionMAP, Auc).
+
+The era classes are host-side numpy ACCUMULATORS with update()/eval()
+(different surface from the 2.0 paddle.metric classes, which are
+batch-metric objects with update/accumulate): Accuracy takes
+(value, weight) pairs, Precision/Recall take binary preds/labels,
+ChunkEvaluator takes the chunk_eval op's count outputs, EditDistance the
+edit_distance op's outputs.  DetectionMAP here is an eager mAP
+accumulator over the padded detection_output rows instead of the
+reference's in-program detection_map op."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, unwrap
+
+__all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall",
+           "Accuracy", "ChunkEvaluator", "EditDistance", "DetectionMAP",
+           "Auc"]
+
+from ..metric import Auc  # noqa: F401,E402  (same streaming surface)
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(unwrap(x))
+    return np.asarray(x)
+
+
+class MetricBase:
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__
+
+    def reset(self):
+        for k, v in self.__dict__.items():
+            if k.startswith("_") and not k.startswith("__"):
+                continue
+            if isinstance(v, (int, float)):
+                setattr(self, k, type(v)(0))
+            elif isinstance(v, np.ndarray):
+                setattr(self, k, np.zeros_like(v))
+
+    def get_config(self):
+        return {k: v for k, v in self.__dict__.items()
+                if not k.startswith("_")}
+
+    def update(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def eval(self):  # noqa: A003
+        raise NotImplementedError
+
+
+class CompositeMetric(MetricBase):
+    """Hold several metrics updated with the same inputs; eval returns
+    their results in add order."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self._metrics = []
+
+    def add_metric(self, metric):
+        if not isinstance(metric, MetricBase):
+            raise ValueError("add_metric expects a MetricBase")
+        self._metrics.append(metric)
+
+    def update(self, preds, labels):
+        for m in self._metrics:
+            m.update(preds, labels)
+
+    def eval(self):  # noqa: A003
+        return [m.eval() for m in self._metrics]
+
+
+class Accuracy(MetricBase):
+    """Weighted running mean of per-batch accuracy values."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.value = 0.0
+        self.weight = 0.0
+
+    def update(self, value, weight=1):
+        value = float(np.asarray(_np(value)).reshape(-1)[0])
+        weight = float(weight)
+        if weight < 0:
+            raise ValueError("weight must be nonnegative")
+        self.value += value * weight
+        self.weight += weight
+
+    def eval(self):  # noqa: A003
+        if self.weight == 0:
+            raise ValueError("no batches accumulated — call update first")
+        return self.value / self.weight
+
+
+class Precision(MetricBase):
+    """Binary precision over thresholded preds (era contract: preds are
+    probabilities, labels 0/1; rounded at 0.5)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        l = _np(labels).reshape(-1).astype(np.int64)  # noqa: E741
+        self.tp += int(np.sum((p == 1) & (l == 1)))
+        self.fp += int(np.sum((p == 1) & (l == 0)))
+
+    def eval(self):  # noqa: A003
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(MetricBase):
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        p = (_np(preds).reshape(-1) > 0.5).astype(np.int64)
+        l = _np(labels).reshape(-1).astype(np.int64)  # noqa: E741
+        self.tp += int(np.sum((p == 1) & (l == 1)))
+        self.fn += int(np.sum((p == 0) & (l == 1)))
+
+    def eval(self):  # noqa: A003
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class ChunkEvaluator(MetricBase):
+    """Accumulate the (num_infer, num_label, num_correct) chunk counts the
+    chunk_eval op emits; eval -> (precision, recall, f1)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(_np(num_infer_chunks).reshape(-1)[0])
+        self.num_label_chunks += int(_np(num_label_chunks).reshape(-1)[0])
+        self.num_correct_chunks += int(
+            _np(num_correct_chunks).reshape(-1)[0])
+
+    def eval(self):  # noqa: A003
+        precision = (self.num_correct_chunks / self.num_infer_chunks
+                     if self.num_infer_chunks else 0.0)
+        recall = (self.num_correct_chunks / self.num_label_chunks
+                  if self.num_label_chunks else 0.0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0.0)
+        return precision, recall, f1
+
+
+class EditDistance(MetricBase):
+    """Accumulate the edit_distance op's (distances, seq_num) outputs;
+    eval -> (avg distance, instance error rate)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        d = _np(distances).reshape(-1)
+        self.total_distance += float(d.sum())
+        self.seq_num += int(_np(seq_num).reshape(-1)[0])
+        self.instance_error += int(np.sum(d != 0))
+
+    def eval(self):  # noqa: A003
+        if self.seq_num == 0:
+            raise ValueError("no sequences accumulated")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / self.seq_num)
+
+
+class DetectionMAP(MetricBase):
+    """Eager mean-average-precision accumulator over padded detection
+    rows (the reference builds an in-program detection_map op instead;
+    fluid/metrics.py DetectionMAP).  update() takes detection_output's
+    (B, K, 6) [label, score, x1, y1, x2, y2] rows + counts and the padded
+    ground truth; eval() computes 11-point or integral mAP."""
+
+    def __init__(self, name=None, overlap_threshold=0.5,
+                 evaluate_difficult=True, ap_version="integral"):
+        super().__init__(name)
+        self.overlap_threshold = overlap_threshold
+        self.ap_version = ap_version
+        self._dets = []   # (label, score, iou-matched flag) per image
+        self._npos = {}
+
+    def update(self, nmsed_out, counts, gt_box, gt_label, gt_count=None):
+        det = _np(nmsed_out)
+        cnt = _np(counts).astype(np.int64)
+        gb = _np(gt_box)
+        gl = _np(gt_label).astype(np.int64)
+        if gl.ndim == 3:
+            gl = gl[..., 0]
+        gc = (_np(gt_count).astype(np.int64) if gt_count is not None
+              else np.full(gb.shape[0], gb.shape[1], np.int64))
+        for b in range(det.shape[0]):
+            boxes_gt = gb[b, :gc[b]]
+            labels_gt = gl[b, :gc[b]]
+            for c in np.unique(labels_gt):
+                self._npos[int(c)] = self._npos.get(int(c), 0) + int(
+                    np.sum(labels_gt == c))
+            used = np.zeros(gc[b], bool)
+            rows = det[b, :cnt[b]]
+            for lab, score, x1, y1, x2, y2 in rows:
+                best_iou, best_j = 0.0, -1
+                for j in range(gc[b]):
+                    if used[j] or labels_gt[j] != int(lab):
+                        continue
+                    bx = boxes_gt[j]
+                    ix1, iy1 = max(x1, bx[0]), max(y1, bx[1])
+                    ix2, iy2 = min(x2, bx[2]), min(y2, bx[3])
+                    inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+                    union = ((x2 - x1) * (y2 - y1)
+                             + (bx[2] - bx[0]) * (bx[3] - bx[1]) - inter)
+                    iou = inter / union if union > 0 else 0.0
+                    if iou > best_iou:
+                        best_iou, best_j = iou, j
+                tp = best_iou >= self.overlap_threshold and best_j >= 0
+                if tp:
+                    used[best_j] = True
+                self._dets.append((int(lab), float(score), bool(tp)))
+
+    def eval(self):  # noqa: A003
+        aps = []
+        for c, npos in self._npos.items():
+            recs = sorted((d for d in self._dets if d[0] == c),
+                          key=lambda d: -d[1])
+            tps = np.cumsum([d[2] for d in recs]) if recs else np.array([])
+            fps = np.cumsum([not d[2] for d in recs]) if recs \
+                else np.array([])
+            if len(recs) == 0 or npos == 0:
+                aps.append(0.0)
+                continue
+            rec = tps / npos
+            prec = tps / np.maximum(tps + fps, 1e-12)
+            if self.ap_version == "11point":
+                ap = float(np.mean([
+                    prec[rec >= t].max() if np.any(rec >= t) else 0.0
+                    for t in np.linspace(0, 1, 11)]))
+            else:  # integral
+                mrec = np.concatenate([[0.0], rec, [1.0]])
+                mpre = np.concatenate([[0.0], prec, [0.0]])
+                for i in range(len(mpre) - 2, -1, -1):
+                    mpre[i] = max(mpre[i], mpre[i + 1])
+                idx = np.nonzero(mrec[1:] != mrec[:-1])[0]
+                ap = float(np.sum(
+                    (mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0
+
+    get_map_var = eval

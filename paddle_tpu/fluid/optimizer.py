@@ -18,3 +18,60 @@ RMSPropOptimizer = RMSProp
 AdadeltaOptimizer = Adadelta
 LambOptimizer = Lamb
 LarsMomentumOptimizer = LarsMomentum
+from ..optimizer import (  # noqa: F401,E402
+    Ftrl, DecayedAdagrad, Dpsgd, Lookahead as LookaheadOptimizer,
+    ExponentialMovingAverage, ModelAverage,
+)
+
+FtrlOptimizer = Ftrl
+DecayedAdagradOptimizer = DecayedAdagrad
+DpsgdOptimizer = Dpsgd
+
+
+class RecomputeOptimizer:
+    """Era wrapper (reference fluid/optimizer.py RecomputeOptimizer):
+    marks checkpoint segments for activation recompute.  TPU-native: the
+    compiled path is `jit.TrainStep(..., remat=True)` / the fleet
+    recompute meta-optimizer (jax.checkpoint); eagerly, minimize is
+    semantically identical (recompute only trades memory)."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = checkpoints
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        # mirror the base Optimizer.minimize contract: return the era
+        # (optimize_ops, params_grads) pair, leave grads inspectable
+        loss.backward()
+        self._optimizer.step()
+        return None, [(p, p.grad)
+                      for p in self._optimizer._parameter_list or []]
+
+
+class PipelineOptimizer:
+    """Era wrapper (reference fluid/optimizer.py PipelineOptimizer): tags
+    the program for pipeline execution.  TPU-native: the executing path is
+    `parallel.pipeline.gpt_pipeline_step` / ShardedTrainStep over a pp
+    mesh axis; this wrapper keeps the era construction site importable and
+    delegates the optimizer surface."""
+
+    def __init__(self, optimizer, num_microbatches=1, start_cpu_core_id=0):
+        self._optimizer = optimizer
+        self._num_microbatches = num_microbatches
+
+    def __getattr__(self, name):
+        return getattr(self._optimizer, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        loss.backward()
+        self._optimizer.step()
+        return None, [(p, p.grad)
+                      for p in self._optimizer._parameter_list or []]

@@ -1,0 +1,10 @@
+"""fluid.regularizer alias module (reference: python/paddle/fluid/
+regularizer.py __all__ = L1Decay, L2Decay, L1DecayRegularizer,
+L2DecayRegularizer)."""
+from ..regularizer import L1Decay, L2Decay  # noqa: F401
+
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer",
+           "L2DecayRegularizer"]

@@ -87,3 +87,33 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=Fals
     for p in params:
         p.grad._set_data(p.grad._data * scale)
     return Tensor(total)
+
+
+# era program-global gradient clip (reference fluid/clip.py
+# set_gradient_clip): applies to optimizers constructed WITHOUT their own
+# grad_clip (optimizer-level clip has priority, as the reference warns)
+_global_gradient_clip = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    global _global_gradient_clip
+    if param_list is not None:
+        raise NotImplementedError(
+            "set_gradient_clip: per-param clip lists are a static-program "
+            "construct — pass grad_clip to the optimizer instead")
+    _global_gradient_clip = clip
+
+
+class ErrorClipByValue:
+    """Era error-clip attribute (reference fluid/clip.py ErrorClipByValue:
+    clips a variable's GRADIENT during backward).  Tape-era analogue:
+    call `.apply(tensor)` to register a gradient hook on the tensor."""
+
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def apply(self, tensor):
+        import jax.numpy as jnp
+        tensor.register_hook(lambda g: jnp.clip(g, self.min, self.max))
+        return tensor

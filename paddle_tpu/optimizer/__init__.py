@@ -1,6 +1,7 @@
 """paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
 from . import lr  # noqa: F401
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,  # noqa: F401
-                        Adagrad, RMSProp, Adadelta, Lamb, LarsMomentum, Ftrl)
-from .averaging import ExponentialMovingAverage, ModelAverage  # noqa: F401
+                        Adagrad, RMSProp, Adadelta, Lamb, LarsMomentum,
+                        Ftrl, DecayedAdagrad, Dpsgd)
+from .averaging import ExponentialMovingAverage, ModelAverage, Lookahead  # noqa: F401
 from .dgc import DGCMomentum  # noqa: F401

@@ -153,3 +153,47 @@ class ModelAverage:
         for p, b in zip(self._params, self._backup):
             p._set_data(b)
         self._backup = None
+
+
+class Lookahead:
+    """Lookahead wrapper (reference: fluid/optimizer.py LookaheadOptimizer,
+    arXiv:1907.08610): the inner ("fast") optimizer steps normally; every
+    k steps the slow weights move slow += alpha*(fast - slow) and the fast
+    weights reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if inner_optimizer is None:
+            raise ValueError("Lookahead needs an inner optimizer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha, self.k = float(alpha), int(k)
+        self._params = _float_params(inner_optimizer._parameter_list or [])
+        self._slow = [p._data for p in self._params]
+        self._n = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._n += 1
+        if self._n % self.k == 0:
+            for i, p in enumerate(self._params):
+                slow = (self._slow[i]
+                        + self.alpha * (p._data.astype(self._slow[i].dtype)
+                                        - self._slow[i]))
+                self._slow[i] = slow
+                p._set_data(slow.astype(p._data.dtype))
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        # base Optimizer.minimize contract: (optimize_ops, params_grads),
+        # grads left inspectable
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad)
+                      for p in self.inner_optimizer._parameter_list or []]
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()

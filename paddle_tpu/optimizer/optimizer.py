@@ -98,12 +98,21 @@ class Optimizer:
             self._step_count += 1
             return
         grads = [p.grad for p in params]
+        # resolve the EFFECTIVE clip up front: the optimizer's own, else
+        # the era program-global from fluid.clip.set_gradient_clip (the
+        # reference documents the optimizer's grad_clip as higher
+        # priority) — the sparse densify guard below must see it too, or
+        # sparse grads would silently bypass the global clip
+        clip = self._grad_clip
+        if clip is None:
+            from ..nn import clip as _clip_mod
+            clip = getattr(_clip_mod, "_global_gradient_clip", None)
         # SelectedRows grads take the lazy row-wise path; a grad_clip
         # densifies them first (the reference likewise forbids global-norm
         # clipping over sparse grads).
         sparse = [(i, g) for i, g in enumerate(grads)
                   if isinstance(g, RowSparseGrad)]
-        if sparse and (self._grad_clip is not None
+        if sparse and (clip is not None
                        or not self._elementwise_update):
             for i, g in sparse:
                 grads[i] = Tensor(g.to_dense(), stop_gradient=True)
@@ -130,8 +139,8 @@ class Optimizer:
             if not params:
                 self._step_count += 1
                 return
-        if self._grad_clip is not None:
-            pg = self._grad_clip(list(zip(params, grads)))
+        if clip is not None:
+            pg = clip(list(zip(params, grads)))
             grads = [g for _, g in pg]
         # decoupled regularizer path: per-param regularizer overrides global wd
         self._step_count += 1
@@ -472,6 +481,59 @@ class LarsMomentum(Optimizer):
         v = self._momentum * state["velocity"] + lr * local_lr * (
             g32 + self._lars_wd * p32)
         return (p32 - v).astype(p.dtype), {"velocity": v}
+
+
+class DecayedAdagrad(Optimizer):
+    """reference: operators/optimizers/decayed_adagrad_op +
+    fluid/optimizer.py:2384 — moment = decay*moment + (1-decay)*g^2;
+    p -= lr * g / (sqrt(moment) + eps)."""
+
+    def __init__(self, learning_rate=0.001, decay=0.95, epsilon=1e-6,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._decay, self._eps = decay, epsilon
+
+    def init_state(self, p):
+        return {"moment": jnp.zeros_like(p, jnp.float32)}
+
+    def update_one(self, p, g, state, lr, step):
+        g32 = g.astype(jnp.float32)
+        m = self._decay * state["moment"] + (1 - self._decay) * jnp.square(g32)
+        new_p = p.astype(jnp.float32) - lr * g32 / (jnp.sqrt(m) + self._eps)
+        return new_p.astype(p.dtype), {"moment": m}
+
+
+class Dpsgd(Optimizer):
+    """Differentially-private SGD (reference:
+    operators/optimizers/dpsgd_op.h, CCS16 arXiv:1607.00133): per-tensor
+    l2 clip to `clip`, ONE gaussian noise sample per tensor scaled by
+    sigma/batch_size, p -= lr*(g/scale + noise/batch_size).  TPU-native:
+    the noise rides core.rng (paddle.seed-reproducible) instead of the
+    kernel's Box-Muller loop."""
+
+    _elementwise_update = False  # per-tensor l2 norm
+
+    def __init__(self, learning_rate=0.001, clip=0.9, batch_size=0.999,
+                 sigma=0.9, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._clip, self._bs, self._sigma = clip, batch_size, sigma
+
+    def init_state(self, p):
+        return {}
+
+    def update_one(self, p, g, state, lr, step):
+        from ..core import rng as _rng
+        g32 = g.astype(jnp.float32)
+        l2 = jnp.sqrt(jnp.sum(jnp.square(g32)))
+        scale = jnp.maximum(l2 / self._clip, 1.0)
+        noise = self._sigma * jax.random.normal(_rng.next_key(), ())
+        new_p = (p.astype(jnp.float32)
+                 - lr * (g32 / scale + noise / self._bs))
+        return new_p.astype(p.dtype), {}
 
 
 class Ftrl(Optimizer):

@@ -260,42 +260,65 @@ layer_norm = _lazy("..nn.functional", "layer_norm")
 prelu = _lazy("..nn.functional", "prelu")
 
 
-def _conv_builder(fname, ndim):
+def _run_conv(fname, input, weight, bias, act, kw):  # noqa: A002
+    if weight is None:
+        from ..core.errors import InvalidArgumentError
+        raise InvalidArgumentError(
+            f"static.nn.{fname}: pass `weight` (and optional `bias`) "
+            f"explicitly — there is no LayerHelper parameter store; "
+            f"or use the stateful nn.Conv layer family")
+    import importlib
+    F = importlib.import_module("..nn.functional", __package__)
+    out = getattr(F, fname)(input, weight, bias, **kw)
+    if act is not None:
+        out = getattr(F, act)(out)
+    return out
+
+
+def _conv_builder(fname):
     """Era static-graph conv builders (reference static/nn: conv2d(input,
-    num_filters, filter_size, ...) creates its weight via LayerHelper).
-    There is no program-scope parameter store here, so the era signature
-    is accepted but the weight must be passed explicitly (the repo's
-    documented convention for LayerHelper-created parameters — see
+    num_filters, filter_size, stride, padding, ...) creates its weight via
+    LayerHelper).  No program-scope parameter store here, so the era
+    signature is accepted but the weight must be passed explicitly (the
+    repo's documented convention for LayerHelper-created parameters — see
     fluid.layers.multi_box_head) or use the stateful nn.Conv*D layer."""
     def f(input, num_filters, filter_size, stride=1, padding=0,  # noqa: A002
           dilation=1, groups=1, param_attr=None, bias_attr=None,
           use_cudnn=True, act=None, name=None, data_format="NCHW",
-          weight=None, bias=None, output_size=None):
-        if weight is None:
-            from ..core.errors import InvalidArgumentError
-            raise InvalidArgumentError(
-                f"static.nn.{fname}: pass `weight` (and optional `bias`) "
-                f"explicitly — there is no LayerHelper parameter store; "
-                f"or build an nn.{fname.replace('conv', 'Conv').replace('_transpose', 'Transpose')}-style layer")
-        import importlib
-        F = importlib.import_module("..nn.functional", __package__)
-        kw = dict(stride=stride, padding=padding, dilation=dilation,
-                  groups=groups, data_format=data_format)
-        if fname.endswith("_transpose") and output_size is not None:
-            kw["output_size"] = output_size
-        out = getattr(F, fname)(input, weight, bias, **kw)
-        if act is not None:
-            out = getattr(F, act)(out)
-        return out
+          weight=None, bias=None):
+        return _run_conv(fname, input, weight, bias, act,
+                         dict(stride=stride, padding=padding,
+                              dilation=dilation, groups=groups,
+                              data_format=data_format))
     f.__name__ = fname
     f.__doc__ = _conv_builder.__doc__
     return f
 
 
-conv2d = _conv_builder("conv2d", 2)
-conv2d_transpose = _conv_builder("conv2d_transpose", 2)
-conv3d = _conv_builder("conv3d", 3)
-conv3d_transpose = _conv_builder("conv3d_transpose", 3)
+def _conv_transpose_builder(fname):
+    """Era transpose signature puts output_size BEFORE filter_size and
+    padding before stride (reference fluid/layers/nn.py:3736
+    conv2d_transpose(input, num_filters, output_size=None,
+    filter_size=None, padding=0, stride=1, ...)) — positional era calls
+    must bind correctly."""
+    def f(input, num_filters, output_size=None, filter_size=None,  # noqa: A002
+          padding=0, stride=1, dilation=1, groups=1, param_attr=None,
+          bias_attr=None, use_cudnn=True, act=None, name=None,
+          data_format="NCHW", weight=None, bias=None):
+        kw = dict(stride=stride, padding=padding, dilation=dilation,
+                  groups=groups, data_format=data_format)
+        if output_size is not None:
+            kw["output_size"] = output_size
+        return _run_conv(fname, input, weight, bias, act, kw)
+    f.__name__ = fname
+    f.__doc__ = _conv_transpose_builder.__doc__
+    return f
+
+
+conv2d = _conv_builder("conv2d")
+conv3d = _conv_builder("conv3d")
+conv2d_transpose = _conv_transpose_builder("conv2d_transpose")
+conv3d_transpose = _conv_transpose_builder("conv3d_transpose")
 
 
 def batch_norm(input, act=None, is_test=False, momentum=0.9,  # noqa: A002

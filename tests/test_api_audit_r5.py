@@ -35,7 +35,14 @@ def _pairs():
     import paddle_tpu.utils, paddle_tpu.regularizer  # noqa: F401
     import paddle_tpu.vision.ops, paddle_tpu.distribution  # noqa: F401
     import paddle_tpu.jit, paddle_tpu.onnx, paddle_tpu.io  # noqa: F401
+    import paddle_tpu.fluid as fluid  # noqa: F401
     return [
+        ("fluid/optimizer.py", fluid.optimizer),
+        ("fluid/initializer.py", fluid.initializer),
+        ("fluid/regularizer.py", fluid.regularizer),
+        ("fluid/clip.py", fluid.clip),
+        ("fluid/metrics.py", fluid.metrics),
+    ] + [
         ("nn/__init__.py", paddle.nn),
         ("nn/functional/__init__.py", paddle.nn.functional),
         ("nn/initializer/__init__.py", paddle.nn.initializer),

@@ -1,0 +1,164 @@
+"""r5 fluid-era tail: DecayedAdagrad/Dpsgd/Lookahead optimizers,
+set_gradient_clip global fallback, and the fluid.metrics numpy
+accumulators vs oracles.  Reference: fluid/optimizer.py:2384 (DecayedAdagrad),
+operators/optimizers/dpsgd_op.h, fluid/optimizer.py LookaheadOptimizer,
+fluid/clip.py set_gradient_clip, fluid/metrics.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+import paddle_tpu.nn as nn
+
+
+def _param(val):
+    return paddle.to_tensor(np.asarray(val, "float32"),
+                            stop_gradient=False)
+
+
+def test_decayed_adagrad_matches_formula():
+    paddle.seed(0)
+    p = _param([1.0, -2.0])
+    opt = paddle.optimizer.DecayedAdagrad(
+        learning_rate=0.1, decay=0.9, epsilon=1e-6, parameters=[p])
+    (p * paddle.to_tensor(np.array([3.0, -1.0], "float32"))).sum().backward()
+    opt.step()
+    g = np.array([3.0, -1.0])
+    m = 0.1 * g ** 2  # decay*0 + (1-decay)*g^2
+    want = np.array([1.0, -2.0]) - 0.1 * g / (np.sqrt(m) + 1e-6)
+    np.testing.assert_allclose(p.numpy(), want, rtol=1e-5)
+
+
+def test_dpsgd_clips_and_is_seed_reproducible():
+    def run():
+        paddle.seed(42)
+        p = _param([1.0, 1.0])
+        opt = paddle.optimizer.Dpsgd(learning_rate=0.1, clip=1.0,
+                                     batch_size=1.0, sigma=0.1,
+                                     parameters=[p])
+        (p * paddle.to_tensor(np.array([30.0, 40.0], "float32"))
+         ).sum().backward()
+        opt.step()
+        return p.numpy()
+
+    a, b = run(), run()
+    np.testing.assert_array_equal(a, b)  # paddle.seed pins the noise
+    # grad (30,40) has l2=50 > clip=1 -> scaled by 1/50; update ~ 0.1*(0.6,0.8)+noise
+    delta = np.array([1.0, 1.0]) - a
+    np.testing.assert_allclose(delta, 0.1 * np.array([0.6, 0.8]),
+                               atol=0.05)
+
+
+def test_lookahead_slow_weight_sync():
+    paddle.seed(0)
+    p = _param([0.0])
+    inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+    la = paddle.optimizer.Lookahead(inner, alpha=0.5, k=2)
+    for _ in range(2):
+        (p * paddle.to_tensor(np.array([-1.0], "float32"))).sum().backward()
+        la.step()
+        la.clear_grad()
+    # fast: 0 -> 1 -> 2; at k=2: slow = 0 + 0.5*(2-0) = 1; fast reset to 1
+    np.testing.assert_allclose(p.numpy(), [1.0])
+
+
+def test_set_gradient_clip_global_fallback():
+    from paddle_tpu.nn import clip as nclip
+    try:
+        fluid.clip.set_gradient_clip(nn.ClipGradByValue(max=0.1))
+        p = _param([0.0])
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        (p * paddle.to_tensor(np.array([100.0], "float32"))).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [-0.1], rtol=1e-6)
+        # optimizer-level clip has priority over the global
+        p2 = _param([0.0])
+        opt2 = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p2],
+                                    grad_clip=nn.ClipGradByValue(max=0.5))
+        (p2 * paddle.to_tensor(np.array([100.0], "float32"))
+         ).sum().backward()
+        opt2.step()
+        np.testing.assert_allclose(p2.numpy(), [-0.5], rtol=1e-6)
+    finally:
+        nclip._global_gradient_clip = None
+
+
+def test_set_gradient_clip_densifies_sparse_grads():
+    """The global clip must densify sparse embedding grads exactly like an
+    optimizer-level clip does — not silently skip them (review r5)."""
+    from paddle_tpu.nn import clip as nclip
+    try:
+        fluid.clip.set_gradient_clip(nn.ClipGradByValue(max=0.01))
+        paddle.seed(0)
+        emb = nn.Embedding(8, 4, sparse=True)
+        before = emb.weight.numpy().copy()
+        opt = paddle.optimizer.SGD(learning_rate=1.0,
+                                   parameters=emb.parameters())
+        out = emb(paddle.to_tensor(np.array([[1, 2]], "int64")))
+        (out * 100).sum().backward()
+        opt.step()
+        delta = np.abs(emb.weight.numpy() - before)
+        assert delta.max() <= 0.01 + 1e-6, (
+            f"sparse grad escaped the global clip: max delta {delta.max()}")
+        assert delta.max() > 0  # the update did happen
+    finally:
+        nclip._global_gradient_clip = None
+
+
+def test_fluid_metrics_accumulators():
+    m = fluid.metrics.Accuracy()
+    m.update(0.8, weight=4)
+    m.update(0.6, weight=1)
+    assert abs(m.eval() - (0.8 * 4 + 0.6) / 5) < 1e-9
+
+    pr, rc = fluid.metrics.Precision(), fluid.metrics.Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.7])
+    labels = np.array([1, 0, 1, 1])
+    pr.update(preds, labels)
+    rc.update(preds, labels)
+    assert abs(pr.eval() - 2 / 3) < 1e-9   # tp=2 fp=1
+    assert abs(rc.eval() - 2 / 3) < 1e-9   # tp=2 fn=1
+
+    ch = fluid.metrics.ChunkEvaluator()
+    ch.update(10, 8, 6)
+    p, r, f1 = ch.eval()
+    assert abs(p - 0.6) < 1e-9 and abs(r - 0.75) < 1e-9
+    assert abs(f1 - 2 * 0.6 * 0.75 / 1.35) < 1e-9
+
+    ed = fluid.metrics.EditDistance()
+    ed.update(np.array([2.0, 0.0, 1.0]), 3)
+    avg, err = ed.eval()
+    assert abs(avg - 1.0) < 1e-9 and abs(err - 2 / 3) < 1e-9
+
+    comp = fluid.metrics.CompositeMetric()
+    comp.add_metric(fluid.metrics.Precision())
+    comp.add_metric(fluid.metrics.Recall())
+    comp.update(preds, labels)
+    assert comp.eval() == [2 / 3, 2 / 3]
+
+
+def test_detection_map_perfect_and_miss():
+    dm = fluid.metrics.DetectionMAP()
+    # one image, one gt box of class 1; one perfect detection
+    det = np.array([[[1, 0.9, 0, 0, 10, 10],
+                     [-1, -1, -1, -1, -1, -1]]], "float32")
+    counts = np.array([1])
+    gtb = np.array([[[0, 0, 10, 10]]], "float32")
+    gtl = np.array([[1]])
+    dm.update(det, counts, gtb, gtl)
+    assert abs(dm.eval() - 1.0) < 1e-6
+
+    dm2 = fluid.metrics.DetectionMAP()
+    det2 = np.array([[[1, 0.9, 50, 50, 60, 60],
+                      [-1, -1, -1, -1, -1, -1]]], "float32")
+    dm2.update(det2, counts, gtb, gtl)
+    assert dm2.eval() == 0.0
+
+
+def test_era_initializer_factories():
+    x = fluid.initializer.Xavier(uniform=False)
+    m = fluid.initializer.MSRA()
+    assert type(x).__name__ == "XavierNormal"
+    assert "Kaiming" in type(m).__name__
+    assert fluid.initializer.NumpyArrayInitializer is \
+        paddle.nn.initializer.Assign

@@ -47,6 +47,7 @@ def test_density_prior_box_oracle():
     np.testing.assert_allclose(b2.numpy(), bn.reshape(-1, 4))
 
 
+@pytest.mark.slow
 def test_detection_output_softmax_contract_and_batched_trace(monkeypatch):
     """detection_output takes RAW confidences and softmaxes internally
     (reference detection.py:721), and the batch NMS is one vmapped trace
