@@ -28,10 +28,7 @@ def Xavier(uniform=True, fan_in=None, fan_out=None, seed=0):  # noqa: N802
 def MSRA(uniform=True, fan_in=None, seed=0):  # noqa: N802
     """Era factory (reference MSRAInitializer -> Kaiming pair)."""
     cls = KaimingUniform if uniform else KaimingNormal
-    try:
-        return cls(fan_in=fan_in)
-    except TypeError:
-        return cls()
+    return cls(fan_in=fan_in)
 
 
 ConstantInitializer = Constant

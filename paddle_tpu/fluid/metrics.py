@@ -20,7 +20,15 @@ __all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall",
            "Accuracy", "ChunkEvaluator", "EditDistance", "DetectionMAP",
            "Auc"]
 
-from ..metric import Auc  # noqa: F401,E402  (same streaming surface)
+from ..metric import Auc as _Auc20  # noqa: E402
+
+
+class Auc(_Auc20):
+    """Era surface over the 2.0 streaming Auc: same update(preds, labels)
+    accumulation, plus the era eval() spelling."""
+
+    def eval(self):  # noqa: A003
+        return self.accumulate()
 
 
 def _np(x):
@@ -65,6 +73,10 @@ class CompositeMetric(MetricBase):
         if not isinstance(metric, MetricBase):
             raise ValueError("add_metric expects a MetricBase")
         self._metrics.append(metric)
+
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
 
     def update(self, preds, labels):
         for m in self._metrics:
@@ -194,11 +206,17 @@ class DetectionMAP(MetricBase):
                  evaluate_difficult=True, ap_version="integral"):
         super().__init__(name)
         self.overlap_threshold = overlap_threshold
+        self.evaluate_difficult = evaluate_difficult
         self.ap_version = ap_version
         self._dets = []   # (label, score, iou-matched flag) per image
         self._npos = {}
 
-    def update(self, nmsed_out, counts, gt_box, gt_label, gt_count=None):
+    def reset(self):
+        self._dets = []
+        self._npos = {}
+
+    def update(self, nmsed_out, counts, gt_box, gt_label, gt_count=None,
+               difficult=None):
         det = _np(nmsed_out)
         cnt = _np(counts).astype(np.int64)
         gb = _np(gt_box)
@@ -207,12 +225,20 @@ class DetectionMAP(MetricBase):
             gl = gl[..., 0]
         gc = (_np(gt_count).astype(np.int64) if gt_count is not None
               else np.full(gb.shape[0], gb.shape[1], np.int64))
+        if difficult is not None:
+            df = _np(difficult).astype(bool)
+            if df.ndim == 3:
+                df = df[..., 0]
+        else:
+            df = np.zeros(gl.shape, bool)
+        count_difficult = self.evaluate_difficult
         for b in range(det.shape[0]):
             boxes_gt = gb[b, :gc[b]]
             labels_gt = gl[b, :gc[b]]
-            for c in np.unique(labels_gt):
-                self._npos[int(c)] = self._npos.get(int(c), 0) + int(
-                    np.sum(labels_gt == c))
+            diff_gt = df[b, :gc[b]]
+            for j, c in enumerate(labels_gt):
+                if count_difficult or not diff_gt[j]:
+                    self._npos[int(c)] = self._npos.get(int(c), 0) + 1
             used = np.zeros(gc[b], bool)
             rows = det[b, :cnt[b]]
             for lab, score, x1, y1, x2, y2 in rows:
@@ -229,10 +255,15 @@ class DetectionMAP(MetricBase):
                     iou = inter / union if union > 0 else 0.0
                     if iou > best_iou:
                         best_iou, best_j = iou, j
-                tp = best_iou >= self.overlap_threshold and best_j >= 0
-                if tp:
+                matched = (best_iou >= self.overlap_threshold
+                           and best_j >= 0)
+                if matched:
                     used[best_j] = True
-                self._dets.append((int(lab), float(score), bool(tp)))
+                    if not count_difficult and diff_gt[best_j]:
+                        # VOC convention: a detection matching a difficult
+                        # box is IGNORED (neither TP nor FP)
+                        continue
+                self._dets.append((int(lab), float(score), bool(matched)))
 
     def eval(self):  # noqa: A003
         aps = []

@@ -43,16 +43,10 @@ class RecomputeOptimizer:
         self._checkpoints = checkpoints
 
     def __getattr__(self, name):
+        # minimize/step/clear_grad all delegate to the inner optimizer —
+        # the base Optimizer.minimize already returns the era
+        # (optimize_ops, params_grads) pair
         return getattr(self._optimizer, name)
-
-    def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
-        # mirror the base Optimizer.minimize contract: return the era
-        # (optimize_ops, params_grads) pair, leave grads inspectable
-        loss.backward()
-        self._optimizer.step()
-        return None, [(p, p.grad)
-                      for p in self._optimizer._parameter_list or []]
 
 
 class PipelineOptimizer:
@@ -67,11 +61,5 @@ class PipelineOptimizer:
         self._num_microbatches = num_microbatches
 
     def __getattr__(self, name):
+        # delegates minimize/step/clear_grad (base contract included)
         return getattr(self._optimizer, name)
-
-    def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
-        loss.backward()
-        self._optimizer.step()
-        return None, [(p, p.grad)
-                      for p in self._optimizer._parameter_list or []]

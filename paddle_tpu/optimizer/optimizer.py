@@ -521,16 +521,27 @@ class Dpsgd(Optimizer):
         super().__init__(learning_rate, parameters, weight_decay,
                          grad_clip, name)
         self._clip, self._bs, self._sigma = clip, batch_size, sigma
+        # noise root: drawn once at construction (paddle.seed-pinned);
+        # per-step keys FOLD IN the traced step number below — calling
+        # next_key() inside update_one would bake ONE constant key into
+        # the jitted update and replay identical noise every step
+        from ..core import rng as _rng
+        self._noise_root = _rng.next_key()
+        self._noise_site = 0  # trace-time per-parameter op counter
 
     def init_state(self, p):
         return {}
 
     def update_one(self, p, g, state, lr, step):
-        from ..core import rng as _rng
         g32 = g.astype(jnp.float32)
         l2 = jnp.sqrt(jnp.sum(jnp.square(g32)))
         scale = jnp.maximum(l2 / self._clip, 1.0)
-        noise = self._sigma * jax.random.normal(_rng.next_key(), ())
+        # distinct per parameter (trace-time site counter constant) AND
+        # per step (traced step folds in at run time)
+        self._noise_site += 1
+        key = jax.random.fold_in(
+            jax.random.fold_in(self._noise_root, self._noise_site), step)
+        noise = self._sigma * jax.random.normal(key, ())
         new_p = (p.astype(jnp.float32)
                  - lr * (g32 / scale + noise / self._bs))
         return new_p.astype(p.dtype), {}

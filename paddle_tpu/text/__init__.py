@@ -16,7 +16,8 @@ import numpy as np
 from ..io.dataset import Dataset
 
 from .packing import pack_sequences, BucketByLengthBatchSampler  # noqa: F401
-from .datasets import Conll05st, WMT14, WMT16, Movielens  # noqa: F401
+from .datasets import (Conll05st, WMT14, WMT16, Movielens,  # noqa: F401
+                       MovieInfo, UserInfo)
 
 __all__ = ["FakeTextDataset", "Imdb", "Imikolov", "UCIHousing",
            "ViterbiDecoder", "viterbi_decode", "pack_sequences",

@@ -272,6 +272,48 @@ class WMT16(Dataset):
         return {v: k for k, v in d.items()} if reverse else d
 
 
+# era age bucketing (reference movielens.py age_table)
+_ML_AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """Era record for one movie (reference movielens.py:37): id, category
+    names and title; value() resolves them through the vocab dicts."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [[self.index],
+                [categories_dict[c] for c in self.categories],
+                [movie_title_dict[w.lower()] for w in self.title.split()]]
+
+    def __repr__(self):
+        return (f"<MovieInfo id({self.index}), title({self.title}), "
+                f"categories({self.categories})>")
+
+
+class UserInfo:
+    """Era record for one user (reference movielens.py:62): id, gender
+    flag, bucketed age index and job id."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = _ML_AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+    def __repr__(self):
+        return (f"<UserInfo id({self.index}), gender({self.is_male}), "
+                f"age({self.age}), job({self.job_id})>")
+
+
 class Movielens(Dataset):
     """MovieLens-1M ratings (reference: movielens.py — ml-1m zip with
     movies.dat/users.dat/ratings.dat '::'-separated; items are

@@ -317,6 +317,30 @@ class ColorJitter(BaseTransform):
         return np.clip(out, 0, hi)
 
 
+class SaturationTransform(BaseTransform):
+    """Saturation jitter alone (reference transforms.py SaturationTransform:
+    factor 1±value) — the ColorJitter luma-blend with one knob."""
+
+    def __init__(self, value, keys=None):
+        self.value = value
+        self._jitter = ColorJitter(saturation=value)
+
+    def _apply_image(self, img):
+        return self._jitter._apply_image(img)
+
+
+class HueTransform(BaseTransform):
+    """Hue jitter alone (reference transforms.py:804 HueTransform, value in
+    [0, 0.5]) — the ColorJitter YIQ chroma rotation with one knob."""
+
+    def __init__(self, value, keys=None):
+        self.value = value
+        self._jitter = ColorJitter(hue=value)
+
+    def _apply_image(self, img):
+        return self._jitter._apply_image(img)
+
+
 # functional aliases (paddle.vision.transforms.functional subset)
 def to_tensor(img, data_format="CHW"):
     return ToTensor(data_format)(img)

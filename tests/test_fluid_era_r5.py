@@ -155,6 +155,64 @@ def test_detection_map_perfect_and_miss():
     assert dm2.eval() == 0.0
 
 
+def test_dpsgd_noise_fresh_across_compiled_steps():
+    """The noise key must FOLD IN the traced step — a constant key baked
+    at trace time would replay identical noise every cached-jit step
+    (review r5)."""
+    paddle.seed(1)
+    p = _param([0.0, 0.0])
+    opt = paddle.optimizer.Dpsgd(learning_rate=1.0, clip=1e9,
+                                 batch_size=1.0, sigma=1.0, parameters=[p])
+    deltas, prev = [], p.numpy().copy()
+    for _ in range(3):
+        (p * paddle.to_tensor(np.zeros(2, "float32"))).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        cur = p.numpy().copy()
+        deltas.append(cur - prev)
+        prev = cur
+    # zero grads -> delta is pure noise; cached-jit steps 2/3 must differ
+    assert not np.allclose(deltas[1], deltas[2])
+    assert not np.allclose(deltas[0], deltas[1])
+
+
+def test_fluid_metrics_reset_and_auc_eval():
+    dm = fluid.metrics.DetectionMAP()
+    det = np.array([[[1, 0.9, 0, 0, 10, 10]]], "float32")
+    counts = np.array([1])
+    gtb = np.array([[[0, 0, 10, 10]]], "float32")
+    gtl = np.array([[1]])
+    dm.update(det, counts, gtb, gtl)
+    assert abs(dm.eval() - 1.0) < 1e-6
+    dm.reset()
+    assert dm.eval() == 0.0  # epoch state actually cleared
+
+    comp = fluid.metrics.CompositeMetric()
+    pr = fluid.metrics.Precision()
+    comp.add_metric(pr)
+    comp.update(np.array([0.9]), np.array([1]))
+    comp.reset()
+    assert pr.tp == 0 and pr.fp == 0
+
+    auc = fluid.metrics.Auc(num_thresholds=255)
+    auc.update(np.array([0.1, 0.9]), np.array([0, 1]))
+    assert auc.eval() > 0.9  # era eval() spelling works
+
+
+def test_detection_map_difficult_boxes():
+    # difficult gt excluded from npos; a detection matching it is ignored
+    dm = fluid.metrics.DetectionMAP(evaluate_difficult=False)
+    det = np.array([[[1, 0.9, 0, 0, 10, 10],
+                     [1, 0.8, 20, 20, 30, 30]]], "float32")
+    counts = np.array([2])
+    gtb = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], "float32")
+    gtl = np.array([[1, 1]])
+    difficult = np.array([[1, 0]])  # first gt is difficult
+    dm.update(det, counts, gtb, gtl, difficult=difficult)
+    # npos=1 (easy box), det[0] ignored (matches difficult), det[1] TP
+    assert abs(dm.eval() - 1.0) < 1e-6
+
+
 def test_era_initializer_factories():
     x = fluid.initializer.Xavier(uniform=False)
     m = fluid.initializer.MSRA()
