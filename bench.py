@@ -334,20 +334,28 @@ def _run_tpu_probe(script, tag, timeout, smoke=False):
             out["expect_step_ms"] = expect
             out["within_expectation"] = bool(
                 out["step_ms"] <= 1.05 * expect)
-        # the published contract: ANY disqualifier on the winning run —
-        # over-expectation mean, >4% rep spread, or an under-par slot —
-        # flags the number explicitly
+        # publishing discipline (r4/r5 VERDICT #1): after the retry budget
+        # a number the harness KNOWS is slot-degraded — over-expectation
+        # mean, >4% rep spread, or an under-par slot — must NEVER ride at
+        # the headline keys (step_ms/mfu).  It moves whole under
+        # `unpublished_degraded_measurement` so round artifacts and
+        # dashboards cannot mistake it for a real rate.
         if not run_ok(out):
-            out["slot_degraded"] = True
+            out = {"slot_degraded": True,
+                   "expect_step_ms": expect,
+                   "slot_tf_s": out.get("slot_tf_s"),
+                   "attempts": out.pop("attempts", history or []),
+                   "unpublished_degraded_measurement": out}
     return out
 
 
 # solo-process expectations from the r4/r5 probe sweeps — the PUBLISHED
 # CONTRACT (r4 verdict #1): a config whose mean exceeds expectation by
-# >5% after the per-config retry budget is flagged slot_degraded
+# >5% after the per-config retry budget is quarantined (its measurement
+# moves under unpublished_degraded_measurement, never the headline keys)
 _EXPECT_STEP_MS = {"BERT": 99.0, "RESNET": 122.0, "GPT2": 115.0,
                    "ERNIE": 86.0}
-_RETRY_BUDGET_PER_CONFIG = 2
+_RETRY_BUDGET_PER_CONFIG = int(os.environ.get("PDTPU_BENCH_RETRIES", "3"))
 
 
 def run_reps(step, args, k, warmup=2, reps=3):
@@ -421,6 +429,17 @@ from paddle_tpu.vision import models as vmodels
 # k=10 steps/compiled call: ResNet's ~270-leaf state costs ~150 ms of
 # per-call dispatch through the tunnel — k=3 leaves ~50 ms/step of
 # overhead in the number (measured r4: k=3 -> 176 ms, k=10 -> ~120 ms)
+# ISSUE-1 attack on the ~54 ms BN/elementwise bound: the NHWC layout
+# policy (jit.layout_policy) runs the conv tower in the measured-faster
+# channels-last layout with boundary-only transposes, and the resnet
+# blocks route BN+relu(+residual) through the fused pallas kernels
+# (ops/fused_bn_act.py; PDTPU_FUSED_BN=0 / PDTPU_RESNET_LAYOUT=NCHW
+# give the unfused/NCHW A-B legs).  probes/hbm_probe.py tracks the XLA
+# bytes-accessed delta between the two paths.
+from paddle_tpu.jit import layout_policy
+LAYOUT = os.environ.get("PDTPU_RESNET_LAYOUT", "NHWC").upper()
+if LAYOUT == "NHWC":
+    layout_policy("NHWC")
 batch, hw, k = (2, 64, 2) if SMOKE else (256, 224, 10)
 paddle.seed(0)
 model = vmodels.resnet18() if SMOKE else vmodels.resnet50()
@@ -434,10 +453,12 @@ y = paddle.to_tensor(rng.randint(0, 1000, (k, batch)).astype("int64"))
 reps = run_reps(step, (x, y), k)
 dt = sum(reps) / len(reps) / 1e3
 sps = batch / dt
+fused = os.environ.get("PDTPU_FUSED_BN", "1") != "0"
 out = {"samples_per_sec_per_chip": round(sps, 1),
        "mfu": (round(RESNET50_TRAIN_FLOPS_PER_IMG * sps / PEAK * 100.0, 2)
                if not SMOKE else None),
-       "config": f"resnet50-b{batch}-{hw}-O2" if not SMOKE
+       "config": (f"resnet50-b{batch}-{hw}-O2-{LAYOUT.lower()}"
+                  f"{'+fusedbn' if fused else ''}") if not SMOKE
        else "resnet18-cpu-smoke",
        "methodology": f"solo process, warmup 2x{k} steps, 3 reps of "
                       f"{k} steps, sync per rep",
@@ -787,13 +808,17 @@ def main():
 
     detail = dict(bert)
     mfu = detail.pop("mfu", 0.0) or 0.0
+    # headline discipline: a slot-degraded flagship never publishes its
+    # measured MFU at the standard metric key
+    degraded = bool(detail.get("slot_degraded"))
     detail["a100_comparison"] = (
         "no published A100 tokens/sec figure exists (reference repo has no "
         "in-tree benchmarks; driver supplies none) — unverifiable")
 
     def line():
         return json.dumps({
-            "metric": "bert_mfu" if on_tpu else "bert_mfu_cpu_smoke",
+            "metric": (("bert_mfu_slot_degraded" if degraded else "bert_mfu")
+                       if on_tpu else "bert_mfu_cpu_smoke"),
             "value": round(mfu, 2),
             "unit": "%",
             "vs_baseline": round(mfu / 45.0, 4),

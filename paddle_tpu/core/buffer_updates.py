@@ -1,0 +1,61 @@
+"""Functional buffer updates: fold running-stat writes into compiled steps.
+
+Eagerly, BatchNorm's running mean/var update is an in-place
+`Tensor._set_data` (the reference's MomentumTensor outputs).  Under a
+traced functional step (jit.TrainStep / jit.functional_call) an in-place
+write of a tracer is meaningless — the value would be discarded when
+`functional_call` restores the layer's original buffers, silently
+freezing the running stats inside compiled training (and forcing the old
+eager pre-compute to run the batch reduction twice per step).
+
+This module is the bridge: a norm functional calls `apply(buffer, raw)`.
+If a capture scope is active (functional_call under TrainStep), the new
+traced value is *recorded* and surfaced as a functional output that the
+compiled step folds into its next-state pytree — one XLA program, no
+host round-trip.  With no scope active it falls back to the eager
+in-place `_set_data`, so eager semantics are unchanged.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional, Tuple
+
+_ACTIVE: Optional[List[Tuple[object, object]]] = None
+
+
+@contextlib.contextmanager
+def capture():
+    """Collect (buffer_tensor, new_raw_value) updates instead of applying
+    them in place.  Yields the log list; nestable (innermost wins)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = []
+    try:
+        yield _ACTIVE
+    finally:
+        _ACTIVE = prev
+
+
+def capturing() -> bool:
+    return _ACTIVE is not None
+
+
+def apply(buffer, raw) -> None:
+    """Update a (non-trainable) buffer: record under capture, else eager
+    in-place."""
+    if _ACTIVE is not None:
+        _ACTIVE.append((buffer, raw))
+    else:
+        buffer._set_data(raw)
+
+
+def resolve(log, state_dict) -> dict:
+    """Map a capture log to {state_key: raw_value} by buffer identity.
+    Later records win (a layer run twice keeps its last update)."""
+    by_id = {id(t): k for k, t in state_dict.items()}
+    out = {}
+    for buf, raw in log:
+        key = by_id.get(id(buf))
+        if key is not None:
+            out[key] = raw
+    return out

@@ -1,0 +1,199 @@
+"""Internal-layout policy: run conv-net hot paths channels-last on TPU.
+
+The r5 bench pinned ResNet-50 at 12.74% MFU with two bound causes: NCHW
+convs measure slower than NHWC on the MXU (98.1 vs 101.9 TF/s at b256,
+probes/resnet_probe_results2.txt) and the training-BN/elementwise chain
+costs ~8 HBM passes.  `layout_policy("NHWC")` attacks the first without
+any user-visible API change: models keep their logical NCHW contract
+(inputs, weights, state_dict all unchanged), but layout-aware ops
+(conv2d / batch_norm / pool2d / the fused BN-act kernels) compute on a
+physically-NHWC array and mark the produced Tensor with a layout tag.
+
+Tag propagation is centralized in `core.op.dispatch` — the single entry
+point every eager op goes through (the same place the reference hangs
+its transfer_layout_pass, framework/ir/transfer_layout_elim_pass.cc):
+
+- ops in `AWARE_OPS` handle tagged inputs themselves (they know their
+  channel axis) and re-tag their outputs;
+- ops in `AGNOSTIC_OPS` (shape-preserving elementwise / broadcasts) run
+  directly on the NHWC data when *every* non-scalar operand is tagged,
+  and the tag flows through — this is what keeps a whole residual block
+  transpose-free;
+- any other op is a *program boundary*: tagged inputs are transposed
+  back to NCHW (through a tape-recorded transpose, so autodiff is
+  exact) before the op sees them.
+
+Under `jax.jit` tracing (TrainStep) the same dispatch path runs at trace
+time, so XLA sees straight-line NHWC programs with transposes only at
+the true boundaries (stem input, head flatten).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+NHWC = "NHWC"
+
+# fast gate: stays False until the first layout_policy() use, so non-vision
+# workloads pay one bool check per dispatch and nothing else
+_ENABLED_EVER = False
+_POLICY: Optional[str] = None
+
+# ops that resolve tags themselves (see their functionals); includes the
+# boundary transposes so normalization cannot recurse
+AWARE_OPS = {
+    "conv2d", "batch_norm", "fused_bn_act", "fused_bn_act_eval",
+    "max_pool2d", "avg_pool2d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
+    "layout_to_nchw", "layout_to_nhwc",
+}
+
+# shape-preserving elementwise / broadcast ops: safe in any layout as long
+# as every non-scalar operand is in the SAME physical permutation
+AGNOSTIC_OPS = {
+    "relu", "relu6", "leaky_relu", "sigmoid", "tanh", "silu", "swish",
+    "gelu", "hardswish", "hardsigmoid", "mish", "elu", "selu", "celu",
+    "softsign",
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "scale", "clip", "cast", "clone", "abs", "neg", "pow",
+    # NOT dropout: its axis/mask-shape arguments (dropout2d/3d) address
+    # the LOGICAL layout, so tagged inputs must boundary-normalize first
+}
+
+
+class _PolicyGuard:
+    """Returned by layout_policy(): sets the policy immediately; usable as
+    a context manager to restore the previous policy on exit."""
+
+    def __init__(self, prev):
+        self._prev = prev
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        global _POLICY
+        _POLICY = self._prev
+        return False
+
+
+def layout_policy(fmt: Optional[str]):
+    """Set the internal compute layout for conv-net ops.
+
+    `layout_policy("NHWC")` makes Conv2D/BatchNorm/pooling built with the
+    default NCHW `data_format` compute in NHWC internally (the TPU-faster
+    layout), with transposes only at program boundaries.  `layout_policy
+    (None)` (or "NCHW") restores the default.  Works as a plain call or a
+    `with` block; must be active when a jitted step is *traced*.
+    """
+    global _POLICY, _ENABLED_EVER
+    prev = _POLICY
+    if fmt is not None and str(fmt).upper() not in (NHWC, "NCHW"):
+        raise ValueError(f"layout_policy: unsupported layout {fmt!r} "
+                         "(expected 'NHWC', 'NCHW', or None)")
+    _POLICY = NHWC if (fmt is not None and str(fmt).upper() == NHWC) else None
+    if _POLICY is not None:
+        _ENABLED_EVER = True
+    return _PolicyGuard(prev)
+
+
+def policy() -> Optional[str]:
+    return _POLICY
+
+
+def enabled() -> bool:
+    """Cheap dispatch gate: True once any layout policy has ever been set
+    (tags may be live even after the policy context exits)."""
+    return _ENABLED_EVER
+
+
+def tag_of(x) -> Optional[str]:
+    from .tensor import Tensor
+    return x._layout if isinstance(x, Tensor) else None
+
+
+def tag(x):
+    """Mark a Tensor as physically NHWC (logical NCHW)."""
+    from .tensor import Tensor
+    if isinstance(x, Tensor) and x._data.ndim == 4:
+        x._layout = NHWC
+    return x
+
+
+def tag_tree(out):
+    """Tag every rank-4 Tensor in an op's output pytree."""
+    import jax
+    from .tensor import Tensor
+
+    def _t(leaf):
+        if isinstance(leaf, Tensor) and leaf._data.ndim == 4:
+            leaf._layout = NHWC
+        return leaf
+    jax.tree_util.tree_map(_t, out,
+                           is_leaf=lambda l: isinstance(l, Tensor))
+    return out
+
+
+def to_nchw(t):
+    """Physically NHWC tagged Tensor -> plain NCHW Tensor (tape-recorded)."""
+    import jax.numpy as jnp
+    from .op import dispatch
+    return dispatch("layout_to_nchw",
+                    lambda x: jnp.transpose(x, (0, 3, 1, 2)), t)
+
+
+def to_nhwc(t):
+    """Plain NCHW Tensor -> tagged physically-NHWC Tensor (tape-recorded)."""
+    import jax.numpy as jnp
+    from .op import dispatch
+    out = dispatch("layout_to_nhwc",
+                   lambda x: jnp.transpose(x, (0, 2, 3, 1)), t)
+    return tag(out)
+
+
+def ensure_nhwc(t):
+    """Tensor in logical NCHW -> physically NHWC (no-op if already tagged)."""
+    return t if tag_of(t) == NHWC else to_nhwc(t)
+
+
+def _operand_ndim(x):
+    from .tensor import Tensor
+    if isinstance(x, Tensor):
+        return x._data.ndim
+    if isinstance(x, np.ndarray) or hasattr(x, "aval") or hasattr(x, "ndim"):
+        nd = getattr(x, "ndim", None)
+        return nd if isinstance(nd, int) else None
+    return None  # python scalar / str / None — layout-neutral
+
+
+def dispatch_prepare(name: str, flat):
+    """Called by core.op.dispatch (when enabled()) before an op runs.
+
+    Returns (flat, propagate): possibly-rewritten operand list (tagged
+    inputs transposed back to NCHW at layout boundaries) and whether the
+    op's rank-4 outputs should inherit the NHWC tag.
+    """
+    from .tensor import Tensor
+    tagged = [i for i, x in enumerate(flat)
+              if isinstance(x, Tensor) and x._layout is not None]
+    if not tagged:
+        return flat, False
+    if name in AWARE_OPS:
+        return flat, False
+    if name in AGNOSTIC_OPS:
+        safe = True
+        tagged_set = set(tagged)
+        for i, x in enumerate(flat):
+            if i in tagged_set:
+                continue
+            nd = _operand_ndim(x)
+            if nd not in (None, 0):
+                safe = False  # mixing tagged NHWC with untagged non-scalar
+                break
+        if safe:
+            return flat, True
+    # layout boundary: hand the op plain NCHW data
+    flat = list(flat)
+    for i in tagged:
+        flat[i] = to_nchw(flat[i])
+    return flat, False

@@ -100,6 +100,17 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
     if not tensor_idx:
         return raw_fn(*args, **kwargs)
 
+    # layout-policy hook (core.layout): transpose tagged-NHWC inputs back
+    # to NCHW at layout boundaries; layout-agnostic elementwise ops run on
+    # the NHWC data directly and propagate the tag to their outputs
+    tag_out = False
+    from . import layout as _layout
+    if _layout.enabled():
+        flat2, tag_out = _layout.dispatch_prepare(name, flat)
+        if flat2 is not flat:
+            flat = flat2
+            args, kwargs = jax.tree_util.tree_unflatten(treedef, flat)
+
     raw = _harmonize_placement(
         [x._data if isinstance(x, Tensor) else x for x in flat])
     # NOTE: the AMP cast runs INSIDE the differentiated closure below, so the
@@ -125,7 +136,9 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
             out = raw_fn(*a2, **k2)
             if _check_nan_inf:
                 _assert_finite(name, out)
-            return jax.tree_util.tree_map(lambda x: Tensor(x, stop_gradient=True), out)
+            wrapped = jax.tree_util.tree_map(
+                lambda x: Tensor(x, stop_gradient=True), out)
+            return _layout.tag_tree(wrapped) if tag_out else wrapped
 
         # differentiable inputs: float/complex Tensors not marked stop_gradient
         diff_idx = [i for i in tensor_idx
@@ -135,7 +148,9 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
             out = raw_fn(*a2, **k2)
             if _check_nan_inf:
                 _assert_finite(name, out)
-            return jax.tree_util.tree_map(lambda x: Tensor(x, stop_gradient=True), out)
+            wrapped = jax.tree_util.tree_map(
+                lambda x: Tensor(x, stop_gradient=True), out)
+            return _layout.tag_tree(wrapped) if tag_out else wrapped
 
         def closed(*diff_vals):
             leaves = list(raw)
@@ -155,7 +170,8 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
         for i, t in enumerate(out_tensors):
             t._node = node
             t._out_index = i
-        return jax.tree_util.tree_unflatten(out_tree, out_tensors)
+        wrapped = jax.tree_util.tree_unflatten(out_tree, out_tensors)
+        return _layout.tag_tree(wrapped) if tag_out else wrapped
     finally:
         if prof is not None:
             prof.__exit__(None, None, None)

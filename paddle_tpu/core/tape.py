@@ -57,6 +57,13 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
         init = jnp.ones_like(loss._data)
     else:
         init = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+        # a layout-tagged root is physically NHWC; a caller-supplied
+        # cotangent in the logical (NCHW) layout must be transposed to
+        # match (an equally-tagged cotangent is already physical)
+        if (loss._layout is not None and init.ndim == 4
+                and not (isinstance(grad_tensor, Tensor)
+                         and grad_tensor._layout == loss._layout)):
+            init = jnp.transpose(init, (0, 2, 3, 1))
 
     # cotangent accumulator keyed by tensor identity
     cotangents: Dict[int, object] = {id(loss): init}
@@ -82,10 +89,7 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
             else:
                 any_ct = True
                 if t is not None and t._hooks:
-                    for hook in t._hooks:
-                        new = hook(wrap(ct))
-                        if new is not None:
-                            ct = new._data if isinstance(new, Tensor) else jnp.asarray(new)
+                    ct = _run_hooks(t, ct)
             out_cts.append(ct)
         if not any_ct:
             continue
@@ -104,7 +108,11 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
             else:
                 cotangents[id(t)] = acc
                 if wanted is not None and id(t) in wanted:
-                    results[id(t)] = acc
+                    out = acc
+                    if (t._layout is not None
+                            and getattr(out, "ndim", 0) == 4):
+                        out = jnp.transpose(out, (0, 3, 1, 2))
+                    results[id(t)] = out
         if not retain_graph:
             node.vjp_fn = None  # free residuals
 
@@ -114,10 +122,30 @@ def backward(loss: Tensor, grad_tensor: Optional[Tensor] = None,
     return results if inputs is not None else None
 
 
+def _run_hooks(t: Tensor, ct):
+    """Invoke t's grad hooks on a cotangent.  Hooks observe the LOGICAL
+    layout: a layout-tagged primal's physically-NHWC cotangent is shown
+    (and taken back) as NCHW."""
+    tagged4 = t._layout is not None and getattr(ct, "ndim", 0) == 4
+    if tagged4:
+        ct = jnp.transpose(ct, (0, 3, 1, 2))
+    for hook in t._hooks:
+        new = hook(wrap(ct))
+        if new is not None:
+            ct = new._data if isinstance(new, Tensor) else jnp.asarray(new)
+    if tagged4:
+        ct = jnp.transpose(ct, (0, 2, 3, 1))
+    return ct
+
+
 def _deposit(t: Tensor, raw_grad, accumulate, wanted, results):
     from .selected_rows import RowSparseGrad
     if wanted is not None:
         if id(t) in wanted:
+            # paddle.grad results are raw arrays handed straight to the
+            # caller — return the LOGICAL layout for tagged primals
+            if t._layout is not None and getattr(raw_grad, "ndim", 0) == 4:
+                raw_grad = jnp.transpose(raw_grad, (0, 3, 1, 2))
             results[id(t)] = raw_grad
         return
     if t.stop_gradient:
@@ -139,16 +167,17 @@ def _deposit(t: Tensor, raw_grad, accumulate, wanted, results):
                                 stop_gradient=True)
             return
     if t._hooks:
-        for hook in t._hooks:
-            new = hook(wrap(raw_grad))
-            if new is not None:
-                raw_grad = new._data if isinstance(new, Tensor) else jnp.asarray(new)
+        raw_grad = _run_hooks(t, raw_grad)
     if t.grad is None or not accumulate:
         t.grad = Tensor(raw_grad, stop_gradient=True)
     elif isinstance(t.grad, RowSparseGrad):
         t.grad = Tensor(t.grad.to_dense() + raw_grad, stop_gradient=True)
     else:
         t.grad = Tensor(t.grad._data + raw_grad, stop_gradient=True)
+    # a layout-tagged primal's cotangent is in the same physical layout:
+    # carry the tag so .grad.numpy()/shape present the logical view
+    if t._layout is not None and t.grad._data.ndim == 4:
+        t.grad._layout = t._layout
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=None,

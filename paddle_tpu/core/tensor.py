@@ -93,7 +93,9 @@ class TapeNode:
         self.inputs: List[Tensor] = inputs
         self.out_refs = [weakref.ref(t) for t in outputs]
         # store shape/dtype so we can make zero cotangents for dead outputs
-        self.out_avals = [(t.shape, t.dtype) for t in outputs]
+        # (PHYSICAL shape: a layout-tagged tensor's cotangent must match
+        # its stored NHWC data, not the logical .shape view)
+        self.out_avals = [(tuple(t._data.shape), t.dtype) for t in outputs]
 
 
 # ---------------------------------------------------------------------------
@@ -104,10 +106,13 @@ class Tensor:
     """N-d array wrapping a jax.Array, with paddle-like eager semantics."""
 
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index",
-                 "name", "persistable", "trainable", "__weakref__", "_hooks")
+                 "name", "persistable", "trainable", "__weakref__", "_hooks",
+                 "_layout")
 
     def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        src_layout = None
         if isinstance(data, Tensor):
+            src_layout = data._layout  # copy shares the physical buffer
             data = data._data
         if not isinstance(data, jax.Array) and not _is_tracer(data):
             data = jnp.asarray(data)
@@ -120,6 +125,10 @@ class Tensor:
         self.persistable = False
         self.trainable = not stop_gradient
         self._hooks = None
+        # physical-layout tag ("NHWC") set by core.layout under a layout
+        # policy; None = data is in the logical (paddle) layout.  A copy
+        # built FROM a Tensor shares its buffer, so it inherits the tag.
+        self._layout = src_layout
 
     # -- basic properties ---------------------------------------------------
     @property
@@ -129,6 +138,7 @@ class Tensor:
     @data.setter
     def data(self, value):
         self._data = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        self._layout = value._layout if isinstance(value, Tensor) else None
 
     @property
     def value(self):
@@ -136,7 +146,12 @@ class Tensor:
 
     @property
     def shape(self):
-        return list(self._data.shape)
+        s = self._data.shape
+        # a layout-tagged tensor is physically NHWC; report the LOGICAL
+        # (NCHW) shape so user code never observes the internal layout
+        if self._layout is not None and len(s) == 4:
+            return [s[0], s[3], s[1], s[2]]
+        return list(s)
 
     @property
     def ndim(self):
@@ -168,20 +183,26 @@ class Tensor:
 
     # -- conversion ---------------------------------------------------------
     def numpy(self):
-        return np.asarray(self._data)
+        a = np.asarray(self._data)
+        # materialization boundary: a layout-tagged tensor is physically
+        # NHWC — hand the caller the logical NCHW view
+        if self._layout is not None and a.ndim == 4:
+            a = np.transpose(a, (0, 3, 1, 2))
+        return a
 
     def __array__(self, dtype=None):
-        a = np.asarray(self._data)
+        a = self.numpy()
         return a.astype(dtype) if dtype is not None else a
 
     def item(self, *args):
-        return np.asarray(self._data).item(*args)
+        return self.numpy().item(*args)
 
     def tolist(self):
-        return np.asarray(self._data).tolist()
+        return self.numpy().tolist()
 
     def detach(self) -> "Tensor":
         t = Tensor(self._data, stop_gradient=True, name=self.name)
+        t._layout = self._layout
         return t
 
     def clone(self) -> "Tensor":
@@ -195,7 +216,9 @@ class Tensor:
         return self._data.dtype.itemsize
 
     def cpu(self):
-        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+        t = Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+        t._layout = self._layout
+        return t
 
     def pin_memory(self):
         return self
@@ -234,8 +257,11 @@ class Tensor:
         return None if self.grad is None else self.grad.numpy()
 
     def _set_data(self, raw):
-        """In-place replace the underlying buffer (optimizer updates)."""
+        """In-place replace the underlying buffer (optimizer updates).
+        The new buffer is in the logical layout — drop any stale NHWC tag
+        (in-place ops route through dispatch, which normalizes first)."""
         self._data = raw
+        self._layout = None
 
     # -- misc dunder --------------------------------------------------------
     def __len__(self):

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import buffer_updates as _bufup
+from ..core.layout import layout_policy  # noqa: F401  (public: jit.layout_policy)
 from ..core.tensor import Tensor, no_grad, unwrap
 from ..nn.layer_base import Layer
 
@@ -37,13 +39,18 @@ def state_arrays(layer: Layer) -> Dict[str, Any]:
 
 def functional_call(layer: Layer, state: Dict[str, Any], *args,
                     training: Optional[bool] = None, method: str = None,
+                    buffer_updates: Optional[Dict[str, Any]] = None,
                     **kwargs):
     """Run layer.forward with `state` (name -> raw array) swapped in.
 
     Works under jit tracing: swapping happens at trace time only.  Tape is
     disabled so the pure-functional jax.grad path is used for autodiff.
     `method` selects an alternative entry point (e.g. a fixed-cache decode
-    forward) instead of __call__.
+    forward) instead of __call__.  When `buffer_updates` (a dict) is
+    passed, in-place buffer writes made during the forward (BatchNorm
+    running stats) are captured FUNCTIONALLY instead of applied: the dict
+    is filled with {state_key: new_raw_value} so a compiled train step can
+    fold them into its next-state outputs (no host round-trip under jit).
     """
     sd = layer.state_dict()
     originals = {k: t._data for k, t in sd.items()}
@@ -58,10 +65,13 @@ def functional_call(layer: Layer, state: Dict[str, Any], *args,
                 t._data = state[k]
         entry = getattr(layer, method) if method else layer
         with no_grad():
-            out = entry(*_wrap_args(args), **kwargs)
-        return jax.tree_util.tree_map(
-            lambda x: x._data if isinstance(x, Tensor) else x, out,
-            is_leaf=lambda x: isinstance(x, Tensor))
+            if buffer_updates is not None:
+                with _bufup.capture() as log:
+                    out = entry(*_wrap_args(args), **kwargs)
+                buffer_updates.update(_bufup.resolve(log, sd))
+            else:
+                out = entry(*_wrap_args(args), **kwargs)
+        return _extract_raw(out)
     finally:
         for k, t in sd.items():
             t._data = originals[k]
@@ -73,6 +83,20 @@ def functional_call(layer: Layer, state: Dict[str, Any], *args,
 def _wrap_args(args):
     return tuple(Tensor(a) if isinstance(a, (jax.Array, np.ndarray)) or _is_tracer(a)
                  else a for a in args)
+
+
+def _extract_raw(out):
+    """Tensor pytree -> raw arrays; a layout boundary: rank-4 tensors the
+    layout policy left physically NHWC are transposed back to the logical
+    NCHW the caller expects (loss functions, hapi metrics, predict)."""
+    def leaf(x):
+        if not isinstance(x, Tensor):
+            return x
+        if x._layout is not None and x._data.ndim == 4:
+            return jnp.transpose(x._data, (0, 3, 1, 2))
+        return x._data
+    return jax.tree_util.tree_map(leaf, out,
+                                  is_leaf=lambda x: isinstance(x, Tensor))
 
 
 def _is_tracer(x):
@@ -122,9 +146,7 @@ class StaticFunction:
                                 t._data = state[k]
                         with no_grad():
                             out = fn(*_wrap_args(args), **kwargs)
-                        return jax.tree_util.tree_map(
-                            lambda x: x._data if isinstance(x, Tensor) else x,
-                            out, is_leaf=lambda x: isinstance(x, Tensor))
+                        return _extract_raw(out)
                     finally:
                         for k, t in sd.items():
                             t._data = originals[k]
@@ -134,9 +156,7 @@ class StaticFunction:
                 def pure(state, *args, **kwargs):
                     with no_grad():
                         out = fn(*_wrap_args(args), **kwargs)
-                    return jax.tree_util.tree_map(
-                        lambda x: x._data if isinstance(x, Tensor) else x,
-                        out, is_leaf=lambda x: isinstance(x, Tensor))
+                    return _extract_raw(out)
             self._compiled = jax.jit(pure)
         return self._compiled
 
@@ -182,21 +202,29 @@ def not_to_static(fn):
 # ---------------------------------------------------------------------------
 
 def forward_loss(model, loss_fn, state, batch, rng_key=None, amp_level=None,
-                 amp_dtype="bfloat16", return_outputs=False):
+                 amp_dtype="bfloat16", return_outputs=False,
+                 return_buffer_updates=False):
     """Shared traced forward+loss used by TrainStep / ShardedTrainStep:
     functional_call with a per-step rng root (fresh dropout masks each step)
     and optional bf16 autocast.  With return_outputs, also returns the raw
     forward outputs (so hapi metrics reuse the training forward instead of
-    paying a second one)."""
+    paying a second one).  With return_buffer_updates, in-place buffer
+    writes (BatchNorm running stats) are captured functionally and
+    returned as a third element {state_key: new_raw} — the compiled step
+    folds them into its next state instead of freezing them under jit."""
     import contextlib
     from .. import amp as amp_mod
     from ..core import rng as _rng
 
     def run():
-        out = functional_call(model, state, *batch[:-1], training=True)
+        bufs = {} if return_buffer_updates else None
+        out = functional_call(model, state, *batch[:-1], training=True,
+                              buffer_updates=bufs)
         label = Tensor(batch[-1])
         outs = out if isinstance(out, tuple) else (out,)
         loss = loss_fn(*[Tensor(o) for o in outs], label)
+        if return_buffer_updates:
+            return unwrap(loss), outs if return_outputs else (), bufs
         if return_outputs:
             return unwrap(loss), outs
         return unwrap(loss)
@@ -349,21 +377,22 @@ class TrainStep:
             def loss_of(train_params):
                 full = dict(params)
                 full.update(train_params)
-                return forward_loss(
+                loss, outs, bufs = forward_loss(
                     self.model, self.loss_fn, full, batch, rng_key,
                     self.amp_level, self.amp_dtype,
-                    return_outputs=with_outputs)
+                    return_outputs=with_outputs,
+                    return_buffer_updates=True)
+                return loss, (outs, bufs)
 
             train_params = {k: v for k, v in params.items() if k in trainable}
             loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
-            if with_outputs:
-                (loss, outs), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True)(train_params)
-            else:
-                loss, grads = jax.value_and_grad(loss_fn)(train_params)
-                outs = ()
+            (loss, (outs, bufs)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(train_params)
             new_params, new_opt = apply_updates(
                 opt, params, grads, opt_state, lr, step_no, decay)
+            # running-stat (buffer) updates captured in the traced forward
+            # ride the same compiled step — no eager _set_data round-trip
+            new_params.update(bufs)
             return new_params, new_opt, loss, outs
 
         def step_sparse(params, opt_state, step_no, lr, rng_key, batch):
@@ -377,25 +406,23 @@ class TrainStep:
                 ctx = sr.SparseGradContext("apply", zeros=zvals,
                                            deny=self._sparse_deny)
                 with sr.use_ctx(ctx):
-                    if with_outputs:
-                        loss, outs = forward_loss(
-                            self.model, self.loss_fn, full, batch, rng_key,
-                            self.amp_level, self.amp_dtype,
-                            return_outputs=True)
-                    else:
-                        loss = self._forward_loss(full, batch, rng_key)
-                        outs = ()
-                return loss, (ctx.ids, outs)
+                    loss, outs, bufs = forward_loss(
+                        self.model, self.loss_fn, full, batch, rng_key,
+                        self.amp_level, self.amp_dtype,
+                        return_outputs=with_outputs,
+                        return_buffer_updates=True)
+                return loss, (ctx.ids, outs, bufs)
 
             train_params = {k: v for k, v in params.items()
                             if k in trainable and k not in sparse_names}
             loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
-            (loss, (ids, outs)), (grads, zgrads) = jax.value_and_grad(
+            (loss, (ids, outs, bufs)), (grads, zgrads) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True)(train_params, zeros)
             grads = self._merge_sparse_grads(grads, zgrads, ids, params,
                                              name_to_key)
             new_params, new_opt = apply_updates(
                 opt, params, grads, opt_state, lr, step_no, decay)
+            new_params.update(bufs)
             return new_params, new_opt, loss, outs
 
         return jax.jit(step_sparse if sparse_specs else step,
@@ -427,15 +454,21 @@ class TrainStep:
                 def loss_of(train_params):
                     full = dict(params)
                     full.update(train_params)
-                    return self._forward_loss(full, xs, key)
+                    loss, _outs, bufs = forward_loss(
+                        self.model, self.loss_fn, full, xs, key,
+                        self.amp_level, self.amp_dtype,
+                        return_buffer_updates=True)
+                    return loss, bufs
 
                 train_params = {k: v for k, v in params.items()
                                 if k in trainable}
                 loss_fn = (jax.checkpoint(loss_of) if self._remat
                            else loss_of)
-                loss, grads = jax.value_and_grad(loss_fn)(train_params)
+                (loss, bufs), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(train_params)
                 new_params, new_opt = apply_updates(
                     opt, params, grads, opt_state, lr, step_no0 + i, decay)
+                new_params.update(bufs)
                 return (new_params, new_opt, i + 1), loss
 
             (params, opt_state, _), losses = jax.lax.scan(
@@ -472,20 +505,24 @@ class TrainStep:
                     ctx = sr.SparseGradContext("apply", zeros=zvals,
                                                deny=self._sparse_deny)
                     with sr.use_ctx(ctx):
-                        loss = self._forward_loss(full, xs, key)
-                    return loss, ctx.ids
+                        loss, _outs, bufs = forward_loss(
+                            self.model, self.loss_fn, full, xs, key,
+                            self.amp_level, self.amp_dtype,
+                            return_buffer_updates=True)
+                    return loss, (ctx.ids, bufs)
 
                 train_params = {k: v for k, v in params.items()
                                 if k in trainable and k not in sparse_names}
                 loss_fn = (jax.checkpoint(loss_of) if self._remat
                            else loss_of)
-                (loss, ids), (grads, zgrads) = jax.value_and_grad(
+                (loss, (ids, bufs)), (grads, zgrads) = jax.value_and_grad(
                     loss_fn, argnums=(0, 1), has_aux=True)(train_params,
                                                            zeros)
                 grads = self._merge_sparse_grads(grads, zgrads, ids, params,
                                                  name_to_key)
                 new_params, new_opt = apply_updates(
                     opt, params, grads, opt_state, lr, step_no0 + i, decay)
+                new_params.update(bufs)
                 return (new_params, new_opt, i + 1), loss
 
             (params, opt_state, _), losses = jax.lax.scan(

@@ -43,8 +43,21 @@ def _dims(n, channel_last):
 
 def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
     channel_last = not data_format.startswith("NC")
+    from ...core import layout as _layout
     from ...core.errors import InvalidArgumentError
+    from ...core.tensor import Tensor as _Tensor
     from ...core.tensor import unwrap as _unwrap
+    # layout policy: a logical-NCHW conv2d computes in NHWC (the faster
+    # MXU layout) when the policy is on — the input is either already
+    # physically NHWC (tagged by an upstream layout-aware op) or gets the
+    # one boundary transpose here; the output carries the tag onward
+    tag_output = False
+    if n == 2 and not channel_last and isinstance(x, _Tensor):
+        if _layout.tag_of(x) == _layout.NHWC:
+            channel_last, tag_output = True, True
+        elif _layout.policy() == _layout.NHWC and _unwrap(x).ndim == 4:
+            x = _layout.ensure_nhwc(x)
+            channel_last, tag_output = True, True
     xv, wv = _unwrap(x), _unwrap(weight)
     if xv.ndim != n + 2:
         raise InvalidArgumentError(
@@ -81,7 +94,10 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, n, data_format):
             shape[1 if not channel_last else -1] = b.shape[0]
             out = out + b.reshape(shape)
         return out
-    return dispatch(f"conv{n}d", raw, x, weight, bias)
+    out = dispatch(f"conv{n}d", raw, x, weight, bias)
+    if tag_output:
+        _layout.tag(out)
+    return out
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,

@@ -3,53 +3,197 @@ operators/batch_norm_op, layer_norm_op, group_norm_op, instance_norm_op).
 XLA fuses these elementwise chains into surrounding matmuls/convs on TPU."""
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
+from ...core import buffer_updates as _bufup
+from ...core import layout as _layout
 from ...core.op import dispatch
 from ...core.tensor import Tensor, unwrap
+
+
+def _channel_axis(x, data_format):
+    """Channel axis of the PHYSICAL data: a layout-tagged tensor is
+    channels-last regardless of the logical data_format."""
+    if _layout.tag_of(x) == _layout.NHWC:
+        return -1
+    return 1 if data_format.startswith("NC") and unwrap(x).ndim > 1 else -1
+
+
+def _update_running_stats(running_mean, running_var, mean_t, var_t, momentum):
+    """Fold `momentum * old + (1-momentum) * batch` into the buffers.
+    Under a functional capture (TrainStep) the new values become outputs
+    of the compiled step; eagerly they are applied in place."""
+    if running_mean is None:
+        return
+    rm, rv = unwrap(running_mean), unwrap(running_var)
+    mean_v = unwrap(mean_t).astype(rm.dtype)
+    var_v = unwrap(var_t).astype(rv.dtype)
+    _bufup.apply(running_mean, momentum * rm + (1 - momentum) * mean_v)
+    _bufup.apply(running_var, momentum * rv + (1 - momentum) * var_v)
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5,
                data_format="NCHW", use_global_stats=None, name=None):
-    """Returns normalized x; updates running stats in-place when training
-    (the reference's batch_norm_op does the same via MomentumTensor outputs)."""
-    channel_axis = 1 if data_format.startswith("NC") and unwrap(x).ndim > 1 else -1
+    """Returns normalized x; updates running stats when training (the
+    reference's batch_norm_op does the same via MomentumTensor outputs).
+    Batch stats are computed ONCE, inside the traced op, and the running
+    update is either applied eagerly or captured as a functional output
+    (core.buffer_updates) when a compiled TrainStep is tracing."""
+    channel_axis = _channel_axis(x, data_format)
     use_batch_stats = training and not use_global_stats
 
     xv = unwrap(x)
     axes = tuple(i for i in range(xv.ndim) if i != channel_axis % xv.ndim)
 
-    if use_batch_stats:
-        # compute batch stats eagerly (outside tape) for the running update
-        mean_now = jnp.mean(unwrap(x), axis=axes)
-        var_now = jnp.var(unwrap(x), axis=axes)
-        if running_mean is not None:
-            rm = unwrap(running_mean)
-            rv = unwrap(running_var)
-            running_mean._set_data(momentum * rm + (1 - momentum) * mean_now)
-            running_var._set_data(momentum * rv + (1 - momentum) * var_now)
-
-    def raw(x, w, b, rm, rv):
-        if use_batch_stats:
-            m = jnp.mean(x, axis=axes)
-            v = jnp.var(x, axis=axes)
-        else:
-            m, v = rm, rv
+    def reshaped(v, x):
         shape = [1] * x.ndim
         shape[channel_axis % x.ndim] = x.shape[channel_axis % x.ndim]
-        inv = jnp.asarray(1.0, x.dtype) / jnp.sqrt(v.reshape(shape) + epsilon)
-        out = (x - m.reshape(shape)) * inv
-        if w is not None:
-            out = out * w.reshape(shape)
-        if b is not None:
-            out = out + b.reshape(shape)
+        return v.reshape(shape)
+
+    if use_batch_stats:
+        def raw_train(x, w, b):
+            m = jnp.mean(x, axis=axes)
+            v = jnp.var(x, axis=axes)
+            inv = jnp.asarray(1.0, x.dtype) / jnp.sqrt(
+                reshaped(v, x) + epsilon)
+            out = (x - reshaped(m, x)) * inv
+            if w is not None:
+                out = out * reshaped(w, x)
+            if b is not None:
+                out = out + reshaped(b, x)
+            return out, m, v
+
+        out, mean_t, var_t = dispatch("batch_norm", raw_train, x, weight,
+                                      bias)
+        _update_running_stats(running_mean, running_var, mean_t, var_t,
+                              momentum)
+        if _layout.tag_of(x) == _layout.NHWC:
+            _layout.tag(out)
         return out
 
-    # stop grads through running stats
+    def raw(x, w, b, rm, rv):
+        inv = jnp.asarray(1.0, x.dtype) / jnp.sqrt(reshaped(rv, x) + epsilon)
+        out = (x - reshaped(rm, x)) * inv
+        if w is not None:
+            out = out * reshaped(w, x)
+        if b is not None:
+            out = out + reshaped(b, x)
+        return out
+
     rm_in = unwrap(running_mean) if running_mean is not None else None
     rv_in = unwrap(running_var) if running_var is not None else None
-    return dispatch("batch_norm", raw, x, weight, bias, rm_in, rv_in)
+    out = dispatch("batch_norm", raw, x, weight, bias, rm_in, rv_in)
+    if _layout.tag_of(x) == _layout.NHWC:
+        _layout.tag(out)
+    return out
+
+
+def bn_act_composite(out, activation=None, residual=None):
+    """Unfused norm-output + residual-add + activation tail: the ONE
+    composite shared by the PDTPU_FUSED_BN=0 escape hatch, custom
+    norm-layer blocks, and forward_fused's unsupported-activation path —
+    keep the fused and composite semantics from diverging."""
+    if residual is not None:
+        out = out + residual
+    if activation is not None:
+        from . import activation as A
+        out = getattr(A, activation)(out)
+    return out
+
+
+def fused_bn_act(x, running_mean, running_var, weight=None, bias=None,
+                 training=True, momentum=0.9, epsilon=1e-5,
+                 data_format="NCHW", activation=None, residual=None,
+                 use_global_stats=None, name=None):
+    """BatchNorm + optional residual-add + activation as ONE op.
+
+    Training-mode batch stats run through the pallas kernel pair in
+    paddle_tpu.ops.fused_bn_act on TPU (single-pass stats + fused
+    normalize/scale/act/residual apply, recompute backward); everywhere
+    else an equivalent jnp composite (which XLA fuses on its own).
+    Running-stat updates follow the same functional-capture contract as
+    `batch_norm`.  Set PDTPU_FUSED_BN=0 to force the unfused composite
+    (A/B probes, bisection).
+
+    AMP contract (deliberate, differs from the black-listed `batch_norm`):
+    this op is NOT amp-black-listed — under O1/O2 the activations stream
+    through the kernel in their storage dtype (bf16) instead of being
+    upcast to f32, which is the entire bandwidth win; batch stats and the
+    normalize affine are computed in f32 INSIDE the kernel.  Under O2 the
+    (C,)-sized gamma/beta arrive bf16-rounded like every other non-black
+    op (the MLPerf-ResNet bf16-BN convention), so the PDTPU_FUSED_BN=0
+    leg — whose `batch_norm` op stays f32 by black-list — is an A/B for
+    performance, not bit-exact numerics.
+    """
+    from ...ops import fused_bn_act as _k
+
+    if activation not in _k._ACTS:
+        # every path (kernel, jnp composite, eval affine) supports the same
+        # set — reject here so PDTPU_FUSED_BN=0 / eval can't silently skip
+        # an activation the kernel path would have refused
+        raise ValueError(
+            f"fused_bn_act: unsupported activation {activation!r} "
+            f"(expected one of {_k._ACTS}); apply it separately")
+
+    if os.environ.get("PDTPU_FUSED_BN", "1") == "0":
+        out = batch_norm(x, running_mean, running_var, weight, bias,
+                         training, momentum, epsilon, data_format,
+                         use_global_stats)
+        return bn_act_composite(out, activation, residual)
+
+    channel_axis = _channel_axis(x, data_format)
+    tagged = _layout.tag_of(x) == _layout.NHWC
+    if residual is not None and tagged != (
+            _layout.tag_of(residual) == _layout.NHWC):
+        # harmonize layouts so the elementwise add is physical-layout-safe
+        residual = (_layout.ensure_nhwc(residual) if tagged
+                    else _layout.to_nchw(residual))
+    use_batch_stats = training and not use_global_stats
+    xv = unwrap(x)
+    nf = xv.shape[channel_axis % xv.ndim]
+
+    def gamma_beta(w, b, dtype):
+        g = w if w is not None else jnp.ones((nf,), dtype)
+        bb = b if b is not None else jnp.zeros((nf,), dtype)
+        return g, bb
+
+    if use_batch_stats:
+        def raw_train(x, w, b, r):
+            g, bb = gamma_beta(w, b, jnp.float32)
+            return _k.bn_act_train(
+                x, g, bb, eps=epsilon, act=activation, residual=r,
+                channel_last=channel_axis % x.ndim == x.ndim - 1)
+
+        out, mean_t, var_t = dispatch("fused_bn_act", raw_train, x, weight,
+                                      bias, residual)
+        _update_running_stats(running_mean, running_var, mean_t, var_t,
+                              momentum)
+    else:
+        rm_in = unwrap(running_mean) if running_mean is not None else None
+        rv_in = unwrap(running_var) if running_var is not None else None
+
+        def raw_eval(x, w, b, rm, rv, r):
+            g, bb = gamma_beta(w, b, x.dtype)
+            inv = jnp.asarray(1.0, jnp.float32) / jnp.sqrt(
+                rv.astype(jnp.float32) + epsilon)
+            a = g.astype(jnp.float32) * inv
+            bias_v = bb.astype(jnp.float32) - rm.astype(jnp.float32) * a
+            shape = [1] * x.ndim
+            shape[channel_axis % x.ndim] = x.shape[channel_axis % x.ndim]
+            z = x.astype(jnp.float32) * a.reshape(shape) + \
+                bias_v.reshape(shape)
+            if r is not None:
+                z = z + r.astype(jnp.float32)
+            return _k._act_apply(z, activation).astype(x.dtype)
+
+        out = dispatch("fused_bn_act_eval", raw_eval, x, weight, bias,
+                       rm_in, rv_in, residual)
+    if tagged:
+        _layout.tag(out)
+    return out
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):

@@ -32,6 +32,10 @@ def _pad_spec(padding, n):
 def _pool(x, kernel, stride, padding, n, data_format, kind, exclusive=True,
           ceil_mode=False):
     channel_last = not data_format.startswith("NC")
+    from ...core import layout as _layout
+    tag_output = False
+    if n == 2 and not channel_last and _layout.tag_of(x) == _layout.NHWC:
+        channel_last, tag_output = True, True  # data is physically NHWC
     kernel = _tup(kernel, n)
     stride = _tup(stride if stride is not None else kernel, n)
     pads = _pad_spec(padding, n)
@@ -61,7 +65,10 @@ def _pool(x, kernel, stride, padding, n, data_format, kind, exclusive=True,
                                         pad_cfg)
             return s / cnt
         return s / float(np.prod(kernel))
-    return dispatch(f"{kind}_pool{n}d", raw, x)
+    out = dispatch(f"{kind}_pool{n}d", raw, x)
+    if tag_output:
+        _layout.tag(out)
+    return out
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -87,6 +94,10 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        from ...core import layout as _layout
+        if _layout.tag_of(x) == _layout.NHWC:
+            x = _layout.to_nchw(x)  # _pool_indices needs the logical layout
     out = _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode=ceil_mode)
     return (out, _pool_indices(x, kernel_size, stride, padding, 2, data_format)) if return_mask else out
 
@@ -131,20 +142,25 @@ def _adaptive_out(in_size, out_size):
 
 def _adaptive_pool(x, output_size, n, data_format, kind):
     out_sz = _tup(output_size, n)
+    channel_last = not data_format.startswith("NC")
+    from ...core import layout as _layout
+    tag_output = False
+    if n == 2 and not channel_last and _layout.tag_of(x) == _layout.NHWC:
+        channel_last, tag_output = True, True  # data is physically NHWC
 
     def raw(x):
         # uniform-window fast path: in divisible by out
-        spatial = x.shape[2:] if data_format.startswith("NC") else x.shape[1:-1]
+        spatial = x.shape[1:-1] if channel_last else x.shape[2:]
         if all(s % o == 0 for s, o in zip(spatial, out_sz)):
             kernel = tuple(s // o for s, o in zip(spatial, out_sz))
-            window = (1, 1) + kernel if data_format.startswith("NC") else (1,) + kernel + (1,)
+            window = (1,) + kernel + (1,) if channel_last else (1, 1) + kernel
             if kind == "max":
                 init = -jnp.inf
                 return jax.lax.reduce_window(x, init, jax.lax.max, window, window, "VALID")
             s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, window, "VALID")
             return s / float(np.prod(kernel))
         # general: gather per output cell (static python loop; shapes static)
-        axes = list(range(2, 2 + n)) if data_format.startswith("NC") else list(range(1, 1 + n))
+        axes = list(range(1, 1 + n)) if channel_last else list(range(2, 2 + n))
         out = x
         for d, ax in enumerate(axes):
             starts, ends = _adaptive_out(out.shape[ax], out_sz[d])
@@ -158,7 +174,10 @@ def _adaptive_pool(x, output_size, n, data_format, kind):
                 slabs.append(red)
             out = jnp.concatenate(slabs, axis=ax)
         return out
-    return dispatch(f"adaptive_{kind}_pool{n}d", raw, x)
+    out = dispatch(f"adaptive_{kind}_pool{n}d", raw, x)
+    if tag_output:
+        _layout.tag(out)
+    return out
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):

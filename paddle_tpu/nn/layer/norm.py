@@ -27,12 +27,39 @@ class _BatchNormBase(Layer):
         self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
         self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
 
-    def forward(self, x):
-        return F.batch_norm(x, self._mean, self._variance, self.weight,
-                            self.bias, training=self.training,
-                            momentum=self.momentum, epsilon=self.epsilon,
-                            data_format=self.data_format,
-                            use_global_stats=self.use_global_stats)
+    def forward(self, x, activation=None, residual=None):
+        if activation is None and residual is None:
+            return F.batch_norm(x, self._mean, self._variance, self.weight,
+                                self.bias, training=self.training,
+                                momentum=self.momentum, epsilon=self.epsilon,
+                                data_format=self.data_format,
+                                use_global_stats=self.use_global_stats)
+        return self._fused_impl(x, activation, residual)
+
+    def _fused_impl(self, x, activation, residual):
+        from ...ops.fused_bn_act import _ACTS
+        if activation not in _ACTS:
+            from ..functional.norm import bn_act_composite
+            return bn_act_composite(self.forward(x), activation, residual)
+        return F.fused_bn_act(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self.momentum,
+            epsilon=self.epsilon, data_format=self.data_format,
+            activation=activation, residual=residual,
+            use_global_stats=self.use_global_stats)
+
+    def forward_fused(self, x, activation=None, residual=None):
+        """BN + residual-add + activation as one fused op (the conv-net
+        block fast path: ops/fused_bn_act.py pallas kernels on TPU, a jnp
+        composite elsewhere).  Same parameters/buffers/running-stat
+        semantics as `forward`; blocks call this when their norm layer
+        provides it and fall back to norm+add+act otherwise.  Routes
+        through __call__ so forward hooks / hapi summary still see the
+        layer run (subclasses with their own forward signature get the
+        direct functional path instead)."""
+        if type(self).forward is _BatchNormBase.forward:
+            return self(x, activation=activation, residual=residual)
+        return self._fused_impl(x, activation, residual)
 
 
 class BatchNorm(_BatchNormBase):

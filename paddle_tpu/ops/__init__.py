@@ -4,3 +4,4 @@ Replaces the reference's hand-written CUDA fused ops
 (paddle/fluid/operators/fused/) with pallas/Mosaic kernels.
 """
 from . import flash_attention  # noqa: F401
+from . import fused_bn_act  # noqa: F401

@@ -42,6 +42,8 @@ from jax.experimental.pallas import tpu as pltpu
 
 _INTERPRET = False  # tests flip this to run the kernels via the interpreter
 
+from ._compat import CompilerParams as _CompilerParams
+
 _NEG_INF = -1e30
 
 
@@ -392,7 +394,7 @@ def _fwd_impl(q, k, v, bias, qseg, kseg, seed, causal, dropout_p, heads, hg):
             jax.ShapeDtypeStruct((b, sq, hd), q.dtype),
             jax.ShapeDtypeStruct((b, n_hg, sq, hg), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_INTERPRET,
@@ -563,7 +565,7 @@ def _bwd_impl(q, k, v, bias, qseg, kseg, seed, o, lse, do,
             jax.ShapeDtypeStruct((b, sk, hd), v.dtype),
         ] + ([jax.ShapeDtypeStruct((b, n_hg, 1, sk), jnp.float32)]
              if bias is not None else []),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_INTERPRET,

@@ -1,0 +1,360 @@
+"""Fused training-BatchNorm + activation + residual-add for TPU via pallas.
+
+The r5 ResNet-50 bench decomposition (bench.py RESNET notes) showed the
+step is bound not by conv rate but by the BN/elementwise HBM traffic:
+~8 passes over 5.7 GB of bf16 activations (conv write, stats read,
+normalize+relu write, next-conv read, plus the backward re-reads)
+~= 55 ms of a 118 ms step.  XLA cannot fuse a training-mode BN chain
+below its reduce/elementwise granularity, so this module does it by
+hand (design notes: /opt/skills/guides/pallas_guide.md):
+
+- forward = 2 HBM passes: one single-pass stats kernel (sum and
+  sum-of-squares accumulated together, f32, per channel) + one apply
+  kernel computing `act(x * a + b [+ residual])` with the per-channel
+  affine folded on the host side of the trace;
+- backward = 2 passes with a `custom_vjp` that RECOMPUTES x_hat and the
+  activation mask from the saved input instead of re-reading saved
+  normalized/pre-activation tensors: one reduce kernel for the
+  d_gamma/d_beta sums, one elementwise kernel producing dx (and the
+  residual gradient) from three per-channel coefficients;
+- all per-channel math ((C,)-sized) runs as plain traced jnp — it is
+  nanoseconds and keeps the kernels pure elementwise/reduce.
+
+Data is handled channels-last as a free (M, C) = (N*H*W, C) reshape —
+the layout `core.layout` puts conv-net activations in anyway.  On
+non-TPU backends (tier-1 CI runs `JAX_PLATFORMS=cpu`) `bn_act_train`
+automatically selects a pure-jnp reference with identical semantics;
+tests flip `_INTERPRET` to run the kernels through the pallas
+interpreter and check parity against that reference.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_INTERPRET = False  # tests flip this to run the kernels via the interpreter
+
+from ._compat import CompilerParams as _CompilerParams
+
+_ACTS = (None, "relu", "relu6")
+# VMEM budget per (blk_m, C) block: keep each f32 buffer <= ~512 KB so the
+# worst kernel (bwd dx: g, x, res in + dx, dres out) stays well under VMEM
+_MAX_BLOCK_ELEMS = 1 << 17
+
+
+def _available() -> bool:
+    if _INTERPRET:
+        return True
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _block_m(m: int, c: int):
+    """Largest divisor of m that is a multiple of 8 and fits the VMEM
+    budget; None when m has no usable divisor (jnp fallback)."""
+    cap = min(m, max(8, _MAX_BLOCK_ELEMS // max(c, 1)))
+    cap -= cap % 8
+    for blk in range(cap, 7, -8):
+        if m % blk == 0:
+            return blk
+    return None
+
+
+def _act_apply(z, act):
+    if act == "relu":
+        return jnp.maximum(z, 0.0)
+    if act == "relu6":
+        return jnp.clip(z, 0.0, 6.0)
+    return z
+
+
+def _act_mask(z, act):
+    if act == "relu":
+        return z > 0.0
+    if act == "relu6":
+        return jnp.logical_and(z > 0.0, z < 6.0)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# pallas kernels.  x is viewed as (M, C); the grid walks M in blk_m rows.
+# Per-channel vectors ride in one (8, C) f32 `coef` input:
+#   row 0 = a  (gamma * invstd)        row 1 = b  (beta - mean * a)
+#   row 2 = mean                       row 3 = invstd
+#   row 4 = A, row 5 = B, row 6 = Cc   (backward dx coefficients)
+
+
+def _stats_kernel(x_ref, sum_ref, sq_ref, *, n_m):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        sum_ref[...] = jnp.zeros_like(sum_ref)
+        sq_ref[...] = jnp.zeros_like(sq_ref)
+
+    xb = x_ref[...].astype(jnp.float32)
+    sum_ref[...] += jnp.sum(xb, axis=0, keepdims=True)
+    sq_ref[...] += jnp.sum(xb * xb, axis=0, keepdims=True)
+
+
+def _apply_kernel(*refs, act, has_res):
+    it = iter(refs)
+    x_ref, coef_ref = next(it), next(it)
+    res_ref = next(it) if has_res else None
+    y_ref = next(it)
+    z = x_ref[...].astype(jnp.float32) * coef_ref[0:1] + coef_ref[1:2]
+    if has_res:
+        z = z + res_ref[...].astype(jnp.float32)
+    y_ref[...] = _act_apply(z, act).astype(y_ref.dtype)
+
+
+def _bwd_reduce_kernel(*refs, act, has_res):
+    it = iter(refs)
+    g_ref, x_ref, coef_ref = next(it), next(it), next(it)
+    res_ref = next(it) if has_res else None
+    sgz_ref, sgzx_ref = next(it), next(it)
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        sgz_ref[...] = jnp.zeros_like(sgz_ref)
+        sgzx_ref[...] = jnp.zeros_like(sgzx_ref)
+
+    xb = x_ref[...].astype(jnp.float32)
+    gz = g_ref[...].astype(jnp.float32)
+    if act is not None:
+        z = xb * coef_ref[0:1] + coef_ref[1:2]
+        if has_res:
+            z = z + res_ref[...].astype(jnp.float32)
+        gz = jnp.where(_act_mask(z, act), gz, 0.0)
+    xhat = (xb - coef_ref[2:3]) * coef_ref[3:4]
+    sgz_ref[...] += jnp.sum(gz, axis=0, keepdims=True)
+    sgzx_ref[...] += jnp.sum(gz * xhat, axis=0, keepdims=True)
+
+
+def _bwd_dx_kernel(*refs, act, has_res):
+    it = iter(refs)
+    g_ref, x_ref, coef_ref = next(it), next(it), next(it)
+    res_ref = next(it) if has_res else None
+    dx_ref = next(it)
+    dres_ref = next(it) if has_res else None
+    xb = x_ref[...].astype(jnp.float32)
+    gz = g_ref[...].astype(jnp.float32)
+    if act is not None:
+        z = xb * coef_ref[0:1] + coef_ref[1:2]
+        if has_res:
+            z = z + res_ref[...].astype(jnp.float32)
+        gz = jnp.where(_act_mask(z, act), gz, 0.0)
+    dx = coef_ref[4:5] * gz + coef_ref[5:6] + coef_ref[6:7] * xb
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    if has_res:
+        dres_ref[...] = gz.astype(dres_ref.dtype)
+
+
+def _row_spec(blk_m, c):
+    return pl.BlockSpec((blk_m, c), lambda i: (i, 0))
+
+
+def _const_spec(rows, c):
+    return pl.BlockSpec((rows, c), lambda i: (0, 0))
+
+
+def _coef(mean, invstd, gamma, beta, A=None, B=None, Cc=None):
+    c = mean.shape[0]
+    a = gamma * invstd
+    b = beta - mean * a
+    zero = jnp.zeros((c,), jnp.float32)
+    rows = [a, b, mean, invstd, A if A is not None else zero,
+            B if B is not None else zero, Cc if Cc is not None else zero,
+            zero]
+    return jnp.stack([r.astype(jnp.float32) for r in rows])
+
+
+def _run_stats(x2, blk_m):
+    m, c = x2.shape
+    n_m = m // blk_m
+    return pl.pallas_call(
+        functools.partial(_stats_kernel, n_m=n_m),
+        grid=(n_m,),
+        in_specs=[_row_spec(blk_m, c)],
+        out_specs=[_const_spec(1, c), _const_spec(1, c)],
+        out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32),
+                   jax.ShapeDtypeStruct((1, c), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_INTERPRET,
+    )(x2)
+
+
+def _run_apply(x2, coef, res2, act, blk_m):
+    m, c = x2.shape
+    inputs = [x2, coef] + ([res2] if res2 is not None else [])
+    in_specs = [_row_spec(blk_m, c), _const_spec(8, c)] + \
+        ([_row_spec(blk_m, c)] if res2 is not None else [])
+    return pl.pallas_call(
+        functools.partial(_apply_kernel, act=act, has_res=res2 is not None),
+        grid=(m // blk_m,),
+        in_specs=in_specs,
+        out_specs=_row_spec(blk_m, c),
+        out_shape=jax.ShapeDtypeStruct((m, c), x2.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=_INTERPRET,
+    )(*inputs)
+
+
+def _run_bwd_reduce(g2, x2, coef, res2, act, blk_m):
+    m, c = x2.shape
+    inputs = [g2, x2, coef] + ([res2] if res2 is not None else [])
+    in_specs = [_row_spec(blk_m, c), _row_spec(blk_m, c),
+                _const_spec(8, c)] + \
+        ([_row_spec(blk_m, c)] if res2 is not None else [])
+    return pl.pallas_call(
+        functools.partial(_bwd_reduce_kernel, act=act,
+                          has_res=res2 is not None),
+        grid=(m // blk_m,),
+        in_specs=in_specs,
+        out_specs=[_const_spec(1, c), _const_spec(1, c)],
+        out_shape=[jax.ShapeDtypeStruct((1, c), jnp.float32),
+                   jax.ShapeDtypeStruct((1, c), jnp.float32)],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=_INTERPRET,
+    )(*inputs)
+
+
+def _run_bwd_dx(g2, x2, coef, res2, act, blk_m):
+    m, c = x2.shape
+    has_res = res2 is not None
+    inputs = [g2, x2, coef] + ([res2] if has_res else [])
+    in_specs = [_row_spec(blk_m, c), _row_spec(blk_m, c),
+                _const_spec(8, c)] + ([_row_spec(blk_m, c)] if has_res else [])
+    out_specs = [_row_spec(blk_m, c)] + ([_row_spec(blk_m, c)] if has_res
+                                         else [])
+    out_shape = [jax.ShapeDtypeStruct((m, c), x2.dtype)] + \
+        ([jax.ShapeDtypeStruct((m, c), res2.dtype)] if has_res else [])
+    outs = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, act=act, has_res=has_res),
+        grid=(m // blk_m,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=_INTERPRET,
+    )(*inputs)
+    return (outs[0], outs[1]) if has_res else (outs[0], None)
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp over the (M, C) view
+
+
+def _fwd_impl(x2, gamma, beta, res2, eps, act, blk_m):
+    m = x2.shape[0]
+    s, sq = _run_stats(x2, blk_m)
+    mean = s[0] / m
+    var = jnp.maximum(sq[0] / m - mean * mean, 0.0)
+    invstd = jax.lax.rsqrt(var + eps)
+    coef = _coef(mean, invstd, gamma, beta)
+    y2 = _run_apply(x2, coef, res2, act, blk_m)
+    return y2, mean, var, invstd
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _bn_act_p(x2, gamma, beta, res2, eps, act, blk_m):
+    y2, mean, var, _ = _fwd_impl(x2, gamma, beta, res2, eps, act, blk_m)
+    return y2, mean, var
+
+
+def _bn_act_fwd(x2, gamma, beta, res2, eps, act, blk_m):
+    y2, mean, var, invstd = _fwd_impl(x2, gamma, beta, res2, eps, act, blk_m)
+    return (y2, mean, var), (x2, gamma, beta, res2, mean, invstd)
+
+
+def _bn_act_bwd(eps, act, blk_m, residuals, cts):
+    x2, gamma, beta, res2, mean, invstd = residuals
+    gy, gmean, gvar = cts
+    m = x2.shape[0]
+    gammaf = gamma.astype(jnp.float32)
+    coef = _coef(mean, invstd, gammaf, beta)
+    sgz, sgzx = _run_bwd_reduce(gy, x2, coef, res2, act, blk_m)
+    sgz, sgzx = sgz[0], sgzx[0]
+    # dx = c1*(gz - sgz/M - xhat*sgzx/M) + gmean/M + gvar*2*(x-mean)/M
+    #    = A*gz + B + Cc*x   with the xhat/mean terms folded per channel
+    c1 = gammaf * invstd
+    k = -c1 * sgzx * invstd / m + 2.0 * gvar.astype(jnp.float32) / m
+    A = c1
+    B = -c1 * sgz / m + gmean.astype(jnp.float32) / m - k * mean
+    Cc = k
+    coef_dx = _coef(mean, invstd, gammaf, beta, A, B, Cc)
+    dx2, dres2 = _run_bwd_dx(gy, x2, coef_dx, res2, act, blk_m)
+    dgamma = sgzx.astype(gamma.dtype)
+    dbeta = sgz.astype(beta.dtype)
+    return dx2, dgamma, dbeta, dres2
+
+
+_bn_act_p.defvjp(_bn_act_fwd, _bn_act_bwd)
+
+
+# ---------------------------------------------------------------------------
+# reference (pure jnp): same math, any channel axis, fully differentiable.
+# Used on CPU / whenever the kernels don't apply, and as the test oracle.
+
+
+def bn_act_reference(x, gamma, beta, eps=1e-5, act=None, residual=None,
+                     channel_axis=-1):
+    """Returns (y, batch_mean, batch_var) — f32 stats, biased variance."""
+    ch = channel_axis % x.ndim
+    axes = tuple(i for i in range(x.ndim) if i != ch)
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.maximum(jnp.mean(xf * xf, axis=axes) - mean * mean, 0.0)
+    shape = [1] * x.ndim
+    shape[ch] = x.shape[ch]
+    a = (gamma.astype(jnp.float32) * jax.lax.rsqrt(var + eps)).reshape(shape)
+    b = (beta.astype(jnp.float32)).reshape(shape) - mean.reshape(shape) * a
+    z = xf * a + b
+    if residual is not None:
+        z = z + residual.astype(jnp.float32)
+    return _act_apply(z, act).astype(x.dtype), mean, var
+
+
+# ---------------------------------------------------------------------------
+# public entry
+
+
+def bn_act_train(x, gamma, beta, eps=1e-5, act=None, residual=None,
+                 channel_last=True):
+    """Fused training BatchNorm + optional residual-add + activation.
+
+    x: (N, ..., C) when channel_last else (N, C, ...); gamma/beta: (C,);
+    residual: same shape as x or None; act in {None, "relu", "relu6"}.
+    Returns (y, batch_mean_f32, batch_var_f32).  Selects the pallas
+    kernel pair on TPU (or under `_INTERPRET`), the jnp reference
+    otherwise — callers never need to know which ran.
+    """
+    if act not in _ACTS:
+        raise ValueError(f"bn_act_train: unsupported activation {act!r}")
+    ch = -1 if channel_last else 1
+    use_kernel = (channel_last and _available() and x.ndim >= 2
+                  and x.dtype in (jnp.float32, jnp.bfloat16)
+                  and (residual is None or residual.shape == x.shape))
+    if use_kernel:
+        c = x.shape[-1]
+        m = int(x.size) // c
+        blk_m = _block_m(m, c)
+        if blk_m is not None:
+            x2 = x.reshape(m, c)
+            res2 = None if residual is None else \
+                residual.astype(x.dtype).reshape(m, c)
+            y2, mean, var = _bn_act_p(x2, gamma, beta, res2, float(eps),
+                                      act, blk_m)
+            return y2.reshape(x.shape), mean, var
+    return bn_act_reference(x, gamma, beta, eps, act, residual, ch)

@@ -108,6 +108,10 @@ class ShardedTrainStep:
 
     # -- compiled step -------------------------------------------------------
     def _forward_loss(self, state, batch, rng_key=None):
+        # NOTE: no return_buffer_updates here — BatchNorm running stats
+        # stay frozen under the SHARDED step (per-replica batch stats
+        # would need a cross-replica mean, the SyncBatchNorm contract;
+        # single-device TrainStep folds them functionally since ISSUE 1)
         from ..jit import forward_loss
         return forward_loss(self.model, self.loss_fn, state, batch, rng_key,
                             "O1" if self._amp else None, self._amp_dtype)

@@ -23,7 +23,9 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
         arr = data._data
         if dtype is not None:
             arr = arr.astype(_dt.convert_dtype(dtype))
-        return Tensor(arr, stop_gradient=stop_gradient)
+        t = Tensor(arr, stop_gradient=stop_gradient)
+        t._layout = data._layout  # shares the physical buffer
+        return t
     arr = np.asarray(data)
     if dtype is not None:
         arr = arr.astype(np.dtype(_dt.convert_dtype(dtype)))
@@ -180,6 +182,9 @@ def meshgrid(*args, **kwargs):
 
 def assign(x, output=None):
     """paddle.assign: copy input into output (or a fresh tensor)."""
+    if isinstance(x, Tensor) and x._layout is not None:
+        from ..core.layout import to_nchw
+        x = to_nchw(x)  # copies materialize in the logical layout
     data = jnp.asarray(unwrap(x))
     if output is None:
         return Tensor(data)

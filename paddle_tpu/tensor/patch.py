@@ -89,6 +89,9 @@ def apply_patches():
         def fn(self, *args, **kwargs):
             out = op(self, *args, **kwargs)
             self._set_data(out._data)
+            # layout-agnostic ops keep NHWC data tagged — carry the
+            # result's tag (for _set_data cleared it assuming logical data)
+            self._layout = out._layout
             return self
         return fn
     Tensor.add_ = _make_inplace(math.add)
@@ -152,6 +155,10 @@ def _getitem(self, idx):
 
 
 def _setitem(self, idx, value):
+    if self._layout is not None and self._data.ndim == 4:
+        # the caller indexes the LOGICAL layout: materialize it first
+        # (_set_data below clears the tag)
+        self._data = jnp.transpose(self._data, (0, 3, 1, 2))
     idx = _unwrap_index(idx)
     v = unwrap(value)
     new = self._data.at[idx].set(jnp.asarray(v, self._data.dtype))

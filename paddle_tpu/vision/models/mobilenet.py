@@ -13,12 +13,12 @@ class ConvBNLayer(nn.Layer):
         self.conv = nn.Conv2D(in_c, out_c, kernel, stride=stride,
                               padding=padding, groups=groups, bias_attr=False)
         self.bn = nn.BatchNorm2D(out_c)
-        self.act = nn.ReLU() if act == "relu" else (
-            nn.ReLU6() if act == "relu6" else None)
+        self._act_name = act if act in ("relu", "relu6") else None
 
     def forward(self, x):
-        x = self.bn(self.conv(x))
-        return self.act(x) if self.act is not None else x
+        # BN + act fused (ops/fused_bn_act.py) — the conv-bn-act idiom
+        return self.bn.forward_fused(self.conv(x),
+                                     activation=self._act_name)
 
 
 class DepthwiseSeparable(nn.Layer):

@@ -13,6 +13,17 @@ from __future__ import annotations
 from ... import nn
 
 
+def _bn_act(norm, x, activation=None, residual=None):
+    """Route a block's norm+residual+act tail through the fused kernel
+    path (ops/fused_bn_act.py) when the norm layer supports it; custom
+    norm_layer callables without forward_fused get the composite."""
+    if hasattr(norm, "forward_fused"):
+        return norm.forward_fused(x, activation=activation,
+                                  residual=residual)
+    from ...nn.functional.norm import bn_act_composite
+    return bn_act_composite(norm(x), activation, residual)
+
+
 class BasicBlock(nn.Layer):
     expansion = 1
 
@@ -36,11 +47,12 @@ class BasicBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.bn2(self.conv2(out))
+        out = _bn_act(self.bn1, self.conv1(x), "relu")
+        out = self.conv2(out)
         if self.downsample is not None:
             identity = self.downsample(x)
-        return self.relu(out + identity)
+        # bn2 + residual-add + relu fused into one kernel (one HBM pass)
+        return _bn_act(self.bn2, out, "relu", residual=identity)
 
 
 class BottleneckBlock(nn.Layer):
@@ -69,12 +81,13 @@ class BottleneckBlock(nn.Layer):
 
     def forward(self, x):
         identity = x
-        out = self.relu(self.bn1(self.conv1(x)))
-        out = self.relu(self.bn2(self.conv2(out)))
-        out = self.bn3(self.conv3(out))
+        out = _bn_act(self.bn1, self.conv1(x), "relu")
+        out = _bn_act(self.bn2, self.conv2(out), "relu")
+        out = self.conv3(out)
         if self.downsample is not None:
             identity = self.downsample(x)
-        return self.relu(out + identity)
+        # bn3 + residual-add + relu fused into one kernel (one HBM pass)
+        return _bn_act(self.bn3, out, "relu", residual=identity)
 
 
 class ResNet(nn.Layer):
@@ -130,7 +143,7 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.maxpool(_bn_act(self.bn1, self.conv1(x), "relu"))
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         if self.with_pool:
             x = self.avgpool(x)

@@ -14,6 +14,26 @@ _CFGS = {
 }
 
 
+class _Features(nn.Sequential):
+    """Sequential that runs a BatchNorm2D immediately followed by ReLU as
+    ONE fused bn+relu op (same sublayers and state_dict keys as a plain
+    Sequential — only the execution is fused)."""
+
+    def forward(self, x):
+        layers = list(self._sub_layers.values())
+        i = 0
+        while i < len(layers):
+            layer = layers[i]
+            nxt = layers[i + 1] if i + 1 < len(layers) else None
+            if hasattr(layer, "forward_fused") and isinstance(nxt, nn.ReLU):
+                x = layer.forward_fused(x, activation="relu")
+                i += 2
+            else:
+                x = layer(x)
+                i += 1
+        return x
+
+
 def _make_layers(cfg, batch_norm=False):
     layers = []
     in_c = 3
@@ -26,7 +46,7 @@ def _make_layers(cfg, batch_norm=False):
                 layers.append(nn.BatchNorm2D(v))
             layers.append(nn.ReLU())
             in_c = v
-    return nn.Sequential(*layers)
+    return _Features(*layers)
 
 
 class VGG(nn.Layer):

@@ -1,12 +1,23 @@
 #!/bin/bash
-# Round 2: async bench-style harness; NHWC vs NCHW full model; tower at 256.
+# Round 2+: async bench-style harness; NHWC vs NCHW full model; tower at 256.
+# The b256 tower uses the GROUPED probe (convtower2, resnet_probe.py) — the
+# r4 monolithic tower OOM'd at b256 (inputs+outputs+grad stash > 16 GB HBM),
+# which is why the original convtower-256 sections came back empty.
+# The hbm section runs the XLA cost-analysis traffic estimator
+# (probes/hbm_probe.py): bytes accessed per train step for NCHW-unfused vs
+# NHWC+fused-BN — the tracked form of the "~8 HBM passes" claim.
 cd "$(dirname "$0")/.."
 out=probes/resnet_probe_results2.txt
 : > "$out"
 for spec in "baseline 64" "baseline 256" "nhwc 64" "nhwc 128" "nhwc 256" \
-            "nhwc_o2 256" "o2 256" "convtower 256" "convtower_nhwc 256"; do
+            "nhwc_o2 256" "o2 256" "convtower2 256" "convtower2_nhwc 256"; do
   set -- $spec
   echo "=== $1 $2 ===" | tee -a "$out"
   timeout 1200 python probes/resnet_probe.py "$1" "$2" 2>&1 | grep -v WARNING | tail -3 | tee -a "$out"
 done
+# b16 is the tracked hbm config (matches the recorded artifact below; the
+# analysis is per-step so the fused/unfused RATIO is batch-independent,
+# and a b256 fwd+bwd lowering can exhaust the CPU-host compile budget)
+echo "=== hbm 50 16 224 O2 ===" | tee -a "$out"
+timeout 1800 python probes/hbm_probe.py 50 16 224 O2 2>&1 | grep -v WARNING | tail -5 | tee -a "$out"
 echo DONE | tee -a "$out"
