@@ -70,7 +70,16 @@ def _amp_hook(name, raw_leaves, tensor_idx):
     return out
 
 
-_op.set_amp_hook(_amp_hook)
+def _amp_cache_key():
+    """Hashable snapshot of the autocast policy for the dispatch fast-path
+    cache: any state change (enable, dtype, level, custom lists) must miss."""
+    if not _state.enabled:
+        return None
+    return (_state.level, jnp.dtype(_state.dtype).name, _state.white,
+            _state.black)
+
+
+_op.set_amp_hook(_amp_hook, _amp_cache_key)
 
 
 class auto_cast:

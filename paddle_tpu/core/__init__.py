@@ -1,4 +1,7 @@
 from . import dtype, device, rng, op, tape  # noqa: F401
 from .tensor import (Tensor, Parameter, no_grad, enable_grad,  # noqa: F401
                      is_grad_enabled, set_grad_enabled, unwrap, wrap)
+from .op import (dispatch_cache_clear, dispatch_cache_stats,  # noqa: F401
+                 dispatch_cache_size, set_dispatch_cache_size,
+                 set_dispatch_cache_enabled)
 from . import errors  # noqa: F401,E402

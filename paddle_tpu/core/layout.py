@@ -31,7 +31,11 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
+import jax.numpy as jnp
 import numpy as np
+
+from .tensor import Tensor
 
 NHWC = "NHWC"
 
@@ -108,13 +112,11 @@ def enabled() -> bool:
 
 
 def tag_of(x) -> Optional[str]:
-    from .tensor import Tensor
     return x._layout if isinstance(x, Tensor) else None
 
 
 def tag(x):
     """Mark a Tensor as physically NHWC (logical NCHW)."""
-    from .tensor import Tensor
     if isinstance(x, Tensor) and x._data.ndim == 4:
         x._layout = NHWC
     return x
@@ -122,8 +124,6 @@ def tag(x):
 
 def tag_tree(out):
     """Tag every rank-4 Tensor in an op's output pytree."""
-    import jax
-    from .tensor import Tensor
 
     def _t(leaf):
         if isinstance(leaf, Tensor) and leaf._data.ndim == 4:
@@ -136,16 +136,14 @@ def tag_tree(out):
 
 def to_nchw(t):
     """Physically NHWC tagged Tensor -> plain NCHW Tensor (tape-recorded)."""
-    import jax.numpy as jnp
-    from .op import dispatch
+    from .op import dispatch  # lazy: core.op imports this module at top
     return dispatch("layout_to_nchw",
                     lambda x: jnp.transpose(x, (0, 3, 1, 2)), t)
 
 
 def to_nhwc(t):
     """Plain NCHW Tensor -> tagged physically-NHWC Tensor (tape-recorded)."""
-    import jax.numpy as jnp
-    from .op import dispatch
+    from .op import dispatch  # lazy: core.op imports this module at top
     out = dispatch("layout_to_nhwc",
                    lambda x: jnp.transpose(x, (0, 2, 3, 1)), t)
     return tag(out)
@@ -157,7 +155,6 @@ def ensure_nhwc(t):
 
 
 def _operand_ndim(x):
-    from .tensor import Tensor
     if isinstance(x, Tensor):
         return x._data.ndim
     if isinstance(x, np.ndarray) or hasattr(x, "aval") or hasattr(x, "ndim"):
@@ -173,7 +170,6 @@ def dispatch_prepare(name: str, flat):
     inputs transposed back to NCHW at layout boundaries) and whether the
     op's rank-4 outputs should inherit the NHWC tag.
     """
-    from .tensor import Tensor
     tagged = [i for i, x in enumerate(flat)
               if isinstance(x, Tensor) and x._layout is not None]
     if not tagged:

@@ -6,29 +6,76 @@ grad-node creation.  Here the "kernel" is a pure jnp function (XLA-compiled
 and cached by jax's eager dispatch), the grad node is a `jax.vjp` closure, and
 AMP is a dtype-cast policy consulted before the call.  Under `jax.jit` the same
 path runs at trace time only, so compiled code pays zero overhead for it.
+
+Eager fast path (the L5 overhead attack): eager per-op Python cost used to be
+dominated by (a) an eager `jax.vjp` that re-traces the op on EVERY call and
+runs its linearization outside any jit cache, and (b) per-call bookkeeping
+(imports, placement scans, layout probes).  `dispatch` now keeps an LRU cache
+keyed on the op's abstract signature
+
+    (op_name, raw_fn identity/closure, input treedef + avals, diff mask,
+     amp-policy state, layout tags, nan-check flag)
+
+whose entries hold a pre-jitted forward that returns ``(outputs, vjp)`` — the
+`jax.vjp` is taken INSIDE `jax.jit`, so forward+linearization compile once and
+replay from XLA's executable cache (jax returns the pullback as a
+`jax.tree_util.Partial`, i.e. a pytree of residuals, so it round-trips through
+jit) — plus a pre-jitted backward that the TapeNode invokes instead of a fresh
+eager vjp closure.  Signatures the cache cannot key safely (tracer inputs,
+unhashable closures, ops that concretize values) fall back to the eager slow
+path below, which is byte-for-byte the original dispatch semantics.
+
+Knobs: ``PADDLE_TPU_DISPATCH_CACHE=0`` disables the fast path at import,
+``PADDLE_TPU_DISPATCH_CACHE_SIZE`` bounds the LRU (default 512);
+`dispatch_cache_clear()` / `set_dispatch_cache_size()` /
+`set_dispatch_cache_enabled()` / `dispatch_cache_stats()` are the in-process
+controls.  Profiler + FLAGS_check_nan_inf hooks fire on BOTH paths.
 """
 from __future__ import annotations
 
+import os
+import sys
+import types
+from collections import OrderedDict
+from functools import partial as _fn_partial
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
-from .tensor import Tensor, TapeNode, _is_tracer, is_grad_enabled
+from . import layout as _layout
+from .tensor import (Tensor, _is_tracer, _tapenode_fast, _tensor_fast,
+                     is_grad_enabled)
+
+_tree_flatten = jax.tree_util.tree_flatten
+_tree_unflatten = jax.tree_util.tree_unflatten
+_tree_map = jax.tree_util.tree_map
+_tree_leaves = jax.tree_util.tree_leaves
 
 # AMP policy hook: set by paddle_tpu.amp.  Signature: (op_name, raw_leaves,
-# tensor_mask) -> raw_leaves (possibly dtype-cast).
+# tensor_mask) -> raw_leaves (possibly dtype-cast).  key_fn returns a hashable
+# snapshot of the policy state (None when inactive) for the dispatch cache.
 _amp_hook: Optional[Callable] = None
+_amp_key_fn: Optional[Callable] = None
 # Profiler hook: set by paddle_tpu.utils.profiler. Signature: (op_name) -> ctx.
 _profiler_hook: Optional[Callable] = None
 # FLAGS_check_nan_inf consumer (reference:
 # framework/details/nan_inf_utils_detail.cc — scan every op's outputs and
 # abort on the first non-finite value).  Toggled by utils.flags.set_flags.
 _check_nan_inf: bool = False
+# placement-harmonization gate: False until the process sees its first device
+# mesh (parallel.mesh.create_mesh calls note_multi_device), so single-device
+# eager loops never pay the per-input sharding scan.
+_multi_device_seen: bool = False
 
 
-def set_amp_hook(fn):
-    global _amp_hook
+def set_amp_hook(fn, key_fn=None):
+    global _amp_hook, _amp_key_fn
     _amp_hook = fn
+    _amp_key_fn = key_fn
+    dispatch_cache_clear()  # traced casts bake the policy hook in
 
 
 def set_profiler_hook(fn):
@@ -41,11 +88,17 @@ def set_check_nan_inf(enabled: bool):
     _check_nan_inf = bool(enabled)
 
 
+def note_multi_device():
+    """Arm `_harmonize_placement`: called by parallel.mesh.create_mesh the
+    first time a device mesh exists, after which eager ops must tolerate
+    mixed mesh-sharded / single-device operands."""
+    global _multi_device_seen
+    _multi_device_seen = True
+
+
 def _assert_finite(name: str, out):
     """Eager-only scan of an op's float outputs for nan/inf."""
-    import jax.numpy as jnp
-    for leaf in jax.tree_util.tree_leaves(
-            out, is_leaf=lambda x: isinstance(x, Tensor)):
+    for leaf in _tree_leaves(out, is_leaf=_is_tensor_leaf):
         arr = leaf._data if isinstance(leaf, Tensor) else leaf
         if _is_tracer(arr) or not hasattr(arr, "dtype"):
             continue
@@ -63,7 +116,6 @@ def _harmonize_placement(raw):
     single device — e.g. DataParallel-sharded activations vs a host-loaded
     label — move the single-device ones onto the mesh (replicated) so the
     op compiles instead of raising an incompatible-devices error."""
-    from jax.sharding import NamedSharding, PartitionSpec
     mesh_sh = None
     for x in raw:
         if (isinstance(x, jax.Array) and not _is_tracer(x)
@@ -83,6 +135,476 @@ def _harmonize_placement(raw):
     return out
 
 
+# ---------------------------------------------------------------------------
+# dispatch fast path: signature-keyed cache of jitted forward+vjp pairs
+# ---------------------------------------------------------------------------
+
+def _env_cache_enabled() -> bool:
+    return os.environ.get("PADDLE_TPU_DISPATCH_CACHE", "1").lower() not in (
+        "0", "off", "false", "no")
+
+
+_cache_enabled: bool = _env_cache_enabled()
+_cache_max: int = max(1, int(
+    os.environ.get("PADDLE_TPU_DISPATCH_CACHE_SIZE", "512")))
+_cache: "OrderedDict" = OrderedDict()
+_stats = {"hits": 0, "misses": 0, "fallbacks": 0, "bypass": 0, "evictions": 0}
+_dispatch_count: int = 0
+
+_MISS = object()       # sentinel: fast path declined, run the slow path
+_FALLBACK = object()   # cached verdict: this signature is not jit-safe
+_UNKEYABLE = object()  # freeze() verdict: value cannot live in a cache key
+
+
+def dispatch_cache_clear():
+    """Drop every cached executable (and un-jittable verdicts)."""
+    _cache.clear()
+
+
+def dispatch_cache_stats() -> dict:
+    s = dict(_stats)
+    s["entries"] = len(_cache)
+    s["enabled"] = _cache_enabled
+    s["max_entries"] = _cache_max
+    return s
+
+
+def dispatch_cache_size() -> int:
+    return _cache_max
+
+
+def set_dispatch_cache_size(n: int) -> int:
+    """Resize the LRU (evicting oldest entries); returns the previous size."""
+    global _cache_max
+    prev = _cache_max
+    _cache_max = max(1, int(n))
+    while len(_cache) > _cache_max:
+        _cache.popitem(last=False)
+        _stats["evictions"] += 1
+    return prev
+
+
+def set_dispatch_cache_enabled(enabled: bool) -> bool:
+    """Toggle the fast path (the in-process form of the
+    PADDLE_TPU_DISPATCH_CACHE env knob); returns the previous setting."""
+    global _cache_enabled
+    prev = _cache_enabled
+    _cache_enabled = bool(enabled)
+    return prev
+
+
+def dispatch_count() -> int:
+    """Monotone count of tensor-carrying dispatches (probe accounting)."""
+    return _dispatch_count
+
+
+_diff_dtype_memo: dict = {}
+
+
+def _is_diff_dtype(x) -> bool:
+    try:
+        dt = x.dtype
+    except AttributeError:
+        return False
+    r = _diff_dtype_memo.get(dt)
+    if r is None:
+        r = bool(jnp.issubdtype(dt, jnp.floating)
+                 or jnp.issubdtype(dt, jnp.complexfloating))
+        _diff_dtype_memo[dt] = r
+    return r
+
+
+def _is_tensor_leaf(x):
+    return isinstance(x, Tensor)
+
+
+_PRIMS = (bool, int, float, complex, str, bytes)
+
+
+def _module_global(fn) -> bool:
+    """True when fn is reachable as a module attribute under its own
+    __qualname__ — then its identity is process-stable and the object itself
+    can key the cache (jnp.add, jax.nn.relu, defop raws, ...)."""
+    mod = getattr(fn, "__module__", None)
+    qn = getattr(fn, "__qualname__", None)
+    if not mod or not qn or "<locals>" in qn:
+        return False
+    obj = sys.modules.get(mod)
+    if obj is None:
+        return False
+    try:
+        for part in qn.split("."):
+            obj = getattr(obj, part)
+    except AttributeError:
+        return False
+    return obj is fn
+
+
+def _freeze(v, depth=0):
+    """Hashable token for a static value, or _UNKEYABLE.  Deliberately a
+    whitelist: anything mutable-and-opaque (Tensors, ndarrays, layer objects)
+    must NOT be baked into a trace, so it falls back to the slow path."""
+    if v is None:
+        return v
+    t = v.__class__
+    if t in _PRIMS:
+        # type-tagged: 1, 1.0 and True compare/hash equal but trace to
+        # different constants (int vs float promotion) — they must not
+        # collide in the cache key
+        return (t.__name__, v)
+    if depth > 4:
+        return _UNKEYABLE
+    if isinstance(v, np.dtype):
+        return ("npdt", str(v))
+    if isinstance(v, np.generic):  # numpy scalar: value-keyed
+        return ("npg", v.item(), str(v.dtype))
+    if t in (tuple, list):
+        items = []
+        for x in v:
+            f = _freeze(x, depth + 1)
+            if f is _UNKEYABLE:
+                return _UNKEYABLE
+            items.append(f)
+        return (t.__name__, tuple(items))
+    if t is dict:
+        try:
+            keys = sorted(v)
+        except TypeError:
+            return _UNKEYABLE
+        items = []
+        for k in keys:
+            f = _freeze(v[k], depth + 1)
+            if f is _UNKEYABLE:
+                return _UNKEYABLE
+            items.append((k, f))
+        return ("dict", tuple(items))
+    if t is slice:
+        return ("slice", _freeze(v.start, depth + 1),
+                _freeze(v.stop, depth + 1), _freeze(v.step, depth + 1))
+    if t is frozenset:
+        return v
+    if isinstance(v, type):  # dtype classes (jnp.float32), enums' classes
+        return v
+    if callable(v):
+        return _fn_key(v, depth + 1, None)
+    return _UNKEYABLE
+
+
+def _fn_key(fn, depth, dyn_cells):
+    """Hashable identity for a raw_fn, or _UNKEYABLE.
+
+    Module-global callables key by object identity.  Call-site-local
+    closures (the `def raw(...)` idiom all over tensor/ and nn/functional/)
+    key by (code object, frozen defaults, frozen closure cells): the same
+    source location with the same closed-over config values maps to the same
+    entry even though the function object is rebuilt per call.  When
+    `dyn_cells` is a list, closure cells holding bare jax.Arrays (dropout's
+    per-call RNG key) become DYNAMIC inputs of the jitted entry — recorded
+    here by position, substituted at trace time via cell rewriting — instead
+    of baked constants."""
+    if depth > 4:
+        return _UNKEYABLE
+    if getattr(fn, "__self__", None) is not None:
+        # bound method: behavior can depend on mutable `self` state that
+        # lives outside __closure__ — never safe to bake into a trace
+        return _UNKEYABLE
+    if isinstance(fn, _fn_partial):
+        f = _fn_key(fn.func, depth + 1, None)
+        a = _freeze(tuple(fn.args), depth + 1)
+        k = _freeze(dict(fn.keywords), depth + 1) if fn.keywords else ()
+        if f is _UNKEYABLE or a is _UNKEYABLE or k is _UNKEYABLE:
+            return _UNKEYABLE
+        return ("partial", f, a, k)
+    code = getattr(fn, "__code__", None)
+    if code is None or _module_global(fn):
+        try:
+            hash(fn)
+        except TypeError:
+            return _UNKEYABLE
+        return fn
+    parts = [code]
+    if fn.__defaults__:
+        d = _freeze(tuple(fn.__defaults__), depth + 1)
+        if d is _UNKEYABLE:
+            return _UNKEYABLE
+        parts.append(d)
+    if fn.__kwdefaults__:
+        d = _freeze(dict(fn.__kwdefaults__), depth + 1)
+        if d is _UNKEYABLE:
+            return _UNKEYABLE
+        parts.append(("kw", d))
+    if fn.__closure__:
+        for i, c in enumerate(fn.__closure__):
+            try:
+                v = c.cell_contents
+            except ValueError:  # empty cell
+                return _UNKEYABLE
+            if (dyn_cells is not None and isinstance(v, jax.Array)
+                    and not isinstance(v, Tensor)):
+                if _is_tracer(v):
+                    return _UNKEYABLE
+                dyn_cells.append(i)
+                av = v.aval
+                parts.append(("dyncell", i, av.shape, av.dtype, av.weak_type))
+            else:
+                fv = _freeze(v, depth + 1)
+                if fv is _UNKEYABLE:
+                    return _UNKEYABLE
+                parts.append(("cell", i, fv))
+    return ("fn", tuple(parts))
+
+
+class _Entry:
+    """One cached signature: jitted fwd (+vjp) and the positional plumbing."""
+
+    __slots__ = ("jfwd", "jbwd", "dyn_leaf_pos", "dyn_cell_pos", "diff_pos",
+                 "tensor_pos")
+
+    def __init__(self, dyn_leaf_pos, dyn_cell_pos, diff_pos, tensor_pos):
+        self.dyn_leaf_pos = dyn_leaf_pos
+        self.dyn_cell_pos = dyn_cell_pos
+        self.diff_pos = diff_pos
+        self.tensor_pos = tensor_pos
+        self.jfwd = None
+        self.jbwd = None
+
+
+class _CachedVjp:
+    """TapeNode backward for the fast path: replays the op's pre-jitted
+    pullback on this call's residuals (a jax Partial pytree) instead of
+    holding a fresh eager vjp closure."""
+
+    __slots__ = ("jbwd", "partial", "out_tree")
+
+    def __init__(self, jbwd, partial, out_tree):
+        self.jbwd = jbwd
+        self.partial = partial
+        self.out_tree = out_tree
+
+    def __call__(self, cts):
+        if not isinstance(cts, tuple):
+            cts = (cts,)
+        ct_tree = _tree_unflatten(self.out_tree, list(cts))
+        return self.jbwd(self.partial, ct_tree)
+
+
+def _call_vjp(vjp_partial, ct_tree):
+    return vjp_partial(ct_tree)
+
+
+def _build_key(name, raw_fn, flat):
+    """Abstract signature of this dispatch, or None (bypass the fast path).
+
+    Returns (key, dyn_leaf_pos, dyn_cell_pos, diff_pos, tensor_pos)."""
+    grad_on = is_grad_enabled()
+    desc = []
+    dyn_leaf_pos = []
+    diff_pos = []
+    tensor_pos = []
+    for i, x in enumerate(flat):
+        if isinstance(x, Tensor):
+            d = x._data
+            if _is_tracer(d):
+                return None  # inside a jit trace: overhead is trace-time only
+            tensor_pos.append(i)
+            dyn_leaf_pos.append(i)
+            diff = grad_on and not x.stop_gradient and _is_diff_dtype(d)
+            if diff:
+                diff_pos.append(i)
+            av = getattr(d, "aval", None)
+            if av is not None:
+                desc.append(("T", av.shape, av.dtype, av.weak_type, diff,
+                             x._layout))
+            else:
+                # _set_data can leave a raw np.ndarray in _data
+                shape = getattr(d, "shape", None)
+                dt = getattr(d, "dtype", None)
+                if shape is None or dt is None:
+                    return None
+                desc.append(("T", tuple(shape), str(dt), False, diff,
+                             x._layout))
+        elif isinstance(x, jax.Array):
+            if _is_tracer(x):
+                return None
+            dyn_leaf_pos.append(i)
+            av = x.aval
+            desc.append(("A", av.shape, av.dtype, av.weak_type))
+        elif isinstance(x, np.ndarray):
+            dyn_leaf_pos.append(i)
+            desc.append(("A", x.shape, x.dtype.str, False))
+        elif x.__class__ is float:
+            # bare float leaves (scales, eps, clip bounds) are DYNAMIC
+            # weak-typed inputs: a per-step-varying scalar must not compile
+            # a fresh executable per value.  ints/bools stay static — they
+            # are structural (axis, k, sizes) and must be trace constants.
+            dyn_leaf_pos.append(i)
+            desc.append(("F",))
+        else:
+            f = _freeze(x)
+            if f is _UNKEYABLE:
+                return None
+            desc.append(("S", f))
+    dyn_cells = []
+    fk = _fn_key(raw_fn, 0, dyn_cells)
+    if fk is _UNKEYABLE:
+        return None
+    if _amp_hook is not None:
+        if _amp_key_fn is None:
+            return None  # unknown policy state: cannot key safely
+        amp_key = _amp_key_fn()
+    else:
+        amp_key = None
+    key = (name, fk, tuple(desc), amp_key, _check_nan_inf)
+    return key, dyn_leaf_pos, tuple(dyn_cells), diff_pos, tensor_pos
+
+
+def _rebuild_with_cells(proto, dyn_cell_pos, dyn_vals):
+    """Clone proto with the dyn closure cells replaced by dyn_vals (tracers
+    at trace time) — how a per-call RNG key becomes a jit input."""
+    sub = dict(zip(dyn_cell_pos, dyn_vals))
+    cells = tuple(
+        types.CellType(sub[i]) if i in sub else c
+        for i, c in enumerate(proto.__closure__))
+    fn = types.FunctionType(proto.__code__, proto.__globals__,
+                            proto.__name__, proto.__defaults__, cells)
+    if proto.__kwdefaults__:
+        fn.__kwdefaults__ = proto.__kwdefaults__
+    return fn
+
+
+def _make_entry(name, raw_fn, flat, treedef, dyn_leaf_pos, dyn_cell_pos,
+                diff_pos, tensor_pos):
+    entry = _Entry(dyn_leaf_pos, dyn_cell_pos, diff_pos, tensor_pos)
+    dyn_set = set(dyn_leaf_pos)
+    static_leaves = [None if i in dyn_set else x for i, x in enumerate(flat)]
+    n_leaf = len(dyn_leaf_pos)
+    amp = _amp_hook
+    proto = raw_fn  # entry keeps the creating call's fn for globals/cells
+
+    def assemble(dyn):
+        leaves = list(static_leaves)
+        for p, v in zip(dyn_leaf_pos, dyn):
+            leaves[p] = v
+        if dyn_cell_pos:
+            fn = _rebuild_with_cells(proto, dyn_cell_pos, dyn[n_leaf:])
+        else:
+            fn = proto
+        return leaves, fn
+
+    if diff_pos:
+        def fwd(*dyn):
+            leaves, fn = assemble(dyn)
+
+            def closed(*diff_vals):
+                lv = list(leaves)
+                for p, v in zip(diff_pos, diff_vals):
+                    lv[p] = v
+                if amp is not None:
+                    lv = amp(name, lv, tensor_pos)
+                a2, k2 = _tree_unflatten(treedef, lv)
+                return fn(*a2, **k2)
+
+            # the vjp INSIDE jit: forward + linearization compile once; the
+            # pullback is a Partial pytree (residual leaves), jit-returnable
+            return jax.vjp(closed, *[leaves[p] for p in diff_pos])
+
+        entry.jfwd = jax.jit(fwd)
+        entry.jbwd = jax.jit(_call_vjp)
+    else:
+        def fwd(*dyn):
+            leaves, fn = assemble(dyn)
+            if amp is not None:
+                leaves = amp(name, leaves, tensor_pos)
+            a2, k2 = _tree_unflatten(treedef, leaves)
+            return fn(*a2, **k2)
+
+        entry.jfwd = jax.jit(fwd)
+    return entry
+
+
+def _run_entry(entry, name, raw_fn, flat, tag_out):
+    dyn = [x._data if isinstance(x, Tensor) else x
+           for x in (flat[p] for p in entry.dyn_leaf_pos)]
+    if entry.dyn_cell_pos:
+        cells = raw_fn.__closure__
+        dyn += [cells[p].cell_contents for p in entry.dyn_cell_pos]
+    if _multi_device_seen:
+        dyn = _harmonize_placement(dyn)
+    prof = _profiler_hook(name) if _profiler_hook is not None else None
+    try:
+        if prof is not None:
+            prof.__enter__()
+        if entry.diff_pos:
+            out_raw, vjp_partial = entry.jfwd(*dyn)
+            if _check_nan_inf:
+                _assert_finite(name, out_raw)
+            out_flat, out_tree = _tree_flatten(out_raw)
+            out_tensors = [_tensor_fast(x, False) for x in out_flat]
+            node = _tapenode_fast(
+                name, _CachedVjp(entry.jbwd, vjp_partial, out_tree),
+                [flat[p] for p in entry.diff_pos], out_tensors)
+            for i, t in enumerate(out_tensors):
+                t._node = node
+                t._out_index = i
+            wrapped = _tree_unflatten(out_tree, out_tensors)
+        else:
+            out = entry.jfwd(*dyn)
+            if _check_nan_inf:
+                _assert_finite(name, out)
+            wrapped = _tree_map(lambda x: _tensor_fast(x, True), out)
+        return _layout.tag_tree(wrapped) if tag_out else wrapped
+    finally:
+        if prof is not None:
+            prof.__exit__(None, None, None)
+
+
+def _dispatch_fast(name, raw_fn, flat, treedef, tag_out):
+    built = _build_key(name, raw_fn, flat)
+    if built is None:
+        _stats["bypass"] += 1
+        return _MISS
+    key0, dyn_leaf_pos, dyn_cell_pos, diff_pos, tensor_pos = built
+    key = (key0, treedef)
+    entry = _cache.get(key)
+    if entry is _FALLBACK:
+        return _MISS
+    if entry is not None:
+        _cache.move_to_end(key)
+        _stats["hits"] += 1
+        return _run_entry(entry, name, raw_fn, flat, tag_out)
+    _stats["misses"] += 1
+    entry = _make_entry(name, raw_fn, flat, treedef, dyn_leaf_pos,
+                        dyn_cell_pos, diff_pos, tensor_pos)
+    try:
+        result = _run_entry(entry, name, raw_fn, flat, tag_out)
+    except FloatingPointError:
+        # FLAGS_check_nan_inf data error AFTER a successful trace: the
+        # entry is valid — keep it (later finite calls stay on the fast
+        # path) and surface the error without re-running the op eagerly
+        _cache[key] = entry
+        if len(_cache) > _cache_max:
+            _cache.popitem(last=False)
+            _stats["evictions"] += 1
+        raise
+    except Exception:
+        # un-jittable op (concretizes values, host control flow, ...): record
+        # the verdict and let the eager slow path run it — a genuine error
+        # re-raises identically there
+        _cache[key] = _FALLBACK
+        _stats["fallbacks"] += 1
+        result = _MISS
+    else:
+        _cache[key] = entry
+    if len(_cache) > _cache_max:  # bound holds for _FALLBACK verdicts too
+        _cache.popitem(last=False)
+        _stats["evictions"] += 1
+    return result
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
 def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
     """Run `raw_fn` over args where Tensor leaves are unwrapped.
 
@@ -93,26 +615,32 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
     Output pytree structure of raw_fn is preserved; array leaves become
     Tensors when any input was a Tensor.
     """
-    flat, treedef = jax.tree_util.tree_flatten(
-        (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+    global _dispatch_count
+    flat, treedef = _tree_flatten((args, kwargs), is_leaf=_is_tensor_leaf)
     tensor_idx = [i for i, x in enumerate(flat) if isinstance(x, Tensor)]
 
     if not tensor_idx:
         return raw_fn(*args, **kwargs)
+    _dispatch_count += 1
 
     # layout-policy hook (core.layout): transpose tagged-NHWC inputs back
     # to NCHW at layout boundaries; layout-agnostic elementwise ops run on
     # the NHWC data directly and propagate the tag to their outputs
     tag_out = False
-    from . import layout as _layout
-    if _layout.enabled():
+    if _layout._ENABLED_EVER:
         flat2, tag_out = _layout.dispatch_prepare(name, flat)
         if flat2 is not flat:
             flat = flat2
-            args, kwargs = jax.tree_util.tree_unflatten(treedef, flat)
+            args, kwargs = _tree_unflatten(treedef, flat)
 
-    raw = _harmonize_placement(
-        [x._data if isinstance(x, Tensor) else x for x in flat])
+    if _cache_enabled:
+        res = _dispatch_fast(name, raw_fn, flat, treedef, tag_out)
+        if res is not _MISS:
+            return res
+
+    raw = [x._data if isinstance(x, Tensor) else x for x in flat]
+    if _multi_device_seen:
+        raw = _harmonize_placement(raw)
     # NOTE: the AMP cast runs INSIDE the differentiated closure below, so the
     # vjp of the cast maps cotangents back to each input's original dtype
     # (bf16 activations get bf16 grads, f32 master params get f32 grads even
@@ -132,45 +660,43 @@ def dispatch(name: str, raw_fn: Callable, *args, **kwargs):
         if prof is not None:
             prof.__enter__()
         if not need_grad:
-            a2, k2 = jax.tree_util.tree_unflatten(treedef, apply_amp(raw))
+            a2, k2 = _tree_unflatten(treedef, apply_amp(raw))
             out = raw_fn(*a2, **k2)
             if _check_nan_inf:
                 _assert_finite(name, out)
-            wrapped = jax.tree_util.tree_map(
-                lambda x: Tensor(x, stop_gradient=True), out)
+            wrapped = _tree_map(lambda x: _tensor_fast(x, True), out)
             return _layout.tag_tree(wrapped) if tag_out else wrapped
 
         # differentiable inputs: float/complex Tensors not marked stop_gradient
         diff_idx = [i for i in tensor_idx
                     if not flat[i].stop_gradient and _is_diff_dtype(raw[i])]
         if not diff_idx:
-            a2, k2 = jax.tree_util.tree_unflatten(treedef, apply_amp(raw))
+            a2, k2 = _tree_unflatten(treedef, apply_amp(raw))
             out = raw_fn(*a2, **k2)
             if _check_nan_inf:
                 _assert_finite(name, out)
-            wrapped = jax.tree_util.tree_map(
-                lambda x: Tensor(x, stop_gradient=True), out)
+            wrapped = _tree_map(lambda x: _tensor_fast(x, True), out)
             return _layout.tag_tree(wrapped) if tag_out else wrapped
 
         def closed(*diff_vals):
             leaves = list(raw)
             for i, v in zip(diff_idx, diff_vals):
                 leaves[i] = v
-            a2, k2 = jax.tree_util.tree_unflatten(treedef, apply_amp(leaves))
+            a2, k2 = _tree_unflatten(treedef, apply_amp(leaves))
             return raw_fn(*a2, **k2)
 
         out_raw, vjp_fn = jax.vjp(closed, *[raw[i] for i in diff_idx])
         if _check_nan_inf:
             _assert_finite(name, out_raw)
 
-        out_flat, out_tree = jax.tree_util.tree_flatten(out_raw)
-        out_tensors = [Tensor(x, stop_gradient=False) for x in out_flat]
-        node = TapeNode(name, _TreeVjp(vjp_fn, out_tree),
-                        [flat[i] for i in diff_idx], out_tensors)
+        out_flat, out_tree = _tree_flatten(out_raw)
+        out_tensors = [_tensor_fast(x, False) for x in out_flat]
+        node = _tapenode_fast(name, _TreeVjp(vjp_fn, out_tree),
+                              [flat[i] for i in diff_idx], out_tensors)
         for i, t in enumerate(out_tensors):
             t._node = node
             t._out_index = i
-        wrapped = jax.tree_util.tree_unflatten(out_tree, out_tensors)
+        wrapped = _tree_unflatten(out_tree, out_tensors)
         return _layout.tag_tree(wrapped) if tag_out else wrapped
     finally:
         if prof is not None:
@@ -189,17 +715,8 @@ class _TreeVjp:
     def __call__(self, cts):
         if not isinstance(cts, tuple):
             cts = (cts,)
-        ct_tree = jax.tree_util.tree_unflatten(self.out_tree, list(cts))
+        ct_tree = _tree_unflatten(self.out_tree, list(cts))
         return self.vjp_fn(ct_tree)
-
-
-def _is_diff_dtype(x) -> bool:
-    try:
-        dt = x.dtype
-    except AttributeError:
-        return False
-    import jax.numpy as jnp
-    return jnp.issubdtype(dt, jnp.floating) or jnp.issubdtype(dt, jnp.complexfloating)
 
 
 def defop(name: str):

@@ -12,6 +12,7 @@ from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 
+from .selected_rows import RowSparseGrad
 from .tensor import Tensor, TapeNode, wrap
 
 
@@ -139,7 +140,6 @@ def _run_hooks(t: Tensor, ct):
 
 
 def _deposit(t: Tensor, raw_grad, accumulate, wanted, results):
-    from .selected_rows import RowSparseGrad
     if wanted is not None:
         if id(t) in wanted:
             # paddle.grad results are raw arrays handed straight to the
@@ -207,7 +207,6 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         for k, v in (res or {}).items():
             total[k] = total[k] + v if k in total else v
 
-    from .selected_rows import RowSparseGrad
     grads = []
     for t in inputs:
         if id(t) in total:

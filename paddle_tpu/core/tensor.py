@@ -302,6 +302,44 @@ class Tensor:
         return self._data
 
 
+_Tensor_new = Tensor.__new__
+
+
+def _tensor_fast(data, stop_gradient=True, name=None) -> Tensor:
+    """__slots__-based fast constructor for the dispatch hot path: direct
+    slot assignment, no isinstance ladder for the common case (data is
+    already a jax.Array / tracer coming out of an op)."""
+    if not isinstance(data, jax.Array) and not _is_tracer(data):
+        data = jnp.asarray(data)
+    t = _Tensor_new(Tensor)
+    t._data = data
+    t.stop_gradient = stop_gradient
+    t.grad = None
+    t._node = None
+    t._out_index = 0
+    t.name = name
+    t.persistable = False
+    t.trainable = not stop_gradient
+    t._hooks = None
+    t._layout = None
+    return t
+
+
+_TapeNode_new = TapeNode.__new__
+
+
+def _tapenode_fast(name, vjp_fn, inputs, outputs) -> TapeNode:
+    """__slots__-based fast constructor mirroring TapeNode.__init__ but
+    reading `_data` slots directly (no property lookups)."""
+    n = _TapeNode_new(TapeNode)
+    n.name = name
+    n.vjp_fn = vjp_fn
+    n.inputs = inputs
+    n.out_refs = [weakref.ref(t) for t in outputs]
+    n.out_avals = [(tuple(t._data.shape), t._data.dtype) for t in outputs]
+    return n
+
+
 class _HookHandle:
     def __init__(self, hooks, hook):
         self._hooks, self._hook = hooks, hook

@@ -42,12 +42,22 @@ def create_mesh(axes: Optional[Dict[str, int]] = None,
             f"mesh wants {n} devices but only {len(devices)} available")
     shape = tuple(sizes.get(a, 1) for a in AXES)
     arr = np.array(devices[:n]).reshape(shape)
+    # arm eager dispatch's placement harmonization: once a mesh exists,
+    # eager ops may mix mesh-sharded and single-device operands (core.op
+    # skips that per-input scan until this is called — the cheap-path gate)
+    from ..core import op as _op
+    _op.note_multi_device()
     return Mesh(arr, AXES)
 
 
 def set_mesh(mesh: Optional[Mesh]):
     global _GLOBAL_MESH
     _GLOBAL_MESH = mesh
+    if mesh is not None:
+        # externally built meshes (jax.sharding.Mesh direct) must also arm
+        # eager placement harmonization
+        from ..core import op as _op
+        _op.note_multi_device()
 
 
 def get_mesh(create_default: bool = False) -> Optional[Mesh]:

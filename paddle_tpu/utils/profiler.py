@@ -70,6 +70,14 @@ def stop_profiler(sorted_key="total", profile_path=None):
     lines = [f"{'op':<32}{'calls':>10}{'total_s':>14}{'avg_ms':>12}"]
     for name, (cnt, tot) in rows[:50]:
         lines.append(f"{name:<32}{cnt:>10}{tot:>14.4f}{tot / cnt * 1e3:>12.4f}")
+    # dispatch fast-path accounting (the hook fires on hit AND miss paths,
+    # so per-op spans above already include both; this line attributes them)
+    cs = _op.dispatch_cache_stats()
+    lines.append(
+        f"dispatch cache: hits={cs['hits']} misses={cs['misses']} "
+        f"fallbacks={cs['fallbacks']} bypass={cs['bypass']} "
+        f"entries={cs['entries']}/{cs['max_entries']} "
+        f"enabled={cs['enabled']}")
     report = "\n".join(lines)
     if profile_path:
         with open(profile_path, "w") as f:

@@ -22,8 +22,14 @@ import pytest  # noqa: E402
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle
+    from paddle_tpu.core import op as _core_op
     np.random.seed(0)
     paddle.seed(0)
+    # fresh dispatch cache per test: a cached entry bakes module state read
+    # at trace time, so monkeypatched kernels/flags from one test must not
+    # leak compiled executables into the next (within-test caching keeps
+    # the eager fast path exercised)
+    _core_op.dispatch_cache_clear()
     yield
 
 

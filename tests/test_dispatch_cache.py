@@ -1,0 +1,319 @@
+"""Dispatch fast-path correctness (ISSUE-2 tentpole): signature-keyed
+jitted forward+vjp cache in core.op — hit/miss semantics, grad parity vs
+the uncached eager-vjp path, hook ordering, LRU/clear semantics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.amp as amp
+import paddle_tpu.nn.functional as F
+from paddle_tpu.core import layout as core_layout
+from paddle_tpu.core import op as core_op
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    prev_en = core_op.set_dispatch_cache_enabled(True)
+    prev_sz = core_op.set_dispatch_cache_size(512)
+    core_op.dispatch_cache_clear()
+    yield
+    core_op.set_dispatch_cache_enabled(prev_en)
+    core_op.set_dispatch_cache_size(prev_sz)
+    core_op.dispatch_cache_clear()
+
+
+def _stats():
+    return core_op.dispatch_cache_stats()
+
+
+def _t(arr, requires_grad=False):
+    t = paddle.to_tensor(np.asarray(arr, dtype="float32"))
+    t.stop_gradient = not requires_grad
+    return t
+
+
+# ---------------------------------------------------------------------------
+# keying: hit/miss on signature changes
+# ---------------------------------------------------------------------------
+
+def test_repeat_signature_hits():
+    x = _t(np.random.randn(4, 4), requires_grad=True)
+    F.relu(x)
+    s0 = _stats()
+    F.relu(x)
+    F.relu(x)
+    s1 = _stats()
+    assert s1["hits"] - s0["hits"] == 2
+    assert s1["misses"] == s0["misses"]
+
+
+def test_shape_change_misses():
+    F.relu(_t(np.random.randn(4, 4), requires_grad=True))
+    s0 = _stats()
+    F.relu(_t(np.random.randn(8, 4), requires_grad=True))
+    s1 = _stats()
+    assert s1["misses"] - s0["misses"] == 1
+
+
+def test_dtype_change_misses():
+    x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    F.relu(x)
+    s0 = _stats()
+    F.relu(paddle.to_tensor(np.random.randn(4, 4).astype("float16")))
+    s1 = _stats()
+    assert s1["misses"] - s0["misses"] >= 1
+
+
+def test_stop_gradient_change_misses():
+    x = _t(np.random.randn(4, 4), requires_grad=True)
+    y = _t(np.random.randn(4, 4), requires_grad=False)
+    F.relu(x)
+    s0 = _stats()
+    F.relu(y)  # same aval, different diff mask -> new entry
+    s1 = _stats()
+    assert s1["misses"] - s0["misses"] == 1
+    F.relu(y)
+    assert _stats()["hits"] - s1["hits"] == 1
+
+
+def test_amp_state_in_key():
+    x = _t(np.random.randn(4, 4), requires_grad=True)
+    w = _t(np.random.randn(4, 4), requires_grad=True)
+    paddle.matmul(x, w)
+    s0 = _stats()
+    with amp.auto_cast():
+        y = paddle.matmul(x, w)
+    s1 = _stats()
+    assert s1["misses"] - s0["misses"] == 1
+    assert str(y.dtype) in ("bfloat16", "jax.numpy.bfloat16") or \
+        "bfloat16" in str(y.dtype)
+    # same policy again: hit
+    with amp.auto_cast():
+        paddle.matmul(x, w)
+    assert _stats()["hits"] - s1["hits"] == 1
+
+
+def test_layout_tag_in_key():
+    x = _t(np.random.randn(2, 3, 4, 4), requires_grad=True)
+    F.relu(x)
+    s0 = _stats()
+    tagged = _t(np.random.randn(2, 4, 4, 3), requires_grad=True)
+    core_layout.tag(tagged)  # physically NHWC
+    F.relu(tagged)  # agnostic op keeps the tag -> distinct signature
+    s1 = _stats()
+    assert s1["misses"] - s0["misses"] == 1
+
+
+def test_grad_mode_in_key():
+    x = _t(np.random.randn(4, 4), requires_grad=True)
+    F.relu(x)
+    s0 = _stats()
+    with paddle.no_grad():
+        F.relu(x)
+    s1 = _stats()
+    assert s1["misses"] - s0["misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# grad parity: cached fast path vs uncached eager-vjp dispatch
+# ---------------------------------------------------------------------------
+
+def _chain_grads(x_np, w_np, sg_w=False, use_amp=False, use_layout=False):
+    x = _t(x_np, requires_grad=True)
+    w = _t(w_np, requires_grad=not sg_w)
+    if use_layout:
+        core_layout.tag(x)  # treat data as physically NHWC
+        core_layout.tag(w)
+
+    def compute():
+        if use_amp:
+            with amp.auto_cast():
+                y = paddle.multiply(x, w)
+        else:
+            y = paddle.multiply(x, w)
+        y = F.relu(y)
+        z = paddle.add(y, x)
+        return paddle.sum(z)
+
+    loss = compute()
+    loss.backward()
+    gx = x.grad.numpy().copy()
+    gw = None if w.grad is None else w.grad.numpy().copy()
+    return float(loss), gx, gw
+
+
+@pytest.mark.parametrize("use_amp", [False, True])
+@pytest.mark.parametrize("use_layout", [False, True])
+@pytest.mark.parametrize("sg_w", [False, True])
+def test_grad_parity_matrix(use_amp, use_layout, sg_w):
+    shape = (2, 4, 4, 3) if use_layout else (4, 4)
+    x_np = np.random.randn(*shape)
+    w_np = np.random.randn(*shape)
+    core_op.set_dispatch_cache_enabled(False)
+    l0, gx0, gw0 = _chain_grads(x_np, w_np, sg_w, use_amp, use_layout)
+    core_op.set_dispatch_cache_enabled(True)
+    core_op.dispatch_cache_clear()
+    # twice: first populates (miss), second replays (hit) — both must match
+    for _ in range(2):
+        l1, gx1, gw1 = _chain_grads(x_np, w_np, sg_w, use_amp, use_layout)
+        assert np.allclose(l1, l0, rtol=1e-5, atol=1e-5)
+        assert np.allclose(gx1, gx0, rtol=1e-5, atol=1e-6)
+        if sg_w:
+            assert gw1 is None and gw0 is None
+        else:
+            assert np.allclose(gw1, gw0, rtol=1e-5, atol=1e-6)
+    assert _stats()["hits"] > 0
+
+
+def test_grad_parity_matmul_backward_bitwise():
+    x_np, w_np = np.random.randn(8, 8), np.random.randn(8, 8)
+
+    def grads():
+        x = _t(x_np, requires_grad=True)
+        w = _t(w_np, requires_grad=True)
+        loss = paddle.sum(paddle.matmul(x, w))
+        loss.backward()
+        return x.grad.numpy().copy(), w.grad.numpy().copy()
+
+    core_op.set_dispatch_cache_enabled(False)
+    gx0, gw0 = grads()
+    core_op.set_dispatch_cache_enabled(True)
+    core_op.dispatch_cache_clear()
+    grads()              # miss (compile)
+    gx1, gw1 = grads()   # hit (replay)
+    assert np.array_equal(gx0, gx1)
+    assert np.array_equal(gw0, gw1)
+
+
+def test_dropout_rng_key_is_dynamic_not_baked():
+    """dropout closes over a fresh RNG key per call; the cache must treat
+    the key as a DYNAMIC input (cell rewrite) — a baked constant would
+    silently repeat the mask on every hit."""
+    x = _t(np.random.randn(64, 64), requires_grad=True)
+    a = F.dropout(x, 0.5, training=True)
+    s0 = _stats()
+    b = F.dropout(x, 0.5, training=True)
+    s1 = _stats()
+    assert s1["hits"] - s0["hits"] == 1
+    assert not np.allclose(a.numpy(), b.numpy())
+
+
+def test_retain_graph_and_hooks_on_fast_path():
+    x = _t(np.random.randn(4, 4), requires_grad=True)
+    w = _t(np.random.randn(4, 4), requires_grad=False)
+    seen = []
+    x.register_hook(lambda g: seen.append(g.numpy().copy()))
+    loss = paddle.sum(paddle.multiply(x, w))
+    loss.backward(retain_graph=True)
+    loss.backward(retain_graph=True)
+    assert len(seen) == 2
+    assert np.allclose(seen[0], np.asarray(w.numpy()))
+
+
+# ---------------------------------------------------------------------------
+# hook ordering: profiler + FLAGS_check_nan_inf fire on the fast path
+# ---------------------------------------------------------------------------
+
+def test_profiler_fires_on_fast_path():
+    from paddle_tpu.utils import profiler
+    x = _t(np.random.randn(4, 4), requires_grad=True)
+    F.relu(x)  # populate the cache BEFORE profiling: hits must still report
+    profiler.start_profiler()
+    try:
+        F.relu(x)
+        F.relu(x)
+        records = dict(profiler._records)
+    finally:
+        profiler.stop_profiler(profile_path="/dev/null")
+    assert records["relu"][0] == 2
+
+
+def test_check_nan_inf_fires_on_fast_path():
+    core_op.set_check_nan_inf(True)
+    try:
+        x = _t([[1.0, 2.0]], requires_grad=True)
+        F.relu(x)  # cache the signature with the flag armed
+        bad = _t([[np.inf, 1.0]], requires_grad=True)
+        with pytest.raises(FloatingPointError):
+            F.relu(bad)  # hit path must still scan outputs
+        with pytest.raises(FloatingPointError):
+            F.relu(bad)
+    finally:
+        core_op.set_check_nan_inf(False)
+
+
+def test_check_nan_inf_on_miss_keeps_signature_cached():
+    """A FloatingPointError on the very FIRST call of a signature is a data
+    error after a successful trace — it must raise (not silently fall back)
+    and must NOT poison the signature: later finite calls stay fast."""
+    core_op.set_check_nan_inf(True)
+    try:
+        bad = _t([[np.inf, 1.0]], requires_grad=True)
+        with pytest.raises(FloatingPointError):
+            F.silu(bad)  # miss path: trace succeeds, data check raises
+        s0 = _stats()
+        assert s0["fallbacks"] == 0
+        good = _t([[1.0, 2.0]], requires_grad=True)
+        F.silu(good)  # same signature, finite data -> fast-path hit
+        s1 = _stats()
+        assert s1["hits"] - s0["hits"] == 1
+    finally:
+        core_op.set_check_nan_inf(False)
+
+
+# ---------------------------------------------------------------------------
+# LRU / clear / disable semantics
+# ---------------------------------------------------------------------------
+
+def test_lru_eviction():
+    core_op.set_dispatch_cache_size(3)
+    xs = [_t(np.random.randn(2, n + 2), requires_grad=True) for n in range(5)]
+    for x in xs:
+        F.relu(x)
+    s = _stats()
+    assert s["entries"] <= 3
+    assert s["evictions"] >= 2
+    # the oldest signature was evicted: dispatching it again is a miss
+    m0 = s["misses"]
+    F.relu(xs[0])
+    assert _stats()["misses"] == m0 + 1
+
+
+def test_cache_clear_resets_entries():
+    F.relu(_t(np.random.randn(3, 3), requires_grad=True))
+    assert _stats()["entries"] >= 1
+    core_op.dispatch_cache_clear()
+    assert _stats()["entries"] == 0
+
+
+def test_disable_bypasses_cache():
+    core_op.set_dispatch_cache_enabled(False)
+    s0 = _stats()
+    x = _t(np.random.randn(4, 4), requires_grad=True)
+    y = F.relu(x)
+    paddle.sum(y).backward()
+    s1 = _stats()
+    assert s1["hits"] == s0["hits"] and s1["misses"] == s0["misses"]
+    assert x.grad is not None
+
+
+def test_unkeyable_signature_falls_back():
+    """A raw_fn whose closure holds an un-freezable object must bypass the
+    cache and still produce correct eager results."""
+    from paddle_tpu.core.op import dispatch
+
+    class Opaque:
+        __hash__ = None  # unhashable -> unkeyable
+
+    cfg = Opaque()
+    cfg_scale = 3.0
+
+    def raw(x):
+        return x * (cfg_scale if cfg is not None else 1.0)
+
+    x = _t(np.random.randn(2, 2), requires_grad=True)
+    s0 = _stats()
+    out = dispatch("opaque_scale", raw, x)
+    s1 = _stats()
+    assert s1["bypass"] - s0["bypass"] == 1
+    assert np.allclose(out.numpy(), x.numpy() * cfg_scale)
