@@ -738,6 +738,27 @@ def measure_eager_dispatch():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_resilience():
+    """ISSUE-3 acceptance artifact: probes/resilience_probe.py in a clean
+    CPU subprocess.  Publishes the async-vs-sync checkpoint stall ratio
+    (async save must stall the step loop >= 2x less than a synchronous
+    save) and the chaos-parity verdict (NaN-injected + worker-killed +
+    SIGTERM-preempted run resumes to the same final loss as an
+    uninterrupted run)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes",
+                                      "resilience_probe.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESIL"):
+            return json.loads(line[len("RESIL"):])
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_mnist_eager():
     """BASELINE config #1: LeNet, EAGER per-op dispatch, single device —
     the CPU-baseline parity check (runs in a CPU subprocess; eager per-op
@@ -858,29 +879,59 @@ def _probe_backend(timeout=None):
     run: BENCH_r05 died rc=1 when the axon tunnel was unreachable and
     `jax.default_backend()` sat in the 300 s subprocess timeout, crashing
     main() with an uncaught TimeoutExpired.  Short, env-tunable timeout
-    (PDTPU_BACKEND_PROBE_TIMEOUT, default 60 s); a dead tunnel returns a
-    structured `backend_unavailable` record instead of a traceback."""
+    (PDTPU_BACKEND_PROBE_TIMEOUT, default 60 s) with the shared
+    utils.retry backoff policy (PDTPU_BACKEND_PROBE_RETRIES, default 2 —
+    a tunnel mid-rebind often answers on the second attempt); a dead
+    tunnel returns a structured `backend_unavailable` record instead of a
+    traceback."""
+    from paddle_tpu.utils import faults as _faults
+    from paddle_tpu.utils.retry import RetryPolicy, RetriesExhausted
     timeout = timeout if timeout is not None else float(
         os.environ.get("PDTPU_BACKEND_PROBE_TIMEOUT", "60"))
+    if _faults.backend_down():  # injected outage: fail fast, shaped
+        return {"backend": None, "backend_unavailable": True,
+                "error": "backend probe fault-injected down "
+                         "(PDTPU_FAULT_BACKEND_DOWN)"}
+
+    class _ProbeFailed(Exception):
+        def __init__(self, record):
+            super().__init__(record["error"])
+            self.record = record
+
+    def once():
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                capture_output=True, text=True, timeout=timeout,
+                cwd=os.path.dirname(os.path.abspath(__file__)))
+        except subprocess.TimeoutExpired:
+            raise _ProbeFailed(
+                {"backend": None, "backend_unavailable": True,
+                 "error": f"backend probe timed out after {int(timeout)}s "
+                          "(accelerator tunnel unreachable)"})
+        except OSError as e:
+            raise _ProbeFailed(
+                {"backend": None, "backend_unavailable": True,
+                 "error": f"backend probe failed: "
+                          f"{type(e).__name__}: {e}"})
+        if probe.returncode != 0:
+            raise _ProbeFailed(
+                {"backend": None, "backend_unavailable": True,
+                 "error": (probe.stderr or probe.stdout)[-300:]})
+        return {"backend": probe.stdout.strip().splitlines()[-1]
+                if probe.stdout.strip() else None,
+                "backend_unavailable": False}
+
+    retries = int(os.environ.get("PDTPU_BACKEND_PROBE_RETRIES", "2"))
+    policy = RetryPolicy(retries=retries, base_delay=1.0, max_delay=10.0,
+                         deadline=3.0 * timeout, retry_on=(_ProbeFailed,))
     try:
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.default_backend())"],
-            capture_output=True, text=True, timeout=timeout,
-            cwd=os.path.dirname(os.path.abspath(__file__)))
-    except subprocess.TimeoutExpired:
-        return {"backend": None, "backend_unavailable": True,
-                "error": f"backend probe timed out after {int(timeout)}s "
-                         "(accelerator tunnel unreachable)"}
-    except OSError as e:
-        return {"backend": None, "backend_unavailable": True,
-                "error": f"backend probe failed: {type(e).__name__}: {e}"}
-    if probe.returncode != 0:
-        return {"backend": None, "backend_unavailable": True,
-                "error": (probe.stderr or probe.stdout)[-300:]}
-    return {"backend": probe.stdout.strip().splitlines()[-1]
-            if probe.stdout.strip() else None,
-            "backend_unavailable": False}
+        return policy.call(once)
+    except RetriesExhausted as e:
+        rec = dict(e.last.record)
+        rec["retry_attempts"] = e.attempts
+        return rec
 
 
 def main():
@@ -945,6 +996,7 @@ def main():
                          ("ernie_large", lambda: measure_ernie(on_tpu)),
                          ("mnist_eager", measure_mnist_eager),
                          ("eager_dispatch", measure_eager_dispatch),
+                         ("resilience", measure_resilience),
                          ("pipeline", measure_pipeline_ratio)):
             try:
                 detail[name] = fn()

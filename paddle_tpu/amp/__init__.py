@@ -261,6 +261,16 @@ class GradScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
 
+    def record_skip(self):
+        """Feed an externally-detected bad step (GuardedTrainStep's
+        on-device nonfinite verdict) into the dynamic loss-scale state
+        machine: counts as a found_inf step, decaying the scale per
+        decr_every_n_nan_or_inf — the decay half of skip-and-decay without
+        a per-grad host isfinite pass."""
+        from ..utils.monitor import stat_add
+        stat_add("STAT_amp_skipped_steps")
+        self._update(True)
+
     def is_enable(self):
         return self._enable
 
@@ -281,3 +291,6 @@ class GradScaler:
         self._scale = sd.get("scale", self._scale)
         self._good_steps = sd.get("good_steps", 0)
         self._bad_steps = sd.get("bad_steps", 0)
+
+    # checkpoint extras use the paddle spelling pair
+    set_state_dict = load_state_dict

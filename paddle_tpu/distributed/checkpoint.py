@@ -26,9 +26,11 @@ from __future__ import annotations
 
 import json
 import os
+import queue
 import re
 import shutil
 import tempfile
+import threading
 import time
 from typing import Dict, Optional
 
@@ -93,19 +95,17 @@ def _unflatten(flat: Dict[str, object]):
 
 # -- save -------------------------------------------------------------------
 
-def save_sharded(state_tree, directory: str, step: int = 0,
-                 extra_meta: Optional[dict] = None) -> str:
-    """Write a sharded checkpoint of a pytree of jax.Arrays (nested dicts).
+def snapshot_tree(state_tree, step: int = 0,
+                  extra_meta: Optional[dict] = None):
+    """Phase 1 of a save: copy this process's owned device shards to HOST
+    memory and build the manifest.  Returns (manifest, shards).
 
-    No host gather: each process saves only shards with replica_id == 0 among
-    its addressable shards.  Returns the final step directory path.
+    The copies are real (np.array, copy=True), never views: the async
+    checkpoint path hands the snapshot to a background writer while the
+    train step DONATES and overwrites the source buffers — a zero-copy view
+    would let the writer read the next step's params (or garbage).
     """
     flat = _flatten(state_tree)
-    pidx = jax.process_index()
-    step_dir = os.path.join(directory, f"step-{step:09d}")
-    tmp_dir = step_dir + f".tmp-p{pidx:05d}"
-    os.makedirs(tmp_dir, exist_ok=True)
-
     manifest = {"step": int(step), "arrays": {}, "extra": extra_meta or {},
                 "n_processes": jax.process_count()}
     shards = {}
@@ -121,40 +121,108 @@ def save_sharded(state_tree, directory: str, step: int = 0,
         for shard in getattr(arr, "addressable_shards", []):
             if shard.replica_id != 0:
                 continue
-            shards[_index_key(name, shard.index)] = np.asarray(shard.data)
+            shards[_index_key(name, shard.index)] = np.array(shard.data,
+                                                             copy=True)
+    return manifest, shards
 
-    npz_name = f"shards-p{pidx:05d}.npz"
-    np.savez(os.path.join(tmp_dir, npz_name), **shards)
 
+def _fsync_dir(path: str):
+    """fsync a directory so the rename that published a checkpoint is
+    durable before `latest` points at it (a power cut after rename but
+    before the metadata hits disk must not leave `latest` dangling —
+    though even then latest_step_dir falls back to the newest valid dir)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def publish_snapshot(directory: str, manifest: dict, shards: dict) -> str:
+    """Phase 2 of a single-process save: write npz + manifest into a temp
+    dir, atomically rename into place, fsync the parent dir, update
+    `latest`.  Runs on the caller thread (sync save) or the
+    AsyncCheckpointManager's writer thread."""
+    from ..utils import faults as _faults
+    step = manifest["step"]
+    step_dir = os.path.join(directory, f"step-{step:09d}")
+    tmp_dir = step_dir + f".tmp-p{jax.process_index():05d}"
+    os.makedirs(tmp_dir, exist_ok=True)
+    # fsync file CONTENTS before the publishing rename: a rename can be
+    # durable while the data pages are not, and a post-crash step dir with
+    # a valid manifest but truncated shards would win the latest-fallback
+    # scan over the genuinely complete previous checkpoint
+    with open(os.path.join(tmp_dir,
+                           f"shards-p{jax.process_index():05d}.npz"),
+              "wb") as f:
+        np.savez(f, **shards)
+        f.flush()
+        os.fsync(f.fileno())
+    with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    # fault point: a kill HERE (files written, not yet renamed) must leave
+    # the previous checkpoint fully restorable
+    _faults.maybe_kill_mid_save()
+    if os.path.exists(step_dir):
+        shutil.rmtree(step_dir)
+    os.rename(tmp_dir, step_dir)
+    _fsync_dir(directory)
+    _write_atomic(os.path.join(directory, "latest"),
+                  os.path.basename(step_dir))
+    return step_dir
+
+
+def save_sharded(state_tree, directory: str, step: int = 0,
+                 extra_meta: Optional[dict] = None) -> str:
+    """Write a sharded checkpoint of a pytree of jax.Arrays (nested dicts).
+
+    No host gather: each process saves only shards with replica_id == 0 among
+    its addressable shards.  Returns the final step directory path.
+    """
+    manifest, shards = snapshot_tree(state_tree, step, extra_meta)
+    pidx = jax.process_index()
     if jax.process_count() == 1:
-        # atomic publish: manifest lands inside the tmp dir, one rename
-        with open(os.path.join(tmp_dir, "manifest.json"), "w") as f:
-            json.dump(manifest, f)
-        if os.path.exists(step_dir):
-            shutil.rmtree(step_dir)
-        os.rename(tmp_dir, step_dir)
-    else:
-        # multi-host on a shared fs: every process lands its npz, then a
-        # global barrier, THEN process 0 publishes manifest + latest — a
-        # reader never sees a manifest without all its shards
-        os.makedirs(step_dir, exist_ok=True)
-        os.replace(os.path.join(tmp_dir, npz_name),
-                   os.path.join(step_dir, npz_name))
-        shutil.rmtree(tmp_dir, ignore_errors=True)
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(f"paddle_tpu-ckpt-{step}")
-        if pidx == 0:
-            # scrub stale shards from an earlier save with more processes
-            # BEFORE publishing the manifest, so readers without the
-            # n_processes filter can't overlay them
-            n = jax.process_count()
-            for f in os.listdir(step_dir):
-                if (f.startswith("shards-p") and f.endswith(".npz")
-                        and int(f[len("shards-p"):-len(".npz")]) >= n):
-                    os.unlink(os.path.join(step_dir, f))
-            _write_atomic(os.path.join(step_dir, "manifest.json"),
-                          json.dumps(manifest))
+        return publish_snapshot(directory, manifest, shards)
+
+    step_dir = os.path.join(directory, f"step-{step:09d}")
+    tmp_dir = step_dir + f".tmp-p{pidx:05d}"
+    os.makedirs(tmp_dir, exist_ok=True)
+    npz_name = f"shards-p{pidx:05d}.npz"
+    # same durability rule as publish_snapshot: shard CONTENTS are synced
+    # before anything publishes them, so a post-crash dir with a valid
+    # manifest can't hold truncated shards
+    with open(os.path.join(tmp_dir, npz_name), "wb") as f:
+        np.savez(f, **shards)
+        f.flush()
+        os.fsync(f.fileno())
+    # multi-host on a shared fs: every process lands its npz, then a
+    # global barrier, THEN process 0 publishes manifest + latest — a
+    # reader never sees a manifest without all its shards
+    os.makedirs(step_dir, exist_ok=True)
+    os.replace(os.path.join(tmp_dir, npz_name),
+               os.path.join(step_dir, npz_name))
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(f"paddle_tpu-ckpt-{step}")
     if pidx == 0:
+        # scrub stale shards from an earlier save with more processes
+        # BEFORE publishing the manifest, so readers without the
+        # n_processes filter can't overlay them
+        n = jax.process_count()
+        for f in os.listdir(step_dir):
+            if (f.startswith("shards-p") and f.endswith(".npz")
+                    and int(f[len("shards-p"):-len(".npz")]) >= n):
+                os.unlink(os.path.join(step_dir, f))
+        _write_atomic(os.path.join(step_dir, "manifest.json"),
+                      json.dumps(manifest))
+        _fsync_dir(step_dir)
         _write_atomic(os.path.join(directory, "latest"),
                       os.path.basename(step_dir))
     return step_dir
@@ -164,6 +232,8 @@ def _write_atomic(path: str, content: str):
     fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".")
     with os.fdopen(fd, "w") as f:
         f.write(content)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 
@@ -225,14 +295,47 @@ class _ShardStore:
         return full
 
 
+def _has_valid_manifest(step_dir: str) -> bool:
+    try:
+        with open(os.path.join(step_dir, "manifest.json")) as f:
+            json.load(f)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
 def latest_step_dir(directory: str) -> Optional[str]:
+    """Resolve the newest restorable checkpoint.
+
+    The `latest` pointer is a hint, not the ground truth: it can be missing
+    (crash before the first pointer write), name a step dir that retention
+    GC deleted on another process, or name a dir whose manifest never
+    landed (kill mid-publish on a non-atomic fs).  Any of those falls back
+    to the newest step-* dir that actually has a loadable manifest — the
+    atomicity contract says such a dir is complete.
+    """
     ptr = os.path.join(directory, "latest")
-    if not os.path.exists(ptr):
+    try:
+        with open(ptr) as f:
+            name = f.read().strip()
+    except OSError:
+        name = None
+    if name:
+        step_dir = os.path.join(directory, name)
+        if os.path.isdir(step_dir) and _has_valid_manifest(step_dir):
+            return step_dir
+    # fallback scan, newest first
+    try:
+        entries = os.listdir(directory)
+    except OSError:
         return None
-    with open(ptr) as f:
-        name = f.read().strip()
-    step_dir = os.path.join(directory, name)
-    return step_dir if os.path.isdir(step_dir) else None
+    steps = sorted((int(m.group(1)), d) for d in entries
+                   if (m := _STEP_DIR_RE.match(d)))
+    for _, d in reversed(steps):
+        step_dir = os.path.join(directory, d)
+        if os.path.isdir(step_dir) and _has_valid_manifest(step_dir):
+            return step_dir
+    return None
 
 
 def restore_sharded(directory: str, mesh: Optional[Mesh] = None,
@@ -287,29 +390,47 @@ def _axes_exist(entry, mesh: Mesh) -> bool:
 _STEP_DIR_RE = re.compile(r"^step-(\d+)$")
 
 
-def save_train_state(directory: str, params, opt_state, step: int,
-                     extra_meta: Optional[dict] = None,
-                     optimizer=None) -> str:
-    """Snapshot params + optimizer state + the host rng stream + the LR
-    scheduler state, so a resumed run reproduces the uninterrupted one even
-    with dropout and a warmup/decay schedule active."""
+def train_state_extras(optimizer=None, extra_meta: Optional[dict] = None,
+                       scaler=None, data_cursor: Optional[dict] = None) -> dict:
+    """Collect the non-array training state for a checkpoint's extra dict:
+    host rng stream, LR scheduler, GradScaler loss-scaling state, and the
+    data-iterator cursor.  Shared by the sync and async save paths."""
     from ..core import rng as _rng
-    from ..utils.monitor import stat_add
-    stat_add("STAT_checkpoint_saves")
     extra = dict(extra_meta or {})
     extra["__rng__"] = np.asarray(_rng.get_rng_state()).tolist()
     sched = getattr(optimizer, "_lr_scheduler", None)
     if sched is not None:
         extra["__lr_sched__"] = sched.state_dict()
+    if scaler is not None:
+        extra["__scaler__"] = scaler.state_dict()
+    if data_cursor is not None:
+        extra["__data_cursor__"] = dict(data_cursor)
+    return extra
+
+
+def save_train_state(directory: str, params, opt_state, step: int,
+                     extra_meta: Optional[dict] = None,
+                     optimizer=None, scaler=None,
+                     data_cursor: Optional[dict] = None) -> str:
+    """Snapshot params + optimizer state + the host rng stream + the LR
+    scheduler state (+ GradScaler loss-scaling state and the data-iterator
+    cursor when given), so a resumed run reproduces the uninterrupted one
+    even with dropout, a warmup/decay schedule, and dynamic loss scaling
+    active."""
+    from ..utils.monitor import stat_add
+    stat_add("STAT_checkpoint_saves")
+    extra = train_state_extras(optimizer, extra_meta, scaler, data_cursor)
     return save_sharded({"params": params, "opt": opt_state}, directory,
                         step, extra)
 
 
-def restore_train_extras(optimizer, step: int, extra: dict) -> dict:
+def restore_train_extras(optimizer, step: int, extra: dict,
+                         scaler=None) -> dict:
     """Apply the non-array training state (step count, rng stream, LR
-    scheduler) from a checkpoint's extra dict.  Shared by every train-step
-    restore path.  Mutates `extra` (pops the internal keys); returns the
-    user-facing meta dict."""
+    scheduler, GradScaler) from a checkpoint's extra dict.  Shared by every
+    train-step restore path.  Mutates `extra` (pops the internal keys);
+    returns the user-facing meta dict.  A saved data cursor surfaces as
+    meta["data_cursor"] for the caller's loader to fast-forward."""
     from ..core import rng as _rng
     optimizer._step_count = step
     rng_state = extra.pop("__rng__", None)
@@ -320,17 +441,23 @@ def restore_train_extras(optimizer, step: int, extra: dict) -> dict:
         sched = getattr(optimizer, "_lr_scheduler", None)
         if sched is not None:
             sched.set_state_dict(sched_state)
+    scaler_state = extra.pop("__scaler__", None)
+    if scaler_state is not None and scaler is not None:
+        scaler.load_state_dict(scaler_state)
+    cursor = extra.pop("__data_cursor__", None)
+    if cursor is not None:
+        extra["data_cursor"] = cursor
     return {"step": step, **extra}
 
 
-def apply_train_state(model, optimizer, restored):
+def apply_train_state(model, optimizer, restored, scaler=None):
     """Write a restore_sharded result back into model/optimizer/rng/scheduler.
     Returns (meta_dict, opt_state_tree)."""
     tree, step, extra = restored
     sd = model.state_dict()
     for k, v in tree["params"].items():
         sd[k]._set_data(v)
-    meta = restore_train_extras(optimizer, step, extra)
+    meta = restore_train_extras(optimizer, step, extra, scaler=scaler)
     # stateless optimizers (SGD) save empty per-param dicts, which the
     # flatten/unflatten roundtrip drops — callers merge over a fresh
     # init_opt_state structure via merge_opt_state
@@ -355,11 +482,15 @@ class CheckpointManager:
 
     def __init__(self, directory: str, max_to_keep: int = 2,
                  save_interval_steps: int = 100,
-                 save_interval_seconds: Optional[float] = None):
+                 save_interval_seconds: Optional[float] = None,
+                 keep_every_k_steps: Optional[int] = None):
         self.directory = directory
         self.max_to_keep = max_to_keep
         self.save_interval_steps = save_interval_steps
         self.save_interval_seconds = save_interval_seconds
+        # retention milestones: steps divisible by K survive the
+        # keep-last-N pruning forever (long-run archaeology checkpoints)
+        self.keep_every_k_steps = keep_every_k_steps
         self._last_saved_step = None
         self._last_saved_time = time.monotonic()
         os.makedirs(directory, exist_ok=True)
@@ -406,9 +537,180 @@ class CheckpointManager:
         if jax.process_index() != 0:
             return
         steps = self.all_steps()
+        k = self.keep_every_k_steps
         for s in steps[:-self.max_to_keep]:
+            if k and s % k == 0:
+                continue  # milestone checkpoint: kept forever
             shutil.rmtree(os.path.join(self.directory,
                                        f"step-{s:09d}"), ignore_errors=True)
+
+
+class AsyncCheckpointManager(CheckpointManager):
+    """Checkpointing off the training thread.
+
+    `save` runs only the device->host snapshot (a memcpy of this process's
+    owned shards) on the caller, then hands the snapshot to a background
+    writer thread that does the npz serialization, atomic rename, dir
+    fsync, `latest` update, and retention GC.  The step loop's stall per
+    save drops from "full serialize+write" to "snapshot + enqueue"
+    (probes/resilience_probe.py measures the ratio).
+
+    - The in-flight queue is BOUNDED (`max_in_flight`, default 1): a writer
+      that can't keep up applies backpressure instead of buffering an
+      unbounded number of full model copies in host RAM.
+    - `wait_until_finished()` blocks until every accepted save is durable
+      (call before reading metrics that must include the save, and at exit).
+    - A watchdog flags a write stuck longer than `watchdog_seconds`
+      (wedged NFS mount, dead disk): the next save/wait raises
+      ExecutionTimeoutError on the training thread instead of silently
+      wedging the run with stale checkpoints.
+    - Writer-thread exceptions are re-raised on the next save/wait — a save
+      that failed on the background thread must not be silently dropped.
+
+    Multi-process saves fall back to the synchronous path: the global
+    publish barrier (sync_global_devices) must run where every process
+    participates, not on a per-host writer thread.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 2,
+                 save_interval_steps: int = 100,
+                 save_interval_seconds: Optional[float] = None,
+                 keep_every_k_steps: Optional[int] = None,
+                 max_in_flight: int = 1,
+                 watchdog_seconds: float = 600.0):
+        super().__init__(directory, max_to_keep, save_interval_steps,
+                         save_interval_seconds, keep_every_k_steps)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, max_in_flight))
+        self._watchdog_seconds = watchdog_seconds
+        self._cv = threading.Condition()
+        self._outstanding = 0
+        self._write_started: Optional[float] = None
+        self._errors: list = []
+        self._closed = False
+        self._writer = threading.Thread(target=self._writer_loop,
+                                        name="paddle_tpu-ckpt-writer",
+                                        daemon=True)
+        self._writer.start()
+
+    # -- background writer ---------------------------------------------------
+    def _writer_loop(self):
+        from ..utils.monitor import stat_add
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            manifest, shards = item
+            with self._cv:
+                self._write_started = time.monotonic()
+            try:
+                publish_snapshot(self.directory, manifest, shards)
+                self._prune()
+                stat_add("STAT_checkpoint_async_writes")
+            except BaseException as e:  # surfaced on the training thread
+                with self._cv:
+                    self._errors.append(e)
+            finally:
+                with self._cv:
+                    self._write_started = None
+                    self._outstanding -= 1
+                    self._cv.notify_all()
+
+    def _raise_pending(self):
+        with self._cv:
+            if self._errors:
+                e = self._errors.pop(0)
+                raise RuntimeError(
+                    "async checkpoint write failed on the background "
+                    f"writer: {type(e).__name__}: {e}") from e
+            started = self._write_started
+        if (started is not None and self._watchdog_seconds is not None
+                and time.monotonic() - started > self._watchdog_seconds):
+            from ..core.errors import ExecutionTimeoutError
+            raise ExecutionTimeoutError(
+                f"[ExecutionTimeout] async checkpoint write has been "
+                f"running for over {self._watchdog_seconds:.0f}s (wedged "
+                "filesystem?) — checkpoints are no longer landing")
+
+    # -- API -----------------------------------------------------------------
+    def save(self, state_tree, step: int, extra_meta: Optional[dict] = None):
+        """Snapshot on the caller thread, write in the background.  Blocks
+        only when `max_in_flight` earlier saves are still being written
+        (backpressure), or re-raises a pending background failure."""
+        if jax.process_count() > 1:
+            return super().save(state_tree, step, extra_meta)
+        self._raise_pending()
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointManager is closed")
+        from ..utils.monitor import stat_add
+        stat_add("STAT_checkpoint_saves")
+        manifest, shards = snapshot_tree(state_tree, step, extra_meta)
+        with self._cv:
+            self._outstanding += 1
+        while True:
+            try:
+                # bounded put, re-checking the watchdog while blocked: a
+                # wedged writer must surface as ExecutionTimeoutError on
+                # the training thread, not as an eternal queue.put
+                self._queue.put((manifest, shards), timeout=0.5)
+                break
+            except queue.Full:
+                try:
+                    self._raise_pending()
+                except BaseException:
+                    with self._cv:
+                        self._outstanding -= 1
+                        self._cv.notify_all()
+                    raise
+        self._last_saved_step = step
+        self._last_saved_time = time.monotonic()
+        return os.path.join(self.directory, f"step-{step:09d}")
+
+    def save_train_state(self, params, opt_state, step: int,
+                         extra_meta: Optional[dict] = None, optimizer=None,
+                         scaler=None, data_cursor: Optional[dict] = None):
+        """Async analogue of module-level save_train_state (rng / scheduler /
+        scaler / cursor extras included)."""
+        extra = train_state_extras(optimizer, extra_meta, scaler, data_cursor)
+        return self.save({"params": params, "opt": opt_state}, step, extra)
+
+    def wait_until_finished(self, timeout: Optional[float] = None) -> bool:
+        """Block until every accepted save is durably published.  Returns
+        False on timeout; re-raises background write errors and fires the
+        watchdog for a wedged write."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._outstanding > 0:
+                wait = 0.5
+                if deadline is not None:
+                    wait = min(wait, deadline - time.monotonic())
+                    if wait <= 0:
+                        return False
+                self._cv.wait(wait)
+                self._raise_pending()  # Condition lock is an RLock
+        self._raise_pending()
+        return True
+
+    def restore_latest(self, mesh=None, shardings=None):
+        self.wait_until_finished()
+        return super().restore_latest(mesh=mesh, shardings=shardings)
+
+    def close(self, timeout: Optional[float] = None):
+        """Flush pending writes and stop the writer thread.  Bounded even
+        when the writer is wedged: the manager closes (further saves
+        rejected), the sentinel is delivered best-effort, and the daemon
+        writer thread is left to die with the process rather than hanging
+        shutdown on a full queue."""
+        if self._closed:
+            return
+        self._closed = True  # reject further saves even if the flush fails
+        try:
+            self.wait_until_finished(timeout)
+        finally:
+            try:
+                self._queue.put_nowait(None)
+            except queue.Full:
+                pass  # wedged writer will never consume it; thread is daemon
+            self._writer.join(timeout=5.0)
 
 
 def train_epoch_range(n_epochs: int, manager: CheckpointManager):

@@ -6,7 +6,8 @@ pre-existing incubate.checkpoint.save_sharded/... calls keep working);
 auto_checkpoint mirrors the reference acp module's env-driven entry."""
 from ...distributed import checkpoint as _dck
 from . import auto_checkpoint  # noqa: F401
-from .auto_checkpoint import train_epoch_range  # noqa: F401
+from .auto_checkpoint import (PreemptionHandler,  # noqa: F401
+                              train_epoch_range)
 
 
 def __getattr__(name):
@@ -14,4 +15,5 @@ def __getattr__(name):
 
 
 def __dir__():
-    return sorted(set(dir(_dck)) | {"auto_checkpoint", "train_epoch_range"})
+    return sorted(set(dir(_dck)) | {"auto_checkpoint", "train_epoch_range",
+                                    "PreemptionHandler"})

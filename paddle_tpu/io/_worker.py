@@ -108,8 +108,38 @@ class WorkerInfo:
         self.dataset = dataset
 
 
+def _maybe_crash(seq, raw):
+    """Fault injection (mirror of utils.faults.maybe_crash_worker, parsed
+    inline so worker processes never import the framework): `raw` is the
+    PDTPU_FAULT_WORKER_CRASH config string, read by the PARENT at worker
+    spawn time and passed as a worker arg — a forkserver's cached
+    environment must not decide whether a fault is armed.
+    "kill:S[:/path/once]" hard-exits this worker when it picks up batch seq
+    S (mode "exc" raises instead); the optional `once` sentinel file limits
+    the fault to a single firing so the respawned worker survives the
+    retried batch."""
+    import os
+    if not raw:
+        return
+    parts = raw.split(":", 2)
+    if parts[0] in ("kill", "exc"):
+        mode, target = parts[0], int(parts[1])
+        once = parts[2] if len(parts) == 3 else None
+    else:
+        mode, target, once = "kill", int(parts[0]), None
+    if seq != target:
+        return
+    if once is not None:
+        if os.path.exists(once):
+            return
+        open(once, "w").close()
+    if mode == "exc":
+        raise RuntimeError(f"injected worker exception at seq {seq}")
+    os._exit(17)  # hard crash: no result, no cleanup — the real thing
+
+
 def worker_loop(dataset, collate_fn, task_q, result_q, worker_id,
-                use_shm, worker_init_fn, num_workers=0):
+                use_shm, worker_init_fn, num_workers=0, crash_cfg=None):
     global _worker_info
     _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     if worker_init_fn is not None:
@@ -120,6 +150,7 @@ def worker_loop(dataset, collate_fn, task_q, result_q, worker_id,
             break
         epoch, seq, indices = item
         try:
+            _maybe_crash(seq, crash_cfg)
             batch = encode(fetch(dataset, indices, collate_fn), use_shm)
             result_q.put((epoch, seq, batch, None))
         except Exception as e:  # surface worker errors to the parent

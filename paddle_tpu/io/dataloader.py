@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import itertools
 import multiprocessing as mp
+import os
 import pickle
 import queue
 import threading
@@ -49,17 +50,31 @@ def _default_mp_context() -> str:
     return "fork"
 
 
+class _WorkerDied(Exception):
+    """Internal: a worker process exited with tasks in flight (respawnable)."""
+
+
 class _WorkerPool:
-    """Worker processes with bounded in-flight tasks + reordering."""
+    """Worker processes with bounded in-flight tasks + reordering.
+
+    Dead workers (OOM-killed, segfaulted, fault-injected) are respawned —
+    with backoff via utils.retry — and their lost tasks resubmitted, up to
+    `max_respawns` per epoch (PDTPU_WORKER_RESPAWNS, default 2); only after
+    that budget does the epoch fail with UnavailableError.
+    """
 
     def __init__(self, dataset, collate_fn, num_workers, use_shm,
-                 worker_init_fn, timeout, mp_context=None):
+                 worker_init_fn, timeout, mp_context=None,
+                 max_respawns=None):
         if mp_context is None or isinstance(mp_context, str):
             method = mp_context or _default_mp_context()
         else:
             method = mp_context.get_start_method()
         self._timeout = timeout if timeout and timeout > 0 else None
         self._epoch = 0
+        if max_respawns is None:
+            max_respawns = int(os.environ.get("PDTPU_WORKER_RESPAWNS", "2"))
+        self._max_respawns = max_respawns
         try:
             self._start(mp.get_context(method), dataset, collate_fn,
                         num_workers, use_shm, worker_init_fn)
@@ -79,15 +94,12 @@ class _WorkerPool:
 
     def _start(self, ctx, dataset, collate_fn, num_workers, use_shm,
                worker_init_fn):
+        self._ctx = ctx
+        self._worker_args = (dataset, collate_fn, use_shm, worker_init_fn,
+                             num_workers)
         self._task_q = ctx.Queue()
         self._result_q = ctx.Queue()
-        self._procs = [
-            ctx.Process(target=_worker_loop,
-                        args=(dataset, collate_fn, self._task_q,
-                              self._result_q, wid, use_shm, worker_init_fn,
-                              num_workers),
-                        daemon=True)
-            for wid in range(num_workers)]
+        self._procs = [self._spawn_worker(wid) for wid in range(num_workers)]
         try:
             for p in self._procs:
                 p.start()
@@ -97,6 +109,42 @@ class _WorkerPool:
                     p.terminate()
             self._procs = []
             raise
+
+    def _spawn_worker(self, wid):
+        dataset, collate_fn, use_shm, worker_init_fn, nw = self._worker_args
+        # fault config is read HERE (parent, spawn time) and passed as an
+        # arg: a forkserver's cached environment must not decide whether
+        # the injection is armed — and a respawned worker picks up the
+        # config current at respawn time (disarmed once the test clears it)
+        from ..utils import faults as _faults
+        return self._ctx.Process(
+            target=_worker_loop,
+            args=(dataset, collate_fn, self._task_q, self._result_q, wid,
+                  use_shm, worker_init_fn, nw, _faults.get("worker_crash")),
+            daemon=True)
+
+    def respawn_dead(self):
+        """Replace every dead worker process; returns how many were
+        replaced.  Transient spawn failures (fd/pid exhaustion under load)
+        back off and retry via the shared RetryPolicy."""
+        from ..utils.monitor import stat_add
+        from ..utils.retry import RetryPolicy
+        replaced = 0
+        policy = RetryPolicy(retries=2, base_delay=0.1, max_delay=1.0,
+                             retry_on=(OSError, RuntimeError))
+        for i, p in enumerate(self._procs):
+            if p.is_alive():
+                continue
+
+            def start_one(wid=i):
+                q = self._spawn_worker(wid)
+                q.start()
+                return q
+            self._procs[i] = policy.call(start_one)
+            replaced += 1
+        if replaced:
+            stat_add("STAT_dataloader_worker_respawns", replaced)
+        return replaced
 
     def _get_result(self):
         """Blocking result fetch that detects dead workers and honors the
@@ -108,11 +156,7 @@ class _WorkerPool:
                 return self._result_q.get(timeout=1.0)
             except queue.Empty:
                 if not self.alive():
-                    from ..core.errors import UnavailableError
-                    raise UnavailableError(
-                        "[Unavailable] DataLoader worker process died "
-                        "unexpectedly (killed or crashed) with a task in "
-                        "flight")
+                    raise _WorkerDied()
                 waited += 1.0
                 if self._timeout is not None and waited >= self._timeout:
                     from ..core.errors import ExecutionTimeoutError
@@ -126,37 +170,59 @@ class _WorkerPool:
         Every task/result carries an epoch id: stale in-flight results from
         an abandoned or failed earlier run (persistent workers) are decoded
         and dropped — decoding frees their shared-memory blocks and keeps
-        sequence numbers from colliding across epochs."""
+        sequence numbers from colliding across epochs.  A worker death
+        respawns the dead workers and resubmits every submitted-but-
+        undelivered task; duplicate deliveries (a surviving worker also had
+        the task) are decoded and dropped."""
         self._epoch += 1
         epoch = self._epoch
         it = enumerate(index_batches)
         pending = {}
+        outstanding = {}  # seq -> indices, submitted but not yet received
         next_seq = 0
-        in_flight = 0
         exhausted = False
+        respawns_left = self._max_respawns
         try:
             while True:
-                while not exhausted and in_flight < max_in_flight:
+                while not exhausted and len(outstanding) < max_in_flight:
                     try:
                         seq, idx = next(it)
                     except StopIteration:
                         exhausted = True
                         break
-                    self._task_q.put((epoch, seq, list(idx)))
-                    in_flight += 1
-                if in_flight == 0:
+                    idx = list(idx)
+                    self._task_q.put((epoch, seq, idx))
+                    outstanding[seq] = idx
+                if not outstanding and next_seq not in pending:
                     return
                 while next_seq not in pending:
-                    ep, seq, batch, err = self._get_result()
-                    if ep != epoch:
+                    try:
+                        ep, seq, batch, err = self._get_result()
+                    except _WorkerDied:
+                        if respawns_left <= 0:
+                            from ..core.errors import UnavailableError
+                            raise UnavailableError(
+                                "[Unavailable] DataLoader worker process "
+                                "died unexpectedly (killed or crashed) "
+                                f"with a task in flight; respawn budget "
+                                f"({self._max_respawns}) exhausted")
+                        respawns_left -= 1
+                        self.respawn_dead()
+                        # the dead worker's tasks are lost — resubmit every
+                        # undelivered one (dupes from surviving workers are
+                        # dropped below)
+                        for seq2, idx2 in sorted(outstanding.items()):
+                            self._task_q.put((epoch, seq2, idx2))
+                        continue
+                    if ep != epoch or seq < next_seq or seq in pending:
                         if batch is not None:
-                            _decode(batch)  # free stale shm, discard
+                            _decode(batch)  # free stale/duplicate shm
                         continue
                     if err is not None:
                         raise RuntimeError(
                             f"DataLoader worker failed: {err}")
                     pending[seq] = batch
-                in_flight -= 1
+                    outstanding.pop(seq, None)
                 yield _decode(pending.pop(next_seq))
                 next_seq += 1
         finally:
@@ -262,24 +328,47 @@ class DataLoader:
         self._pool: Optional[_WorkerPool] = None
         self._pool_busy = False
         self._pool_lock = threading.Lock()
+        # owned (non-persistent) pools of live iterations, so an abandoned
+        # iterator whose producer thread is wedged can still be torn down
+        # from close()/__del__ instead of leaking worker processes
+        self._owned_pools: set = set()
 
     def __len__(self):
         if self._iterable_mode:
             raise TypeError("IterableDataset has no length")
         return len(self.batch_sampler)
 
+    def close(self):
+        """Shut down the persistent pool and any owned pools left behind by
+        abandoned iterators.  Idempotent; also runs from __del__."""
+        with self._pool_lock:
+            owned = list(self._owned_pools)
+            self._owned_pools.clear()
+            pool, self._pool = self._pool, None
+            self._pool_busy = False
+        for p in owned + ([pool] if pool is not None else []):
+            try:
+                p.shutdown()
+            except Exception:
+                pass
+
     def __del__(self):
         try:
-            if self._pool is not None:
-                self._pool.shutdown()
+            self.close()
         except Exception:
             pass
 
     def _new_pool(self):
-        return _WorkerPool(self.dataset, self.collate_fn, self.num_workers,
-                           self.use_shared_memory, self.worker_init_fn,
-                           self.timeout,
-                           mp_context=self.multiprocessing_context)
+        # transient spawn failures (fd/pid exhaustion on a loaded host)
+        # back off and retry; real config errors (unpicklable dataset under
+        # an explicit spawn context) surface immediately
+        from ..utils.retry import retry_call
+        return retry_call(
+            _WorkerPool, self.dataset, self.collate_fn, self.num_workers,
+            self.use_shared_memory, self.worker_init_fn, self.timeout,
+            mp_context=self.multiprocessing_context,
+            retries=2, base_delay=0.2, max_delay=2.0,
+            retry_on=(OSError,))
 
     def _acquire_pool(self):
         """Returns (pool, owned): owned pools are shut down by the caller.
@@ -303,7 +392,7 @@ class DataLoader:
             self._pool_busy = True
             return self._pool, False
 
-    def _batches_numpy(self):
+    def _batches_numpy(self, pool_box=None):
         if self._iterable_mode:
             # workers for iterable datasets would need stream sharding;
             # single-process here (the common map-style path is parallel)
@@ -317,11 +406,18 @@ class DataLoader:
                 yield self.collate_fn(chunk)
         elif self.num_workers > 0:
             pool, owned = self._acquire_pool()
+            if owned:
+                with self._pool_lock:
+                    self._owned_pools.add(pool)
+                if pool_box is not None:
+                    pool_box.append(pool)
             max_in_flight = self.num_workers * self.prefetch_factor
             try:
                 yield from pool.run(self.batch_sampler, max_in_flight)
             finally:
                 if owned:
+                    with self._pool_lock:
+                        self._owned_pools.discard(pool)
                     pool.shutdown()
                 else:
                     with self._pool_lock:
@@ -362,8 +458,10 @@ class DataLoader:
                 except queue.Full:
                     continue
 
+        pool_box: list = []
+
         def producer():
-            gen = self._batches_numpy()
+            gen = self._batches_numpy(pool_box)
             try:
                 for b in gen:
                     put_bounded(to_device(b))  # device_put is async
@@ -392,4 +490,76 @@ class DataLoader:
                     q.get_nowait()
                 except queue.Empty:
                     break
-            t.join(timeout=10)
+            t.join(timeout=5)
+            if t.is_alive():
+                # producer wedged (worker fetch stuck past the join budget):
+                # don't leak THIS iteration's worker pool until process exit
+                # — tear it down from here.  The producer's own cleanup then
+                # finds dead queues and exits; its exception is swallowed by
+                # put_bounded's stop check.
+                from ..utils.monitor import stat_add
+                stat_add("STAT_dataloader_forced_pool_teardowns")
+                for p in pool_box:
+                    with self._pool_lock:
+                        self._owned_pools.discard(p)
+                    try:
+                        p.shutdown()
+                    except Exception:
+                        pass
+
+
+class ResumableLoader:
+    """Iteration cursor over a DataLoader (or any iterable of batches).
+
+    The missing piece of crash-consistent resume: params/optimizer/rng ride
+    in the checkpoint, but without the data position a resumed run replays
+    batches it already trained on.  Wrap the loader, checkpoint
+    `state_dict()` (TrainStep.save_checkpoint(data_cursor=...)), and after
+    `load_state_dict` the first epoch fast-forwards past the already-
+    consumed batches — drawing and discarding them, so any deterministic
+    sampler (seeded shuffles included) lands on exactly the batch the
+    interrupted run would have seen next.
+
+        cursor = ResumableLoader(loader)
+        meta = step.restore_checkpoint(ckpt)
+        if meta and "data_cursor" in meta:
+            cursor.load_state_dict(meta["data_cursor"])
+        for batch in cursor:
+            ...
+    """
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.epoch = 0
+        self.index = 0  # batches consumed in the current epoch
+        self._skip = 0
+
+    def state_dict(self) -> dict:
+        return {"epoch": self.epoch, "index": self.index}
+
+    def load_state_dict(self, state: dict):
+        self.epoch = int(state.get("epoch", 0))
+        self.index = 0
+        self._skip = int(state.get("index", 0))
+
+    def __iter__(self):
+        from ..utils.monitor import stat_add
+        # each iteration restarts the loader from batch 0, so the cursor
+        # restarts too (a broken-off epoch must not leave a stale index
+        # that a later checkpoint would fast-forward past); the load_
+        # state_dict fast-forward belongs to the FIRST iteration only
+        skip, self._skip = self._skip, 0
+        self.index = 0
+        for b in self.loader:
+            if skip > 0:
+                skip -= 1
+                self.index += 1
+                stat_add("STAT_dataloader_resume_skipped_batches")
+                continue
+            self.index += 1
+            yield b
+        self.epoch += 1
+        self.index = 0
+
+    def __len__(self):
+        return len(self.loader)

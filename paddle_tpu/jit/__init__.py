@@ -237,18 +237,53 @@ def forward_loss(model, loss_fn, state, batch, rng_key=None, amp_level=None,
                 return run()
         return run()
 
+def guard_select(params, opt_state, new_params, new_opt, loss, grads):
+    """Device-side step guard, shared by TrainStep / ShardedTrainStep.
+
+    Computes loss + global-grad-norm finiteness INSIDE the compiled step
+    (no extra host sync: the scalars ride out as two more outputs the host
+    reads together with the loss it was reading anyway) and selects the
+    pre-update state when the step is bad — a NaN/Inf batch leaves params,
+    optimizer moments, AND BatchNorm running stats untouched.  This is the
+    skip half of GradScaler's skip-and-decay, applied even without AMP.
+
+    Returns (guarded_params, guarded_opt, grad_norm, ok).
+    """
+    from ..core.selected_rows import RowSparseGrad
+    leaves = [g.values if isinstance(g, RowSparseGrad) else g
+              for g in grads.values()]
+    if leaves:
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))
+                             for l in leaves))
+    else:
+        gnorm = jnp.float32(0)
+    ok = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+
+    def sel(new, old):
+        return jnp.where(ok, new, old)
+
+    return (jax.tree_util.tree_map(sel, new_params, params),
+            jax.tree_util.tree_map(sel, new_opt, opt_state),
+            gnorm, ok)
+
+
 class TrainStep:
     """One compiled training step (the perf path used by hapi/bench).
 
     step(params, opt_state, step_no, lr, *batch) -> (params', opt_state', loss)
     with `params`/`opt_state` donated — the XLA analogue of the reference's
     fused-allreduce + inplace-addto passes is simply donation + XLA fusion.
+
+    guard=True compiles the finiteness guard into the step (see
+    guard_select) and exposes per-step (grad_norm, ok) on `last_guard`;
+    utils.guarded.GuardedTrainStep adds the host-side policy (spike window,
+    quarantine records, rollback).
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  amp_level: Optional[str] = None, amp_dtype="bfloat16",
                  mesh=None, batch_sharding=None, remat: bool = False,
-                 with_outputs: bool = False):
+                 with_outputs: bool = False, guard: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -291,6 +326,10 @@ class TrainStep:
         self._compiled_multi = None
         self._opt_state = None
         self._remat = remat
+        self._guard = bool(guard)
+        # (grad_norm, ok) device scalars from the last guarded call; read
+        # them together with the loss to avoid an extra host sync
+        self.last_guard = None
 
     def _forward_loss(self, state, batch, rng_key=None):
         return forward_loss(self.model, self.loss_fn, state, batch, rng_key,
@@ -372,6 +411,8 @@ class TrainStep:
                 example_state, example_batch)
 
         with_outputs = self._with_outputs
+        guard = self._guard
+        from ..utils import faults as _faults
 
         def step(params, opt_state, step_no, lr, rng_key, batch):
             def loss_of(train_params):
@@ -388,11 +429,17 @@ class TrainStep:
             loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
             (loss, (outs, bufs)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(train_params)
+            # trace-time gated: identity (zero compiled ops) unless armed
+            grads = _faults.poison_grads(grads, step_no)
             new_params, new_opt = apply_updates(
                 opt, params, grads, opt_state, lr, step_no, decay)
             # running-stat (buffer) updates captured in the traced forward
             # ride the same compiled step — no eager _set_data round-trip
             new_params.update(bufs)
+            if guard:
+                new_params, new_opt, gnorm, ok = guard_select(
+                    params, opt_state, new_params, new_opt, loss, grads)
+                return new_params, new_opt, loss, outs, gnorm, ok
             return new_params, new_opt, loss, outs
 
         def step_sparse(params, opt_state, step_no, lr, rng_key, batch):
@@ -420,9 +467,14 @@ class TrainStep:
                 loss_fn, argnums=(0, 1), has_aux=True)(train_params, zeros)
             grads = self._merge_sparse_grads(grads, zgrads, ids, params,
                                              name_to_key)
+            grads = _faults.poison_grads(grads, step_no)
             new_params, new_opt = apply_updates(
                 opt, params, grads, opt_state, lr, step_no, decay)
             new_params.update(bufs)
+            if guard:
+                new_params, new_opt, gnorm, ok = guard_select(
+                    params, opt_state, new_params, new_opt, loss, grads)
+                return new_params, new_opt, loss, outs, gnorm, ok
             return new_params, new_opt, loss, outs
 
         return jax.jit(step_sparse if sparse_specs else step,
@@ -466,6 +518,8 @@ class TrainStep:
                            else loss_of)
                 (loss, bufs), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(train_params)
+                from ..utils import faults as _faults
+                grads = _faults.poison_grads(grads, step_no0 + i)
                 new_params, new_opt = apply_updates(
                     opt, params, grads, opt_state, lr, step_no0 + i, decay)
                 new_params.update(bufs)
@@ -520,6 +574,8 @@ class TrainStep:
                                                            zeros)
                 grads = self._merge_sparse_grads(grads, zgrads, ids, params,
                                                  name_to_key)
+                from ..utils import faults as _faults
+                grads = _faults.poison_grads(grads, step_no0 + i)
                 new_params, new_opt = apply_updates(
                     opt, params, grads, opt_state, lr, step_no0 + i, decay)
                 new_params.update(bufs)
@@ -539,6 +595,12 @@ class TrainStep:
         array.  Works with Embedding(sparse=True): lookup counts are baked
         per batch-shape signature, so each signature compiles its own
         multi-step program."""
+        if self._guard:
+            raise NotImplementedError(
+                "TrainStep(guard=True) does not support run_steps: the "
+                "multi-step scan has no per-step skip/rollback point (a "
+                "silent bypass would apply NaN updates the guard promised "
+                "to block) — use per-call steps under the guard")
         state = state_arrays(self.model)
         if self._opt_state is None:
             self._opt_state = self.init_opt_state(state)
@@ -588,8 +650,13 @@ class TrainStep:
         from ..core import rng as _rng
         rng_key = _rng.next_key()  # fresh per step: dropout masks differ
         raw_batch = tuple(unwrap(b) for b in batch)
-        new_state, self._opt_state, loss, outs = self._compiled(
+        out = self._compiled(
             state, self._opt_state, step_no, lr, rng_key, raw_batch)
+        if self._guard:
+            new_state, self._opt_state, loss, outs, gnorm, ok = out
+            self.last_guard = (gnorm, ok)
+        else:
+            new_state, self._opt_state, loss, outs = out
         self.last_outputs = (tuple(Tensor(o) for o in outs)
                              if outs else None)
         sd = self.model.state_dict()
@@ -598,7 +665,8 @@ class TrainStep:
         return Tensor(loss)
 
     # -- checkpointing (single-device variant of ShardedTrainStep's) ---------
-    def save_checkpoint(self, directory, step=None, extra_meta=None):
+    def save_checkpoint(self, directory, step=None, extra_meta=None,
+                        scaler=None, data_cursor=None):
         from ..distributed import checkpoint as dck
         state = state_arrays(self.model)
         if self._opt_state is None:
@@ -606,15 +674,16 @@ class TrainStep:
         return dck.save_train_state(
             directory, state, self._opt_state,
             step if step is not None else self.optimizer._step_count,
-            extra_meta, optimizer=self.optimizer)
+            extra_meta, optimizer=self.optimizer, scaler=scaler,
+            data_cursor=data_cursor)
 
-    def restore_checkpoint(self, directory):
+    def restore_checkpoint(self, directory, scaler=None):
         from ..distributed import checkpoint as dck
         res = dck.restore_sharded(directory)
         if res is None:
             return None
         meta, restored_opt = dck.apply_train_state(
-            self.model, self.optimizer, res)
+            self.model, self.optimizer, res, scaler=scaler)
         fresh = self.init_opt_state(state_arrays(self.model))
         self._opt_state = dck.merge_opt_state(fresh, restored_opt)
         return meta

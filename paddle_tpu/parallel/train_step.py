@@ -39,10 +39,14 @@ class ShardedTrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  strategy: Optional[DistributedStrategy] = None,
                  mesh: Optional[Mesh] = None,
-                 batch_spec=None):
+                 batch_spec=None, guard: bool = False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        # compiled finiteness guard (see jit.guard_select): bad steps are
+        # skipped on-device; (grad_norm, ok) ride out on last_guard
+        self._guard = bool(guard)
+        self.last_guard = None
         self.strategy = strategy or DistributedStrategy()
         self.mesh = mesh or get_mesh(create_default=True)
         st = self.strategy
@@ -171,6 +175,9 @@ class ShardedTrainStep:
         grads_of = (grads_of_explicit if self._fp16_allreduce
                     else grads_of_implicit)
 
+        guard = self._guard
+        from ..utils import faults as _faults
+
         def step(params, opt_state, step_no, lr, rng_key, batch):
             if k_steps > 1:
                 # gradient merge: split batch into k microbatches, scan
@@ -194,14 +201,23 @@ class ShardedTrainStep:
                         lambda g: g / k_steps, grads)
             else:
                 loss, grads = grads_of(params, batch, rng_key)
+            # trace-time gated fault injection: identity unless armed
+            grads = _faults.poison_grads(grads, step_no)
             new_params, new_opt = apply_updates(
                 opt, params, grads, opt_state, lr, step_no, decay)
+            if guard:
+                from ..jit import guard_select
+                new_params, new_opt, gnorm, ok = guard_select(
+                    params, opt_state, new_params, new_opt, loss, grads)
+                return new_params, new_opt, loss, gnorm, ok
             return new_params, new_opt, loss
 
         n_batch = self._n_batch
         in_shardings = (self.param_shardings, opt_shardings, None, None, None,
                         (self._batch_sharding,) * n_batch)
         out_shardings = (self.param_shardings, opt_shardings, None)
+        if guard:
+            out_shardings += (None, None)
         return jax.jit(step, in_shardings=in_shardings,
                        out_shardings=out_shardings, donate_argnums=(0, 1))
 
@@ -236,8 +252,13 @@ class ShardedTrainStep:
         rng_key = _rng.next_key()
         raw_batch = tuple(jax.device_put(unwrap(b), self._batch_sharding)
                           for b in batch)
-        new_state, self._opt_state, loss = self._compiled(
+        out = self._compiled(
             state, self._opt_state, step_no, lr, rng_key, raw_batch)
+        if self._guard:
+            new_state, self._opt_state, loss, gnorm, ok = out
+            self.last_guard = (gnorm, ok)
+        else:
+            new_state, self._opt_state, loss = out
         sd = self.model.state_dict()
         for k, v in new_state.items():
             sd[k]._set_data(v)
@@ -245,9 +266,13 @@ class ShardedTrainStep:
 
     # -- checkpointing -------------------------------------------------------
     def save_checkpoint(self, directory: str, step: Optional[int] = None,
-                        extra_meta: Optional[dict] = None) -> str:
+                        extra_meta: Optional[dict] = None,
+                        scaler=None, data_cursor=None) -> str:
         """Snapshot sharded params + optimizer state without host gather
-        (each process writes only its own shards)."""
+        (each process writes only its own shards).  `scaler` adds the
+        GradScaler loss-scaling state to the extras so an AMP resume does
+        not restart dynamic loss scaling from init; `data_cursor` records
+        the data-iterator position."""
         from ..distributed import checkpoint as dck
         if not self._placed:
             self.place_params()
@@ -258,11 +283,14 @@ class ShardedTrainStep:
         return dck.save_train_state(
             directory, state, self._opt_state,
             step if step is not None else self.optimizer._step_count,
-            extra_meta, optimizer=self.optimizer)
+            extra_meta, optimizer=self.optimizer, scaler=scaler,
+            data_cursor=data_cursor)
 
-    def restore_checkpoint(self, directory: str) -> Optional[dict]:
+    def restore_checkpoint(self, directory: str,
+                           scaler=None) -> Optional[dict]:
         """Restore the newest checkpoint onto this step's shardings; resumes
-        the optimizer step count + rng stream. Returns meta or None."""
+        the optimizer step count + rng stream (+ GradScaler state when
+        `scaler` is given). Returns meta or None."""
         from ..distributed import checkpoint as dck
         if not self._placed:
             self.place_params()
@@ -273,7 +301,7 @@ class ShardedTrainStep:
         if res is None:
             return None
         meta, restored_opt = dck.apply_train_state(
-            self.model, self.optimizer, res)
+            self.model, self.optimizer, res, scaler=scaler)
         fresh = jax.device_put(
             self.init_opt_state(state_arrays(self.model)),
             self._ensure_opt_shardings())

@@ -1,6 +1,19 @@
 """paddle_tpu.utils."""
-from . import checkpoint, flags, profiler  # noqa: F401
+from . import checkpoint, faults, flags, profiler, retry  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
+from .retry import RetryPolicy, retry_call  # noqa: F401
+
+
+def __getattr__(name):
+    # lazy: guarded pulls in jit (which pulls the op/layer stack) — keep
+    # `import paddle_tpu.utils` light and cycle-free
+    if name == "guarded":
+        from . import guarded
+        return guarded
+    if name == "GuardedTrainStep":
+        from .guarded import GuardedTrainStep
+        return GuardedTrainStep
+    raise AttributeError(name)
 
 
 def dump_config(path=None):

@@ -1,0 +1,186 @@
+"""Fault injection for resilience testing.
+
+Reference: the reference framework's fault tolerance (EDL preemption,
+checkpoint_notify, reader worker restarts) ships with no way to *prove* the
+recovery paths work — they are only exercised by real production failures.
+This module gives every recovery path in paddle_tpu a deterministic trigger,
+driven by env vars (so subprocess / worker-process faults inherit them) or
+the in-process API, and the `faults`-marked test suite + resilience probe
+use it to demonstrate end-to-end recovery in CI.
+
+Injection points (consumed elsewhere in the framework):
+
+  nan_grads       step k (or [a, b) range): compiled train steps poison the
+                  gradients with NaN when step_no hits the window.  The
+                  *presence* of the injection is decided at trace time, so
+                  the production compiled step carries zero overhead.
+                  Env: PDTPU_FAULT_NAN_GRADS="k" or "a:b".
+  worker_crash    DataLoader worker hard-exits (mode "kill", exercising the
+                  death-detect + respawn path) or raises (mode "exc",
+                  exercising error propagation) when it picks up batch seq
+                  S.  A `once` sentinel file makes the fault fire a single
+                  time so the respawned worker can finish the batch.
+                  Env: PDTPU_FAULT_WORKER_CRASH="kill:S[:/path/once]".
+  kill_mid_save   checkpoint writer SIGKILLs its own process right before
+                  the atomic rename of save number N (1-based), proving a
+                  kill mid-save never corrupts the latest checkpoint.
+                  Env: PDTPU_FAULT_KILL_MID_SAVE="N".
+  backend_down    the bench backend probe reports the accelerator tunnel
+                  unreachable without waiting out a real timeout.
+                  Env: PDTPU_FAULT_BACKEND_DOWN="1".
+
+Deliberately import-light (no jax at module scope): DataLoader worker
+processes and the bench orchestrator consult it before any backend exists.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import threading
+from typing import Optional, Tuple
+
+__all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
+           "poison_grads", "worker_crash_config", "maybe_crash_worker",
+           "maybe_kill_mid_save", "backend_down"]
+
+_ENV = {
+    "nan_grads": "PDTPU_FAULT_NAN_GRADS",
+    "worker_crash": "PDTPU_FAULT_WORKER_CRASH",
+    "kill_mid_save": "PDTPU_FAULT_KILL_MID_SAVE",
+    "backend_down": "PDTPU_FAULT_BACKEND_DOWN",
+}
+
+_lock = threading.Lock()
+_registry = {}          # point -> raw config string (authoritative mirror)
+_save_counter = {"n": 0}  # kill_mid_save is counted per process
+
+
+def enable(point: str, value="1"):
+    """Arm a fault.  Mirrors into os.environ so worker subprocesses (fork /
+    forkserver started after this call) and checkpoint subprocesses inherit
+    it.  `value` is the point's config string (see module docstring)."""
+    if point not in _ENV:
+        raise ValueError(f"unknown fault point {point!r}; "
+                         f"known: {sorted(_ENV)}")
+    with _lock:
+        _registry[point] = str(value)
+        os.environ[_ENV[point]] = str(value)
+
+
+def disable(point: str):
+    with _lock:
+        _registry.pop(point, None)
+        os.environ.pop(_ENV[point], None)
+
+
+def reset():
+    """Disarm every fault (test teardown)."""
+    for point in _ENV:
+        disable(point)
+    with _lock:
+        _save_counter["n"] = 0
+
+
+def get(point: str) -> Optional[str]:
+    """Live config string for a point, or None.  Reads the registry first,
+    then the env — so faults armed via the environment (subprocess tests)
+    are seen without any enable() call in this process."""
+    with _lock:
+        v = _registry.get(point)
+    if v is not None:
+        return v
+    return os.environ.get(_ENV[point])
+
+
+# -- nan_grads ---------------------------------------------------------------
+
+def nan_grads_window() -> Optional[Tuple[int, int]]:
+    """[a, b) step window to poison, or None when disarmed.  Consulted at
+    TRACE time by the compiled train steps: the window bounds are baked as
+    constants, the comparison against step_no stays dynamic."""
+    raw = get("nan_grads")
+    if not raw:
+        return None
+    if ":" in raw:
+        a, b = raw.split(":", 1)
+        return int(a), int(b)
+    k = int(raw)
+    return k, k + 1
+
+
+def poison_grads(grads, step_no):
+    """Multiply every gradient leaf by NaN inside the poison window (traced;
+    identity outside it).  RowSparseGrad leaves are poisoned through their
+    .values so the sparse path is exercised too."""
+    import jax.numpy as jnp
+    from ..core.selected_rows import RowSparseGrad
+    window = nan_grads_window()
+    if window is None:
+        return grads
+    a, b = window
+    bad = (step_no >= a) & (step_no < b)
+
+    def leaf(g):
+        if isinstance(g, RowSparseGrad):
+            return RowSparseGrad(g.rows, leaf(g.values), g.dense_shape)
+        factor = jnp.where(bad, jnp.asarray(float("nan"), g.dtype),
+                           jnp.asarray(1.0, g.dtype))
+        return g * factor
+    return {k: leaf(g) for k, g in grads.items()}
+
+
+# -- worker_crash ------------------------------------------------------------
+
+def worker_crash_config() -> Optional[Tuple[str, int, Optional[str]]]:
+    """(mode, seq, once_path) or None.  mode: "kill" | "exc"."""
+    raw = get("worker_crash")
+    if not raw:
+        return None
+    parts = raw.split(":", 2)
+    if len(parts) == 1:  # bare seq -> kill
+        return "kill", int(parts[0]), None
+    mode = parts[0] if parts[0] in ("kill", "exc") else "kill"
+    seq = int(parts[1] if parts[0] in ("kill", "exc") else parts[0])
+    once = parts[2] if len(parts) == 3 else None
+    return mode, seq, once
+
+
+def maybe_crash_worker(seq: int):
+    """Called by the DataLoader worker loop per task.  Fires at most once
+    when a `once` sentinel path is configured (the sentinel is created
+    BEFORE dying so the respawned worker survives the retried batch)."""
+    cfg = worker_crash_config()
+    if cfg is None:
+        return
+    mode, target, once = cfg
+    if seq != target:
+        return
+    if once is not None:
+        if os.path.exists(once):
+            return
+        open(once, "w").close()
+    if mode == "exc":
+        raise RuntimeError(f"injected worker exception at seq {seq}")
+    os._exit(17)  # hard crash: no result, no cleanup — the real thing
+
+
+# -- kill_mid_save -----------------------------------------------------------
+
+def maybe_kill_mid_save():
+    """Called by the checkpoint writer after the shard/manifest files are on
+    disk but BEFORE the atomic rename publishes them.  SIGKILL — not
+    sys.exit — so no finally/atexit softens the crash."""
+    raw = get("kill_mid_save")
+    if not raw:
+        return
+    with _lock:
+        _save_counter["n"] += 1
+        n = _save_counter["n"]
+    if n >= int(raw):
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+# -- backend_down ------------------------------------------------------------
+
+def backend_down() -> bool:
+    return bool(get("backend_down"))

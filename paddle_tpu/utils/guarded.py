@@ -1,0 +1,156 @@
+"""GuardedTrainStep — the host-side half of step guarding.
+
+The device half (jit.guard_select, compiled into TrainStep /
+ShardedTrainStep via guard=True) computes loss/global-grad-norm finiteness
+inside the compiled step and skips bad updates on-device with no extra
+host sync.  This wrapper adds the policy around it:
+
+- reads (loss, grad_norm, ok) together — ONE host sync per step, the same
+  one the caller's float(loss) already paid;
+- skip-and-decay: a nonfinite step feeds an attached GradScaler's dynamic
+  loss-scale state machine (record_skip) even when AMP is off;
+- loss-spike detection against a rolling window of recent finite losses
+  (a spiking-but-finite step can't be skipped retroactively — it counts
+  toward the bad streak and the rollback handles sustained divergence);
+- after `max_bad_steps` CONSECUTIVE bad steps it rolls back to the last
+  checkpoint and writes a structured quarantine record
+  (<ckpt_dir>/quarantine.jsonl) naming the step span, reason, loss and
+  grad norm — the post-mortem artifact the reference's silent NaN crashes
+  never left behind.
+
+Usage:
+    step = jit.TrainStep(model, loss_fn, opt, guard=True)
+    gstep = GuardedTrainStep(step, checkpoint_dir=ckpt, max_bad_steps=3)
+    for batch in loader:
+        loss = gstep(*batch)
+        if gstep.last_skipped:
+            continue  # optionally retry the batch
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Optional
+
+__all__ = ["GuardedTrainStep"]
+
+
+class GuardedTrainStep:
+    """Policy wrapper over a guard-enabled TrainStep/ShardedTrainStep."""
+
+    def __init__(self, step, checkpoint_dir: Optional[str] = None,
+                 scaler=None, spike_window: int = 32,
+                 spike_factor: float = 8.0, min_window: int = 8,
+                 max_bad_steps: int = 3):
+        if getattr(step, "_compiled", None) is not None and not step._guard:
+            raise ValueError(
+                "GuardedTrainStep needs the inner step built with "
+                "guard=True (it already compiled without the guard)")
+        step._guard = True
+        self.step = step
+        self.checkpoint_dir = checkpoint_dir
+        self.scaler = scaler
+        self.spike_factor = float(spike_factor)
+        self.min_window = int(min_window)
+        self.max_bad_steps = int(max_bad_steps)
+        self._window: deque = deque(maxlen=int(spike_window))
+        self.bad_streak = 0
+        self.quarantine: list = []
+        self.last_skipped = False
+        self.last_reason: Optional[str] = None
+
+    # passthroughs ----------------------------------------------------------
+    @property
+    def model(self):
+        return self.step.model
+
+    @property
+    def optimizer(self):
+        return self.step.optimizer
+
+    def save_checkpoint(self, directory=None, step=None, extra_meta=None,
+                        data_cursor=None):
+        return self.step.save_checkpoint(
+            directory or self.checkpoint_dir, step=step,
+            extra_meta=extra_meta, scaler=self.scaler,
+            data_cursor=data_cursor)
+
+    def restore_checkpoint(self, directory=None):
+        return self.step.restore_checkpoint(directory or self.checkpoint_dir,
+                                            scaler=self.scaler)
+
+    # the guarded call ------------------------------------------------------
+    def __call__(self, *batch):
+        import numpy as np
+        loss_t = self.step(*batch)
+        gnorm_d, ok_d = self.step.last_guard
+        # one fused host read for loss/gnorm/ok (the loss read was already
+        # the step's host sync point)
+        loss, gnorm, ok = (float(np.asarray(loss_t._data)),
+                           float(np.asarray(gnorm_d)),
+                           bool(np.asarray(ok_d)))
+        reason = None
+        if not ok:
+            reason = "nonfinite"
+        elif self._is_spike(loss):
+            reason = "loss_spike"
+        if reason is None:
+            self._window.append(loss)
+            self.bad_streak = 0
+            self.last_skipped = False
+            self.last_reason = None
+        else:
+            self._on_bad_step(reason, loss, gnorm)
+        return loss_t
+
+    def _is_spike(self, loss: float) -> bool:
+        if len(self._window) < self.min_window:
+            return False
+        med = sorted(self._window)[len(self._window) // 2]
+        return abs(loss) > self.spike_factor * max(abs(med), 1e-12)
+
+    def _on_bad_step(self, reason: str, loss: float, gnorm: float):
+        from .monitor import stat_add
+        stat_add("STAT_guarded_bad_steps")
+        self.bad_streak += 1
+        self.last_skipped = reason == "nonfinite"  # spikes were applied
+        self.last_reason = reason
+        rec = {"step": int(self.step.optimizer._step_count),
+               "reason": reason, "loss": loss, "grad_norm": gnorm,
+               "bad_streak": self.bad_streak, "time": time.time(),
+               "skipped_on_device": self.last_skipped}
+        if reason == "nonfinite" and self.scaler is not None:
+            # skip-and-decay: drive the dynamic loss-scale state machine
+            # from the on-device verdict (no per-grad host isfinite pass)
+            self.scaler.record_skip()
+            rec["loss_scale"] = self.scaler.get_init_loss_scaling()
+        if (self.bad_streak >= self.max_bad_steps
+                and self.checkpoint_dir is not None):
+            meta = self.restore_checkpoint()
+            if meta is not None:
+                rec["rolled_back_to"] = meta["step"]
+                stat_add("STAT_guarded_rollbacks")
+                self.bad_streak = 0
+                self._window.clear()
+            else:
+                # no checkpoint to roll back to: nothing was restored, so
+                # the streak and spike window must survive (resetting them
+                # would rebaseline spike detection on the diverged losses)
+                rec["rolled_back_to"] = None
+                rec["rollback_failed"] = "no restorable checkpoint"
+                stat_add("STAT_guarded_rollback_failures")
+        self.quarantine.append(rec)
+        self._append_quarantine(rec)
+
+    def _append_quarantine(self, rec: dict):
+        if self.checkpoint_dir is None:
+            return
+        try:
+            os.makedirs(self.checkpoint_dir, exist_ok=True)
+            with open(os.path.join(self.checkpoint_dir,
+                                   "quarantine.jsonl"), "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError:
+            pass  # quarantine bookkeeping must never kill the run
