@@ -759,6 +759,31 @@ def measure_resilience():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_serving():
+    """ISSUE-4 acceptance artifact: probes/serving_probe.py in a clean CPU
+    subprocess.  Publishes continuous-batching tokens/sec and p50 TTFT
+    against the sequential per-request generate baseline (bars: >= 1.5x
+    tokens/sec, lower TTFT, greedy streams bit-identical) plus the
+    compile-count bound (len(prefill_buckets) + 1 programs)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes", "serving_probe.py"),
+         "--steps", os.environ.get("PDTPU_SERVING_PROBE_STEPS", "40")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("SERVE"):
+            rec = json.loads(line[len("SERVE"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"serving bars failed: {rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return rec
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_mnist_eager():
     """BASELINE config #1: LeNet, EAGER per-op dispatch, single device —
     the CPU-baseline parity check (runs in a CPU subprocess; eager per-op
@@ -996,6 +1021,7 @@ def main():
                          ("ernie_large", lambda: measure_ernie(on_tpu)),
                          ("mnist_eager", measure_mnist_eager),
                          ("eager_dispatch", measure_eager_dispatch),
+                         ("serving", measure_serving),
                          ("resilience", measure_resilience),
                          ("pipeline", measure_pipeline_ratio)):
             try:

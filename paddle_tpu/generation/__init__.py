@@ -23,7 +23,9 @@ import numpy as np
 
 from ..core.tensor import Tensor, unwrap
 
-__all__ = ["generate", "apply_top_k", "apply_top_p"]
+__all__ = ["generate", "apply_top_k", "apply_top_p",
+           "apply_top_k_dynamic", "apply_top_p_dynamic",
+           "process_logits_dynamic"]
 
 _NEG = -1e9
 
@@ -36,20 +38,59 @@ def apply_top_k(logits, k):
     return jnp.where(logits < kth, _NEG, logits)
 
 
-def apply_top_p(logits, p):
-    """Nucleus filtering: keep the smallest prefix of the sorted
-    distribution whose cumulative probability exceeds p."""
-    if p >= 1.0:
-        return logits
+def _nucleus_cutoff(logits, p):
+    """Per-row logit cutoff for nucleus filtering: the smallest logit in
+    the shortest sorted prefix whose cumulative probability exceeds p.
+    `p` may be a scalar or a per-row (B,) array (broadcast against the
+    sorted (B, V) distribution) — the serving decode step passes per-slot
+    p values through one shared trace."""
     sort = jnp.sort(logits, axis=-1)[..., ::-1]
     probs = jax.nn.softmax(sort, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     # keep entries where the cumulative mass BEFORE them is < p; the top
     # token always survives (p=0 must mean greedy, not uniform)
-    keep = (cum - probs) < p
+    keep = (cum - probs) < jnp.asarray(p)[..., None]
     keep = keep.at[..., 0].set(True)
-    cutoff = jnp.min(jnp.where(keep, sort, jnp.inf), axis=-1, keepdims=True)
-    return jnp.where(logits < cutoff, _NEG, logits)
+    return jnp.min(jnp.where(keep, sort, jnp.inf), axis=-1, keepdims=True)
+
+
+def apply_top_p(logits, p):
+    """Nucleus filtering: keep the smallest prefix of the sorted
+    distribution whose cumulative probability exceeds p."""
+    if p >= 1.0:
+        return logits
+    return jnp.where(logits < _nucleus_cutoff(logits, p), _NEG, logits)
+
+
+def apply_top_k_dynamic(logits, k):
+    """apply_top_k with a per-row (B,) TRACED k: rows with k <= 0 pass
+    through unfiltered.  Static-k callers keep apply_top_k (lax.top_k is
+    cheaper than the full sort); the serving decode step uses this form so
+    heterogeneous per-slot k values share one compiled program."""
+    v = logits.shape[-1]
+    sort = jnp.sort(logits, axis=-1)[..., ::-1]
+    kth = jnp.take_along_axis(
+        sort, jnp.clip(k - 1, 0, v - 1)[..., None], axis=-1)
+    return jnp.where((k > 0)[..., None] & (logits < kth), _NEG, logits)
+
+
+def apply_top_p_dynamic(logits, p):
+    """apply_top_p with a per-row (B,) TRACED p: rows with p >= 1.0 keep
+    the whole distribution (their cutoff lands on the smallest logit)."""
+    return jnp.where(logits < _nucleus_cutoff(logits, p), _NEG, logits)
+
+
+def process_logits_dynamic(logits, temperature, top_k, top_p, greedy):
+    """_process_logits with every sampling knob a per-row dynamic input:
+    temperature (B,) f32 (1.0 = untempered), top_k (B,) i32 (0 = off),
+    top_p (B,) f32 (1.0 = off), greedy (B,) bool (True rows bypass the
+    whole pipeline, matching the static greedy trace).  This is what lets
+    the serving engine run heterogeneous requests through ONE decode
+    program instead of one trace per sampling configuration."""
+    proc = logits / temperature[..., None]
+    proc = apply_top_k_dynamic(proc, top_k)
+    proc = apply_top_p_dynamic(proc, top_p)
+    return jnp.where(greedy[..., None], logits, proc)
 
 
 def _process_logits(logits, temperature, top_k, top_p, greedy):
@@ -180,8 +221,25 @@ def _sample_loop(state, apply_fixed, model, ids, max_new, total, greedy,
             score = score + jnp.where(finished, 0.0, step_lp)
             nxt = jnp.where(finished, pad, nxt)
             finished = finished | (nxt == eos)
-            logits, caches = apply_fixed(state, nxt[:, None], caches, pos)
-            nlast = logits[:, -1, :].astype(jnp.float32)
+
+            # once EVERY row is finished the remaining iterations only
+            # emit pad: skip the model call entirely (lax.cond executes
+            # one branch at runtime — short completions inside a long
+            # max_new_tokens budget stop paying full decode FLOPs).  The
+            # zeroed last-logits are never observed: every later step has
+            # finished all-True, so its sampled token is overridden by pad
+            # and its score increment masked to 0.
+            def live(ops):
+                tok_, caches_ = ops
+                logits, c2 = apply_fixed(state, tok_[:, None], caches_, pos)
+                return logits[:, -1, :].astype(jnp.float32), c2
+
+            def drained(ops):
+                _, caches_ = ops
+                return jnp.zeros_like(last), caches_
+
+            nlast, caches = jax.lax.cond(jnp.all(finished), drained, live,
+                                         (nxt, caches))
             return (nxt, caches, pos + 1, key, finished, score,
                     nlast), nxt
 

@@ -19,7 +19,8 @@ from typing import Dict, List, Optional
 import jax
 import numpy as np
 
-__all__ = ["Config", "Predictor", "PredictorTensor", "create_predictor"]
+__all__ = ["Config", "Predictor", "PredictorTensor", "ServingPredictor",
+           "create_predictor"]
 
 
 class Config:
@@ -37,6 +38,7 @@ class Config:
         self._profile = False
         self._device = "tpu"
         self._threads = 1
+        self._serving = None
 
     # -- model ----------------------------------------------------------------
     def set_model(self, model_path: str, params_path: Optional[str] = None):
@@ -103,6 +105,33 @@ class Config:
     def switch_specify_input_names(self, flag=True):
         pass
 
+    # -- serving mode ---------------------------------------------------------
+    def enable_serving(self, model=None, model_provider=None, **engine_opts):
+        """Switch create_predictor() to the continuous-batching
+        ServingEngine (paddle_tpu.serving).
+
+        Exactly one of:
+          model           an in-memory Layer implementing the
+                          gen_fixed_cache/forward_fixed protocol
+          model_provider  zero-arg callable building such a Layer; its
+                          weights are then restored from this Config's
+                          jit.save artifact (`<prefix>.pdiparams.npz`) —
+                          the serving analogue of loading a saved model
+
+        engine_opts pass through to ServingEngine (max_slots, max_len,
+        prefill_buckets, max_queue_depth, pad_token_id, dtype).
+        """
+        if (model is None) == (model_provider is None):
+            raise ValueError(
+                "enable_serving needs exactly one of model= (in-memory) or "
+                "model_provider= (architecture factory for a saved "
+                "artifact)")
+        self._serving = {"model": model, "model_provider": model_provider,
+                         **engine_opts}
+
+    def serving_enabled(self) -> bool:
+        return self._serving is not None
+
     # -- profiling ------------------------------------------------------------
     def enable_profile(self):
         self._profile = True
@@ -110,7 +139,9 @@ class Config:
     def summary(self) -> str:
         return (f"Config(model={self._prefix!r}, device={self._device}, "
                 f"ir_optim={self._ir_optim}, "
-                f"memory_optim={self._memory_optim})")
+                f"memory_optim={self._memory_optim}, "
+                f"threads={self._threads}, "
+                f"serving={self.serving_enabled()})")
 
 
 class PredictorTensor:
@@ -201,6 +232,88 @@ class Predictor:
     def get_output_handle(self, name: str) -> PredictorTensor:
         return self._outputs[name]
 
+    def profile_report(self) -> Dict:
+        """One coherent report for the one-shot predictor: the Config's
+        accepted-but-recorded knobs (ir_optim, memory_optim, threads)
+        alongside profiler op spans and monitor counters — the same shape
+        ServingPredictor.profile_report() returns for serving mode."""
+        return _profile_report(self._config)
 
-def create_predictor(config: Config) -> Predictor:
+
+def _profile_report(config: Config, serving_metrics=None) -> Dict:
+    from ..utils import profiler
+    from ..utils.monitor import stats
+    rep = {
+        "config": {"model": config._prefix, "device": config._device,
+                   "ir_optim": config._ir_optim,
+                   "memory_optim": config._memory_optim,
+                   "threads": config._threads,
+                   "profile": config._profile},
+        "op_spans": profiler.summary(),
+        "stats": {k: v for k, v in stats().items()
+                  if k.startswith("STAT_serving_")
+                  or k == "STAT_predictor_runs"},
+    }
+    if serving_metrics is not None:
+        rep["serving"] = serving_metrics
+    return rep
+
+
+class ServingPredictor:
+    """Serving-mode predictor: create_predictor(config) returns this when
+    `config.enable_serving(...)` was called.  Wraps a running
+    paddle_tpu.serving.ServingEngine (background loop started, programs
+    precompiled) behind the predictor surface."""
+
+    def __init__(self, config: Config):
+        from ..serving import ServingEngine
+        opts = dict(config._serving)
+        model = opts.pop("model", None)
+        provider = opts.pop("model_provider", None)
+        warmup = opts.pop("warmup", True)
+        start = opts.pop("start", True)
+        if model is None:
+            model = provider()
+            prefix = config.model_dir()
+            if prefix is None:
+                raise ValueError(
+                    "serving with model_provider= needs a jit.save artifact "
+                    "(Config.set_model) to restore weights from")
+            data = np.load(prefix + ".pdiparams.npz")
+            model.set_state_dict({k: data[k] for k in data.files})
+        model.eval()
+        self._config = config
+        self.engine = ServingEngine(model, profile=config._profile, **opts)
+        if warmup:
+            self.engine.warmup()
+        if start:
+            self.engine.start()
+
+    def submit(self, prompt, max_new_tokens, **kwargs):
+        """Enqueue a request; returns the streaming serving.Response."""
+        return self.engine.submit(prompt, max_new_tokens, **kwargs)
+
+    def metrics(self):
+        return self.engine.metrics()
+
+    def profile_report(self) -> Dict:
+        """Config knobs + profiler spans + live serving metrics in one
+        report (enable_profile additionally records serving_prefill /
+        serving_decode spans in the profiler table)."""
+        return _profile_report(self._config, self.engine.metrics())
+
+    def close(self):
+        self.engine.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def create_predictor(config: Config):
+    if config.serving_enabled():
+        return ServingPredictor(config)
     return Predictor(config)

@@ -189,7 +189,13 @@ class GPTModel(Layer):
 
     def gen_fixed_cache(self, batch_size, max_length, dtype=None):
         """Preallocated (k, v) buffers per layer for the jitted decode loop:
-        each (B, max_length, H, D) raw jax arrays."""
+        each (B, max_length, H, D) raw jax arrays.
+
+        This (with forward_fixed below) is the serving protocol the
+        continuous-batching engine consumes — paddle_tpu.serving allocates
+        ONE gen_fixed_cache(max_slots, max_len) pool per engine and vmaps
+        forward_fixed over the slot axis; see the serving package
+        docstring for the full contract."""
         import jax.numpy as jnp
         cfg = self.config
         hd = cfg.hidden_size // cfg.num_attention_heads

@@ -1,0 +1,69 @@
+"""paddle_tpu.serving — continuous-batching inference engine.
+
+The ROADMAP's "serves heavy traffic from millions of users" surface: where
+`inference.Predictor` runs one whole-batch program per call and
+`generation.generate` owns a compiled `(batch, prompt_len, max_new)` loop
+per shape, the ServingEngine keeps ONE resident slot-based KV-cache pool
+and exactly two compiled program families — bucketed prefill and a single
+all-slots decode step — that requests join and leave between iterations.
+This is the TPU-native equivalent of the reference's AnalysisPredictor +
+dynamic_decode deployment path, redesigned for continuous batching.
+
+Model protocol contract
+-----------------------
+Any model can be served if it implements the fixed-cache decode protocol
+(`models/gpt.py:190,201` is the reference implementation):
+
+- ``gen_fixed_cache(batch_size, max_length, dtype=None)`` returns the
+  per-layer KV buffers as a list of ``(k, v)`` RAW jax arrays, each of
+  shape ``(batch_size, max_length, heads, head_dim)`` (any per-layer pytree
+  with a leading batch axis on every leaf works — the engine only ever
+  slices/maps axis 0 and axis 1 of each leaf).
+- ``forward_fixed(input_ids, caches, pos)`` runs the model over
+  ``input_ids`` (B, S) with the chunk's KV written into the fixed buffers
+  at ``[pos, pos + S)`` (``pos`` may be a traced scalar), attention masked
+  causally so query ``i`` sees buffer slots ``<= pos + i``, and returns
+  ``(logits, new_caches)``.  Content of the buffers at positions
+  ``> pos + S`` must never influence the output (the engine relies on this
+  to reuse slots without scrubbing, and ADDITIONALLY overwrites the full
+  slot range at prefill).
+
+Engine lifecycle
+----------------
+::
+
+    engine = ServingEngine(model, max_slots=8, max_len=256,
+                           prefill_buckets=(16, 32, 64), max_queue_depth=64)
+    engine.warmup()          # compile len(buckets) + 1 programs, the total
+    engine.start()           # background loop (or drive step() yourself)
+    resp = engine.submit(prompt_ids, max_new_tokens=64,
+                         eos_token_id=eos, deadline=30.0)
+    for tok in resp:         # streams as decoded; TTFT at first yield
+        ...
+    engine.close()
+
+Guarantees: compilation count ≤ len(prefill_buckets) + 1 programs per
+engine regardless of traffic mix (`compile_counts()` asserts it); greedy
+requests are bit-identical to a solo `generation.generate` of the same
+prompt; one poisoned/expired/cancelled request only ever costs its own
+slot.
+
+Metrics (all live under `metrics()`, the STAT_serving_* monitor counters,
+and — with profiling enabled — the profiler report): ttft_p50_ms,
+inter_token_ms, tokens_per_sec, queue_depth, slot_occupancy,
+requests_completed/errored, STAT_serving_{requests,rejects,tokens,
+prefills,decode_steps,compiles,queue_depth,slots_active,cancelled,
+deadline_expired,nonfinite}.
+"""
+from __future__ import annotations
+
+from .engine import ServingEngine, NonFiniteLogitsError
+from .request import Request, Response, RequestCancelled
+from .scheduler import (RequestScheduler, QueueFullError,
+                        DeadlineExceededError)
+
+__all__ = [
+    "ServingEngine", "Request", "Response", "RequestScheduler",
+    "QueueFullError", "DeadlineExceededError", "RequestCancelled",
+    "NonFiniteLogitsError",
+]

@@ -1,0 +1,651 @@
+"""ServingEngine: continuous batching over a slot-based KV-cache pool.
+
+Exactly two compiled program families serve every request mix:
+
+- **bucketed prefill** (one trace per prompt-length bucket): the prompt,
+  right-padded to the bucket, runs through `model.forward_fixed` against a
+  bucket-sized scratch cache; the resulting KV is written into the assigned
+  slot of the engine-lifetime pool via `dynamic_update_slice`, overwriting
+  the slot's FULL [0, max_len) range (stale KV from the slot's previous
+  occupant can never leak).  The first generated token is sampled inside
+  the same program from the prompt's last-position logits.
+- **one decode step** (a single trace, ever): `model.forward_fixed` is
+  vmapped over the slot axis so every slot advances one token per call with
+  its OWN write position, and every sampling knob — temperature, top-k,
+  top-p, greedy flag, RNG key — is a per-slot dynamic input
+  (`generation.process_logits_dynamic`), so heterogeneous requests share
+  the trace.  Requests join and leave the resident batch between
+  iterations; nobody owns a compilation.
+
+Compilation count is therefore bounded by len(prefill_buckets) + 1 per
+engine, regardless of how many (prompt_len, max_new, sampling-param)
+combinations the traffic mixes — asserted by `compile_counts()`.
+
+Greedy requests are bit-identical to a solo
+`generation.generate(decode_strategy='greedy_search')` run of the same
+prompt: prefill logits at the prompt's last position are unaffected by
+right-padding (causal mask), and decode attends exactly the
+[0, pos] prefix of the slot, the same masked-buffer attention the solo
+loop runs.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import FatalError, InvalidArgumentError, UnavailableError
+from ..generation import process_logits_dynamic
+from ..utils import faults
+from ..utils.monitor import stat_add
+from .request import Request, Response, RequestCancelled
+from .scheduler import RequestScheduler, DeadlineExceededError
+
+__all__ = ["ServingEngine", "NonFiniteLogitsError"]
+
+
+class NonFiniteLogitsError(FatalError):
+    """Decode produced NaN/Inf logits for this request's slot; the request
+    is errored individually and its slot recycled."""
+    code = "Fatal"
+
+
+def _default_buckets(max_len: int):
+    """Powers of two from 16 up to max_len (prompt lengths round up)."""
+    buckets, b = [], 16
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return tuple(buckets)
+
+
+class _SlotRun:
+    """Host-side per-slot decode state."""
+    __slots__ = ("req", "resp", "pos", "produced", "last_token",
+                 "last_token_at", "key")
+
+    def __init__(self, req: Request, resp: Response, pos: int,
+                 first_token: int, key: np.ndarray):
+        self.req = req
+        self.resp = resp
+        self.pos = pos              # kv length so far (write offset)
+        self.produced = 1           # first token came from prefill
+        self.last_token = first_token
+        self.last_token_at = time.monotonic()
+        self.key = key
+
+
+class ServingEngine:
+    """Continuous-batching engine over a model implementing the
+    `gen_fixed_cache` / `forward_fixed` protocol (see the serving package
+    docstring and models/gpt.py:190,201)."""
+
+    def __init__(self, model, max_slots: int = 8, max_len: int = 256,
+                 prefill_buckets=None, max_queue_depth: int = 64,
+                 pad_token_id: int = 0, dtype=None, profile: bool = False,
+                 decode_chunk: int = 4):
+        from ..generation import _model_fns
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len)
+        self.pad_token_id = int(pad_token_id)
+        self.buckets = tuple(sorted(set(
+            int(b) for b in (prefill_buckets or _default_buckets(max_len)))))
+        if self.buckets[-1] > self.max_len:
+            raise InvalidArgumentError(
+                f"prefill bucket {self.buckets[-1]} exceeds max_len "
+                f"{self.max_len}")
+        self._dtype = dtype
+        self._profile = bool(profile)
+        # tokens decoded per compiled decode call (an internal lax.scan):
+        # amortizes the per-call host+dispatch cost across chunk tokens per
+        # slot.  Tokens stream in bursts of `chunk`; admission, deadline
+        # and cancel sweeps run between calls.  A slot finishing mid-chunk
+        # wastes its tail iterations (its post-finish tokens are discarded
+        # on the host and its KV garbage is overwritten by the slot's next
+        # prefill) — with budgets >> chunk the waste is marginal and the
+        # dispatch amortization dominates on every backend.
+        self.decode_chunk = max(1, int(decode_chunk))
+        self.scheduler = RequestScheduler(self.max_slots, max_queue_depth)
+        self._state, self._apply = _model_fns(model)
+        # THE pool: one gen_fixed_cache(max_slots, max_len) allocation,
+        # reused for the engine's lifetime
+        self._pools = model.gen_fixed_cache(self.max_slots, self.max_len,
+                                            dtype)
+        self._slots: Dict[int, _SlotRun] = {}
+        # device-resident decode batch state; rebuilt from host _SlotRun
+        # state only when membership changes (admission / slot release)
+        self._dev_tokens = None
+        self._dev_pos = None
+        self._dev_params = None
+        self._batch_dirty = True
+        self._rid = 0
+        self._submit_lock = threading.Lock()
+        # nan_logits fault: presence decided NOW (trace time) — the clean
+        # decode program carries zero fault branches
+        self._poison_target = faults.nan_logits_request()
+        self._key_width = len(np.asarray(jax.random.PRNGKey(0)))
+        # the pool is DONATED to every prefill/decode call and replaced by
+        # the returned buffers: XLA updates the slots in place instead of
+        # copying max_slots * max_len of KV per call (measured 166x on a
+        # CPU pool-passthrough update; the same aliasing TPU donation does)
+        self._donate = (1,)
+        self._compiles = {"decode": 0, "prefill": {b: 0 for b in self.buckets}}
+        self._decode_fn = self._build_decode()
+        self._prefill_fns = {b: self._build_prefill(b) for b in self.buckets}
+        # metrics accumulators
+        self._m_lock = threading.Lock()
+        self._ttfts: List[float] = []
+        self._itl_sum = 0.0
+        self._itl_n = 0
+        self._tokens_out = 0
+        self._completed = 0
+        self._errored = 0
+        self._started_at = time.monotonic()
+        # background loop
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._work = threading.Event()
+        self._closed = False
+        self._dead: Optional[BaseException] = None
+
+    # ------------------------------------------------------------------
+    # compiled programs
+    # ------------------------------------------------------------------
+    def _build_prefill(self, bucket: int):
+        apply_fixed = self._apply
+        model, max_len, dtype = self.model, self.max_len, self._dtype
+
+        def prefill(state, pools, ids, slot, prompt_len, key, temp, top_k,
+                    top_p, greedy):
+            self._compiles["prefill"][bucket] += 1  # trace-count (host)
+            stat_add("STAT_serving_compiles")
+            scratch = model.gen_fixed_cache(1, bucket, dtype)
+            logits, kv = apply_fixed(state, ids, scratch, 0)
+            new_pools = []
+            for (kp, vp), (kc, vc) in zip(pools, kv):
+                # full-range overwrite: bucket KV + zeros to max_len, so a
+                # recycled slot keeps no stale KV from its previous tenant
+                krow = jnp.zeros((1, max_len) + kp.shape[2:], kp.dtype)
+                vrow = jnp.zeros((1, max_len) + vp.shape[2:], vp.dtype)
+                krow = jax.lax.dynamic_update_slice(
+                    krow, kc.astype(kp.dtype), (0, 0, 0, 0))
+                vrow = jax.lax.dynamic_update_slice(
+                    vrow, vc.astype(vp.dtype), (0, 0, 0, 0))
+                new_pools.append((
+                    jax.lax.dynamic_update_slice(kp, krow, (slot, 0, 0, 0)),
+                    jax.lax.dynamic_update_slice(vp, vrow, (slot, 0, 0, 0))))
+            # right-padding never touches the prompt's last-position logits
+            # (causal mask), so this matches the solo generate prefill
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0].astype(jnp.float32), prompt_len - 1, axis=0,
+                keepdims=False)
+            finite = jnp.isfinite(last).all()
+            proc = process_logits_dynamic(
+                last[None], temp[None], top_k[None], top_p[None],
+                greedy[None])[0]
+            # the first token's key is folded at (prompt_len - 1); decode
+            # step j folds at prompt_len + j — counters never collide
+            sampled = jax.random.categorical(
+                jax.random.fold_in(key, prompt_len - 1), proc)
+            tok = jnp.where(greedy, jnp.argmax(proc, axis=-1),
+                            sampled).astype(jnp.int32)
+            logp = jax.nn.log_softmax(proc)[tok]
+            return tok, logp, finite, new_pools
+
+        return jax.jit(prefill, donate_argnums=self._donate)
+
+    def _build_decode(self):
+        apply_fixed = self._apply
+        poison_armed = self._poison_target is not None
+
+        chunk = self.decode_chunk
+
+        def decode(state, pools, tokens, pos, keys, temp, top_k, top_p,
+                   greedy, poison):
+            self._compiles["decode"] += 1  # trace-count (host side effect)
+            stat_add("STAT_serving_compiles")
+
+            def one(carry, _):
+                tokens, pos, pools = carry
+
+                def row(tok, caches, p):
+                    c = [(k[None], v[None]) for (k, v) in caches]
+                    logits, new = apply_fixed(state, tok[None, None], c, p)
+                    return (logits[0, -1].astype(jnp.float32),
+                            [(k[0], v[0]) for (k, v) in new])
+
+                last, pools = jax.vmap(row)(tokens, pools, pos)
+                if poison_armed:
+                    last = faults.poison_logits(last, poison)
+                finite = jnp.isfinite(last).all(axis=-1)
+
+                # all-greedy fast path: the full dynamic sampling pipeline
+                # (two (S, V) sorts + threefry draw) costs real time per
+                # iteration; a pure-greedy batch — the common serving mix —
+                # skips it at runtime via lax.cond, INSIDE the single
+                # decode trace (no extra program, identical tokens: with
+                # greedy all-True process_logits_dynamic returns the raw
+                # logits, so both branches argmax the same array)
+                def mixed(last):
+                    proc = process_logits_dynamic(last, temp, top_k, top_p,
+                                                  greedy)
+                    folded = jax.vmap(jax.random.fold_in)(keys, pos)
+                    sampled = jax.vmap(jax.random.categorical)(folded, proc)
+                    tok = jnp.where(greedy, jnp.argmax(proc, axis=-1),
+                                    sampled).astype(jnp.int32)
+                    logp = jnp.take_along_axis(
+                        jax.nn.log_softmax(proc, axis=-1), tok[:, None],
+                        axis=-1)[:, 0]
+                    return tok, logp
+
+                def all_greedy(last):
+                    tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+                    logp = jnp.take_along_axis(
+                        jax.nn.log_softmax(last, axis=-1), tok[:, None],
+                        axis=-1)[:, 0]
+                    return tok, logp
+
+                tok, logp = jax.lax.cond(jnp.all(greedy), all_greedy,
+                                         mixed, last)
+                return (tok, pos + 1, pools), (tok, logp, finite)
+
+            # chunked decode: `chunk` iterations per compiled call, the
+            # per-call host+dispatch cost amortized across chunk * slots
+            # tokens.  The final (tokens, pos) carry is exactly the next
+            # call's input while batch membership is unchanged: the engine
+            # feeds the device arrays straight back, so a steady-state
+            # decode call uploads nothing.
+            (tokens, pos, pools), (toks, logps, finites) = jax.lax.scan(
+                one, (tokens, pos, pools), None, length=chunk)
+            return toks, logps, finites, tokens, pos, pools
+
+        return jax.jit(decode, donate_argnums=self._donate)
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int,
+               decode_strategy: str = "greedy_search", temperature=1.0,
+               top_k=0, top_p=1.0, eos_token_id: Optional[int] = None,
+               seed: Optional[int] = None, deadline: Optional[float] = None,
+               block: bool = False, timeout: Optional[float] = None
+               ) -> Response:
+        """Enqueue one request; returns its streaming Response.
+
+        Raises InvalidArgumentError for a prompt/budget the engine can
+        never serve (prompt longer than the largest prefill bucket, or
+        prompt + max_new_tokens past max_len), QueueFullError at
+        max_queue_depth (backpressure).
+        """
+        if self._closed:
+            raise UnavailableError("serving engine is closed")
+        if self._dead is not None:
+            raise UnavailableError(
+                f"serving engine loop died: {self._dead!r}")
+        if decode_strategy not in ("greedy_search", "sampling"):
+            raise InvalidArgumentError(
+                f"serving supports 'greedy_search' or 'sampling', got "
+                f"{decode_strategy!r} (beam search holds k hypotheses per "
+                "slot — use generation.generate)")
+        with self._submit_lock:
+            rid = self._rid
+            self._rid += 1
+        req = Request(rid, prompt, max_new_tokens,
+                      greedy=decode_strategy == "greedy_search",
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      eos_token_id=eos_token_id,
+                      seed=seed if seed is not None else rid,
+                      deadline=deadline)
+        plen = req.prompt.shape[0]
+        if plen > self.buckets[-1]:
+            stat_add("STAT_serving_rejects")
+            raise InvalidArgumentError(
+                f"prompt length {plen} exceeds the largest prefill bucket "
+                f"{self.buckets[-1]} (engine max_len={self.max_len})")
+        if plen + req.max_new_tokens > self.max_len:
+            stat_add("STAT_serving_rejects")
+            raise InvalidArgumentError(
+                f"prompt ({plen}) + max_new_tokens ({req.max_new_tokens}) "
+                f"exceeds the engine's max_len {self.max_len}")
+        if self._poison_target is not None and rid == self._poison_target:
+            req.poison = True
+        resp = Response(req)
+        stat_add("STAT_serving_requests")
+        self.scheduler.submit(req, resp, block=block, timeout=timeout)
+        self._work.set()
+        return resp
+
+    # ------------------------------------------------------------------
+    # the engine loop
+    # ------------------------------------------------------------------
+    def step(self) -> bool:
+        """One engine iteration: sweep deadlines/cancels, admit waiting
+        requests into free slots (one bucketed prefill each), then advance
+        every occupied slot one token with the single decode program.
+        Returns whether any work was done."""
+        did = False
+        self._sweep()
+        self.scheduler.sweep_pending()
+        while True:
+            adm = self.scheduler.next_admission()
+            if adm is None:
+                break
+            self._admit(*adm)
+            did = True
+        if self._slots:
+            self._decode_step()
+            did = True
+        return did
+
+    def _sweep(self):
+        for slot in list(self._slots):
+            run = self._slots[slot]
+            if run.resp.cancelled:
+                stat_add("STAT_serving_cancelled")
+                run.resp._fail(RequestCancelled(
+                    f"request {run.req.id} cancelled mid-decode"))
+                self._release(slot)
+            elif run.req.deadline is not None and run.req.deadline.expired():
+                stat_add("STAT_serving_deadline_expired")
+                run.resp._fail(DeadlineExceededError(
+                    f"request {run.req.id} deadline "
+                    f"({run.req.deadline.seconds}s) expired mid-decode"))
+                self._release(slot)
+
+    def _release(self, slot: int):
+        self._slots.pop(slot, None)
+        self.scheduler.release(slot)
+        self._batch_dirty = True
+
+    def _bucket_for(self, plen: int) -> int:
+        for b in self.buckets:
+            if b >= plen:
+                return b
+        raise InvalidArgumentError(f"no bucket fits prompt length {plen}")
+
+    def _request_key(self, req: Request) -> np.ndarray:
+        # any well-mixed bits work as a raw PRNG key; host-only derivation
+        # keeps submit()/admission free of device round-trips
+        rs = np.random.RandomState(np.uint32(req.seed))
+        return rs.randint(0, 2 ** 32, size=self._key_width, dtype=np.uint64
+                          ).astype(np.uint32)
+
+    def _admit(self, req: Request, resp: Response, slot: int):
+        span = self._span("serving_prefill")
+        try:
+            plen = req.prompt.shape[0]
+            bucket = self._bucket_for(plen)
+            ids = np.full((1, bucket), self.pad_token_id, np.int32)
+            ids[0, :plen] = req.prompt
+            key = self._request_key(req)
+            tok, logp, finite, self._pools = self._prefill_fns[bucket](
+                self._state, self._pools, jnp.asarray(ids),
+                jnp.int32(slot), jnp.int32(plen), jnp.asarray(key),
+                jnp.float32(req.temperature), jnp.int32(req.top_k),
+                jnp.float32(req.top_p), jnp.asarray(req.greedy))
+            stat_add("STAT_serving_prefills")
+            if not bool(finite):
+                self._fail_slot(slot, resp, "prefill")
+                return
+            tok = int(tok)
+            run = _SlotRun(req, resp, pos=plen, first_token=tok, key=key)
+            self._slots[slot] = run
+            self._batch_dirty = True
+            self._emit(run, tok, float(logp))
+            stat_add("STAT_serving_tokens")
+            self._maybe_finish(slot, run, tok)
+        finally:
+            if span is not None:
+                span.__exit__(None, None, None)
+
+    def _rebuild_batch(self):
+        s = self.max_slots
+        tokens = np.zeros((s,), np.int32)
+        pos = np.zeros((s,), np.int32)
+        keys = np.zeros((s, self._key_width), np.uint32)
+        temp = np.ones((s,), np.float32)
+        top_k = np.zeros((s,), np.int32)
+        top_p = np.ones((s,), np.float32)
+        greedy = np.ones((s,), bool)
+        poison = np.zeros((s,), bool)
+        for slot, run in self._slots.items():
+            tokens[slot] = run.last_token
+            pos[slot] = run.pos
+            keys[slot] = run.key
+            temp[slot] = run.req.temperature
+            top_k[slot] = run.req.top_k
+            top_p[slot] = run.req.top_p
+            greedy[slot] = run.req.greedy
+            poison[slot] = run.req.poison
+        self._dev_tokens = jnp.asarray(tokens)
+        self._dev_pos = jnp.asarray(pos)
+        self._dev_params = tuple(jnp.asarray(a) for a in (
+            keys, temp, top_k, top_p, greedy, poison))
+        self._batch_dirty = False
+
+    def _decode_step(self):
+        span = self._span("serving_decode")
+        try:
+            if self._batch_dirty:
+                self._rebuild_batch()
+            keys, temp, top_k, top_p, greedy, poison = self._dev_params
+            toks, logps, finites, ntok, npos, self._pools = self._decode_fn(
+                self._state, self._pools, self._dev_tokens, self._dev_pos,
+                keys, temp, top_k, top_p, greedy, poison)
+            self._dev_tokens, self._dev_pos = ntok, npos
+            # one device->host pull for the whole (chunk, slots) burst
+            toks, logps, finites = jax.device_get((toks, logps, finites))
+            stat_add("STAT_serving_decode_steps")
+            emitted = 0
+            for slot in list(self._slots):
+                run = self._slots[slot]
+                for j in range(toks.shape[0]):
+                    if not finites[j, slot]:
+                        self._fail_slot(slot, run.resp, "decode")
+                        break
+                    t = int(toks[j, slot])
+                    run.pos += 1
+                    run.produced += 1
+                    run.last_token = t
+                    self._emit(run, t, float(logps[j, slot]))
+                    emitted += 1
+                    self._maybe_finish(slot, run, t)
+                    if slot not in self._slots:
+                        # finished mid-chunk: the tail iterations of this
+                        # slot are discarded (their KV garbage dies with
+                        # the slot's next prefill)
+                        break
+            if emitted:
+                stat_add("STAT_serving_tokens", emitted)
+        finally:
+            if span is not None:
+                span.__exit__(None, None, None)
+
+    def _fail_slot(self, slot: int, resp: Response, phase: str):
+        stat_add("STAT_serving_nonfinite")
+        with self._m_lock:
+            self._errored += 1
+        resp._fail(NonFiniteLogitsError(
+            f"request {resp.request.id}: non-finite logits during {phase}; "
+            "slot recycled, engine keeps serving"))
+        self._release(slot)
+
+    def _emit(self, run: _SlotRun, tok: int, logp: float):
+        now = time.monotonic()
+        first = run.resp.first_token_at is None
+        run.resp._push_token(tok, logp)
+        with self._m_lock:
+            self._tokens_out += 1
+            if first:
+                self._ttfts.append(run.resp.ttft)
+            else:
+                self._itl_sum += now - run.last_token_at
+                self._itl_n += 1
+        run.last_token_at = now
+
+    def _maybe_finish(self, slot: int, run: _SlotRun, tok: int):
+        eos = run.req.eos_token_id
+        if eos is not None and tok == eos:
+            reason = "eos"
+        elif run.produced >= run.req.max_new_tokens:
+            reason = "length"
+        else:
+            return
+        with self._m_lock:
+            self._completed += 1
+        run.resp._finish(reason)
+        self._release(slot)
+
+    def _span(self, name: str):
+        if not self._profile:
+            return None
+        from ..utils.profiler import RecordEvent
+        return RecordEvent(name).__enter__()
+
+    # ------------------------------------------------------------------
+    # driving
+    # ------------------------------------------------------------------
+    def has_work(self) -> bool:
+        return bool(self._slots) or self.scheduler.has_work()
+
+    def run_until_drained(self, timeout: Optional[float] = None):
+        """Drive the loop in the caller's thread until queue and slots are
+        empty (tests / batch jobs).  Not for use while start() is live."""
+        t0 = time.monotonic()
+        while self.has_work():
+            self.step()
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                raise TimeoutError("serving engine did not drain in "
+                                   f"{timeout}s")
+
+    def _abort_all(self, make_exc):
+        """Fail every in-flight and queued request (engine death/close):
+        a consumer blocked in Response.__iter__ / tokens() must get an
+        error, never hang."""
+        for slot in list(self._slots):
+            run = self._slots.pop(slot)
+            self.scheduler.release(slot)
+            run.resp._fail(make_exc(run.req))
+        for req, resp in self.scheduler.drain_pending():
+            resp._fail(make_exc(req))
+        self._batch_dirty = True
+
+    def start(self):
+        """Background engine loop (streaming servers / the probe)."""
+        if self._thread is not None:
+            return
+        if self._closed:
+            raise UnavailableError("serving engine is closed")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    did = self.step()
+                except BaseException as e:  # noqa: BLE001 — must not hang
+                    # the loop thread dying silently would leave every
+                    # consumer blocked forever: record the cause, fail all
+                    # outstanding requests, refuse new ones
+                    self._dead = e
+                    self._abort_all(lambda req: UnavailableError(
+                        f"request {req.id} aborted: serving engine loop "
+                        f"died: {e!r}"))
+                    return
+                if not did:
+                    self._work.wait(0.002)
+                    self._work.clear()
+
+        self._thread = threading.Thread(target=loop, name="serving-engine",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        """Stop the loop and fail any still-outstanding requests (a
+        Response consumer must never be left blocked on a closed
+        engine)."""
+        self._closed = True
+        self._stop.set()
+        self._work.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._abort_all(lambda req: RequestCancelled(
+            f"request {req.id} aborted: serving engine closed"))
+
+    def warmup(self):
+        """Compile every program the engine will ever run (one prefill per
+        bucket + the decode step) so no request pays a trace.  Runs dummy
+        data through slot 0; safe any time no request is in flight."""
+        for b in self.buckets:
+            ids = np.full((1, b), self.pad_token_id, np.int32)
+            _, _, _, self._pools = self._prefill_fns[b](
+                self._state, self._pools, jnp.asarray(ids), jnp.int32(0),
+                jnp.int32(1), jnp.asarray(np.zeros(self._key_width,
+                                                   np.uint32)),
+                jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0),
+                jnp.asarray(True))
+        s = self.max_slots
+        _, _, _, _, _, self._pools = self._decode_fn(
+            self._state, self._pools, jnp.zeros((s,), jnp.int32),
+            jnp.zeros((s,), jnp.int32),
+            jnp.zeros((s, self._key_width), jnp.uint32),
+            jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
+            jnp.ones((s,), jnp.float32), jnp.ones((s,), bool),
+            jnp.zeros((s,), bool))
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def compile_counts(self) -> Dict:
+        """Traced-program counts: the ≤ len(buckets) + 1 guarantee."""
+        return {"decode": self._compiles["decode"],
+                "prefill": dict(self._compiles["prefill"]),
+                "total": (self._compiles["decode"]
+                          + sum(self._compiles["prefill"].values())),
+                "bound": len(self.buckets) + 1}
+
+    def metrics(self) -> Dict:
+        """Serving metrics snapshot (also published as STAT_serving_*
+        monitor counters and, under enable_profile, in the profiler
+        report)."""
+        with self._m_lock:
+            ttfts = sorted(self._ttfts)
+            p50 = ttfts[len(ttfts) // 2] if ttfts else None
+            itl = self._itl_sum / self._itl_n if self._itl_n else None
+            elapsed = time.monotonic() - self._started_at
+            return {
+                "requests_completed": self._completed,
+                "requests_errored": self._errored,
+                "tokens_out": self._tokens_out,
+                "tokens_per_sec": (self._tokens_out / elapsed
+                                   if elapsed > 0 else 0.0),
+                "ttft_p50_ms": None if p50 is None else p50 * 1e3,
+                "inter_token_ms": None if itl is None else itl * 1e3,
+                "queue_depth": self.scheduler.queue_depth(),
+                "slot_occupancy": self.scheduler.occupancy(),
+                "max_slots": self.max_slots,
+                "compile_counts": self.compile_counts(),
+            }
+
+    def reset_metrics(self):
+        with self._m_lock:
+            self._ttfts = []
+            self._itl_sum = 0.0
+            self._itl_n = 0
+            self._tokens_out = 0
+            self._completed = 0
+            self._errored = 0
+            self._started_at = time.monotonic()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -28,6 +28,15 @@ Injection points (consumed elsewhere in the framework):
   backend_down    the bench backend probe reports the accelerator tunnel
                   unreachable without waiting out a real timeout.
                   Env: PDTPU_FAULT_BACKEND_DOWN="1".
+  nan_logits      the serving engine's compiled decode step poisons the
+                  logits of the request with submission sequence number N
+                  (0-based) with NaN, exercising the engine's per-slot
+                  non-finite guard: the poisoned request must error and
+                  free its slot while the other slots keep decoding.  The
+                  *presence* of the injection is decided at decode TRACE
+                  time (engine construction), so the production decode
+                  program carries zero overhead; which slot is poisoned is
+                  a dynamic input.  Env: PDTPU_FAULT_NAN_LOGITS="N".
 
 Deliberately import-light (no jax at module scope): DataLoader worker
 processes and the bench orchestrator consult it before any backend exists.
@@ -41,13 +50,15 @@ from typing import Optional, Tuple
 
 __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "poison_grads", "worker_crash_config", "maybe_crash_worker",
-           "maybe_kill_mid_save", "backend_down"]
+           "maybe_kill_mid_save", "backend_down", "nan_logits_request",
+           "poison_logits"]
 
 _ENV = {
     "nan_grads": "PDTPU_FAULT_NAN_GRADS",
     "worker_crash": "PDTPU_FAULT_WORKER_CRASH",
     "kill_mid_save": "PDTPU_FAULT_KILL_MID_SAVE",
     "backend_down": "PDTPU_FAULT_BACKEND_DOWN",
+    "nan_logits": "PDTPU_FAULT_NAN_LOGITS",
 }
 
 _lock = threading.Lock()
@@ -178,6 +189,30 @@ def maybe_kill_mid_save():
         n = _save_counter["n"]
     if n >= int(raw):
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+# -- nan_logits --------------------------------------------------------------
+
+def nan_logits_request() -> Optional[int]:
+    """Submission sequence number (0-based) of the serving request whose
+    decode logits get poisoned, or None when disarmed.  Consulted at decode
+    TRACE time for presence (so the clean decode program has zero fault
+    branches); the engine maps the sequence number to a per-slot poison
+    mask passed as a dynamic input."""
+    raw = get("nan_logits")
+    if not raw:
+        return None
+    return int(raw)
+
+
+def poison_logits(logits, poison_mask):
+    """Multiply each poisoned row of (S, V) logits by NaN (traced; identity
+    rows elsewhere).  Only ever traced into the decode program when
+    nan_logits is armed at engine-construction time."""
+    import jax.numpy as jnp
+    factor = jnp.where(poison_mask, jnp.float32(float("nan")),
+                       jnp.float32(1.0))
+    return logits * factor[:, None]
 
 
 # -- backend_down ------------------------------------------------------------

@@ -16,7 +16,43 @@ import random
 import time
 from typing import Callable, Iterable, Optional, Tuple, Type
 
-__all__ = ["RetryPolicy", "retry_call", "RetriesExhausted"]
+__all__ = ["RetryPolicy", "retry_call", "RetriesExhausted", "Deadline"]
+
+
+class Deadline:
+    """A wall-clock budget anchored at creation time.
+
+    The deadline RetryPolicy.call enforces across attempts, factored out
+    so other host services (the serving RequestScheduler's per-request
+    deadlines, cancellation sweeps) count down against the same clock
+    object instead of re-deriving `start + budget` arithmetic per call
+    site.  `seconds=None` means unbounded (never expires).
+    """
+
+    __slots__ = ("seconds", "_t0", "_clock")
+
+    def __init__(self, seconds: Optional[float] = None,
+                 _clock: Callable[[], float] = time.monotonic):
+        self.seconds = None if seconds is None else float(seconds)
+        self._clock = _clock
+        self._t0 = _clock()
+
+    def elapsed(self) -> float:
+        return self._clock() - self._t0
+
+    def remaining(self) -> Optional[float]:
+        """Seconds left, or None when unbounded.  Never negative."""
+        if self.seconds is None:
+            return None
+        return max(0.0, self.seconds - self.elapsed())
+
+    def expired(self) -> bool:
+        return self.seconds is not None and self.elapsed() >= self.seconds
+
+    def __repr__(self):
+        if self.seconds is None:
+            return "Deadline(unbounded)"
+        return f"Deadline({self.seconds}s, remaining={self.remaining():.3f}s)"
 
 
 class RetriesExhausted(Exception):
@@ -72,7 +108,7 @@ class RetryPolicy:
     def call(self, fn: Callable, *args, **kwargs):
         """Run fn until it succeeds, a non-retryable error escapes, the
         attempt budget empties, or the deadline passes."""
-        start = time.monotonic()
+        dl = Deadline(self.deadline)
         attempt = 0
         delays = iter(self.delays())
         while True:
@@ -91,8 +127,9 @@ class RetryPolicy:
                         e, attempt) from e
                 if self.jitter:
                     delay += random.uniform(0.0, self.jitter * delay)
-                if (self.deadline is not None
-                        and time.monotonic() - start + delay > self.deadline):
+                remaining = dl.remaining()
+                if remaining is not None and (dl.expired()
+                                              or delay > remaining):
                     raise RetriesExhausted(
                         f"{getattr(fn, '__name__', fn)!s} exceeded the "
                         f"{self.deadline}s retry deadline after {attempt} "

@@ -68,6 +68,7 @@ _SLOW = {
     "test_sequence_concat_and_enumerate_and_expand",
     # round-3 additions over ~5s (grad sweeps / scan-compile heavy)
     "test_yolo_loss_grad_flows", "test_generate_greedy_matches_eager_argmax",
+    "test_generate_all_finished_early_exit_parity",
     "test_generate_beam_matches_numpy_oracle",
     "test_deform_conv2d_grads_numeric", "test_bert_forward_shapes",
     "test_generate_topk1_matches_greedy_and_seeded_sampling_reproducible",

@@ -83,6 +83,44 @@ def test_generate_eos_padding_and_score():
     assert got[0] == first and (got[1:] == 0).all()
 
 
+def test_generate_all_finished_early_exit_parity():
+    """The scan body skips the model call via lax.cond once every row is
+    finished (short completions inside a long max_new_tokens budget stop
+    paying decode FLOPs).  Output contract is unchanged: same tokens, pad
+    after eos, same scores as a small-budget run of the same prompt."""
+    model = tiny_gpt()
+    prompt = np.array([[1, 2, 3], [4, 5, 6]], dtype="int32")
+    # eos = each row's greedy first token => all rows finished after step 1;
+    # rows disagree, so pick row 0's and let row 1 run to its own eos/pad
+    first = int(eager_logits(model, prompt).argmax(-1)[0])
+    out, scores = model.generate(paddle.to_tensor(prompt),
+                                 max_new_tokens=24, eos_token_id=first,
+                                 pad_token_id=0)
+    got = np.asarray(unwrap(out))
+    # oracle: step the eager forward until every row has hit eos
+    seq = prompt.copy()
+    want = np.zeros_like(got)
+    finished = np.zeros(2, bool)
+    for t in range(24):
+        nxt = eager_logits(model, seq).argmax(-1)
+        nxt = np.where(finished, 0, nxt)
+        want[:, t] = nxt
+        finished |= nxt == first
+        seq = np.concatenate([seq, nxt[:, None].astype("int32")], axis=1)
+        if finished.all():
+            break
+    assert (got == want).all(), (got, want)
+    # the finished rows' scores stop accumulating after their eos
+    short_out, short_scores = model.generate(
+        paddle.to_tensor(prompt), max_new_tokens=4, eos_token_id=first,
+        pad_token_id=0)
+    if bool((np.asarray(unwrap(short_out)) == got[:, :4]).all()) and \
+            finished.all():
+        np.testing.assert_allclose(np.asarray(unwrap(scores)),
+                                   np.asarray(unwrap(short_scores)),
+                                   rtol=1e-5, atol=1e-6)
+
+
 def test_generate_topk1_matches_greedy_and_seeded_sampling_reproducible():
     model = tiny_gpt()
     prompt = np.array([[3, 1], [2, 5]], dtype="int32")
@@ -103,6 +141,39 @@ def test_top_p_filter_keeps_nucleus():
     out = np.asarray(apply_top_p(logits, 0.7))
     assert np.isfinite(out[0, 0]) and np.isfinite(out[0, 1])
     assert out[0, 2] <= -1e8 and out[0, 3] <= -1e8
+
+
+def test_dynamic_sampling_helpers_match_static():
+    """The per-row traced variants (the serving decode step's shared-trace
+    path) must agree with the static helpers row by row, including the
+    k=0 / p=1 disabled encodings."""
+    from paddle_tpu.generation import (apply_top_k, apply_top_p,
+                                       apply_top_k_dynamic,
+                                       apply_top_p_dynamic,
+                                       process_logits_dynamic,
+                                       _process_logits)
+    rng = np.random.RandomState(0)
+    logits = jnp.asarray(rng.randn(4, 9).astype("float32"))
+    for k in (0, 1, 3, 9):
+        dyn = apply_top_k_dynamic(logits, jnp.full((4,), k, jnp.int32))
+        np.testing.assert_allclose(np.asarray(dyn),
+                                   np.asarray(apply_top_k(logits, k)))
+    for p in (0.3, 0.7, 1.0):
+        dyn = apply_top_p_dynamic(logits, jnp.full((4,), p, jnp.float32))
+        np.testing.assert_allclose(np.asarray(dyn),
+                                   np.asarray(apply_top_p(logits, p)))
+    # per-row heterogeneity: each row filtered under its own params
+    temp = jnp.array([1.0, 0.7, 1.3, 1.0], jnp.float32)
+    top_k = jnp.array([0, 3, 0, 2], jnp.int32)
+    top_p = jnp.array([1.0, 1.0, 0.8, 0.9], jnp.float32)
+    greedy = jnp.array([True, False, False, False])
+    out = np.asarray(process_logits_dynamic(logits, temp, top_k, top_p,
+                                            greedy))
+    np.testing.assert_allclose(out[0], np.asarray(logits)[0])  # greedy raw
+    for i in (1, 2, 3):
+        want = _process_logits(logits[i:i + 1], float(temp[i]),
+                               int(top_k[i]), float(top_p[i]), False)
+        np.testing.assert_allclose(out[i], np.asarray(want)[0], rtol=1e-6)
 
 
 def _numpy_beam(model, prompt, k, max_new, eos, pad):

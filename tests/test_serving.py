@@ -1,0 +1,509 @@
+"""Continuous-batching serving engine (paddle_tpu.serving).
+
+Covers the ISSUE-4 contracts: greedy streams bit-identical to solo
+`generation.generate`, compilation bounded by len(prefill_buckets) + 1
+regardless of traffic heterogeneity, scheduler edge cases (queue-full
+backpressure, deadline expiry mid-decode, cancel before prefill, slot
+recycling with no stale KV), per-request fault isolation
+(PDTPU_FAULT_NAN_LOGITS), and the inference.Config serving mode."""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.nn.layer.common import Embedding
+from paddle_tpu.serving import (ServingEngine, QueueFullError,
+                                DeadlineExceededError, RequestCancelled,
+                                NonFiniteLogitsError)
+from paddle_tpu.utils import faults
+from paddle_tpu.utils.monitor import stat_get
+
+pytestmark = pytest.mark.serving
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class ProtocolModel(Layer):
+    """Minimal gen_fixed_cache/forward_fixed protocol model: logits are an
+    embedding of the current token (deterministic greedy cycles), the KV
+    "cache" is a ones-marker per written position — cheap to compile, and
+    stale-KV leaks are directly visible in the pool."""
+
+    def __init__(self, vocab=24):
+        super().__init__()
+        self.emb = Embedding(vocab, vocab)
+
+    def gen_fixed_cache(self, batch_size, max_length, dtype=None):
+        import jax.numpy as jnp
+        dt = dtype or jnp.float32
+        return [(jnp.zeros((batch_size, max_length, 1, 2), dt),
+                 jnp.zeros((batch_size, max_length, 1, 2), dt))]
+
+    def forward_fixed(self, input_ids, caches, pos):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import unwrap
+        ids = unwrap(input_ids)
+        p = unwrap(pos)
+        b, s = ids.shape
+        logits = unwrap(self.emb(input_ids)).astype(jnp.float32)
+        k, v = caches[0]
+        chunk = jnp.ones((b, s, 1, 2), k.dtype)
+        k = jax.lax.dynamic_update_slice(k, chunk, (0, p, 0, 0))
+        v = jax.lax.dynamic_update_slice(v, chunk, (0, p, 0, 0))
+        return logits, [(k, v)]
+
+
+def tiny_gpt():
+    cfg = models.GPTConfig(vocab_size=13, hidden_size=16,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0,
+                           max_position_embeddings=64)
+    paddle.seed(7)
+    m = models.GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def solo(model, prompt, max_new, **kw):
+    out, _ = model.generate(paddle.to_tensor(
+        np.asarray(prompt, np.int32)[None]), max_new_tokens=max_new, **kw)
+    return np.asarray(out.numpy())[0].tolist()
+
+
+def expected_stream(solo_tokens, eos):
+    """Engine streams stop at eos (inclusive); solo pads after it."""
+    if eos is not None and eos in solo_tokens:
+        return solo_tokens[:solo_tokens.index(eos) + 1]
+    return solo_tokens
+
+
+@pytest.fixture(scope="module")
+def gpt_engine():
+    m = tiny_gpt()
+    eng = ServingEngine(m, max_slots=3, max_len=48, prefill_buckets=(8, 16),
+                        decode_chunk=4, max_queue_depth=64)
+    eng.warmup()
+    return m, eng
+
+
+@pytest.fixture(scope="module")
+def stub_engine():
+    paddle.seed(3)
+    m = ProtocolModel()
+    m.eval()
+    eng = ServingEngine(m, max_slots=2, max_len=32, prefill_buckets=(8,),
+                        decode_chunk=2, max_queue_depth=64)
+    eng.warmup()
+    return m, eng
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: greedy parity with solo generate (<= 3 requests, tiny GPT)
+# ---------------------------------------------------------------------------
+
+def test_serving_smoke_greedy_parity(gpt_engine):
+    model, eng = gpt_engine
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 13, (n,)) for n in (4, 7, 11)]
+    resps = [eng.submit(p, max_new_tokens=6) for p in prompts]
+    eng.run_until_drained(timeout=120)
+    for p, r in zip(prompts, resps):
+        assert r.tokens(timeout=5) == solo(model, p, 6)
+        assert r.finish_reason == "length"
+        assert r.ttft is not None and r.ttft >= 0
+
+
+def test_serving_eos_stops_stream_and_frees_slot(gpt_engine):
+    model, eng = gpt_engine
+    prompt = [1, 2, 3]
+    toks = solo(model, prompt, 6)
+    eos = toks[2]  # force a mid-stream eos
+    r = eng.submit(prompt, max_new_tokens=6, eos_token_id=eos)
+    eng.run_until_drained(timeout=120)
+    assert r.tokens() == expected_stream(toks, eos)
+    assert r.finish_reason == "eos"
+    assert eng.scheduler.free_slot_count() == eng.max_slots
+
+
+def test_slot_reuse_keeps_no_stale_kv_gpt(gpt_engine):
+    """A short request admitted into a slot that previously held a longer
+    one must decode exactly like a solo run (stale KV beyond the new
+    prompt would poison its attention)."""
+    model, eng = gpt_engine
+    rng = np.random.RandomState(5)
+    long_p = rng.randint(0, 13, (12,))
+    [eng.submit(long_p, max_new_tokens=20) for _ in range(eng.max_slots)]
+    eng.run_until_drained(timeout=120)
+    short_p = rng.randint(0, 13, (4,))
+    rs = [eng.submit(short_p, max_new_tokens=5)
+          for _ in range(eng.max_slots)]
+    eng.run_until_drained(timeout=120)
+    want = solo(model, short_p, 5)
+    for r in rs:
+        assert r.tokens() == want
+
+
+def test_prefill_overwrites_full_slot_range():
+    """Direct pool proof: after a long tenant, a bucket-8 prefill zeroes
+    the slot's whole [bucket, max_len) tail."""
+    paddle.seed(3)
+    m = ProtocolModel()
+    m.eval()
+    eng = ServingEngine(m, max_slots=1, max_len=32, prefill_buckets=(8,),
+                        decode_chunk=2)
+    r = eng.submit(np.arange(6), max_new_tokens=20)  # writes up to pos ~26
+    eng.run_until_drained(timeout=60)
+    assert r.done()
+    assert np.any(np.asarray(eng._pools[0][0])[0, 8:] != 0), \
+        "sanity: the long tenant must have left KV beyond the bucket"
+    # max_new=1 finishes at prefill: no decode write after the overwrite
+    r2 = eng.submit(np.arange(4), max_new_tokens=1)
+    eng.run_until_drained(timeout=60)
+    assert r2.done()
+    k = np.asarray(eng._pools[0][0])
+    assert np.all(k[0, :8] == 1), "prefill chunk written"
+    assert np.all(k[0, 8:] == 0), "tail beyond the bucket must be scrubbed"
+
+
+# ---------------------------------------------------------------------------
+# compile-count bound + heterogeneity retraces nothing
+# ---------------------------------------------------------------------------
+
+def test_compile_bound_over_heterogeneous_traffic(stub_engine):
+    """>= 20 requests, >= 4 distinct (prompt_len, max_new, sampling-param)
+    combos: at most len(prefill_buckets) + 1 compiled programs, and the
+    jit/dispatch cache-miss counters stay flat across the mixed steps."""
+    from paddle_tpu.core import op as core_op
+    _, eng = stub_engine
+    combos = [
+        dict(max_new_tokens=3),
+        dict(max_new_tokens=5, decode_strategy="sampling",
+             temperature=0.7, seed=1),
+        dict(max_new_tokens=4, decode_strategy="sampling", top_k=3, seed=2),
+        dict(max_new_tokens=6, decode_strategy="sampling", top_p=0.8,
+             temperature=1.3, seed=3),
+        dict(max_new_tokens=3, decode_strategy="sampling", top_k=5,
+             top_p=0.9, seed=4),
+    ]
+    rng = np.random.RandomState(0)
+    before = eng.compile_counts()
+    disp_before = core_op.dispatch_cache_stats()["misses"]
+    resps = []
+    for i in range(22):
+        plen = int(rng.randint(2, 8))
+        resps.append(eng.submit(rng.randint(0, 24, (plen,)),
+                                **combos[i % len(combos)]))
+        eng.step()
+    eng.run_until_drained(timeout=120)
+    for r in resps:
+        assert r.done() and r.error is None
+    after = eng.compile_counts()
+    assert after == before, "mixed sampling params must not retrace"
+    assert after["total"] <= after["bound"] == len(eng.buckets) + 1
+    assert core_op.dispatch_cache_stats()["misses"] == disp_before
+
+
+def test_sampling_deterministic_per_seed(stub_engine):
+    _, eng = stub_engine
+    kw = dict(max_new_tokens=5, decode_strategy="sampling", top_k=4, seed=9)
+    a = eng.submit([1, 2, 3], **kw)
+    eng.run_until_drained(timeout=60)
+    b = eng.submit([1, 2, 3], **kw)
+    eng.run_until_drained(timeout=60)
+    assert a.tokens() == b.tokens()
+
+
+# ---------------------------------------------------------------------------
+# scheduler edge cases
+# ---------------------------------------------------------------------------
+
+def test_queue_full_rejection_backpressure():
+    paddle.seed(3)
+    m = ProtocolModel()
+    m.eval()
+    eng = ServingEngine(m, max_slots=1, max_len=16, prefill_buckets=(8,),
+                        max_queue_depth=2)
+    rejects0 = stat_get("STAT_serving_rejects")
+    eng.submit([1, 2], max_new_tokens=3)
+    eng.submit([1, 2], max_new_tokens=3)
+    with pytest.raises(QueueFullError):
+        eng.submit([1, 2], max_new_tokens=3)
+    assert stat_get("STAT_serving_rejects") == rejects0 + 1
+    eng.run_until_drained(timeout=60)  # the queued two still complete
+    assert eng.scheduler.queue_depth() == 0
+
+
+def test_deadline_expiry_mid_decode_frees_slot(stub_engine):
+    _, eng = stub_engine
+    r = eng.submit(np.arange(4), max_new_tokens=25, deadline=0.03)
+    eng.step()  # prefill + first decode chunk
+    assert eng.scheduler.occupancy() == 1
+    time.sleep(0.05)
+    eng.step()  # sweep notices the expired deadline
+    with pytest.raises(DeadlineExceededError):
+        r.tokens(timeout=5)
+    assert r.finish_reason == "error"
+    assert eng.scheduler.occupancy() == 0
+    assert eng.scheduler.free_slot_count() == eng.max_slots
+
+
+def test_deadline_expiry_while_queued(stub_engine):
+    _, eng = stub_engine
+    r = eng.submit(np.arange(4), max_new_tokens=5, deadline=0.01)
+    time.sleep(0.03)
+    eng.step()
+    with pytest.raises(DeadlineExceededError):
+        r.tokens(timeout=5)
+
+
+def test_cancel_before_prefill(stub_engine):
+    _, eng = stub_engine
+    prefills0 = stat_get("STAT_serving_prefills")
+    r = eng.submit(np.arange(4), max_new_tokens=5)
+    r.cancel()
+    eng.step()
+    with pytest.raises(RequestCancelled):
+        r.tokens(timeout=5)
+    assert stat_get("STAT_serving_prefills") == prefills0, \
+        "cancelled-before-prefill must never reach the device"
+    assert eng.scheduler.free_slot_count() == eng.max_slots
+
+
+def test_cancel_mid_decode_recycles_slot(stub_engine):
+    _, eng = stub_engine
+    r = eng.submit(np.arange(4), max_new_tokens=25)
+    eng.step()
+    assert len(r.tokens_so_far()) >= 1
+    r.cancel()
+    eng.step()
+    with pytest.raises(RequestCancelled):
+        r.tokens(timeout=5)
+    assert eng.scheduler.free_slot_count() == eng.max_slots
+
+
+# ---------------------------------------------------------------------------
+# per-request fault handling
+# ---------------------------------------------------------------------------
+
+def test_oversize_requests_rejected_individually(stub_engine):
+    _, eng = stub_engine
+    with pytest.raises(InvalidArgumentError):
+        eng.submit(np.arange(9), max_new_tokens=2)  # > largest bucket (8)
+    with pytest.raises(InvalidArgumentError):
+        eng.submit(np.arange(4), max_new_tokens=40)  # 4 + 40 > max_len 32
+    r = eng.submit(np.arange(4), max_new_tokens=3)  # engine keeps serving
+    eng.run_until_drained(timeout=60)
+    assert r.error is None and len(r.tokens()) == 3
+
+
+@pytest.mark.faults
+def test_nan_logits_poisons_one_request_not_the_batch():
+    """PDTPU_FAULT_NAN_LOGITS=N: request N's decode logits go NaN — it must
+    error individually, its slot recycled, every other slot unharmed."""
+    paddle.seed(3)
+    m = ProtocolModel()
+    m.eval()
+    faults.enable("nan_logits", "1")
+    try:
+        eng = ServingEngine(m, max_slots=3, max_len=32, prefill_buckets=(8,),
+                            decode_chunk=2)
+        r0 = eng.submit(np.arange(4), max_new_tokens=6)
+        r1 = eng.submit(np.arange(4), max_new_tokens=6)  # seq 1: poisoned
+        r2 = eng.submit(np.arange(4), max_new_tokens=6)
+        eng.run_until_drained(timeout=120)
+    finally:
+        faults.reset()
+    with pytest.raises(NonFiniteLogitsError):
+        r1.tokens(timeout=5)
+    assert r0.tokens() == r2.tokens() and len(r0.tokens()) == 6
+    assert eng.scheduler.free_slot_count() == eng.max_slots
+    assert eng.metrics()["requests_errored"] == 1
+    assert eng.metrics()["requests_completed"] == 2
+
+
+def test_clean_engine_has_no_poison_branch(stub_engine):
+    """Without the fault armed the decode trace must carry zero fault
+    code (presence is decided at engine-construction trace time)."""
+    _, eng = stub_engine
+    assert eng._poison_target is None
+
+
+# ---------------------------------------------------------------------------
+# background loop + streaming
+# ---------------------------------------------------------------------------
+
+def test_streaming_iterator_with_background_loop():
+    paddle.seed(3)
+    m = ProtocolModel()
+    m.eval()
+    eng = ServingEngine(m, max_slots=2, max_len=32, prefill_buckets=(8,),
+                        decode_chunk=2)
+    eng.warmup()
+    with eng:
+        eng.start()
+        r = eng.submit(np.arange(5), max_new_tokens=7)
+        streamed = list(r)
+        assert len(streamed) == 7
+        assert streamed == r.tokens(timeout=5)
+        assert r.ttft is not None
+        met = eng.metrics()
+        assert met["tokens_out"] >= 7
+        assert met["ttft_p50_ms"] is not None
+
+
+def test_engine_loop_death_fails_requests_instead_of_hanging():
+    """A crash inside the background loop must error every outstanding
+    response and make further submits refuse — never leave a consumer
+    blocked in tokens()/iteration forever."""
+    from paddle_tpu.core.errors import UnavailableError
+    paddle.seed(3)
+    m = ProtocolModel()
+    m.eval()
+    eng = ServingEngine(m, max_slots=2, max_len=32, prefill_buckets=(8,),
+                        decode_chunk=2)
+    eng.warmup()
+
+    def boom(*a, **k):
+        raise RuntimeError("injected decode crash")
+
+    eng._decode_fn = boom
+    eng.start()
+    r = eng.submit(np.arange(4), max_new_tokens=9)
+    with pytest.raises(UnavailableError, match="injected decode crash"):
+        r.tokens(timeout=10)
+    # the engine refuses new work with the recorded cause
+    with pytest.raises(UnavailableError, match="died"):
+        eng.submit(np.arange(4), max_new_tokens=2)
+    eng.close()
+
+
+def test_close_fails_outstanding_requests_instead_of_hanging():
+    paddle.seed(3)
+    m = ProtocolModel()
+    m.eval()
+    eng = ServingEngine(m, max_slots=1, max_len=32, prefill_buckets=(8,),
+                        decode_chunk=2)
+    active = eng.submit(np.arange(4), max_new_tokens=20)
+    queued = eng.submit(np.arange(4), max_new_tokens=20)
+    eng.step()  # `active` holds the slot mid-decode, `queued` waits
+    eng.close()
+    for r in (active, queued):
+        with pytest.raises(RequestCancelled, match="engine closed"):
+            r.tokens(timeout=10)
+    from paddle_tpu.core.errors import UnavailableError
+    with pytest.raises(UnavailableError, match="closed"):
+        eng.submit(np.arange(2), max_new_tokens=2)
+
+
+# ---------------------------------------------------------------------------
+# inference.Config serving mode
+# ---------------------------------------------------------------------------
+
+def test_serving_predictor_in_memory_and_profile_report():
+    from paddle_tpu.inference import Config, create_predictor
+    model = tiny_gpt()
+    cfg = Config()
+    cfg.enable_serving(model=model, max_slots=2, max_len=48,
+                       prefill_buckets=(8,), decode_chunk=2, start=False)
+    cfg.enable_profile()
+    cfg.set_cpu_math_library_num_threads(3)
+    pred = create_predictor(cfg)
+    try:
+        prompt = [1, 2, 3, 4]
+        r = pred.submit(prompt, max_new_tokens=5)
+        pred.engine.run_until_drained(timeout=120)
+        assert r.tokens() == solo(model, prompt, 5)
+        rep = pred.profile_report()
+        # the accepted-but-recorded knobs surface next to serving metrics
+        assert rep["config"]["threads"] == 3
+        assert rep["config"]["ir_optim"] is True
+        assert rep["config"]["memory_optim"] is False
+        assert rep["serving"]["requests_completed"] >= 1
+        assert rep["serving"]["compile_counts"]["total"] <= 2
+        assert any(k.startswith("STAT_serving_") for k in rep["stats"])
+        assert "serving=True" in cfg.summary()
+    finally:
+        pred.close()
+
+
+def test_serving_predictor_from_artifact(tmp_path):
+    """model_provider + jit.save artifact: weights restored, streams match
+    the in-memory model."""
+    from paddle_tpu.inference import Config, create_predictor
+    model = tiny_gpt()
+    path = str(tmp_path / "gpt_srv")
+    paddle.jit.save(model, path)  # weights-only artifact is enough
+    cfg = Config()
+    cfg.set_model(path)
+    cfg.enable_serving(model_provider=tiny_gpt, max_slots=2, max_len=48,
+                       prefill_buckets=(8,), decode_chunk=2, start=False,
+                       warmup=False)
+    pred = create_predictor(cfg)
+    try:
+        r = pred.submit([3, 1, 4], max_new_tokens=4)
+        pred.engine.run_until_drained(timeout=120)
+        assert r.tokens() == solo(model, [3, 1, 4], 4)
+    finally:
+        pred.close()
+
+
+def test_enable_serving_validates_arguments():
+    from paddle_tpu.inference import Config
+    cfg = Config()
+    with pytest.raises(ValueError):
+        cfg.enable_serving()
+    with pytest.raises(ValueError):
+        cfg.enable_serving(model=object(), model_provider=lambda: None)
+
+
+def test_one_shot_predictor_profile_report(tmp_path):
+    from paddle_tpu.inference import Config, create_predictor
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 4))
+    net.eval()
+    path = str(tmp_path / "oneshot")
+    paddle.jit.save(net, path, input_spec=[
+        paddle.static.InputSpec([2, 8], "float32")])
+    cfg = Config(path)
+    cfg.enable_memory_optim()
+    pred = create_predictor(cfg)
+    h = pred.get_input_handle("x0")
+    h.copy_from_cpu(np.zeros((2, 8), np.float32))
+    pred.run()
+    rep = pred.profile_report()
+    assert rep["config"]["memory_optim"] is True
+    assert rep["stats"].get("STAT_predictor_runs", 0) >= 1
+    assert "serving" not in rep
+
+
+# ---------------------------------------------------------------------------
+# probe smoke (fresh interpreter: slow tier)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serving_probe_smoke():
+    import json
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "probes", "serving_probe.py"),
+         "--steps", "3"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=_REPO)
+    assert proc.returncode == 0, (proc.stderr or proc.stdout)[-800:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("SERVE")]
+    assert lines, proc.stdout[-400:]
+    out = json.loads(lines[-1][len("SERVE"):])
+    assert out["smoke"] is True
+    assert "failures" not in out, out.get("failures")
+    assert out["compile_counts"]["total"] <= out["compile_counts"]["bound"]
+    assert out["metrics"]["requests_completed"] == 3
