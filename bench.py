@@ -784,6 +784,33 @@ def measure_serving():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_observability():
+    """ISSUE-5 acceptance artifact: probes/observability_probe.py in a
+    clean CPU subprocess.  Publishes the measured instrumentation overhead
+    (full tracer-backed span recording on every eager dispatch; bar < 3%
+    of eager MLP steps/sec) and the 10k-span chrome-trace + Prometheus
+    export timings as `detail.observability.{overhead_pct,export_ms}`."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes",
+                                      "observability_probe.py"),
+         "--steps", os.environ.get("PDTPU_OBS_PROBE_STEPS", "300")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("OBS"):
+            rec = json.loads(line[len("OBS"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"observability bars failed: "
+                                 f"{rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return rec
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_mnist_eager():
     """BASELINE config #1: LeNet, EAGER per-op dispatch, single device —
     the CPU-baseline parity check (runs in a CPU subprocess; eager per-op
@@ -1023,6 +1050,7 @@ def main():
                          ("eager_dispatch", measure_eager_dispatch),
                          ("serving", measure_serving),
                          ("resilience", measure_resilience),
+                         ("observability", measure_observability),
                          ("pipeline", measure_pipeline_ratio)):
             try:
                 detail[name] = fn()

@@ -66,6 +66,7 @@ from . import quantization  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import text  # noqa: F401
 from . import inference  # noqa: F401
+from . import observability  # noqa: F401
 from . import onnx  # noqa: F401
 from .nn.layer_base import ParamAttr  # noqa: F401
 from .distributed.parallel_layer import DataParallel  # noqa: F401

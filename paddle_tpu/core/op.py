@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import os
 import sys
+import time
 import types
 from collections import OrderedDict
 from functools import partial as _fn_partial
@@ -573,6 +574,7 @@ def _dispatch_fast(name, raw_fn, flat, treedef, tag_out):
         _stats["hits"] += 1
         return _run_entry(entry, name, raw_fn, flat, tag_out)
     _stats["misses"] += 1
+    t_compile = time.perf_counter()
     entry = _make_entry(name, raw_fn, flat, treedef, dyn_leaf_pos,
                         dyn_cell_pos, diff_pos, tensor_pos)
     try:
@@ -595,10 +597,56 @@ def _dispatch_fast(name, raw_fn, flat, treedef, tag_out):
         result = _MISS
     else:
         _cache[key] = entry
+        # miss = trace+compile+first run; compile dominates — record it in
+        # the compiled-program registry (no extra lowering: per-op cost
+        # analysis would double-compile every eager signature)
+        _note_compile(name, time.perf_counter() - t_compile)
     if len(_cache) > _cache_max:  # bound holds for _FALLBACK verdicts too
         _cache.popitem(last=False)
         _stats["evictions"] += 1
     return result
+
+
+def _note_compile(name, seconds):
+    """Report a dispatch-cache miss compile to the observability program
+    registry (best-effort: telemetry must never break dispatch)."""
+    try:
+        from ..observability.programs import note_compile
+        note_compile("dispatch:" + name, seconds)
+    except Exception:
+        pass
+
+
+def _dispatch_cache_collector():
+    """Surface the hot-path cache dict in the metrics registry at scrape
+    time — the counters 'move into the registry' without dispatch paying a
+    registry lock per op."""
+    s = dispatch_cache_stats()
+    total = s["hits"] + s["misses"]
+    return [
+        {"name": "dispatch_cache_hits_total", "kind": "counter",
+         "value": s["hits"], "help": "eager dispatch fast-path cache hits"},
+        {"name": "dispatch_cache_misses_total", "kind": "counter",
+         "value": s["misses"], "help": "eager dispatch fast-path misses"},
+        {"name": "dispatch_cache_fallbacks_total", "kind": "counter",
+         "value": s["fallbacks"], "help": "signatures not jit-safe"},
+        {"name": "dispatch_cache_bypass_total", "kind": "counter",
+         "value": s["bypass"], "help": "dispatches that bypassed the cache"},
+        {"name": "dispatch_cache_evictions_total", "kind": "counter",
+         "value": s["evictions"], "help": "LRU evictions"},
+        {"name": "dispatch_cache_entries", "kind": "gauge",
+         "value": s["entries"], "help": "live cache entries"},
+        {"name": "dispatch_cache_hit_rate", "kind": "gauge",
+         "value": (s["hits"] / total) if total else 0.0,
+         "help": "hits / (hits + misses)"},
+    ]
+
+
+try:
+    from ..observability.metrics import get_registry as _obs_get_registry
+    _obs_get_registry().register_collector(_dispatch_cache_collector)
+except Exception:  # observability must never gate the op system
+    pass
 
 
 # ---------------------------------------------------------------------------

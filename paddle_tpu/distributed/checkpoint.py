@@ -40,6 +40,26 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+_obs_handles = None
+
+
+def _obs():
+    """(save_stall_histogram, async_in_flight_gauge) — observability
+    handles, created once (registry.reset() zeroes in place)."""
+    global _obs_handles
+    if _obs_handles is None:
+        from ..observability import metrics as _m
+        _obs_handles = (
+            _m.histogram("checkpoint_save_stall_seconds",
+                         "wall time the training thread was stalled by a "
+                         "checkpoint save (sync: full write; async: "
+                         "snapshot + enqueue)"),
+            _m.gauge("checkpoint_async_in_flight",
+                     "snapshots queued or being written by the async "
+                     "checkpoint writer"))
+    return _obs_handles
+
+
 # -- PartitionSpec (de)serialization ----------------------------------------
 
 def _spec_to_json(spec) -> list:
@@ -148,6 +168,17 @@ def publish_snapshot(directory: str, manifest: dict, shards: dict) -> str:
     dir, atomically rename into place, fsync the parent dir, update
     `latest`.  Runs on the caller thread (sync save) or the
     AsyncCheckpointManager's writer thread."""
+    from ..observability import span as _span
+    from ..utils.monitor import stat_add
+    step = manifest["step"]
+    with _span("checkpoint_publish", args={"step": step}):
+        stat_add("STAT_checkpoint_bytes_written",
+                 sum(a.nbytes for a in shards.values()))
+        return _publish_snapshot_inner(directory, manifest, shards)
+
+
+def _publish_snapshot_inner(directory: str, manifest: dict,
+                            shards: dict) -> str:
     from ..utils import faults as _faults
     step = manifest["step"]
     step_dir = os.path.join(directory, f"step-{step:09d}")
@@ -186,10 +217,20 @@ def save_sharded(state_tree, directory: str, step: int = 0,
     No host gather: each process saves only shards with replica_id == 0 among
     its addressable shards.  Returns the final step directory path.
     """
-    manifest, shards = snapshot_tree(state_tree, step, extra_meta)
-    pidx = jax.process_index()
-    if jax.process_count() == 1:
-        return publish_snapshot(directory, manifest, shards)
+    stall_h, _ = _obs()
+    with stall_h.time():
+        manifest, shards = snapshot_tree(state_tree, step, extra_meta)
+        pidx = jax.process_index()
+        if jax.process_count() == 1:
+            return publish_snapshot(directory, manifest, shards)
+        return _save_sharded_multi(state_tree, directory, step, manifest,
+                                   shards, pidx)
+
+
+def _save_sharded_multi(state_tree, directory, step, manifest, shards, pidx):
+    from ..utils.monitor import stat_add
+    stat_add("STAT_checkpoint_bytes_written",
+             sum(a.nbytes for a in shards.values()))
 
     step_dir = os.path.join(directory, f"step-{step:09d}")
     tmp_dir = step_dir + f".tmp-p{pidx:05d}"
@@ -613,6 +654,7 @@ class AsyncCheckpointManager(CheckpointManager):
                 with self._cv:
                     self._write_started = None
                     self._outstanding -= 1
+                    _obs()[1].set(self._outstanding)
                     self._cv.notify_all()
 
     def _raise_pending(self):
@@ -643,9 +685,12 @@ class AsyncCheckpointManager(CheckpointManager):
             raise RuntimeError("AsyncCheckpointManager is closed")
         from ..utils.monitor import stat_add
         stat_add("STAT_checkpoint_saves")
+        stall_h, inflight_g = _obs()
+        t0 = time.perf_counter()
         manifest, shards = snapshot_tree(state_tree, step, extra_meta)
         with self._cv:
             self._outstanding += 1
+            inflight_g.set(self._outstanding)
         while True:
             try:
                 # bounded put, re-checking the watchdog while blocked: a
@@ -659,8 +704,12 @@ class AsyncCheckpointManager(CheckpointManager):
                 except BaseException:
                     with self._cv:
                         self._outstanding -= 1
+                        inflight_g.set(self._outstanding)
                         self._cv.notify_all()
                     raise
+        # the training thread's stall: snapshot + (possibly backpressured)
+        # enqueue — the background write itself is not a stall
+        stall_h.observe(time.perf_counter() - t0)
         self._last_saved_step = step
         self._last_saved_time = time.monotonic()
         return os.path.join(self.directory, f"step-{step:09d}")

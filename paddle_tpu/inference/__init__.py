@@ -241,6 +241,7 @@ class Predictor:
 
 
 def _profile_report(config: Config, serving_metrics=None) -> Dict:
+    from .. import observability
     from ..utils import profiler
     from ..utils.monitor import stats
     rep = {
@@ -253,6 +254,10 @@ def _profile_report(config: Config, serving_metrics=None) -> Dict:
         "stats": {k: v for k, v in stats().items()
                   if k.startswith("STAT_serving_")
                   or k == "STAT_predictor_runs"},
+        # the unified telemetry report (PR 5): dispatch cache, dataloader,
+        # checkpoint, train, serving histograms, compiled programs — the
+        # same shape observability.report() returns everywhere else
+        "observability": observability.report(),
     }
     if serving_metrics is not None:
         rep["serving"] = serving_metrics

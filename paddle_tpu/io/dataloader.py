@@ -29,6 +29,7 @@ import os
 import pickle
 import queue
 import threading
+import time
 import warnings
 from typing import Optional
 
@@ -39,6 +40,25 @@ from ..core.tensor import Tensor
 from .dataset import BatchSampler, IterableDataset
 from ._worker import (default_collate_fn, fetch as _fetch,  # noqa: F401
                       decode as _decode, worker_loop as _worker_loop)
+
+
+_obs_handles = None
+
+
+def _obs():
+    """(data_wait_histogram, queue_depth_gauge) — observability handles,
+    created once and cached (registry.reset() zeroes values in place, so
+    the cache stays valid)."""
+    global _obs_handles
+    if _obs_handles is None:
+        from ..observability import metrics as _m
+        _obs_handles = (
+            _m.histogram("dataloader_data_wait_seconds",
+                         "time the consumer waited for its next batch "
+                         "(the train loop's data-starvation signal)"),
+            _m.gauge("dataloader_queue_depth",
+                     "device-prefetch queue depth seen at consume time"))
+    return _obs_handles
 
 
 def _default_mp_context() -> str:
@@ -440,10 +460,18 @@ class DataLoader:
                 lambda a: Tensor(jax.device_put(a)) if isinstance(a, np.ndarray) else a,
                 np_batch)
 
+        wait_h, depth_g = _obs()
+
         if self.prefetch <= 0:
-            for b in self._batches_numpy():
+            gen = self._batches_numpy()
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    b = next(gen)
+                except StopIteration:
+                    return
+                wait_h.observe(time.perf_counter() - t0)
                 yield to_device(b)
-            return
 
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
@@ -477,7 +505,10 @@ class DataLoader:
         t.start()
         try:
             while True:
+                t0 = time.perf_counter()
                 item = q.get()
+                wait_h.observe(time.perf_counter() - t0)
+                depth_g.set(q.qsize())
                 if item is sentinel:
                     break
                 if isinstance(item, BaseException):

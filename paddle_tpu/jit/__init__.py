@@ -157,7 +157,10 @@ class StaticFunction:
                     with no_grad():
                         out = fn(*_wrap_args(args), **kwargs)
                     return _extract_raw(out)
-            self._compiled = jax.jit(pure)
+            from ..observability import track
+            label = (type(self._layer).__name__ if self._layer is not None
+                     else getattr(self._fn, "__name__", "fn"))
+            self._compiled = track(f"to_static:{label}", jax.jit(pure))
         return self._compiled
 
     def __call__(self, *args, **kwargs):
@@ -236,6 +239,22 @@ def forward_loss(model, loss_fn, state, batch, rng_key=None, amp_level=None,
             with amp_mod.auto_cast(level=amp_level, dtype=amp_dtype):
                 return run()
         return run()
+
+_obs_step_hist = None
+
+
+def _step_hist():
+    """train_step_seconds histogram handle (created once; registry.reset()
+    zeroes values in place so the cache stays valid)."""
+    global _obs_step_hist
+    if _obs_step_hist is None:
+        from ..observability import metrics as _m
+        _obs_step_hist = _m.histogram(
+            "train_step_seconds",
+            "host wall time per TrainStep/ShardedTrainStep call (dispatch "
+            "+ any synchronous device wait)")
+    return _obs_step_hist
+
 
 def guard_select(params, opt_state, new_params, new_opt, loss, grads):
     """Device-side step guard, shared by TrainStep / ShardedTrainStep.
@@ -477,8 +496,10 @@ class TrainStep:
                 return new_params, new_opt, loss, outs, gnorm, ok
             return new_params, new_opt, loss, outs
 
-        return jax.jit(step_sparse if sparse_specs else step,
-                       donate_argnums=(0, 1))
+        from ..observability import track
+        return track(f"train_step:{type(self.model).__name__}",
+                     jax.jit(step_sparse if sparse_specs else step,
+                             donate_argnums=(0, 1)))
 
     def init_opt_state(self, state):
         return {k: self.optimizer.init_state(v) for k, v in state.items()
@@ -529,7 +550,9 @@ class TrainStep:
                 body, (params, opt_state, jnp.int32(0)), stacked)
             return params, opt_state, losses
 
-        return jax.jit(multi, donate_argnums=(0, 1))
+        from ..observability import track
+        return track(f"train_step_multi:{type(self.model).__name__}",
+                     jax.jit(multi, donate_argnums=(0, 1)))
 
     def _build_multi_sparse(self, example_state, example_batch_one):
         """K sparse-grad steps per compiled call: the same zeros-cotangent
@@ -585,7 +608,9 @@ class TrainStep:
                 body, (params, opt_state, jnp.int32(0)), stacked)
             return params, opt_state, losses
 
-        return jax.jit(multi, donate_argnums=(0, 1))
+        from ..observability import track
+        return track(f"train_step_multi:{type(self.model).__name__}",
+                     jax.jit(multi, donate_argnums=(0, 1)))
 
     def run_steps(self, *stacked_batch):
         """Run K train steps in ONE compiled call.
@@ -629,6 +654,11 @@ class TrainStep:
         return Tensor(losses)
 
     def __call__(self, *batch):
+        from ..observability import span as _span
+        with _span("train_step"), _step_hist().time():
+            return self._call_inner(*batch)
+
+    def _call_inner(self, *batch):
         state = state_arrays(self.model)
         if self._opt_state is None:
             self._opt_state = self.init_opt_state(state)

@@ -1,0 +1,69 @@
+"""paddle_tpu.observability — the unified telemetry subsystem.
+
+Reference: the platform observability slice — platform/profiler.h
+RecordEvent, the CUPTI DeviceTracer chrome-trace path
+(platform/device_tracer.cc) and the platform/monitor.h StatRegistry —
+rebuilt as one first-class package every runtime component reports
+through:
+
+- **metrics**  — typed Counter/Gauge/Histogram registry with label sets,
+  per-metric locks, and collector callbacks for hot-path counters that
+  live elsewhere (the dispatch cache).  `utils.monitor`'s STAT_* verbs
+  are a compat shim over it.
+- **tracer**   — nestable host spans with thread ids and explicit
+  parents, a bounded ring buffer, chrome://tracing export, and
+  jax.profiler trace-annotation passthrough so host spans line up with
+  the XLA device timeline.  `utils.profiler` is a compat shim over it.
+- **programs** — the compiled-program registry: every jit /
+  dispatch-cache / TrainStep / serving compile records compile
+  wall-time, XLA cost-analysis FLOPs + bytes, and argument/donated/
+  output buffer bytes, queryable by program name.
+- **exporters** — Prometheus text exposition over a stdlib HTTP
+  endpoint, a JSONL file sink, and `report()`: ONE report shape that
+  subsumes the profiler table, `monitor.stats()`,
+  `ServingEngine.metrics()` and `Predictor.profile_report()`.
+
+Quick use:
+
+    from paddle_tpu import observability as obs
+    with obs.span("load_batch"):
+        ...
+    obs.counter("my_events_total").inc()
+    print(obs.prometheus_text())
+    rep = obs.report()          # dispatch cache, dataloader, checkpoint,
+                                # train, serving, compiled programs
+    srv = obs.serve_metrics(9464)   # GET /metrics, /report
+"""
+from __future__ import annotations
+
+from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
+                      counter, gauge, get_registry, histogram)
+from .tracer import Span, Tracer, get_tracer, span  # noqa: F401
+from .programs import (ProgramRegistry, TrackedJit,  # noqa: F401
+                       get_program_registry, note_compile, track)
+from .exporters import (JsonlSink, MetricsServer, prometheus_text,  # noqa: F401
+                        render_endpoint, report, serve_metrics)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "counter", "gauge",
+    "histogram", "get_registry",
+    "Span", "Tracer", "get_tracer", "span",
+    "ProgramRegistry", "TrackedJit", "get_program_registry", "note_compile",
+    "track",
+    "JsonlSink", "MetricsServer", "prometheus_text", "render_endpoint",
+    "report", "serve_metrics",
+    "export_chrome_trace", "reset",
+]
+
+
+def export_chrome_trace(path: str) -> str:
+    """Write the default tracer's ring as a chrome://tracing JSON file."""
+    return get_tracer().export_chrome_trace(path)
+
+
+def reset():
+    """Zero metrics, clear spans and the program registry (tests, or a
+    live `FLAGS_reset_stats`-style wipe)."""
+    get_registry().reset()
+    get_tracer().clear()
+    get_program_registry().clear()

@@ -1,0 +1,300 @@
+"""Exporters: Prometheus text exposition, JSONL sink, the unified report.
+
+- `prometheus_text()` renders the metrics registry (plus collector-fed
+  series, e.g. the dispatch cache) in Prometheus text exposition format
+  0.0.4.  `serve_metrics(port)` exposes it over a stdlib HTTP endpoint
+  (`/metrics`, and `/report` as JSON); rendering is separated from the
+  socket so tests exercise the exact handler payload without binding a
+  port.
+- `JsonlSink` appends periodic `report()` snapshots to a JSONL file from
+  a daemon thread (the VisualDL-style flight recorder for post-mortems).
+- `report()` is THE unified report: one pass over metrics registry,
+  tracer aggregates, compiled-program registry and the dispatch cache,
+  with derived sections for the runtime subsystems (dataloader /
+  checkpoint / train / serving) that used to each print their own format.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional
+
+from .metrics import Histogram, get_registry
+from .programs import get_program_registry
+from .tracer import get_tracer
+
+__all__ = ["prometheus_text", "serve_metrics", "MetricsServer",
+           "JsonlSink", "report", "render_endpoint"]
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+def _esc(v: str) -> str:
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n").replace(
+        '"', '\\"')
+
+
+def _labels_str(labelnames, values, extra=None) -> str:
+    pairs = [f'{n}="{_esc(v)}"' for n, v in zip(labelnames, values)]
+    if extra:
+        pairs += [f'{n}="{_esc(v)}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def prometheus_text(registry=None) -> str:
+    """Render the registry in Prometheus text exposition format."""
+    registry = registry or get_registry()
+    lines = []
+    snap = registry.snapshot()
+    for name in sorted(snap):
+        ent = snap[name]
+        kind = ent["kind"]
+        prom_kind = kind if kind in ("counter", "gauge", "histogram") \
+            else "untyped"
+        if ent.get("help"):
+            lines.append(f"# HELP {name} {_esc(ent['help'])}")
+        lines.append(f"# TYPE {name} {prom_kind}")
+        labelnames = ent.get("labelnames", ())
+        for values, v in ent["samples"]:
+            if isinstance(v, dict) and "buckets" in v:  # histogram
+                cum = 0
+                for bound, c in zip(v["buckets"], v["counts"]):
+                    cum += c
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_labels_str(labelnames, values, [('le', _fmt(bound))])}"
+                        f" {cum}")
+                cum += v["counts"][-1]
+                lines.append(
+                    f"{name}_bucket"
+                    f"{_labels_str(labelnames, values, [('le', '+Inf')])}"
+                    f" {cum}")
+                lines.append(f"{name}_sum"
+                             f"{_labels_str(labelnames, values)} "
+                             f"{_fmt(v['sum'])}")
+                lines.append(f"{name}_count"
+                             f"{_labels_str(labelnames, values)} {cum}")
+            else:
+                lines.append(f"{name}{_labels_str(labelnames, values)} "
+                             f"{_fmt(v)}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# unified report
+# ---------------------------------------------------------------------------
+
+def _hist_summary(name: str) -> Optional[dict]:
+    m = get_registry().get(name)
+    if not isinstance(m, Histogram):
+        return None
+    snap = m.snapshot()
+    if not snap["count"]:
+        return {"count": 0}
+    return {"count": snap["count"], "sum_s": snap["sum"],
+            "mean_ms": snap["sum"] / snap["count"] * 1e3,
+            "p50_ms": (m.quantile(0.5) or 0.0) * 1e3,
+            "p90_ms": (m.quantile(0.9) or 0.0) * 1e3,
+            "p99_ms": (m.quantile(0.99) or 0.0) * 1e3,
+            "min_ms": (snap["min"] or 0.0) * 1e3,
+            "max_ms": (snap["max"] or 0.0) * 1e3}
+
+
+def _gauge_value(name: str):
+    m = get_registry().get(name)
+    try:
+        return m.value() if m is not None else None
+    except Exception:
+        return None
+
+
+def report() -> dict:
+    """One report for the whole runtime — subsumes the profiler table,
+    `monitor.stats()`, `ServingEngine.metrics()` and
+    `Predictor.profile_report()`'s divergent shapes."""
+    from ..utils import monitor
+
+    # dispatch cache (hot-path dict, surfaced via its collector too)
+    try:
+        from ..core import op as _op
+        cs = _op.dispatch_cache_stats()
+        total = cs["hits"] + cs["misses"]
+        dispatch = dict(cs, hit_rate=(cs["hits"] / total if total else None))
+    except Exception:
+        dispatch = {}
+
+    stats = monitor.stats()
+    train = {
+        "step_seconds": _hist_summary("train_step_seconds"),
+        "data_wait_seconds": _hist_summary("dataloader_data_wait_seconds"),
+        "checkpoint_stall_seconds":
+            _hist_summary("checkpoint_save_stall_seconds"),
+        "guard_bad_steps": stats.get("STAT_guarded_bad_steps", 0),
+        "guard_rollbacks": stats.get("STAT_guarded_rollbacks", 0),
+    }
+    dataloader = {
+        "data_wait_seconds": _hist_summary("dataloader_data_wait_seconds"),
+        "queue_depth": _gauge_value("dataloader_queue_depth"),
+        "batches": stats.get("STAT_dataloader_batch_count", 0),
+        "bytes": stats.get("STAT_dataloader_bytes", 0),
+        "worker_respawns": stats.get("STAT_dataloader_worker_respawns", 0),
+    }
+    checkpoint = {
+        "save_stall_seconds": _hist_summary("checkpoint_save_stall_seconds"),
+        "async_in_flight": _gauge_value("checkpoint_async_in_flight"),
+        "bytes_written": stats.get("STAT_checkpoint_bytes_written", 0),
+        "saves": stats.get("STAT_checkpoint_saves", 0),
+        "async_writes": stats.get("STAT_checkpoint_async_writes", 0),
+    }
+    serving = {
+        "ttft_seconds": _hist_summary("serving_ttft_seconds"),
+        "inter_token_seconds": _hist_summary("serving_inter_token_seconds"),
+        "slot_occupancy": _gauge_value("serving_slot_occupancy"),
+        "queue_depth": _gauge_value("serving_queue_depth"),
+        "queue_full_rejections": stats.get("STAT_serving_rejects", 0),
+        "tokens_out": stats.get("STAT_serving_tokens", 0),
+        "requests": stats.get("STAT_serving_requests", 0),
+    }
+    return {
+        "generated_at": time.time(),
+        "dispatch_cache": dispatch,
+        "dataloader": dataloader,
+        "checkpoint": checkpoint,
+        "train": train,
+        "serving": serving,
+        "programs": get_program_registry().snapshot(),
+        "spans": get_tracer().aggregates(),
+        "stats": stats,
+        "metrics": get_registry().snapshot(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoint (stdlib only)
+# ---------------------------------------------------------------------------
+
+def render_endpoint(path: str):
+    """(status, content_type, body) for a metrics-endpoint path — the
+    handler body, callable without a socket (tier-1 stays port-free)."""
+    if path.split("?")[0] in ("/metrics", "/"):
+        return (200, "text/plain; version=0.0.4; charset=utf-8",
+                prometheus_text().encode())
+    if path.split("?")[0] == "/report":
+        return (200, "application/json",
+                json.dumps(report(), default=str).encode())
+    return 404, "text/plain", b"not found\n"
+
+
+class MetricsServer:
+    """`/metrics` (Prometheus) + `/report` (JSON) over http.server."""
+
+    def __init__(self, port: int = 0, addr: str = "127.0.0.1"):
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib naming)
+                status, ctype, body = render_endpoint(self.path)
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence per-scrape stderr noise
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer((addr, port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.addr = addr
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="paddle_tpu-metrics",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def serve_metrics(port: int = 9464, addr: str = "127.0.0.1") -> MetricsServer:
+    """Start the metrics endpoint; returns the server (`.close()` stops)."""
+    return MetricsServer(port=port, addr=addr)
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink
+# ---------------------------------------------------------------------------
+
+class JsonlSink:
+    """Periodic `report()` snapshots appended to a JSONL file.
+
+    flush() writes one line now; a daemon thread writes every
+    `interval_seconds` (None = manual-only).  Lines are self-contained
+    JSON objects, so a crashed run's file is readable up to the last
+    complete line."""
+
+    def __init__(self, path: str, interval_seconds: Optional[float] = 30.0,
+                 full_metrics: bool = False):
+        self.path = path
+        self.interval = interval_seconds
+        self._full = full_metrics
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = None
+        if interval_seconds is not None:
+            self._thread = threading.Thread(target=self._loop,
+                                            name="paddle_tpu-jsonl-sink",
+                                            daemon=True)
+            self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.flush()
+            except Exception:
+                pass  # a full disk must not kill the run
+
+    def flush(self) -> str:
+        rec = report()
+        if not self._full:  # keep lines compact: drop the raw dumps
+            rec.pop("metrics", None)
+            rec.pop("spans", None)
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        return self.path
+
+    def close(self, final_flush: bool = True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if final_flush:
+            try:
+                self.flush()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

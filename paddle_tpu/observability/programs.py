@@ -1,0 +1,221 @@
+"""Compiled-program registry: every big XLA program, queryable by name.
+
+Promotes the machinery `probes/hbm_probe.py` uses ad hoc (lower -> compile
+-> cost_analysis "bytes accessed"/"flops") to a first-class API: each
+jit / dispatch-cache / TrainStep / serving compile records its compile
+wall-time, XLA cost-analysis FLOPs + bytes accessed, and the executable's
+argument/output/donated(alias)/temp buffer bytes, keyed by program name.
+
+Two entry points:
+
+- `track(name, jitted)` wraps a `jax.jit` result.  On a new input
+  signature it compiles via the AOT path (`lower().compile()`) — the same
+  single compilation `jitted(...)` would have paid, but with the compiled
+  object in hand for cost/memory analysis — caches the executable per
+  signature, and records the compile.  Signature mismatches or AOT
+  failures fall back to the wrapped jitted callable, so tracking can
+  never change program semantics.  `PDTPU_OBS_PROGRAMS=0` makes `track`
+  return the jitted fn untouched.
+- `note_compile(name, seconds, ...)` records a compile observed elsewhere
+  (the eager dispatch cache times its miss path and reports here without
+  paying an extra lowering per op signature).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+__all__ = ["ProgramRegistry", "get_program_registry", "track",
+           "note_compile", "TrackedJit"]
+
+
+def _tracking_enabled() -> bool:
+    return os.environ.get("PDTPU_OBS_PROGRAMS", "1").lower() not in (
+        "0", "off", "false", "no")
+
+
+def _cost_dict(compiled) -> dict:
+    """Flatten cost_analysis + memory_analysis of a jax Compiled object
+    into plain floats; every field is best-effort (backends differ)."""
+    out = {}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if ca:
+            out["flops"] = float(ca.get("flops", 0.0))
+            out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        for field, key in (("argument_size_in_bytes", "argument_bytes"),
+                           ("output_size_in_bytes", "output_bytes"),
+                           ("alias_size_in_bytes", "donated_bytes"),
+                           ("temp_size_in_bytes", "temp_bytes"),
+                           ("generated_code_size_in_bytes", "code_bytes")):
+            v = getattr(ma, field, None)
+            if v is not None:
+                out[key] = float(v)
+    except Exception:
+        pass
+    if "argument_bytes" in out and "donated_bytes" in out:
+        # buffers live across the call = arguments not donated + outputs
+        out["live_bytes"] = (out["argument_bytes"] - out["donated_bytes"]
+                             + out.get("output_bytes", 0.0)
+                             + out.get("temp_bytes", 0.0))
+    return out
+
+
+class ProgramRegistry:
+    """name -> {compiles, compile_seconds_total, last_compile_ms, cost...}"""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs: Dict[str, dict] = {}
+
+    def note_compile(self, name: str, seconds: float,
+                     cost: Optional[dict] = None,
+                     meta: Optional[dict] = None):
+        with self._lock:
+            rec = self._programs.get(name)
+            if rec is None:
+                rec = self._programs[name] = {
+                    "name": name, "compiles": 0,
+                    "compile_seconds_total": 0.0, "last_compile_ms": None,
+                    "first_compiled_at": time.time()}
+            rec["compiles"] += 1
+            rec["compile_seconds_total"] += float(seconds)
+            rec["last_compile_ms"] = float(seconds) * 1e3
+            if cost:
+                rec.update(cost)
+            if meta:
+                rec.setdefault("meta", {}).update(meta)
+
+    def get(self, name: str) -> Optional[dict]:
+        with self._lock:
+            rec = self._programs.get(name)
+            return dict(rec) if rec is not None else None
+
+    def names(self):
+        with self._lock:
+            return sorted(self._programs)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: dict(v) for k, v in self._programs.items()}
+
+    def total_compile_seconds(self) -> float:
+        with self._lock:
+            return sum(v["compile_seconds_total"]
+                       for v in self._programs.values())
+
+    def clear(self):
+        with self._lock:
+            self._programs.clear()
+
+
+_default_programs = ProgramRegistry()
+
+
+def get_program_registry() -> ProgramRegistry:
+    return _default_programs
+
+
+def note_compile(name: str, seconds: float, cost: Optional[dict] = None,
+                 meta: Optional[dict] = None):
+    _default_programs.note_compile(name, seconds, cost, meta)
+
+
+def _sig_leaf(x):
+    aval = getattr(x, "aval", None)
+    if aval is not None:
+        return (tuple(aval.shape), str(aval.dtype),
+                bool(getattr(aval, "weak_type", False)))
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:  # np.ndarray
+        return (tuple(shape), str(dtype), False)
+    # python scalar: jax treats it as a weak-typed constant input whose
+    # aval does not depend on the value — key by type only
+    return ("py", type(x).__name__)
+
+
+class TrackedJit:
+    """Wrapper over a `jax.jit` callable that records every compile into
+    the program registry (wall time + cost/memory analysis) by compiling
+    through the AOT path once per input signature.
+
+    Passes unknown attributes (`lower`, `eval_shape`, ...) through to the
+    wrapped jitted fn, so call sites that lower explicitly
+    (probes/hbm_probe.py does `step._build(...).lower(...)`) are
+    unaffected."""
+
+    def __init__(self, name: str, jitted, registry: ProgramRegistry = None):
+        self._name = name
+        self._jitted = jitted
+        self._registry = registry or _default_programs
+        self._exe = {}      # sig -> compiled executable
+        self._last = None   # most recent executable (steady-state fast path)
+        self._direct = False  # permanent fallback after an AOT failure
+
+    def __call__(self, *args, **kwargs):
+        # Executables validate input avals BEFORE donating or executing
+        # anything and raise TypeError on mismatch (ValueError for pytree
+        # structure) — so trying the last-used executable first is safe
+        # and makes the steady state pay zero signature computation.  Any
+        # OTHER exception comes from real execution and must propagate:
+        # re-running the wrapped jit then could replay a donated-buffer
+        # program and mask the original error.
+        if self._direct:
+            return self._jitted(*args, **kwargs)
+        if self._last is not None:
+            try:
+                return self._last(*args, **kwargs)
+            except (TypeError, ValueError):
+                pass  # different signature: take the keyed path
+        import jax
+        flat, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sig = (treedef, tuple(_sig_leaf(x) for x in flat))
+        exe = self._exe.get(sig)
+        if exe is None:
+            t0 = time.perf_counter()
+            try:
+                exe = self._jitted.lower(*args, **kwargs).compile()
+            except Exception:
+                # not AOT-able (symbolic shapes, backend quirk): permanent
+                # pass-through; estimate this compile from the first call
+                self._direct = True
+                out = self._jitted(*args, **kwargs)
+                self._registry.note_compile(
+                    self._name, time.perf_counter() - t0,
+                    meta={"aot": False})
+                return out
+            dt = time.perf_counter() - t0
+            self._registry.note_compile(self._name, dt, _cost_dict(exe),
+                                        meta={"aot": True})
+            self._exe[sig] = exe
+        self._last = exe
+        try:
+            return exe(*args, **kwargs)
+        except TypeError:
+            # aval-validation mismatch (raised before donation/execution):
+            # our signature key was too coarse for this call pattern — run
+            # the safe path and stop tracking; semantics over telemetry
+            self._direct = True
+            self._exe.clear()
+            self._last = None
+            return self._jitted(*args, **kwargs)
+
+    def __getattr__(self, attr):
+        return getattr(self._jitted, attr)
+
+
+def track(name: str, jitted, registry: ProgramRegistry = None):
+    """Wrap a jitted callable for compile tracking (identity when
+    PDTPU_OBS_PROGRAMS=0)."""
+    if not _tracking_enabled():
+        return jitted
+    return TrackedJit(name, jitted, registry)

@@ -218,8 +218,11 @@ class ShardedTrainStep:
         out_shardings = (self.param_shardings, opt_shardings, None)
         if guard:
             out_shardings += (None, None)
-        return jax.jit(step, in_shardings=in_shardings,
-                       out_shardings=out_shardings, donate_argnums=(0, 1))
+        from ..observability import track
+        return track(f"sharded_train_step:{type(self.model).__name__}",
+                     jax.jit(step, in_shardings=in_shardings,
+                             out_shardings=out_shardings,
+                             donate_argnums=(0, 1)))
 
     def init_opt_state(self, state):
         return {k: self.optimizer.init_state(v) for k, v in state.items()
@@ -235,6 +238,12 @@ class ShardedTrainStep:
         return self._opt_state_shardings
 
     def __call__(self, *batch):
+        from ..jit import _step_hist
+        from ..observability import span as _span
+        with _span("sharded_train_step"), _step_hist().time():
+            return self._call_inner(*batch)
+
+    def _call_inner(self, *batch):
         if not self._placed:
             self.place_params()
         state = state_arrays(self.model)

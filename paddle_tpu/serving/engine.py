@@ -138,6 +138,15 @@ class ServingEngine:
         self._compiles = {"decode": 0, "prefill": {b: 0 for b in self.buckets}}
         self._decode_fn = self._build_decode()
         self._prefill_fns = {b: self._build_prefill(b) for b in self.buckets}
+        # observability: latency histograms shared with the unified
+        # report / Prometheus endpoint (handles cached; registry.reset()
+        # zeroes values in place)
+        from ..observability import metrics as _obs_m
+        self._h_ttft = _obs_m.histogram(
+            "serving_ttft_seconds", "submit -> first streamed token")
+        self._h_itl = _obs_m.histogram(
+            "serving_inter_token_seconds",
+            "gap between consecutive tokens of one request")
         # metrics accumulators
         self._m_lock = threading.Lock()
         self._ttfts: List[float] = []
@@ -198,7 +207,9 @@ class ServingEngine:
             logp = jax.nn.log_softmax(proc)[tok]
             return tok, logp, finite, new_pools
 
-        return jax.jit(prefill, donate_argnums=self._donate)
+        from ..observability import track
+        return track(f"serving_prefill_b{bucket}",
+                     jax.jit(prefill, donate_argnums=self._donate))
 
     def _build_decode(self):
         apply_fixed = self._apply
@@ -265,7 +276,9 @@ class ServingEngine:
                 one, (tokens, pos, pools), None, length=chunk)
             return toks, logps, finites, tokens, pos, pools
 
-        return jax.jit(decode, donate_argnums=self._donate)
+        from ..observability import track
+        return track("serving_decode",
+                     jax.jit(decode, donate_argnums=self._donate))
 
     # ------------------------------------------------------------------
     # submission
@@ -487,6 +500,10 @@ class ServingEngine:
             else:
                 self._itl_sum += now - run.last_token_at
                 self._itl_n += 1
+        if first:
+            self._h_ttft.observe(run.resp.ttft)
+        else:
+            self._h_itl.observe(now - run.last_token_at)
         run.last_token_at = now
 
     def _maybe_finish(self, slot: int, run: _SlotRun, tok: int):

@@ -23,6 +23,24 @@ from .request import Request, Response, RequestCancelled
 
 __all__ = ["RequestScheduler", "QueueFullError", "DeadlineExceededError"]
 
+_obs_handles = None
+
+
+def _obs():
+    """(slot_occupancy_gauge, queue_depth_gauge, queue_full_counter) —
+    cached observability handles (registry.reset() zeroes in place)."""
+    global _obs_handles
+    if _obs_handles is None:
+        from ..observability import metrics as _m
+        _obs_handles = (
+            _m.gauge("serving_slot_occupancy",
+                     "KV-cache slots currently decoding"),
+            _m.gauge("serving_queue_depth",
+                     "requests waiting for admission"),
+            _m.counter("serving_queue_full_total",
+                       "submissions rejected at max_queue_depth"))
+    return _obs_handles
+
 
 class QueueFullError(ResourceExhaustedError):
     """Admission queue at max_queue_depth: the request was rejected.  The
@@ -58,13 +76,16 @@ class RequestScheduler:
                 self._space.wait_for(
                     lambda: len(self._pending) < self.max_queue_depth,
                     timeout=timeout)
+            occ_g, depth_g, full_c = _obs()
             if len(self._pending) >= self.max_queue_depth:
                 stat_add("STAT_serving_rejects")
+                full_c.inc()
                 raise QueueFullError(
                     f"serving queue full ({self.max_queue_depth} waiting); "
                     "request rejected")
             self._pending.append((req, resp))
             stat_add("STAT_serving_queue_depth")
+            depth_g.set(len(self._pending))
 
     # -- engine side --------------------------------------------------------
     def queue_depth(self) -> int:
@@ -93,12 +114,14 @@ class RequestScheduler:
         is empty or no slot is free (the popped-but-unadmittable case does
         not exist: a slot is acquired before the pop commits)."""
         with self._space:
+            occ_g, depth_g, _ = _obs()
             while self._pending:
                 if not self._free:
                     return None
                 req, resp = self._pending.popleft()
                 self._space.notify()
                 stat_add("STAT_serving_queue_depth", -1)
+                depth_g.set(len(self._pending))
                 if resp.cancelled:
                     stat_add("STAT_serving_cancelled")
                     resp._fail(RequestCancelled(
@@ -113,6 +136,7 @@ class RequestScheduler:
                 slot = self._free.pop()
                 self._active[slot] = (req, resp)
                 stat_add("STAT_serving_slots_active")
+                occ_g.set(len(self._active))
                 return req, resp, slot
             return None
 
@@ -125,6 +149,7 @@ class RequestScheduler:
                 del self._active[slot]
                 self._free.append(slot)
                 stat_add("STAT_serving_slots_active", -1)
+                _obs()[0].set(len(self._active))
 
     def drain_pending(self):
         """Remove and return every queued (request, response) — engine
@@ -134,6 +159,7 @@ class RequestScheduler:
             if drained:
                 stat_add("STAT_serving_queue_depth", -len(drained))
             self._pending = deque()
+            _obs()[1].set(0)
             self._space.notify_all()
             return drained
 
@@ -158,3 +184,4 @@ class RequestScheduler:
                 stat_add("STAT_serving_queue_depth", -1)
                 self._space.notify()
             self._pending = keep
+            _obs()[1].set(len(self._pending))

@@ -39,8 +39,13 @@ def _apply_effect(key: str, value):
         from ..core.op import set_check_nan_inf
         set_check_nan_inf(bool(value))
     elif key == "FLAGS_reset_stats" and value:
+        # a live reset clears the observability registry the STAT shim
+        # writes into (values zeroed, registrations + collectors survive),
+        # not just the legacy STAT name set
         from .monitor import stat_reset
         stat_reset()
+        from ..observability import get_registry
+        get_registry().reset()
 
 
 def _bootstrap_from_env():

@@ -128,7 +128,9 @@ class GuardedTrainStep:
             rec["loss_scale"] = self.scaler.get_init_loss_scaling()
         if (self.bad_streak >= self.max_bad_steps
                 and self.checkpoint_dir is not None):
-            meta = self.restore_checkpoint()
+            from ..observability import span as _span
+            with _span("guarded_rollback", args={"reason": reason}):
+                meta = self.restore_checkpoint()
             if meta is not None:
                 rec["rolled_back_to"] = meta["step"]
                 stat_add("STAT_guarded_rollbacks")

@@ -1,28 +1,52 @@
-"""Monitor: cheap always-on global STAT counters.
+"""Monitor: cheap always-on global STAT counters — compat shim.
 
 Reference: paddle/fluid/platform/monitor.h:77 (StatRegistry,
 STAT_ADD/STAT_SUB/STAT_RESET macros backing e.g. the dataset-feed byte/ins
 counters in data_feed.cc) and monitor.h:130 (the int64 stat registration
-list).  TPU-native: a process-local dict with the same add/sub/get/reset
-verbs; the runtime hot paths (dataloader, dataset engine, checkpointing)
-bump these, `profiler.summary()` surfaces them next to op spans, and the
-`FLAGS_reset_stats` flag clears them live.
+list).
+
+Since PR 5 the STAT values live in `paddle_tpu.observability`'s typed
+metrics registry (as Gauges — the STAT verbs go both ways, stat_sub is
+real usage) instead of a private dict: the same names show up in
+`observability.report()`, the Prometheus endpoint and `stats()` here.
+The verbs keep their exact legacy semantics; `stats()` gains prefix
+filtering and `FLAGS_reset_stats` (utils.flags) clears the registry-backed
+values, not a shadow dict.
 """
 from __future__ import annotations
 
 import threading
-from typing import Dict
+from typing import Dict, Optional
+
+from ..observability.metrics import get_registry
 
 __all__ = ["stat_add", "stat_sub", "stat_get", "stat_reset", "stats",
            "STAT_ADD", "STAT_SUB", "STAT_RESET"]
 
-_lock = threading.Lock()
-_stats: Dict[str, int] = {}
+# names created through the STAT verbs: stats() reports exactly these (the
+# registry also holds non-STAT metrics that must not leak into the legacy
+# view)
+_names_lock = threading.Lock()
+_names: set = set()
+# handle memo: hot call sites (dataloader per batch, serving per token
+# burst) pay one dict hit instead of registry get-or-create locks per
+# bump; invalidated by stat_reset (which removes the gauges)
+_gauge_memo: Dict[str, object] = {}
+
+
+def _gauge(name: str):
+    g = _gauge_memo.get(name)
+    if g is None:
+        g = get_registry().gauge(
+            name, help="legacy STAT counter (utils.monitor shim)")
+        with _names_lock:
+            _names.add(name)
+            _gauge_memo[name] = g
+    return g
 
 
 def stat_add(name: str, value: int = 1) -> None:
-    with _lock:
-        _stats[name] = _stats.get(name, 0) + int(value)
+    _gauge(name).inc(int(value))
 
 
 def stat_sub(name: str, value: int = 1) -> None:
@@ -30,21 +54,46 @@ def stat_sub(name: str, value: int = 1) -> None:
 
 
 def stat_get(name: str) -> int:
-    with _lock:
-        return _stats.get(name, 0)
+    m = get_registry().get(name)
+    if m is None:
+        return 0
+    try:
+        return int(m.value())
+    except Exception:
+        return 0
 
 
-def stat_reset(name: str = None) -> None:
-    with _lock:
+def stat_reset(name: Optional[str] = None) -> None:
+    reg = get_registry()
+    with _names_lock:
         if name is None:
-            _stats.clear()
+            targets = sorted(_names)
+            _names.clear()
+            _gauge_memo.clear()
         else:
-            _stats.pop(name, None)
+            targets = [name] if name in _names else []
+            _names.discard(name)
+            _gauge_memo.pop(name, None)
+    for n in targets:
+        reg.remove(n)
 
 
-def stats() -> Dict[str, int]:
-    with _lock:
-        return dict(_stats)
+def stats(prefix: Optional[str] = None) -> Dict[str, int]:
+    """Snapshot of the STAT counters.  `prefix` filters by name; for
+    convenience it matches either the full name or the part after the
+    conventional `STAT_` prefix, so `stats(prefix="serving_")` returns the
+    `STAT_serving_*` family."""
+    with _names_lock:
+        names = sorted(_names)
+    out = {}
+    for n in names:
+        if prefix is not None and not (
+                n.startswith(prefix)
+                or (n.startswith("STAT_")
+                    and n[len("STAT_"):].startswith(prefix))):
+            continue
+        out[n] = stat_get(n)
+    return out
 
 
 # macro-style aliases matching the reference's spelling

@@ -1,57 +1,65 @@
-"""Profiler.
+"""Profiler — compat shim over `paddle_tpu.observability.tracer`.
 
 Reference: platform/profiler.h RecordEvent/EnableProfiler + CUPTI
 DeviceTracer -> chrome trace (platform/device_tracer.h).  TPU-native:
-jax.profiler (XLA/TensorBoard trace) for the device timeline + a host-side
-op-span recorder hooked into core.op dispatch for eager-mode op accounting.
+jax.profiler (XLA/TensorBoard trace) for the device timeline + host spans
+for eager-mode op accounting.
+
+Since PR 5 the span storage is the observability tracer (bounded ring +
+per-name aggregates under a lock) instead of this module's bare
+`_records` dict / `_events` list — which serving-engine threads used to
+mutate concurrently without a lock.  The public API (start_profiler /
+stop_profiler / profiler / RecordEvent / summary / export_chrome_tracing)
+is unchanged, and `_records` / `_events` remain readable as snapshots for
+callers that poked the internals.
 """
 from __future__ import annotations
 
 import contextlib
-import time
-from collections import defaultdict
-from typing import Optional
 
 import jax
 
 from ..core import op as _op
+from ..observability.tracer import get_tracer
 
-_records = defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
-_events: list = []                        # (name, t0_s, dur_s) for the trace
-_MAX_EVENTS = 200_000                     # bound host memory
 _enabled = False
-
-
-class _Span:
-    __slots__ = ("name", "t0")
-
-    def __init__(self, name):
-        self.name = name
-
-    def __enter__(self):
-        self.t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, *exc):
-        now = time.perf_counter()
-        rec = _records[self.name]
-        rec[0] += 1
-        rec[1] += now - self.t0
-        if len(_events) < _MAX_EVENTS:
-            _events.append((self.name, self.t0, now - self.t0))
-        return False
+# aggregates snapshot taken at start_profiler: the profiler reports the
+# DELTA since then, so starting a profile no longer wipes span history
+# other subsystems (checkpoint writer, train loop, serving engine)
+# accumulated in the shared tracer
+_baseline: dict = {}
 
 
 def _hook(name):
-    return _Span(name)
+    # light span: the hook fires on EVERY eager dispatch — pay wall-time +
+    # ring/aggregate recording only (no ids/parenting/annotation)
+    return get_tracer().light_span(name)
+
+
+def _delta():
+    agg = get_tracer().aggregates()
+    out = {}
+    for k, (c, t) in agg.items():
+        bc, bt = _baseline.get(k, (0, 0.0))
+        if c - bc > 0:
+            out[k] = [c - bc, t - bt]
+    return out
+
+
+def __getattr__(name):
+    # legacy internals, now lock-safe snapshots of the tracer state
+    if name == "_records":
+        return _delta()
+    if name == "_events":
+        return [(n, t0, dur) for n, t0, dur, *_ in get_tracer().events()]
+    raise AttributeError(name)
 
 
 def start_profiler(state="All", tracer_option="Default", log_dir=None):
     """reference: fluid.profiler.start_profiler"""
-    global _enabled
+    global _enabled, _baseline
     _enabled = True
-    _records.clear()
-    _events.clear()
+    _baseline = get_tracer().aggregates()
     _op.set_profiler_hook(_hook)
     if log_dir:
         jax.profiler.start_trace(log_dir)
@@ -66,7 +74,8 @@ def stop_profiler(sorted_key="total", profile_path=None):
     _op.set_profiler_hook(None)
     if getattr(start_profiler, "_trace_dir", None):
         jax.profiler.stop_trace()
-    rows = sorted(_records.items(), key=lambda kv: -kv[1][1])
+    agg = _delta()
+    rows = sorted(agg.items(), key=lambda kv: -kv[1][1])
     lines = [f"{'op':<32}{'calls':>10}{'total_s':>14}{'avg_ms':>12}"]
     for name, (cnt, tot) in rows[:50]:
         lines.append(f"{name:<32}{cnt:>10}{tot:>14.4f}{tot / cnt * 1e3:>12.4f}")
@@ -84,7 +93,7 @@ def stop_profiler(sorted_key="total", profile_path=None):
             f.write(report)
     else:
         print(report)
-    return dict(_records)
+    return agg
 
 
 @contextlib.contextmanager
@@ -98,26 +107,22 @@ def profiler(state="All", sorted_key="total", profile_path=None, log_dir=None):
 
 
 class RecordEvent:
-    """RAII host span (reference: platform/profiler.h:127)."""
+    """RAII host span (reference: platform/profiler.h:127) — an
+    observability span with the jax TraceAnnotation passthrough, so host
+    spans line up with the XLA device timeline."""
 
     def __init__(self, name):
         self.name = name
         self._span = None
-        self._jax_ctx = None
 
     def __enter__(self):
-        self._span = _Span(self.name).__enter__()
-        try:
-            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
-            self._jax_ctx.__enter__()
-        except Exception:
-            self._jax_ctx = None
+        self._span = get_tracer().span(self.name, annotate=True)
         return self
 
     def __exit__(self, *exc):
-        if self._jax_ctx is not None:
-            self._jax_ctx.__exit__(*exc)
-        self._span.__exit__(*exc)
+        if self._span is not None:
+            self._span.end()
+            self._span = None
         return False
 
     def end(self):
@@ -128,7 +133,7 @@ def summary():
     """Op-span records plus the monitor's STAT counters (reference:
     platform/monitor.h StatRegistry — surfaced here the way the reference
     prints stats alongside the profiler report)."""
-    out = dict(_records)
+    out = _delta()  # == full aggregates when no profile was ever started
     from .monitor import stats
     st = stats()
     if st:
@@ -137,22 +142,10 @@ def summary():
 
 
 def export_chrome_tracing(path: str) -> str:
-    """Write recorded host op spans as a chrome://tracing (catapult) JSON —
+    """Write recorded host spans as a chrome://tracing (catapult) JSON —
     the analogue of the reference DeviceTracer's GenProfile chrome trace
     (platform/device_tracer.cc).  The XLA device timeline comes from the
-    jax.profiler trace dir (TensorBoard); this file covers the host/eager
-    dispatch side."""
-    import json
-    import os
-    events = [{
-        "name": name, "ph": "X", "cat": "op",
-        "ts": t0 * 1e6, "dur": dur * 1e6,
-        "pid": 0, "tid": 0,
-    } for name, t0, dur in _events]
-    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
-    d = os.path.dirname(path)
-    if d:
-        os.makedirs(d, exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(doc, f)
-    return path
+    jax.profiler trace dir (TensorBoard); this file covers the host side.
+    Spans carry real thread ids + parent links now (observability
+    tracer)."""
+    return get_tracer().export_chrome_trace(path)
