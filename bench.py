@@ -811,6 +811,35 @@ def measure_observability():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_gateway():
+    """ISSUE-6 acceptance artifact: probes/gateway_probe.py in a clean CPU
+    subprocess.  Publishes the high-priority lane's p99 TTFT under 3x
+    Poisson overload with chaos armed (slow decode, NaN logits, cancels,
+    tight deadlines) and the low-priority shed/preempt rate — bars: p99
+    TTFT under its bound while >= 30% of low work is shed or preempted,
+    every preempted-and-resumed stream bit-identical to solo generate,
+    every request terminal, compile count at the PR-4 bound."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes", "gateway_probe.py"),
+         "--steps", os.environ.get("PDTPU_GATEWAY_PROBE_STEPS", "60")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("GATE"):
+            rec = json.loads(line[len("GATE"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"gateway bars failed: {rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return {"p99_ttft_hi_ms": rec.get("p99_ttft_hi_ms"),
+                    "shed_rate": rec.get("shed_rate"),
+                    "detail": rec}
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_mnist_eager():
     """BASELINE config #1: LeNet, EAGER per-op dispatch, single device —
     the CPU-baseline parity check (runs in a CPU subprocess; eager per-op
@@ -1049,6 +1078,7 @@ def main():
                          ("mnist_eager", measure_mnist_eager),
                          ("eager_dispatch", measure_eager_dispatch),
                          ("serving", measure_serving),
+                         ("gateway", measure_gateway),
                          ("resilience", measure_resilience),
                          ("observability", measure_observability),
                          ("pipeline", measure_pipeline_ratio)):

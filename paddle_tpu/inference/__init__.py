@@ -120,6 +120,15 @@ class Config:
 
         engine_opts pass through to ServingEngine (max_slots, max_len,
         prefill_buckets, max_queue_depth, pad_token_id, dtype).
+
+        `gateway=` additionally fronts the engine with the multi-tenant
+        SLO-aware ServingGateway (per-tenant rate limits + weighted
+        fairness, priority preemption with KV save/restore, load
+        shedding, OpenAI-shaped HTTP endpoint).  Pass True for defaults,
+        or a dict of ServingGateway kwargs (tenants=, shed=, preempt=,
+        model_name=, ...).  The predictor then routes submit() through
+        the gateway (tenant=/priority= become available) and the gateway
+        drives the engine loop.
         """
         if (model is None) == (model_provider is None):
             raise ValueError(
@@ -277,6 +286,7 @@ class ServingPredictor:
         provider = opts.pop("model_provider", None)
         warmup = opts.pop("warmup", True)
         start = opts.pop("start", True)
+        gateway = opts.pop("gateway", None)
         if model is None:
             model = provider()
             prefix = config.model_dir()
@@ -291,24 +301,60 @@ class ServingPredictor:
         self.engine = ServingEngine(model, profile=config._profile, **opts)
         if warmup:
             self.engine.warmup()
+        self.gateway = None
+        if gateway is not None and gateway is not False:
+            from ..serving import ServingGateway
+            gw_opts = {} if gateway is True else dict(gateway)
+            self.gateway = ServingGateway(self.engine, **gw_opts)
         if start:
-            self.engine.start()
+            # the gateway owns the engine loop when present (preemption
+            # must interleave with engine steps on one thread)
+            if self.gateway is not None:
+                self.gateway.start()
+            else:
+                self.engine.start()
 
     def submit(self, prompt, max_new_tokens, **kwargs):
-        """Enqueue a request; returns the streaming serving.Response."""
+        """Enqueue a request; returns the streaming serving.Response.
+        With a gateway configured, kwargs additionally accept tenant= and
+        priority= and every admission outcome is a terminal Response
+        (shed/rate-limited requests come back already failed instead of
+        raising)."""
+        if self.gateway is not None:
+            return self.gateway.submit(prompt, max_new_tokens, **kwargs)
         return self.engine.submit(prompt, max_new_tokens, **kwargs)
 
     def metrics(self):
+        if self.gateway is not None:
+            return self.gateway.metrics()
         return self.engine.metrics()
+
+    def serve_http(self, port: int = 8000, addr: str = "127.0.0.1"):
+        """Start the OpenAI-shaped streaming endpoint over the gateway
+        (requires gateway= in enable_serving); returns the server."""
+        if self.gateway is None:
+            raise ValueError(
+                "serve_http needs a gateway: enable_serving(..., "
+                "gateway=True) or gateway={...}")
+        from ..serving import serve_gateway
+        return serve_gateway(self.gateway, port=port, addr=addr)
 
     def profile_report(self) -> Dict:
         """Config knobs + profiler spans + live serving metrics in one
         report (enable_profile additionally records serving_prefill /
         serving_decode spans in the profiler table)."""
-        return _profile_report(self._config, self.engine.metrics())
+        rep = _profile_report(self._config, self.engine.metrics())
+        if self.gateway is not None:
+            gm = self.gateway.metrics()
+            gm.pop("engine", None)  # already under rep["serving"]
+            rep["gateway"] = gm
+        return rep
 
     def close(self):
-        self.engine.close()
+        if self.gateway is not None:
+            self.gateway.close()  # closes the engine too
+        else:
+            self.engine.close()
 
     def __enter__(self):
         return self

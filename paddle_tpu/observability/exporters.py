@@ -163,6 +163,19 @@ def report() -> dict:
         "tokens_out": stats.get("STAT_serving_tokens", 0),
         "requests": stats.get("STAT_serving_requests", 0),
     }
+    gateway = {
+        "ttft_hi_seconds": _hist_summary("gateway_ttft_hi_seconds"),
+        "ttft_lo_seconds": _hist_summary("gateway_ttft_lo_seconds"),
+        "lane_depth_hi": _gauge_value("gateway_lane_hi_depth"),
+        "lane_depth_lo": _gauge_value("gateway_lane_lo_depth"),
+        "paused_runs": _gauge_value("gateway_paused_runs"),
+        "requests": stats.get("STAT_gateway_requests", 0),
+        "admitted": stats.get("STAT_gateway_admitted", 0),
+        "shed": stats.get("STAT_gateway_shed", 0),
+        "rate_limited": stats.get("STAT_gateway_rate_limited", 0),
+        "preemptions": stats.get("STAT_gateway_preemptions", 0),
+        "resumes": stats.get("STAT_gateway_resumes", 0),
+    }
     return {
         "generated_at": time.time(),
         "dispatch_cache": dispatch,
@@ -170,6 +183,7 @@ def report() -> dict:
         "checkpoint": checkpoint,
         "train": train,
         "serving": serving,
+        "gateway": gateway,
         "programs": get_program_registry().snapshot(),
         "spans": get_tracer().aggregates(),
         "stats": stats,

@@ -48,6 +48,21 @@ requests are bit-identical to a solo `generation.generate` of the same
 prompt; one poisoned/expired/cancelled request only ever costs its own
 slot.
 
+Gateway
+-------
+`ServingGateway` (gateway.py + slo.py) is the multi-tenant front door
+over the engine: per-tenant token-bucket rate limits with stride-fair
+weighted admission, priority lanes whose high-priority arrivals preempt
+resumable low-priority decodes (slot KV rows + sampling state snapshotted
+to host via `engine.preempt_slot`, restored bit-identical via
+`engine.restore_run` — zero extra compiled programs), SLO-driven load
+shedding (`ShedPolicy` over live lane depth / occupancy / TTFT-p99
+signals), and an OpenAI-shaped streaming HTTP endpoint
+(`GatewayServer`, port-free `gateway.handle()` for tests).  Every
+admission outcome — shed, rate-limited, expired, preempted-then-cancelled
+— is a terminal Response: no consumer ever hangs.  See the README
+"Gateway" section.
+
 Metrics (all live under `metrics()`, the STAT_serving_* monitor counters,
 and — with profiling enabled — the profiler report): ttft_p50_ms,
 inter_token_ms, tokens_per_sec, queue_depth, slot_occupancy,
@@ -57,13 +72,21 @@ deadline_expired,nonfinite}.
 """
 from __future__ import annotations
 
-from .engine import ServingEngine, NonFiniteLogitsError
+from .engine import ServingEngine, NonFiniteLogitsError, PreemptedRun
 from .request import Request, Response, RequestCancelled
 from .scheduler import (RequestScheduler, QueueFullError,
                         DeadlineExceededError)
+from .slo import ShedPolicy, Signals, SLOTracker, TenantConfig, TokenBucket
+from .gateway import (ServingGateway, GatewayServer, RateLimitedError,
+                      SheddedError, serve_gateway, PRIORITY_HIGH,
+                      PRIORITY_LOW)
 
 __all__ = [
     "ServingEngine", "Request", "Response", "RequestScheduler",
     "QueueFullError", "DeadlineExceededError", "RequestCancelled",
-    "NonFiniteLogitsError",
+    "NonFiniteLogitsError", "PreemptedRun",
+    # gateway (multi-tenant SLO-aware admission over the engine)
+    "ServingGateway", "GatewayServer", "serve_gateway", "TenantConfig",
+    "TokenBucket", "ShedPolicy", "Signals", "SLOTracker",
+    "RateLimitedError", "SheddedError", "PRIORITY_HIGH", "PRIORITY_LOW",
 ]

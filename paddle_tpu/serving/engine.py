@@ -45,7 +45,7 @@ from ..utils.monitor import stat_add
 from .request import Request, Response, RequestCancelled
 from .scheduler import RequestScheduler, DeadlineExceededError
 
-__all__ = ["ServingEngine", "NonFiniteLogitsError"]
+__all__ = ["ServingEngine", "NonFiniteLogitsError", "PreemptedRun"]
 
 
 class NonFiniteLogitsError(FatalError):
@@ -78,6 +78,33 @@ class _SlotRun:
         self.last_token = first_token
         self.last_token_at = time.monotonic()
         self.key = key
+
+
+class PreemptedRun:
+    """Host snapshot of a preempted in-flight decode — everything needed
+    to resume the stream, bit-identical, in ANY free slot later.
+
+    The same snapshot/publish split `distributed.checkpoint` uses: the
+    live KV rows are copied device->host NOW (so the pool stays free to be
+    donated to the next compiled call), and "publish" is the later
+    `restore_run` writing them back.  `kv_rows` holds per-layer
+    ``(k_rows, v_rows)`` numpy arrays of shape ``(pos, ...)``; sampling
+    state (RNG key, write position, produced count, last token) rides
+    along so decode step `pos` folds the same key it would have folded
+    uninterrupted."""
+
+    __slots__ = ("req", "resp", "pos", "produced", "last_token", "key",
+                 "kv_rows", "preempted_at")
+
+    def __init__(self, run: _SlotRun, kv_rows):
+        self.req = run.req
+        self.resp = run.resp
+        self.pos = run.pos
+        self.produced = run.produced
+        self.last_token = run.last_token
+        self.key = run.key
+        self.kv_rows = kv_rows
+        self.preempted_at = time.monotonic()
 
 
 class ServingEngine:
@@ -136,6 +163,7 @@ class ServingEngine:
         # CPU pool-passthrough update; the same aliasing TPU donation does)
         self._donate = (1,)
         self._compiles = {"decode": 0, "prefill": {b: 0 for b in self.buckets}}
+        self._decode_calls = 0  # slow_decode fault stride counter
         self._decode_fn = self._build_decode()
         self._prefill_fns = {b: self._build_prefill(b) for b in self.buckets}
         # observability: latency histograms shared with the unified
@@ -283,19 +311,16 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # submission
     # ------------------------------------------------------------------
-    def submit(self, prompt, max_new_tokens: int,
-               decode_strategy: str = "greedy_search", temperature=1.0,
-               top_k=0, top_p=1.0, eos_token_id: Optional[int] = None,
-               seed: Optional[int] = None, deadline: Optional[float] = None,
-               block: bool = False, timeout: Optional[float] = None
-               ) -> Response:
-        """Enqueue one request; returns its streaming Response.
-
-        Raises InvalidArgumentError for a prompt/budget the engine can
-        never serve (prompt longer than the largest prefill bucket, or
-        prompt + max_new_tokens past max_len), QueueFullError at
-        max_queue_depth (backpressure).
-        """
+    def make_request(self, prompt, max_new_tokens: int,
+                     decode_strategy: str = "greedy_search", temperature=1.0,
+                     top_k=0, top_p=1.0, eos_token_id: Optional[int] = None,
+                     seed: Optional[int] = None,
+                     deadline: Optional[float] = None, priority: int = 0,
+                     tenant: Optional[str] = None):
+        """Validate + build one (Request, Response) pair WITHOUT enqueuing
+        it — the gateway's admission layer owns its own lanes and hands
+        requests to `try_admit` directly.  Raises InvalidArgumentError for
+        a prompt/budget the engine can never serve."""
         if self._closed:
             raise UnavailableError("serving engine is closed")
         if self._dead is not None:
@@ -314,7 +339,7 @@ class ServingEngine:
                       temperature=temperature, top_k=top_k, top_p=top_p,
                       eos_token_id=eos_token_id,
                       seed=seed if seed is not None else rid,
-                      deadline=deadline)
+                      deadline=deadline, priority=priority, tenant=tenant)
         plen = req.prompt.shape[0]
         if plen > self.buckets[-1]:
             stat_add("STAT_serving_rejects")
@@ -330,6 +355,25 @@ class ServingEngine:
             req.poison = True
         resp = Response(req)
         stat_add("STAT_serving_requests")
+        return req, resp
+
+    def submit(self, prompt, max_new_tokens: int,
+               decode_strategy: str = "greedy_search", temperature=1.0,
+               top_k=0, top_p=1.0, eos_token_id: Optional[int] = None,
+               seed: Optional[int] = None, deadline: Optional[float] = None,
+               block: bool = False, timeout: Optional[float] = None
+               ) -> Response:
+        """Enqueue one request; returns its streaming Response.
+
+        Raises InvalidArgumentError for a prompt/budget the engine can
+        never serve (prompt longer than the largest prefill bucket, or
+        prompt + max_new_tokens past max_len), QueueFullError at
+        max_queue_depth (backpressure).
+        """
+        req, resp = self.make_request(
+            prompt, max_new_tokens, decode_strategy=decode_strategy,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            eos_token_id=eos_token_id, seed=seed, deadline=deadline)
         self.scheduler.submit(req, resp, block=block, timeout=timeout)
         self._work.set()
         return resp
@@ -417,6 +461,84 @@ class ServingEngine:
             if span is not None:
                 span.__exit__(None, None, None)
 
+    # ------------------------------------------------------------------
+    # gateway admission: direct placement, preemption, restore
+    # ------------------------------------------------------------------
+    def try_admit(self, req: Request, resp: Response) -> bool:
+        """Place the request into a free slot NOW (one bucketed prefill),
+        bypassing the FIFO queue — the gateway's admission path, which
+        keeps its own priority lanes and only hands a request over once a
+        slot is actually available.  Returns False when every slot is
+        occupied.  Must be called from the thread driving step() (the
+        engine loop is single-threaded by design)."""
+        slot = self.scheduler.acquire(req, resp)
+        if slot is None:
+            return False
+        self._admit(req, resp, slot)
+        return True
+
+    def preempt_slot(self, slot: int) -> PreemptedRun:
+        """Evict the run occupying `slot`, snapshotting its live KV rows +
+        sampling state to host, and free the slot.  The response stream
+        stays OPEN (paused); `restore_run` later continues it bit-identical
+        to an uninterrupted run.
+
+        Zero new compiled programs: the snapshot is a plain
+        `jax.device_get` of the pool (host copy, same donation-safe move
+        the async checkpointer's snapshot phase makes) and the row slices
+        are numpy.  Known cost: the transfer is O(pool), not O(victim
+        rows) — free on CPU (aliased memory), two full-pool copies per
+        preempt/restore pair on an accelerator; a device-side row
+        gather/scatter would shrink it at the price of extra compiled
+        programs.  Must be called between engine steps from the driving
+        thread."""
+        run = self._slots.get(slot)
+        if run is None:
+            raise InvalidArgumentError(f"slot {slot} holds no active run")
+        host = jax.device_get(self._pools)
+        kv_rows = [(np.array(k[slot, :run.pos]), np.array(v[slot, :run.pos]))
+                   for k, v in host]
+        paused = PreemptedRun(run, kv_rows)
+        run.req.preempts += 1
+        self._slots.pop(slot, None)
+        self.scheduler.release(slot)
+        self._batch_dirty = True
+        stat_add("STAT_serving_preemptions")
+        return paused
+
+    def restore_run(self, paused: PreemptedRun) -> bool:
+        """Resume a preempted run into any free slot: the saved KV rows are
+        written back into the pool (host-side copy + upload — no compiled
+        program) and decode continues from the saved position with the
+        saved RNG key, so the remaining stream is bit-identical to a run
+        that was never preempted.  Returns False when no slot is free."""
+        slot = self.scheduler.acquire(paused.req, paused.resp)
+        if slot is None:
+            return False
+        host = jax.device_get(self._pools)
+        new_pools = []
+        for (hk, hv), (rk, rv) in zip(host, paused.kv_rows):
+            # device_get may alias backend memory on CPU: copy before the
+            # in-place row write, then re-upload (rows beyond `pos` may
+            # hold garbage from the slot's idle decode passes — the model
+            # protocol guarantees positions > pos never influence output,
+            # and decode overwrites them as it advances)
+            hk = np.array(hk)
+            hv = np.array(hv)
+            hk[slot, :paused.pos] = rk
+            hv[slot, :paused.pos] = rv
+            new_pools.append((jnp.asarray(hk), jnp.asarray(hv)))
+        self._pools = new_pools
+        run = _SlotRun(paused.req, paused.resp, pos=paused.pos,
+                       first_token=paused.last_token, key=paused.key)
+        run.produced = paused.produced
+        paused.req.resumes += 1
+        paused.req.paused_seconds += time.monotonic() - paused.preempted_at
+        self._slots[slot] = run
+        self._batch_dirty = True
+        stat_add("STAT_serving_resumes")
+        return True
+
     def _rebuild_batch(self):
         s = self.max_slots
         tokens = np.zeros((s,), np.int32)
@@ -447,6 +569,11 @@ class ServingEngine:
         try:
             if self._batch_dirty:
                 self._rebuild_batch()
+            # PDTPU_FAULT_SLOW_DECODE: host-side latency injection, read
+            # live per call — overload/SLO-miss paths become testable on
+            # CPU without a big model
+            faults.maybe_slow_decode(self._decode_calls)
+            self._decode_calls += 1
             keys, temp, top_k, top_p, greedy, poison = self._dev_params
             toks, logps, finites, ntok, npos, self._pools = self._decode_fn(
                 self._state, self._pools, self._dev_tokens, self._dev_pos,
@@ -459,6 +586,20 @@ class ServingEngine:
             for slot in list(self._slots):
                 run = self._slots[slot]
                 for j in range(toks.shape[0]):
+                    # deadline enforcement on the decode tick itself, not
+                    # only at the next sweep: a budget that expired while
+                    # the chunk was computing stops the stream here — no
+                    # post-expiry tokens are delivered, the slot recycles
+                    # now (regression: deadline shorter than one chunk)
+                    if (run.req.deadline is not None
+                            and run.req.deadline.expired()):
+                        stat_add("STAT_serving_deadline_expired")
+                        run.resp._fail(DeadlineExceededError(
+                            f"request {run.req.id} deadline "
+                            f"({run.req.deadline.seconds}s) expired "
+                            "mid-decode"))
+                        self._release(slot)
+                        break
                     if not finites[j, slot]:
                         self._fail_slot(slot, run.resp, "decode")
                         break

@@ -33,14 +33,16 @@ class Request:
 
     __slots__ = ("id", "prompt", "max_new_tokens", "greedy", "temperature",
                  "top_k", "top_p", "eos_token_id", "seed", "deadline",
-                 "poison")
+                 "poison", "priority", "tenant", "preempts", "resumes",
+                 "paused_seconds")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  greedy: bool = True, temperature: float = 1.0,
                  top_k: int = 0, top_p: float = 1.0,
                  eos_token_id: Optional[int] = None,
                  seed: Optional[int] = None,
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 priority: int = 0, tenant: Optional[str] = None):
         self.id = int(rid)
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if self.prompt.size == 0:
@@ -60,6 +62,17 @@ class Request:
         # wall-clock semantics utils.retry.RetryPolicy enforces
         self.deadline = Deadline(deadline) if deadline is not None else None
         self.poison = False  # set by the engine under PDTPU_FAULT_NAN_LOGITS
+        # gateway lane / fairness attribution (0 = best effort; higher
+        # priorities may preempt lower ones when a gateway fronts the
+        # engine — the bare engine ignores both fields)
+        self.priority = int(priority)
+        self.tenant = tenant
+        # lifecycle counters stamped by engine.preempt_slot/restore_run
+        # (kept on the request so bookkeeping dies with it — a long-lived
+        # gateway must not accumulate per-request state)
+        self.preempts = 0
+        self.resumes = 0
+        self.paused_seconds = 0.0  # total wall time spent preempted
 
 
 _TOK, _END, _ERR = 0, 1, 2

@@ -140,6 +140,20 @@ class RequestScheduler:
                 return req, resp, slot
             return None
 
+    def acquire(self, req: Request, resp: Response) -> Optional[int]:
+        """Directly claim a free slot for a request that bypasses the FIFO
+        queue (the gateway's admission / preemption-restore path, which
+        owns its own priority lanes).  Returns the slot, or None when every
+        slot is occupied."""
+        with self._lock:
+            if not self._free:
+                return None
+            slot = self._free.pop()
+            self._active[slot] = (req, resp)
+            stat_add("STAT_serving_slots_active")
+            _obs()[0].set(len(self._active))
+            return slot
+
     def release(self, slot: int):
         """Recycle a slot (completion, cancellation, deadline, or fault).
         The KV content is left as-is: the next prefill into this slot

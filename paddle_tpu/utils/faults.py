@@ -37,6 +37,14 @@ Injection points (consumed elsewhere in the framework):
                   time (engine construction), so the production decode
                   program carries zero overhead; which slot is poisoned is
                   a dynamic input.  Env: PDTPU_FAULT_NAN_LOGITS="N".
+  slow_decode     the serving engine sleeps `ms` milliseconds on the host
+                  before every `every_n`-th decode call (default every
+                  call).  Purely host-side — the compiled decode program
+                  is untouched and the injection is consulted live per
+                  call, so it can be armed/disarmed on a running engine.
+                  Makes overload, SLO-miss, and mid-decode-deadline paths
+                  testable on CPU without a big model.
+                  Env: PDTPU_FAULT_SLOW_DECODE="ms[:every_n]".
 
 Deliberately import-light (no jax at module scope): DataLoader worker
 processes and the bench orchestrator consult it before any backend exists.
@@ -51,7 +59,7 @@ from typing import Optional, Tuple
 __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "poison_grads", "worker_crash_config", "maybe_crash_worker",
            "maybe_kill_mid_save", "backend_down", "nan_logits_request",
-           "poison_logits"]
+           "poison_logits", "slow_decode_config", "maybe_slow_decode"]
 
 _ENV = {
     "nan_grads": "PDTPU_FAULT_NAN_GRADS",
@@ -59,6 +67,7 @@ _ENV = {
     "kill_mid_save": "PDTPU_FAULT_KILL_MID_SAVE",
     "backend_down": "PDTPU_FAULT_BACKEND_DOWN",
     "nan_logits": "PDTPU_FAULT_NAN_LOGITS",
+    "slow_decode": "PDTPU_FAULT_SLOW_DECODE",
 }
 
 _lock = threading.Lock()
@@ -213,6 +222,37 @@ def poison_logits(logits, poison_mask):
     factor = jnp.where(poison_mask, jnp.float32(float("nan")),
                        jnp.float32(1.0))
     return logits * factor[:, None]
+
+
+# -- slow_decode -------------------------------------------------------------
+
+def slow_decode_config() -> Optional[Tuple[float, int]]:
+    """(sleep_ms, every_n) or None when disarmed.  Consulted live per
+    decode call (host-side only — nothing is baked into any trace), so a
+    running engine reacts to arm/disarm immediately."""
+    raw = get("slow_decode")
+    if not raw:
+        return None
+    parts = raw.split(":", 1)
+    ms = float(parts[0])
+    every = int(parts[1]) if len(parts) == 2 else 1
+    return ms, max(1, every)
+
+
+def maybe_slow_decode(call_no: int) -> float:
+    """Host-side sleep before decode call number `call_no` (0-based) when
+    slow_decode is armed and call_no hits the every_n stride.  Returns the
+    seconds slept (0.0 when disarmed / off-stride)."""
+    cfg = slow_decode_config()
+    if cfg is None:
+        return 0.0
+    ms, every = cfg
+    if call_no % every:
+        return 0.0
+    import time
+    secs = ms / 1000.0
+    time.sleep(secs)
+    return secs
 
 
 # -- backend_down ------------------------------------------------------------

@@ -1,0 +1,123 @@
+"""utils.retry edge cases the serving gateway relies on (ISSUE-6
+satellite): zero/negative deadlines, Deadline reuse across retries,
+backoff-with-jitter bounds."""
+import pytest
+
+from paddle_tpu.utils.retry import (Deadline, RetriesExhausted, RetryPolicy,
+                                    retry_call)
+
+pytestmark = pytest.mark.gateway
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+# ---------------------------------------------------------------------------
+
+def test_deadline_zero_budget_expires_immediately():
+    d = Deadline(0.0)
+    assert d.expired()
+    assert d.remaining() == 0.0
+
+
+def test_deadline_negative_budget_expires_immediately():
+    d = Deadline(-3.0)
+    assert d.expired()
+    assert d.remaining() == 0.0, "remaining is clamped, never negative"
+
+
+def test_deadline_unbounded_never_expires():
+    d = Deadline(None)
+    assert not d.expired()
+    assert d.remaining() is None
+    assert "unbounded" in repr(d)
+
+
+def test_deadline_counts_from_creation_with_injected_clock():
+    t = [100.0]
+    d = Deadline(0.5, _clock=lambda: t[0])
+    assert not d.expired() and d.remaining() == 0.5
+    t[0] += 0.3
+    assert d.remaining() == pytest.approx(0.2)
+    t[0] += 0.3
+    assert d.expired() and d.remaining() == 0.0
+    assert d.elapsed() == pytest.approx(0.6)
+    assert "remaining=0.000" in repr(d)
+
+
+def test_deadline_object_is_reusable_across_checks_not_resettable():
+    """One Deadline is ONE budget: repeated expired()/remaining() calls
+    observe the same anchor (the scheduler sweeps it every tick), and a
+    fresh retry loop must create a fresh Deadline — RetryPolicy.call does."""
+    t = [0.0]
+    d = Deadline(1.0, _clock=lambda: t[0])
+    for _ in range(5):
+        assert not d.expired()
+    t[0] += 2.0
+    for _ in range(5):
+        assert d.expired(), "expiry is permanent for this budget"
+
+
+def test_retry_policy_fresh_deadline_per_call():
+    """The policy's deadline is per-CALL, not per-policy-lifetime: a
+    second .call() gets the full budget again (the gateway submits many
+    requests through one shared policy object)."""
+    sleeps = []
+    p = RetryPolicy(retries=2, base_delay=0.0, jitter=0.0, deadline=5.0,
+                    sleep=sleeps.append)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] % 2:
+            raise OSError("transient")
+        return "ok"
+
+    assert p.call(flaky) == "ok"
+    assert p.call(flaky) == "ok"
+    assert calls["n"] == 4
+
+
+def test_retry_policy_zero_deadline_exhausts_on_first_failure():
+    p = RetryPolicy(retries=5, base_delay=0.01, jitter=0.0, deadline=0.0,
+                    sleep=lambda s: None)
+    with pytest.raises(RetriesExhausted) as ei:
+        p.call(lambda: (_ for _ in ()).throw(OSError("down")))
+    assert ei.value.attempts == 1
+    assert isinstance(ei.value.last, OSError)
+
+
+# ---------------------------------------------------------------------------
+# backoff + jitter bounds
+# ---------------------------------------------------------------------------
+
+def test_backoff_schedule_doubles_and_caps():
+    p = RetryPolicy(retries=5, base_delay=0.1, max_delay=0.5, jitter=0.0)
+    assert list(p.delays()) == [0.1, 0.2, 0.4, 0.5, 0.5]
+    assert list(RetryPolicy(retries=0).delays()) == []
+
+
+def test_jitter_bounds_observed_sleeps():
+    """jitter=j draws uniformly in [d, (1+j)d] — every actual sleep must
+    stay inside the bound (thundering-herd decorrelation must never
+    shorten a delay below the schedule)."""
+    sleeps = []
+    p = RetryPolicy(retries=3, base_delay=0.1, max_delay=10.0, jitter=0.5,
+                    sleep=sleeps.append)
+    with pytest.raises(RetriesExhausted):
+        p.call(lambda: (_ for _ in ()).throw(OSError("down")))
+    assert len(sleeps) == 3
+    for got, base in zip(sleeps, [0.1, 0.2, 0.4]):
+        assert base <= got <= base * 1.5 + 1e-9, (got, base)
+
+
+def test_retry_call_giveup_on_beats_retry_on():
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise KeyboardInterrupt()
+
+    with pytest.raises(KeyboardInterrupt):
+        retry_call(fatal, retries=5, base_delay=0.0,
+                   retry_on=(BaseException,), giveup_on=(KeyboardInterrupt,))
+    assert calls["n"] == 1, "giveup_on must re-raise on the first attempt"
