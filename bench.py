@@ -840,6 +840,38 @@ def measure_gateway():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_spec_decode():
+    """ISSUE-7 acceptance artifact: probes/spec_decode_probe.py in a clean
+    CPU subprocess.  Publishes speculative decoding and int8 weight-only
+    quantization against the PR-4 continuous-batching baseline —
+    `detail.spec_decode.{accept_rate,tokens_per_sec_ratio}` (bars: >= 1.5x
+    tokens/sec at accept-rate >= 0.6, greedy streams bit-identical to solo
+    generate) and `detail.quant.{int8_tokens_per_sec_ratio,max_logit_err}`
+    (bars: quantized streams bit-identical to quantized solo generate,
+    max per-token logit error <= 5% of the logit scale), with compile
+    counts at the len(buckets)+1 bound on every leg.  The caller splits
+    the `quant` sub-record out to `detail.quant`."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes",
+                                      "spec_decode_probe.py"),
+         "--steps", os.environ.get("PDTPU_SPEC_PROBE_STEPS", "40")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("SPEC"):
+            rec = json.loads(line[len("SPEC"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"spec-decode bars failed: "
+                                 f"{rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return rec
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_mnist_eager():
     """BASELINE config #1: LeNet, EAGER per-op dispatch, single device —
     the CPU-baseline parity check (runs in a CPU subprocess; eager per-op
@@ -1078,6 +1110,7 @@ def main():
                          ("mnist_eager", measure_mnist_eager),
                          ("eager_dispatch", measure_eager_dispatch),
                          ("serving", measure_serving),
+                         ("spec_decode", measure_spec_decode),
                          ("gateway", measure_gateway),
                          ("resilience", measure_resilience),
                          ("observability", measure_observability),
@@ -1086,6 +1119,12 @@ def main():
                 detail[name] = fn()
             except Exception as e:  # secondary configs never kill the line
                 detail[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+            if name == "spec_decode" and isinstance(detail[name], dict):
+                # one probe run publishes TWO documented detail keys:
+                # detail.spec_decode.* and detail.quant.*
+                quant = detail[name].pop("quant", None)
+                if quant is not None:
+                    detail["quant"] = quant
             with open(os.path.join(
                     os.path.dirname(os.path.abspath(__file__)),
                     "BENCH_PROGRESS.json"), "w") as f:

@@ -22,10 +22,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor, unwrap
+from .speculative import (commit_speculative_greedy,  # noqa: F401
+                          commit_speculative_sampled)
 
 __all__ = ["generate", "apply_top_k", "apply_top_p",
            "apply_top_k_dynamic", "apply_top_p_dynamic",
-           "process_logits_dynamic"]
+           "process_logits_dynamic",
+           "commit_speculative_greedy", "commit_speculative_sampled"]
 
 _NEG = -1e9
 

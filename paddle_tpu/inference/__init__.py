@@ -119,7 +119,22 @@ class Config:
                           the serving analogue of loading a saved model
 
         engine_opts pass through to ServingEngine (max_slots, max_len,
-        prefill_buckets, max_queue_depth, pad_token_id, dtype).
+        prefill_buckets, max_queue_depth, pad_token_id, dtype,
+        draft_model, spec_tokens).
+
+        `quantize="int8"` converts the model (and the draft model, when
+        one is configured) with `quantization.quantize_for_serving`
+        before the engine is built: int8 weight-only Linears with
+        per-channel fp scales, dequantized at use inside the UNCHANGED
+        serving programs — no new compiled programs beyond the quantized
+        set, half/quarter the weight HBM per decode step.
+
+        `draft_model=` (a smaller Layer speaking the same fixed-cache
+        protocol) turns on speculative decoding: the draft proposes
+        `spec_tokens` tokens per tick and the target verifies them in one
+        batched forward; greedy streams stay bit-identical to solo
+        generate.  See the README "Speculative + quantized decoding"
+        section.
 
         `gateway=` additionally fronts the engine with the multi-tenant
         SLO-aware ServingGateway (per-tenant rate limits + weighted
@@ -287,6 +302,7 @@ class ServingPredictor:
         warmup = opts.pop("warmup", True)
         start = opts.pop("start", True)
         gateway = opts.pop("gateway", None)
+        quantize = opts.pop("quantize", None)
         if model is None:
             model = provider()
             prefix = config.model_dir()
@@ -297,6 +313,18 @@ class ServingPredictor:
             data = np.load(prefix + ".pdiparams.npz")
             model.set_state_dict({k: data[k] for k in data.files})
         model.eval()
+        draft = opts.get("draft_model")
+        if draft is not None:
+            draft.eval()
+        if quantize is not None:
+            # int8 weight-only conversion at deployment: the fp weights
+            # (in-memory or restored from the artifact) become int8
+            # buffers + scales BEFORE any serving program traces, so the
+            # compiled set holds int8 from the first compile
+            from ..quantization import quantize_for_serving
+            model = quantize_for_serving(model, quantize)
+            if draft is not None:
+                opts["draft_model"] = quantize_for_serving(draft, quantize)
         self._config = config
         self.engine = ServingEngine(model, profile=config._profile, **opts)
         if warmup:

@@ -5,3 +5,4 @@ Replaces the reference's hand-written CUDA fused ops
 """
 from . import flash_attention  # noqa: F401
 from . import fused_bn_act  # noqa: F401
+from . import int8_matmul  # noqa: F401

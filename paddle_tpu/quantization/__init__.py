@@ -33,6 +33,9 @@ __all__ = [
     "QuantedLinear", "QuantedConv2D",
     "ImperativeQuantAware", "PostTrainingQuantization",
     "Int8Linear", "Int8Conv2D",
+    # serving int8 weight-only path (dequant-at-use inside the compiled
+    # serving/generate programs)
+    "quantize_weight_int8", "Int8WeightOnlyLinear", "quantize_for_serving",
 ]
 
 
@@ -268,6 +271,88 @@ class Int8Conv2D(Layer):
         stride, padding, dilation, groups, fmt = self._cfg
         return F.conv2d(x, w, self.bias, stride, padding, dilation, groups,
                         fmt)
+
+
+def quantize_weight_int8(w, per_channel=True, axis=1):
+    """Symmetric abs-max int8 quantization of a raw weight array.
+
+    Returns ``(w_int8, scale)`` with ``w ≈ w_int8 * scale`` — scale is the
+    DEQUANT multiplier (absmax/127), shaped to broadcast: per-channel over
+    ``axis`` keeps one scale per output channel (for a (in, out) Linear
+    weight, axis=1 -> scale (1, out)); per_channel=False collapses to one
+    scalar scale shaped (1,) * ndim.  Round-trip error is bounded by
+    scale/2 per element — the `test_quantization` round-trip bound."""
+    w = jnp.asarray(w)
+    if per_channel:
+        axes = tuple(i for i in range(w.ndim) if i != axis)
+        absmax = jnp.max(jnp.abs(w), axis=axes, keepdims=True)
+    else:
+        absmax = jnp.max(jnp.abs(w)).reshape((1,) * w.ndim)
+    absmax = jnp.maximum(absmax, 1e-8)
+    scale = (absmax / 127.0).astype(jnp.float32)
+    w_int8 = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return w_int8, scale
+
+
+class Int8WeightOnlyLinear(Layer):
+    """Serving int8 weight-only Linear: the weight lives as an int8
+    buffer + per-out-channel fp32 scale and is dequantized AT USE via
+    `ops.int8_matmul.dequant_matmul` (pallas kernel on TPU, XLA-fused jnp
+    fallback elsewhere).  Unlike `Int8Linear` there is NO activation
+    quantization: the decode path is weight-HBM-bound, activations stay
+    floating point, so the only error source is the ~1/127 per-channel
+    weight grid.  Buffers ride through `jit.state_arrays` into every
+    compiled serving/generate program (the program holds int8 weights —
+    the HBM win) and through `jit.save` artifacts (the .pdiparams.npz
+    stores int8 + scales)."""
+
+    def __init__(self, layer: Linear, per_channel=True):
+        super().__init__()
+        self.in_features = layer.in_features
+        self.out_features = layer.out_features
+        w_int8, scale = quantize_weight_int8(unwrap(layer.weight),
+                                             per_channel=per_channel,
+                                             axis=1)
+        self.register_buffer("w_int8", Tensor(w_int8, stop_gradient=True))
+        self.register_buffer("w_scale", Tensor(scale.reshape(1, -1),
+                                               stop_gradient=True))
+        self.bias = layer.bias
+
+    def forward(self, x):
+        from ..ops.int8_matmul import dequant_matmul
+        y = dequant_matmul(unwrap(x), unwrap(self.w_int8),
+                           unwrap(self.w_scale))
+        if self.bias is not None:
+            y = y + unwrap(self.bias)
+        return Tensor(y, stop_gradient=True)
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, int8 weight-only")
+
+
+def quantize_for_serving(model: Layer, quantize: str = "int8",
+                         per_channel: bool = True) -> Layer:
+    """Post-training int8 WEIGHT-ONLY conversion for the serving /
+    generate path: swaps every Linear in place for `Int8WeightOnlyLinear`
+    (no calibration pass needed — activations are untouched).  Returns
+    the same model object.  Embeddings and tied LM heads stay fp
+    (quantizing the tied weight would also perturb the embedding lookup);
+    the Linears carry the bulk of a transformer's weight bytes, which is
+    where the decode HBM traffic lives.  Wired through
+    ``inference.Config.enable_serving(..., quantize="int8")``; a
+    quantized model runs through the UNCHANGED serving programs (same
+    compile bound — the int8 buffers are just different-dtype state
+    inputs)."""
+    if quantize != "int8":
+        raise ValueError(
+            f"quantize_for_serving supports 'int8', got {quantize!r}")
+
+    def factory(child):
+        if isinstance(child, Linear):
+            return Int8WeightOnlyLinear(child, per_channel=per_channel)
+        return None
+    return _replace_layers(model, factory)
 
 
 class PostTrainingQuantization:

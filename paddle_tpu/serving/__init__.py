@@ -48,6 +48,22 @@ requests are bit-identical to a solo `generation.generate` of the same
 prompt; one poisoned/expired/cancelled request only ever costs its own
 slot.
 
+Speculative decoding
+--------------------
+``ServingEngine(model, draft_model=small_model, spec_tokens=K)`` swaps
+the decode program for ONE verify program: the draft proposes K tokens
+per tick (its own slot pool, same protocol), the target scores all K+1
+positions in one batched forward, and the longest accepted prefix plus a
+corrected token commits in-program (`generation.speculative` — greedy
+argmax-equality accept, distribution-preserving rejection sampling for
+sampling slots).  The program bound is unchanged; per-request
+``submit(..., spec=False)`` opts out inside the same trace; greedy
+streams stay bit-identical to solo generate at ANY draft quality.
+Quantize the served weights with
+``quantization.quantize_for_serving(model)`` (int8 weight-only,
+dequant-at-use) — composable with speculation and with the gateway.  See
+the README "Speculative + quantized decoding" section.
+
 Gateway
 -------
 `ServingGateway` (gateway.py + slo.py) is the multi-tenant front door

@@ -21,6 +21,25 @@ Compilation count is therefore bounded by len(prefill_buckets) + 1 per
 engine, regardless of how many (prompt_len, max_new, sampling-param)
 combinations the traffic mixes — asserted by `compile_counts()`.
 
+**Speculative decoding** (``draft_model=``): the decode program is
+replaced by ONE verify program per engine that (a) runs ``spec_tokens``
+sequential draft-model steps proposing K tokens per slot (the draft owns
+its own slot pool, written with the same protocol), (b) scores
+``[last_committed, d_1..d_K]`` — K+1 positions — in ONE batched target
+forward, and (c) commits the longest accepted prefix plus one corrected
+token entirely in-program (`generation.speculative`: greedy equality
+accept, or distribution-preserving rejection sampling for sampling
+slots), so a tick advances 1..K+1 tokens per slot with a single target
+dispatch.  Per-bucket prefill additionally prefills the draft pool inside
+the same program.  The program bound is UNCHANGED: len(prefill_buckets)
+prefill programs (each covering target + draft) + 1 verify program —
+spec on/off per request, greedy/sampling, and every sampling-param combo
+share the single verify trace via dynamic per-slot inputs.  Greedy
+speculative streams stay bit-identical to solo `generate` (acceptance is
+argmax equality against the same logits rows the solo loop argmaxes);
+spec-off slots inside a speculative engine reproduce the plain decode
+step token-for-token (same key folds, same distributions).
+
 Greedy requests are bit-identical to a solo
 `generation.generate(decode_strategy='greedy_search')` run of the same
 prompt: prefill logits at the prompt's last position are unaffected by
@@ -94,9 +113,9 @@ class PreemptedRun:
     uninterrupted."""
 
     __slots__ = ("req", "resp", "pos", "produced", "last_token", "key",
-                 "kv_rows", "preempted_at")
+                 "kv_rows", "draft_kv_rows", "preempted_at")
 
-    def __init__(self, run: _SlotRun, kv_rows):
+    def __init__(self, run: _SlotRun, kv_rows, draft_kv_rows=None):
         self.req = run.req
         self.resp = run.resp
         self.pos = run.pos
@@ -104,6 +123,12 @@ class PreemptedRun:
         self.last_token = run.last_token
         self.key = run.key
         self.kv_rows = kv_rows
+        # speculative engines snapshot the draft pool rows too: resuming
+        # with a coherent draft context preserves the accept rate (output
+        # correctness never depends on draft KV — rejected proposals are
+        # free — but garbage draft context would decay a resumed stream
+        # to target-only throughput)
+        self.draft_kv_rows = draft_kv_rows
         self.preempted_at = time.monotonic()
 
 
@@ -115,7 +140,8 @@ class ServingEngine:
     def __init__(self, model, max_slots: int = 8, max_len: int = 256,
                  prefill_buckets=None, max_queue_depth: int = 64,
                  pad_token_id: int = 0, dtype=None, profile: bool = False,
-                 decode_chunk: int = 4):
+                 decode_chunk: int = 4, draft_model=None,
+                 spec_tokens: int = 4):
         from ..generation import _model_fns
         self.model = model
         self.max_slots = int(max_slots)
@@ -140,9 +166,29 @@ class ServingEngine:
         self.decode_chunk = max(1, int(decode_chunk))
         self.scheduler = RequestScheduler(self.max_slots, max_queue_depth)
         self._state, self._apply = _model_fns(model)
-        # THE pool: one gen_fixed_cache(max_slots, max_len) allocation,
+        self.draft_model = draft_model
+        self.spec_tokens = int(spec_tokens)
+        if draft_model is not None:
+            if self.spec_tokens < 1:
+                raise InvalidArgumentError(
+                    f"spec_tokens must be >= 1, got {self.spec_tokens}")
+            if self.spec_tokens >= self.max_len:
+                raise InvalidArgumentError(
+                    f"spec_tokens {self.spec_tokens} must be < max_len "
+                    f"{self.max_len}")
+        # pool length: speculative engines get spec_tokens rows of
+        # HEADROOM beyond max_len — a verify tick writes K+1 rows at
+        # pos..pos+K even when only one commits, and pos legitimately
+        # reaches plen+max_new-2 <= max_len-2; without the headroom the
+        # final ticks of a full-budget request would have
+        # dynamic_update_slice CLAMP the write start and silently
+        # overwrite committed KV (breaking greedy parity).  Request
+        # validation stays at plen+max_new <= max_len.
+        self._pool_len = self.max_len + (
+            self.spec_tokens if draft_model is not None else 0)
+        # THE pool: one gen_fixed_cache(max_slots, pool_len) allocation,
         # reused for the engine's lifetime
-        self._pools = model.gen_fixed_cache(self.max_slots, self.max_len,
+        self._pools = model.gen_fixed_cache(self.max_slots, self._pool_len,
                                             dtype)
         self._slots: Dict[int, _SlotRun] = {}
         # device-resident decode batch state; rebuilt from host _SlotRun
@@ -164,8 +210,29 @@ class ServingEngine:
         self._donate = (1,)
         self._compiles = {"decode": 0, "prefill": {b: 0 for b in self.buckets}}
         self._decode_calls = 0  # slow_decode fault stride counter
-        self._decode_fn = self._build_decode()
-        self._prefill_fns = {b: self._build_prefill(b) for b in self.buckets}
+        # speculative decoding: a draft model swaps the decode program for
+        # the single verify program and adds a draft slot pool + draft
+        # prefill folded into the per-bucket prefill programs — the
+        # compiled-program bound stays len(buckets) + 1
+        if draft_model is not None:
+            self._dstate, self._dapply = _model_fns(draft_model)
+            self._draft_pools = draft_model.gen_fixed_cache(
+                self.max_slots, self._pool_len, dtype)
+            # draft_diverge fault: presence decided NOW (trace time); the
+            # per-tick flag is a dynamic input
+            self._diverge_every = faults.draft_diverge_every()
+            self._spec_ticks = 0
+            from ..observability import metrics as _obs_m2
+            self._h_accept = _obs_m2.histogram(
+                "serving_spec_accept_rate",
+                "accepted draft proposals / spec_tokens, per slot per tick")
+            self._spec_proposed = 0
+            self._spec_accepted = 0
+            self._decode_fn = self._build_verify()
+        else:
+            self._decode_fn = self._build_decode()
+        self._prefill_fns = {b: self._build_prefill(b)
+                             for b in self.buckets}
         # observability: latency histograms shared with the unified
         # report / Prometheus endpoint (handles cached; registry.reset()
         # zeroes values in place)
@@ -195,21 +262,25 @@ class ServingEngine:
     # compiled programs
     # ------------------------------------------------------------------
     def _build_prefill(self, bucket: int):
+        """One per-bucket prefill program.  On a speculative engine the
+        SAME program additionally prefills the draft pool (one draft
+        forward over the same padded ids, slot row written with the same
+        full-range overwrite) — the first token still comes from the
+        target's last-prompt-position logits, so greedy parity is
+        identical with and without a draft."""
         apply_fixed = self._apply
-        model, max_len, dtype = self.model, self.max_len, self._dtype
+        model, draft = self.model, self.draft_model
+        pool_len, dtype = self._pool_len, self._dtype
+        dapply = self._dapply if draft is not None else None
 
-        def prefill(state, pools, ids, slot, prompt_len, key, temp, top_k,
-                    top_p, greedy):
-            self._compiles["prefill"][bucket] += 1  # trace-count (host)
-            stat_add("STAT_serving_compiles")
-            scratch = model.gen_fixed_cache(1, bucket, dtype)
-            logits, kv = apply_fixed(state, ids, scratch, 0)
+        def write_slot(pools, kv, slot):
             new_pools = []
             for (kp, vp), (kc, vc) in zip(pools, kv):
-                # full-range overwrite: bucket KV + zeros to max_len, so a
-                # recycled slot keeps no stale KV from its previous tenant
-                krow = jnp.zeros((1, max_len) + kp.shape[2:], kp.dtype)
-                vrow = jnp.zeros((1, max_len) + vp.shape[2:], vp.dtype)
+                # full-range overwrite: bucket KV + zeros to pool_len, so
+                # a recycled slot keeps no stale KV from its previous
+                # tenant
+                krow = jnp.zeros((1, pool_len) + kp.shape[2:], kp.dtype)
+                vrow = jnp.zeros((1, pool_len) + vp.shape[2:], vp.dtype)
                 krow = jax.lax.dynamic_update_slice(
                     krow, kc.astype(kp.dtype), (0, 0, 0, 0))
                 vrow = jax.lax.dynamic_update_slice(
@@ -217,8 +288,13 @@ class ServingEngine:
                 new_pools.append((
                     jax.lax.dynamic_update_slice(kp, krow, (slot, 0, 0, 0)),
                     jax.lax.dynamic_update_slice(vp, vrow, (slot, 0, 0, 0))))
-            # right-padding never touches the prompt's last-position logits
-            # (causal mask), so this matches the solo generate prefill
+            return new_pools
+
+        def first_token(logits, prompt_len, key, temp, top_k, top_p,
+                        greedy):
+            # right-padding never touches the prompt's last-position
+            # logits (causal mask), so this matches the solo generate
+            # prefill
             last = jax.lax.dynamic_index_in_dim(
                 logits[0].astype(jnp.float32), prompt_len - 1, axis=0,
                 keepdims=False)
@@ -233,11 +309,42 @@ class ServingEngine:
             tok = jnp.where(greedy, jnp.argmax(proc, axis=-1),
                             sampled).astype(jnp.int32)
             logp = jax.nn.log_softmax(proc)[tok]
-            return tok, logp, finite, new_pools
+            return tok, logp, finite
+
+        def count_trace():
+            self._compiles["prefill"][bucket] += 1  # trace-count (host)
+            stat_add("STAT_serving_compiles")
+
+        if draft is None:
+            def prefill(state, pools, ids, slot, prompt_len, key, temp,
+                        top_k, top_p, greedy):
+                count_trace()
+                scratch = model.gen_fixed_cache(1, bucket, dtype)
+                logits, kv = apply_fixed(state, ids, scratch, 0)
+                new_pools = write_slot(pools, kv, slot)
+                tok, logp, finite = first_token(
+                    logits, prompt_len, key, temp, top_k, top_p, greedy)
+                return tok, logp, finite, new_pools
+
+            name, donate = f"serving_prefill_b{bucket}", self._donate
+        else:
+            def prefill(state, dstate, pools, dpools, ids, slot,
+                        prompt_len, key, temp, top_k, top_p, greedy):
+                count_trace()
+                scratch = model.gen_fixed_cache(1, bucket, dtype)
+                logits, kv = apply_fixed(state, ids, scratch, 0)
+                new_pools = write_slot(pools, kv, slot)
+                dscratch = draft.gen_fixed_cache(1, bucket, dtype)
+                _, dkv = dapply(dstate, ids, dscratch, 0)
+                new_dpools = write_slot(dpools, dkv, slot)
+                tok, logp, finite = first_token(
+                    logits, prompt_len, key, temp, top_k, top_p, greedy)
+                return tok, logp, finite, new_pools, new_dpools
+
+            name, donate = f"serving_prefill_spec_b{bucket}", (2, 3)
 
         from ..observability import track
-        return track(f"serving_prefill_b{bucket}",
-                     jax.jit(prefill, donate_argnums=self._donate))
+        return track(name, jax.jit(prefill, donate_argnums=donate))
 
     def _build_decode(self):
         apply_fixed = self._apply
@@ -309,6 +416,123 @@ class ServingEngine:
                      jax.jit(decode, donate_argnums=self._donate))
 
     # ------------------------------------------------------------------
+    # speculative verify program (draft_model engines)
+    # ------------------------------------------------------------------
+    def _build_verify(self):
+        """THE speculative tick: K sequential draft proposals, one batched
+        target forward over [last_committed, d_1..d_K] (K+1 positions),
+        in-program accept/reject + commit (generation.speculative).  One
+        trace, ever: sampling params, spec on/off, poison and diverge are
+        all dynamic per-slot/per-tick inputs."""
+        from ..generation.speculative import (commit_speculative_greedy,
+                                              commit_speculative_sampled,
+                                              draft_proposal_key)
+        apply_fixed, dapply = self._apply, self._dapply
+        poison_armed = self._poison_target is not None
+        diverge_armed = self._diverge_every is not None
+        k_spec = self.spec_tokens
+        pad = self.pad_token_id
+
+        def verify(state, dstate, pools, dpools, tokens, pos, keys, temp,
+                   top_k, top_p, greedy, spec_on, poison, diverge):
+            self._compiles["decode"] += 1  # trace-count (host side effect)
+            stat_add("STAT_serving_compiles")
+
+            def drow(tok, caches, p):
+                c = [(kb[None], vb[None]) for (kb, vb) in caches]
+                logits, new = dapply(dstate, tok[None, None], c, p)
+                return (logits[0, -1].astype(jnp.float32),
+                        [(kb[0], vb[0]) for (kb, vb) in new])
+
+            def dstep(carry, i):
+                cur, dp = carry
+                dlast, dp = jax.vmap(drow)(cur, dp, pos + i)
+                if diverge_armed:
+                    dlast = faults.poison_draft_logits(dlast, diverge)
+                dfin = jnp.isfinite(dlast).all(axis=-1)
+
+                # all-greedy fast path, same rationale as the plain decode
+                # step: a pure-greedy batch skips the per-proposal sort
+                # pipeline + threefry inside the one shared trace
+                def mixed(dlast):
+                    proc = process_logits_dynamic(dlast, temp, top_k,
+                                                  top_p, greedy)
+                    kd = jax.vmap(
+                        lambda kk, pp: draft_proposal_key(kk, pp, i))(
+                            keys, pos)
+                    sampled = jax.vmap(jax.random.categorical)(kd, proc)
+                    prop = jnp.where(greedy, jnp.argmax(proc, axis=-1),
+                                     sampled).astype(jnp.int32)
+                    return prop, jax.nn.softmax(proc, axis=-1)
+
+                def all_greedy(dlast):
+                    return (jnp.argmax(dlast, axis=-1).astype(jnp.int32),
+                            jax.nn.softmax(dlast, axis=-1))
+
+                prop, q = jax.lax.cond(jnp.all(greedy), all_greedy, mixed,
+                                       dlast)
+                return (prop, dp), (prop, q, dfin)
+
+            # K+1 draft steps, not K: step K feeds the LAST proposal d_K
+            # at pos+K so a fully-accepted tick leaves the draft pool
+            # dense (d_K commits when everything accepts; without this
+            # row every all-accept tick would punch a permanent zero-KV
+            # hole the draft attends over forever, decaying the accept
+            # rate cumulatively — worst exactly when the draft is good).
+            # Step K's proposal/q outputs are discarded; on a rejection
+            # its KV row is beyond the committed prefix and the next
+            # tick overwrites it before any query can attend it.
+            (_, dpools), (props, qs, dfins) = jax.lax.scan(
+                dstep, (tokens, dpools), jnp.arange(k_spec + 1))
+            props = props[:k_spec].T             # (S, K)
+            qs = jnp.swapaxes(qs[:k_spec], 0, 1)  # (S, K, V)
+            dfin = dfins.all(axis=0)             # (S,)
+
+            # target scores all K proposals + the bonus position in ONE
+            # forward of K+1 tokens per slot
+            ids = jnp.concatenate([tokens[:, None], props], axis=1)
+
+            def trow(row_ids, caches, p):
+                c = [(kb[None], vb[None]) for (kb, vb) in caches]
+                logits, new = apply_fixed(state, row_ids[None], c, p)
+                return (logits[0].astype(jnp.float32),
+                        [(kb[0], vb[0]) for (kb, vb) in new])
+
+            tlog, pools = jax.vmap(trow)(ids, pools, pos)  # (S, K+1, V)
+            if poison_armed:
+                factor = jnp.where(poison, jnp.float32(float("nan")),
+                                   jnp.float32(1.0))
+                tlog = tlog * factor[:, None, None]
+            # draft non-finiteness only matters for slots actually
+            # speculating — a spec-off slot must never die for garbage in
+            # a pool it does not consume
+            finite = (jnp.isfinite(tlog).all(axis=(1, 2))
+                      & (dfin | ~spec_on))
+
+            def proc_all(t):
+                flat = t.reshape(-1, t.shape[-1])
+
+                def rep(a):
+                    return jnp.repeat(a, k_spec + 1, axis=0)
+                return process_logits_dynamic(
+                    flat, rep(temp), rep(top_k), rep(top_p),
+                    rep(greedy)).reshape(t.shape)
+
+            plog = jax.lax.cond(jnp.all(greedy), lambda t: t, proc_all,
+                                tlog)
+            ops = (props, qs, plog, keys, pos, greedy, spec_on)
+            out, count, accepted, last, logps = jax.lax.cond(
+                jnp.all(greedy),
+                lambda o: commit_speculative_greedy(*o, pad),
+                lambda o: commit_speculative_sampled(*o, pad), ops)
+            return (out, logps, finite, count, accepted, last, pos + count,
+                    pools, dpools)
+
+        from ..observability import track
+        return track("serving_verify",
+                     jax.jit(verify, donate_argnums=(2, 3)))
+
+    # ------------------------------------------------------------------
     # submission
     # ------------------------------------------------------------------
     def make_request(self, prompt, max_new_tokens: int,
@@ -316,7 +540,8 @@ class ServingEngine:
                      top_k=0, top_p=1.0, eos_token_id: Optional[int] = None,
                      seed: Optional[int] = None,
                      deadline: Optional[float] = None, priority: int = 0,
-                     tenant: Optional[str] = None):
+                     tenant: Optional[str] = None,
+                     spec: Optional[bool] = None):
         """Validate + build one (Request, Response) pair WITHOUT enqueuing
         it — the gateway's admission layer owns its own lanes and hands
         requests to `try_admit` directly.  Raises InvalidArgumentError for
@@ -331,6 +556,15 @@ class ServingEngine:
                 f"serving supports 'greedy_search' or 'sampling', got "
                 f"{decode_strategy!r} (beam search holds k hypotheses per "
                 "slot — use generation.generate)")
+        # spec=None -> the engine default: speculate whenever a draft
+        # model is configured.  Explicit spec=True on a draftless engine
+        # is a caller error, not a silent downgrade.
+        if spec is None:
+            spec = self.draft_model is not None
+        elif spec and self.draft_model is None:
+            raise InvalidArgumentError(
+                "spec=True requires the engine to be built with a "
+                "draft_model (speculative decoding)")
         with self._submit_lock:
             rid = self._rid
             self._rid += 1
@@ -339,7 +573,8 @@ class ServingEngine:
                       temperature=temperature, top_k=top_k, top_p=top_p,
                       eos_token_id=eos_token_id,
                       seed=seed if seed is not None else rid,
-                      deadline=deadline, priority=priority, tenant=tenant)
+                      deadline=deadline, priority=priority, tenant=tenant,
+                      spec=bool(spec))
         plen = req.prompt.shape[0]
         if plen > self.buckets[-1]:
             stat_add("STAT_serving_rejects")
@@ -361,8 +596,8 @@ class ServingEngine:
                decode_strategy: str = "greedy_search", temperature=1.0,
                top_k=0, top_p=1.0, eos_token_id: Optional[int] = None,
                seed: Optional[int] = None, deadline: Optional[float] = None,
-               block: bool = False, timeout: Optional[float] = None
-               ) -> Response:
+               block: bool = False, timeout: Optional[float] = None,
+               spec: Optional[bool] = None) -> Response:
         """Enqueue one request; returns its streaming Response.
 
         Raises InvalidArgumentError for a prompt/budget the engine can
@@ -373,7 +608,8 @@ class ServingEngine:
         req, resp = self.make_request(
             prompt, max_new_tokens, decode_strategy=decode_strategy,
             temperature=temperature, top_k=top_k, top_p=top_p,
-            eos_token_id=eos_token_id, seed=seed, deadline=deadline)
+            eos_token_id=eos_token_id, seed=seed, deadline=deadline,
+            spec=spec)
         self.scheduler.submit(req, resp, block=block, timeout=timeout)
         self._work.set()
         return resp
@@ -441,11 +677,20 @@ class ServingEngine:
             ids = np.full((1, bucket), self.pad_token_id, np.int32)
             ids[0, :plen] = req.prompt
             key = self._request_key(req)
-            tok, logp, finite, self._pools = self._prefill_fns[bucket](
-                self._state, self._pools, jnp.asarray(ids),
-                jnp.int32(slot), jnp.int32(plen), jnp.asarray(key),
-                jnp.float32(req.temperature), jnp.int32(req.top_k),
-                jnp.float32(req.top_p), jnp.asarray(req.greedy))
+            if self.draft_model is not None:
+                (tok, logp, finite, self._pools,
+                 self._draft_pools) = self._prefill_fns[bucket](
+                    self._state, self._dstate, self._pools,
+                    self._draft_pools, jnp.asarray(ids), jnp.int32(slot),
+                    jnp.int32(plen), jnp.asarray(key),
+                    jnp.float32(req.temperature), jnp.int32(req.top_k),
+                    jnp.float32(req.top_p), jnp.asarray(req.greedy))
+            else:
+                tok, logp, finite, self._pools = self._prefill_fns[bucket](
+                    self._state, self._pools, jnp.asarray(ids),
+                    jnp.int32(slot), jnp.int32(plen), jnp.asarray(key),
+                    jnp.float32(req.temperature), jnp.int32(req.top_k),
+                    jnp.float32(req.top_p), jnp.asarray(req.greedy))
             stat_add("STAT_serving_prefills")
             if not bool(finite):
                 self._fail_slot(slot, resp, "prefill")
@@ -488,17 +733,24 @@ class ServingEngine:
         the async checkpointer's snapshot phase makes) and the row slices
         are numpy.  Known cost: the transfer is O(pool), not O(victim
         rows) — free on CPU (aliased memory), two full-pool copies per
-        preempt/restore pair on an accelerator; a device-side row
+        preempt/restore pair on an accelerator (four on a speculative
+        engine, whose draft pool rides along); a device-side row
         gather/scatter would shrink it at the price of extra compiled
-        programs.  Must be called between engine steps from the driving
-        thread."""
+        programs — and slicing `[slot, :pos]` before the device_get
+        would compile one tiny gather per distinct pos, which is worse.
+        Must be called between engine steps from the driving thread."""
         run = self._slots.get(slot)
         if run is None:
             raise InvalidArgumentError(f"slot {slot} holds no active run")
         host = jax.device_get(self._pools)
         kv_rows = [(np.array(k[slot, :run.pos]), np.array(v[slot, :run.pos]))
                    for k, v in host]
-        paused = PreemptedRun(run, kv_rows)
+        draft_rows = None
+        if self.draft_model is not None:
+            dhost = jax.device_get(self._draft_pools)
+            draft_rows = [(np.array(k[slot, :run.pos]),
+                           np.array(v[slot, :run.pos])) for k, v in dhost]
+        paused = PreemptedRun(run, kv_rows, draft_rows)
         run.req.preempts += 1
         self._slots.pop(slot, None)
         self.scheduler.release(slot)
@@ -515,20 +767,26 @@ class ServingEngine:
         slot = self.scheduler.acquire(paused.req, paused.resp)
         if slot is None:
             return False
-        host = jax.device_get(self._pools)
-        new_pools = []
-        for (hk, hv), (rk, rv) in zip(host, paused.kv_rows):
-            # device_get may alias backend memory on CPU: copy before the
-            # in-place row write, then re-upload (rows beyond `pos` may
-            # hold garbage from the slot's idle decode passes — the model
-            # protocol guarantees positions > pos never influence output,
-            # and decode overwrites them as it advances)
-            hk = np.array(hk)
-            hv = np.array(hv)
-            hk[slot, :paused.pos] = rk
-            hv[slot, :paused.pos] = rv
-            new_pools.append((jnp.asarray(hk), jnp.asarray(hv)))
-        self._pools = new_pools
+        def write_rows(pools, rows):
+            new_pools = []
+            for (hk, hv), (rk, rv) in zip(jax.device_get(pools), rows):
+                # device_get may alias backend memory on CPU: copy before
+                # the in-place row write, then re-upload (rows beyond
+                # `pos` may hold garbage from the slot's idle decode
+                # passes — the model protocol guarantees positions > pos
+                # never influence output, and decode overwrites them as
+                # it advances)
+                hk = np.array(hk)
+                hv = np.array(hv)
+                hk[slot, :paused.pos] = rk
+                hv[slot, :paused.pos] = rv
+                new_pools.append((jnp.asarray(hk), jnp.asarray(hv)))
+            return new_pools
+
+        self._pools = write_rows(self._pools, paused.kv_rows)
+        if self.draft_model is not None and paused.draft_kv_rows is not None:
+            self._draft_pools = write_rows(self._draft_pools,
+                                           paused.draft_kv_rows)
         run = _SlotRun(paused.req, paused.resp, pos=paused.pos,
                        first_token=paused.last_token, key=paused.key)
         run.produced = paused.produced
@@ -549,6 +807,7 @@ class ServingEngine:
         top_p = np.ones((s,), np.float32)
         greedy = np.ones((s,), bool)
         poison = np.zeros((s,), bool)
+        spec_on = np.zeros((s,), bool)
         for slot, run in self._slots.items():
             tokens[slot] = run.last_token
             pos[slot] = run.pos
@@ -558,13 +817,17 @@ class ServingEngine:
             top_p[slot] = run.req.top_p
             greedy[slot] = run.req.greedy
             poison[slot] = run.req.poison
+            spec_on[slot] = run.req.spec
         self._dev_tokens = jnp.asarray(tokens)
         self._dev_pos = jnp.asarray(pos)
         self._dev_params = tuple(jnp.asarray(a) for a in (
-            keys, temp, top_k, top_p, greedy, poison))
+            keys, temp, top_k, top_p, greedy, poison, spec_on))
         self._batch_dirty = False
 
     def _decode_step(self):
+        if self.draft_model is not None:
+            self._spec_step()
+            return
         span = self._span("serving_decode")
         try:
             if self._batch_dirty:
@@ -574,7 +837,7 @@ class ServingEngine:
             # CPU without a big model
             faults.maybe_slow_decode(self._decode_calls)
             self._decode_calls += 1
-            keys, temp, top_k, top_p, greedy, poison = self._dev_params
+            keys, temp, top_k, top_p, greedy, poison, _ = self._dev_params
             toks, logps, finites, ntok, npos, self._pools = self._decode_fn(
                 self._state, self._pools, self._dev_tokens, self._dev_pos,
                 keys, temp, top_k, top_p, greedy, poison)
@@ -617,6 +880,88 @@ class ServingEngine:
                         break
             if emitted:
                 stat_add("STAT_serving_tokens", emitted)
+        finally:
+            if span is not None:
+                span.__exit__(None, None, None)
+
+    def _spec_step(self):
+        """One speculative tick: K draft proposals + one batched target
+        verify, committing 1..K+1 tokens per slot.  Host side mirrors the
+        chunked decode step — including the PR-6 deadline rule: a tick can
+        commit up to K+1 tokens, and a deadline that expired while the
+        tick was computing stops the stream BEFORE the next commit — no
+        post-expiry token is ever delivered."""
+        span = self._span("serving_verify")
+        try:
+            if self._batch_dirty:
+                self._rebuild_batch()
+            tick_no = self._decode_calls  # lifetime stride counter: the
+            # diverge fault keys off it, NOT _spec_ticks, which is a
+            # metrics-window counter reset_metrics() zeroes
+            faults.maybe_slow_decode(tick_no)
+            self._decode_calls += 1
+            keys, temp, top_k, top_p, greedy, poison, spec_on = \
+                self._dev_params
+            diverge = bool(self._diverge_every is not None
+                           and tick_no % self._diverge_every == 0)
+            self._spec_ticks += 1
+            (toks, logps, finites, counts, accepts, last, npos,
+             self._pools, self._draft_pools) = self._decode_fn(
+                self._state, self._dstate, self._pools, self._draft_pools,
+                self._dev_tokens, self._dev_pos, keys, temp, top_k, top_p,
+                greedy, spec_on, poison, jnp.asarray(diverge))
+            self._dev_tokens, self._dev_pos = last, npos
+            # one device->host pull for the whole (slots, K+1) tick
+            toks, logps, finites, counts, accepts = jax.device_get(
+                (toks, logps, finites, counts, accepts))
+            stat_add("STAT_serving_decode_steps")
+            stat_add("STAT_spec_ticks")
+            k_spec = self.spec_tokens
+            emitted = proposed = accepted_n = 0
+            for slot in list(self._slots):
+                run = self._slots[slot]
+                if not finites[slot]:
+                    self._fail_slot(slot, run.resp, "verify")
+                    continue
+                if run.req.spec:
+                    proposed += k_spec
+                    accepted_n += int(accepts[slot])
+                    self._h_accept.observe(int(accepts[slot]) / k_spec)
+                for j in range(int(counts[slot])):
+                    # deadline enforcement on the tick itself (PR-6 rule):
+                    # a speculative tick may hold K+1 ready tokens, but a
+                    # budget that expired mid-tick delivers none of the
+                    # remainder — the slot recycles now (regression:
+                    # deadline shorter than one speculative tick)
+                    if (run.req.deadline is not None
+                            and run.req.deadline.expired()):
+                        stat_add("STAT_serving_deadline_expired")
+                        run.resp._fail(DeadlineExceededError(
+                            f"request {run.req.id} deadline "
+                            f"({run.req.deadline.seconds}s) expired "
+                            "mid-decode"))
+                        self._release(slot)
+                        break
+                    t = int(toks[slot, j])
+                    run.pos += 1
+                    run.produced += 1
+                    run.last_token = t
+                    self._emit(run, t, float(logps[slot, j]))
+                    emitted += 1
+                    self._maybe_finish(slot, run, t)
+                    if slot not in self._slots:
+                        # finished mid-tick: the tail commits are
+                        # discarded (their KV garbage dies with the
+                        # slot's next prefill)
+                        break
+            if emitted:
+                stat_add("STAT_serving_tokens", emitted)
+            if proposed:
+                stat_add("STAT_spec_proposed", proposed)
+                stat_add("STAT_spec_accepted", accepted_n)
+                with self._m_lock:
+                    self._spec_proposed += proposed
+                    self._spec_accepted += accepted_n
         finally:
             if span is not None:
                 span.__exit__(None, None, None)
@@ -738,30 +1083,54 @@ class ServingEngine:
 
     def warmup(self):
         """Compile every program the engine will ever run (one prefill per
-        bucket + the decode step) so no request pays a trace.  Runs dummy
-        data through slot 0; safe any time no request is in flight."""
+        bucket + the decode/verify step) so no request pays a trace.  Runs
+        dummy data through slot 0; safe any time no request is in
+        flight."""
+        s = self.max_slots
+        zero_key = jnp.asarray(np.zeros(self._key_width, np.uint32))
         for b in self.buckets:
             ids = np.full((1, b), self.pad_token_id, np.int32)
-            _, _, _, self._pools = self._prefill_fns[b](
-                self._state, self._pools, jnp.asarray(ids), jnp.int32(0),
-                jnp.int32(1), jnp.asarray(np.zeros(self._key_width,
-                                                   np.uint32)),
-                jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0),
-                jnp.asarray(True))
-        s = self.max_slots
-        _, _, _, _, _, self._pools = self._decode_fn(
-            self._state, self._pools, jnp.zeros((s,), jnp.int32),
-            jnp.zeros((s,), jnp.int32),
-            jnp.zeros((s, self._key_width), jnp.uint32),
-            jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
-            jnp.ones((s,), jnp.float32), jnp.ones((s,), bool),
-            jnp.zeros((s,), bool))
+            if self.draft_model is not None:
+                (_, _, _, self._pools,
+                 self._draft_pools) = self._prefill_fns[b](
+                    self._state, self._dstate, self._pools,
+                    self._draft_pools, jnp.asarray(ids), jnp.int32(0),
+                    jnp.int32(1), zero_key, jnp.float32(1.0), jnp.int32(0),
+                    jnp.float32(1.0), jnp.asarray(True))
+            else:
+                _, _, _, self._pools = self._prefill_fns[b](
+                    self._state, self._pools, jnp.asarray(ids),
+                    jnp.int32(0), jnp.int32(1), zero_key, jnp.float32(1.0),
+                    jnp.int32(0), jnp.float32(1.0), jnp.asarray(True))
+        if self.draft_model is not None:
+            (_, _, _, _, _, _, _, self._pools,
+             self._draft_pools) = self._decode_fn(
+                self._state, self._dstate, self._pools, self._draft_pools,
+                jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
+                jnp.zeros((s, self._key_width), jnp.uint32),
+                jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
+                jnp.ones((s,), jnp.float32), jnp.ones((s,), bool),
+                jnp.ones((s,), bool), jnp.zeros((s,), bool),
+                jnp.asarray(False))
+        else:
+            _, _, _, _, _, self._pools = self._decode_fn(
+                self._state, self._pools, jnp.zeros((s,), jnp.int32),
+                jnp.zeros((s,), jnp.int32),
+                jnp.zeros((s, self._key_width), jnp.uint32),
+                jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
+                jnp.ones((s,), jnp.float32), jnp.ones((s,), bool),
+                jnp.zeros((s,), bool))
 
     # ------------------------------------------------------------------
     # observability
     # ------------------------------------------------------------------
     def compile_counts(self) -> Dict:
-        """Traced-program counts: the ≤ len(buckets) + 1 guarantee."""
+        """Traced-program counts: the ≤ len(buckets) + 1 guarantee.  For
+        speculative engines the same bound holds — "decode" counts the one
+        verify program (draft proposal scan + batched target verify +
+        in-program commit) and each per-bucket prefill program covers
+        target AND draft prefill, so spec on/off × greedy/sampling traffic
+        never adds a program."""
         return {"decode": self._compiles["decode"],
                 "prefill": dict(self._compiles["prefill"]),
                 "total": (self._compiles["decode"]
@@ -789,7 +1158,21 @@ class ServingEngine:
                 "slot_occupancy": self.scheduler.occupancy(),
                 "max_slots": self.max_slots,
                 "compile_counts": self.compile_counts(),
+                "spec": self._spec_metrics(),
             }
+
+    def _spec_metrics(self):
+        if self.draft_model is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "spec_tokens": self.spec_tokens,
+            "ticks": self._spec_ticks,
+            "proposed": self._spec_proposed,
+            "accepted": self._spec_accepted,
+            "accept_rate": (self._spec_accepted / self._spec_proposed
+                            if self._spec_proposed else None),
+        }
 
     def reset_metrics(self):
         with self._m_lock:
@@ -800,6 +1183,10 @@ class ServingEngine:
             self._completed = 0
             self._errored = 0
             self._started_at = time.monotonic()
+            if self.draft_model is not None:
+                self._spec_ticks = 0
+                self._spec_proposed = 0
+                self._spec_accepted = 0
 
     def __enter__(self):
         return self

@@ -34,7 +34,7 @@ class Request:
     __slots__ = ("id", "prompt", "max_new_tokens", "greedy", "temperature",
                  "top_k", "top_p", "eos_token_id", "seed", "deadline",
                  "poison", "priority", "tenant", "preempts", "resumes",
-                 "paused_seconds")
+                 "paused_seconds", "spec")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  greedy: bool = True, temperature: float = 1.0,
@@ -42,7 +42,8 @@ class Request:
                  eos_token_id: Optional[int] = None,
                  seed: Optional[int] = None,
                  deadline: Optional[float] = None,
-                 priority: int = 0, tenant: Optional[str] = None):
+                 priority: int = 0, tenant: Optional[str] = None,
+                 spec: bool = False):
         self.id = int(rid)
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if self.prompt.size == 0:
@@ -62,6 +63,10 @@ class Request:
         # wall-clock semantics utils.retry.RetryPolicy enforces
         self.deadline = Deadline(deadline) if deadline is not None else None
         self.poison = False  # set by the engine under PDTPU_FAULT_NAN_LOGITS
+        # speculative decoding: draft proposals verified/committed for this
+        # request (engines with a draft model default it on; heterogeneous
+        # spec on/off slots share the one verify trace via a dynamic mask)
+        self.spec = bool(spec)
         # gateway lane / fairness attribution (0 = best effort; higher
         # priorities may preempt lower ones when a gateway fronts the
         # engine — the bare engine ignores both fields)

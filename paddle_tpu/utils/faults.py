@@ -37,6 +37,18 @@ Injection points (consumed elsewhere in the framework):
                   time (engine construction), so the production decode
                   program carries zero overhead; which slot is poisoned is
                   a dynamic input.  Env: PDTPU_FAULT_NAN_LOGITS="N".
+  draft_diverge   the speculative-decoding verify program poisons the
+                  DRAFT model's logits (negation: the draft proposes its
+                  least-likely token) on every N-th speculative tick,
+                  driving the accept rate toward zero.  Proves the
+                  accept/reject path degrades gracefully to target-only
+                  throughput: streams stay bit-identical (greedy) /
+                  distribution-preserving (sampling) because rejected
+                  proposals never commit — only tokens/sec drops.  The
+                  *presence* of the injection is decided at verify TRACE
+                  time (engine construction); whether the current tick
+                  diverges is a dynamic input.
+                  Env: PDTPU_FAULT_DRAFT_DIVERGE="N".
   slow_decode     the serving engine sleeps `ms` milliseconds on the host
                   before every `every_n`-th decode call (default every
                   call).  Purely host-side — the compiled decode program
@@ -59,7 +71,8 @@ from typing import Optional, Tuple
 __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "poison_grads", "worker_crash_config", "maybe_crash_worker",
            "maybe_kill_mid_save", "backend_down", "nan_logits_request",
-           "poison_logits", "slow_decode_config", "maybe_slow_decode"]
+           "poison_logits", "slow_decode_config", "maybe_slow_decode",
+           "draft_diverge_every", "poison_draft_logits"]
 
 _ENV = {
     "nan_grads": "PDTPU_FAULT_NAN_GRADS",
@@ -68,6 +81,7 @@ _ENV = {
     "backend_down": "PDTPU_FAULT_BACKEND_DOWN",
     "nan_logits": "PDTPU_FAULT_NAN_LOGITS",
     "slow_decode": "PDTPU_FAULT_SLOW_DECODE",
+    "draft_diverge": "PDTPU_FAULT_DRAFT_DIVERGE",
 }
 
 _lock = threading.Lock()
@@ -222,6 +236,31 @@ def poison_logits(logits, poison_mask):
     factor = jnp.where(poison_mask, jnp.float32(float("nan")),
                        jnp.float32(1.0))
     return logits * factor[:, None]
+
+
+# -- draft_diverge -----------------------------------------------------------
+
+def draft_diverge_every() -> Optional[int]:
+    """Tick stride N (poison the draft every N-th speculative tick,
+    0-based: ticks 0, N, 2N, ...), or None when disarmed.  Consulted at
+    verify TRACE time for presence (the clean verify program carries zero
+    fault branches); which tick diverges is a dynamic input the engine
+    computes host-side per call."""
+    raw = get("draft_diverge")
+    if not raw:
+        return None
+    return max(1, int(raw))
+
+
+def poison_draft_logits(logits, diverge):
+    """Negate the draft logits when `diverge` (traced bool) is set: the
+    draft proposes its LEAST-likely token, which the target all but
+    certainly rejects — a finite corruption (never NaN) so the engine's
+    non-finite guard stays out of the picture and the degradation under
+    test is purely accept-rate -> throughput.  Only ever traced into the
+    verify program when draft_diverge is armed at engine construction."""
+    import jax.numpy as jnp
+    return jnp.where(diverge, -logits, logits)
 
 
 # -- slow_decode -------------------------------------------------------------

@@ -12,7 +12,8 @@ from paddle_tpu.quantization import (
     fake_quantize_dequantize_abs_max,
     fake_quantize_dequantize_channel_wise_abs_max,
     ImperativeQuantAware, PostTrainingQuantization, Int8Linear,
-    QuantedLinear, QuantedConv2D)
+    Int8WeightOnlyLinear, QuantedLinear, QuantedConv2D,
+    quantize_for_serving, quantize_weight_int8)
 
 
 def test_fake_qdq_values_on_grid_and_ste_grad():
@@ -204,3 +205,156 @@ def test_ptq_int8_linear_numerics():
     out = q(x).numpy()
     # per-channel int8 weight quant: ~1/127 relative error budget
     assert np.abs(out - ref).max() < 0.05 * np.abs(ref).max() + 1e-3
+
+
+# ---------------------------------------------------------------------------
+# int8 weight-only serving path (ISSUE-7)
+# ---------------------------------------------------------------------------
+
+def _tiny_gpt(seed=7):
+    from paddle_tpu import models
+    cfg = models.GPTConfig(vocab_size=13, hidden_size=16,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0,
+                           max_position_embeddings=64)
+    paddle.seed(seed)
+    m = models.GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def test_int8_roundtrip_weight_error_bound():
+    """quantize -> dequant round-trip error is bounded by half a grid
+    step PER CHANNEL: |w - w_int8*scale| <= scale/2 elementwise."""
+    rng = np.random.RandomState(0)
+    w = (rng.randn(32, 16) * np.logspace(-2, 1, 16)[None, :]) \
+        .astype("float32")
+    wi, scale = quantize_weight_int8(w, per_channel=True, axis=1)
+    assert np.asarray(wi).dtype == np.int8
+    deq = np.asarray(wi).astype(np.float32) * np.asarray(scale)
+    err = np.abs(deq - w)
+    assert (err <= np.asarray(scale) / 2 + 1e-8).all(), err.max()
+
+
+def test_int8_per_channel_beats_per_tensor_on_spread_weights():
+    """With a 1000x per-channel magnitude spread, per-channel scales keep
+    ~8-bit resolution in every column; the single per-tensor scale
+    crushes the small columns — the reason the serving path defaults to
+    per-channel."""
+    rng = np.random.RandomState(1)
+    w = (rng.randn(64, 8) * np.array([0.01, 0.05, 0.1, 0.5, 1, 2, 5, 10])
+         [None, :]).astype("float32")
+    wi_c, s_c = quantize_weight_int8(w, per_channel=True, axis=1)
+    wi_t, s_t = quantize_weight_int8(w, per_channel=False)
+    err_c = np.abs(np.asarray(wi_c).astype(np.float32) * np.asarray(s_c)
+                   - w).max()
+    err_t = np.abs(np.asarray(wi_t).astype(np.float32) * np.asarray(s_t)
+                   - w).max()
+    # worst-channel relative error: per-channel stays on the 1/254 grid
+    rel_c = np.abs(np.asarray(wi_c).astype(np.float32) * np.asarray(s_c)
+                   - w) / np.abs(w).max(0, keepdims=True)
+    assert rel_c.max() < 1.0 / 127
+    assert err_c <= err_t + 1e-8
+    # per-tensor destroys the smallest column's resolution
+    small = np.abs(np.asarray(wi_t).astype(np.float32) * np.asarray(s_t)
+                   - w)[:, 0].max() / np.abs(w[:, 0]).max()
+    assert small > 1.0 / 127
+
+
+def test_quanted_linear_matches_imperative_quant_aware_on_mlp():
+    """ImperativeQuantAware.quantize must be exactly 'wrap every Linear
+    in QuantedLinear': hand-wrapping a tiny MLP layer-by-layer produces
+    the same outputs as the driver."""
+    paddle.seed(4)
+    mlp = _mlp()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(6, 16)
+                         .astype("float32"))
+    paddle.seed(4)
+    ref_model = _mlp()  # identical weights (same seed)
+    hand = paddle.nn.Sequential(
+        QuantedLinear(ref_model[0]), paddle.nn.ReLU(),
+        QuantedLinear(ref_model[2]))
+    auto = ImperativeQuantAware().quantize(mlp)
+    hand.eval()
+    auto.eval()
+    np.testing.assert_allclose(hand(x).numpy(), auto(x).numpy(),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_quantize_for_serving_swaps_linears_and_bounds_logit_error():
+    m = _tiny_gpt()
+    ids = paddle.to_tensor(np.random.RandomState(0)
+                           .randint(0, 13, (2, 8)).astype(np.int32))
+    ref = m(ids).numpy()
+    qm = quantize_for_serving(m)
+    assert qm is m  # in place
+    assert isinstance(qm.gpt.blocks[0].qkv, Int8WeightOnlyLinear)
+    out = qm(ids).numpy()
+    assert np.abs(out - ref).max() < 0.05 * np.abs(ref).max() + 1e-3
+    # weights really live as int8 buffers (-> compiled-program state and
+    # jit.save artifacts hold int8)
+    int8_keys = [k for k, v in qm.state_dict().items()
+                 if v.numpy().dtype == np.int8]
+    assert len(int8_keys) == 2 * 4  # 2 blocks x (qkv, proj, ffn_in, ffn_out)
+    with pytest.raises(ValueError):
+        quantize_for_serving(_tiny_gpt(), quantize="int4")
+
+
+def test_quantized_serving_stream_matches_quantized_solo():
+    """enable_serving(..., quantize='int8') end-to-end: the engine's
+    greedy stream is bit-identical to solo generate of the SAME quantized
+    model, with no new programs beyond the quantized set."""
+    from paddle_tpu.inference import Config, create_predictor
+    cfg = Config()
+    cfg.enable_serving(model=_tiny_gpt(), quantize="int8", max_slots=2,
+                       max_len=48, prefill_buckets=(8,), start=False)
+    pred = create_predictor(cfg)
+    try:
+        qm = pred.engine.model  # the quantized layer tree
+        assert isinstance(qm.gpt.blocks[0].qkv, Int8WeightOnlyLinear)
+        r = pred.submit([1, 2, 3, 4], max_new_tokens=6)
+        pred.engine.run_until_drained(timeout=120)
+        out, _ = qm.generate(paddle.to_tensor(
+            np.asarray([1, 2, 3, 4], np.int32)[None]), max_new_tokens=6)
+        assert r.tokens() == np.asarray(out.numpy())[0].tolist()
+        cc = pred.engine.compile_counts()
+        assert cc["total"] <= cc["bound"]
+    finally:
+        pred.close()
+
+
+def test_quantized_jit_save_artifact_roundtrip(tmp_path):
+    """jit.save of a quantized model stores int8 weights + fp scales in
+    the .pdiparams.npz; restoring them into a fresh quantized skeleton
+    reproduces the outputs exactly."""
+    qm = quantize_for_serving(_tiny_gpt(seed=5))
+    ids = paddle.to_tensor(np.random.RandomState(1)
+                           .randint(0, 13, (2, 6)).astype(np.int32))
+    ref = qm(ids).numpy()
+    path = str(tmp_path / "qgpt")
+    paddle.jit.save(qm, path)
+    data = np.load(path + ".pdiparams.npz")
+    assert sum(1 for k in data.files if data[k].dtype == np.int8) == 8
+    fresh = quantize_for_serving(_tiny_gpt(seed=99))  # different weights
+    fresh.set_state_dict({k: data[k] for k in data.files})
+    np.testing.assert_array_equal(fresh(ids).numpy(), ref)
+
+
+def test_int8_dequant_matmul_pallas_interpret_parity():
+    """The TPU dequant-matmul kernel (via the pallas interpreter) must
+    match the XLA fallback bit-for-bit on aligned shapes, including the
+    M-padding path."""
+    from paddle_tpu.ops import int8_matmul
+    rng = np.random.RandomState(0)
+    for m, k, n in [(5, 32, 128), (16, 64, 256), (300, 32, 128)]:
+        x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+        wi, s = quantize_weight_int8(rng.randn(k, n).astype("float32"))
+        ref = int8_matmul.dequant_matmul(x, wi, s.reshape(1, -1))
+        int8_matmul._INTERPRET = True
+        try:
+            out = int8_matmul.dequant_matmul(x, wi, s.reshape(1, -1))
+        finally:
+            int8_matmul._INTERPRET = False
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
