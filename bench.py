@@ -872,6 +872,35 @@ def measure_spec_decode():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_paged_serving():
+    """ISSUE-8 acceptance artifact: probes/paged_serving_probe.py in a
+    clean CPU subprocess.  Publishes the paged-vs-fixed KV pool density
+    story as `detail.paged.{resident_slots_ratio,kv_bytes_ratio,
+    tokens_per_sec_ratio}` — bars: >= 2x peak resident slots in the SAME
+    KV byte budget on mixed 32-512-token traffic, throughput >= 0.9x the
+    fixed pool, every paged stream bit-identical to the fixed leg, both
+    legs at the len(buckets)+1 compile bound."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes",
+                                      "paged_serving_probe.py"),
+         "--steps", os.environ.get("PDTPU_PAGED_PROBE_STEPS", "32")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("PAGED"):
+            rec = json.loads(line[len("PAGED"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"paged-serving bars failed: "
+                                 f"{rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return rec
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_mnist_eager():
     """BASELINE config #1: LeNet, EAGER per-op dispatch, single device —
     the CPU-baseline parity check (runs in a CPU subprocess; eager per-op
@@ -1110,6 +1139,7 @@ def main():
                          ("mnist_eager", measure_mnist_eager),
                          ("eager_dispatch", measure_eager_dispatch),
                          ("serving", measure_serving),
+                         ("paged", measure_paged_serving),
                          ("spec_decode", measure_spec_decode),
                          ("gateway", measure_gateway),
                          ("resilience", measure_resilience),

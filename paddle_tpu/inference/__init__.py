@@ -120,7 +120,10 @@ class Config:
 
         engine_opts pass through to ServingEngine (max_slots, max_len,
         prefill_buckets, max_queue_depth, pad_token_id, dtype,
-        draft_model, spec_tokens).
+        draft_model, spec_tokens, and the distributed-serving knobs:
+        kv="paged" + block_size/num_blocks for the block-granular KV
+        pool, mesh= for the tensor-parallel engine — see the README
+        "Distributed serving" section).
 
         `quantize="int8"` converts the model (and the draft model, when
         one is configured) with `quantization.quantize_for_serving`

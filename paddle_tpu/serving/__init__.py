@@ -64,6 +64,24 @@ Quantize the served weights with
 dequant-at-use) — composable with speculation and with the gateway.  See
 the README "Speculative + quantized decoding" section.
 
+Distributed serving
+-------------------
+``ServingEngine(kv="paged", block_size=B, num_blocks=N)`` swaps the
+slot-row pool for ONE block pool per layer (`PagedKVPool`,
+serving/kv_pool.py): block-granular KV allocation with per-slot block
+tables, so long and short requests share HBM instead of every slot
+paying ``max_len`` — ≥2x resident slots in the same KV byte budget on
+mixed traffic (probes/paged_serving_probe.py).  Recycled blocks are
+scrubbed in-program at re-serve, exhaustion is backpressure (admission
+waits, mid-decode shortfall preempts the newest low-priority run and
+resumes it later; `KVPoolExhaustedError` is the typed terminal state;
+``PDTPU_FAULT_KV_EXHAUST=N`` forces it all).  ``mesh=`` runs the whole
+engine tensor-parallel over a `jax.sharding.Mesh` — Megatron param
+layout, heads-sharded KV pool, same program count, streams bit-identical
+to the single-device engine.  Both compose with the gateway,
+speculation, and quantization.  See the README "Distributed serving"
+section.
+
 Gateway
 -------
 `ServingGateway` (gateway.py + slo.py) is the multi-tenant front door
@@ -89,6 +107,7 @@ deadline_expired,nonfinite}.
 from __future__ import annotations
 
 from .engine import ServingEngine, NonFiniteLogitsError, PreemptedRun
+from .kv_pool import PagedKVPool, KVPoolExhaustedError
 from .request import Request, Response, RequestCancelled
 from .scheduler import (RequestScheduler, QueueFullError,
                         DeadlineExceededError)
@@ -101,6 +120,8 @@ __all__ = [
     "ServingEngine", "Request", "Response", "RequestScheduler",
     "QueueFullError", "DeadlineExceededError", "RequestCancelled",
     "NonFiniteLogitsError", "PreemptedRun",
+    # distributed serving (paged KV pool + tensor-parallel engine)
+    "PagedKVPool", "KVPoolExhaustedError",
     # gateway (multi-tenant SLO-aware admission over the engine)
     "ServingGateway", "GatewayServer", "serve_gateway", "TenantConfig",
     "TokenBucket", "ShedPolicy", "Signals", "SLOTracker",

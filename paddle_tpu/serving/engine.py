@@ -40,6 +40,39 @@ argmax equality against the same logits rows the solo loop argmaxes);
 spec-off slots inside a speculative engine reproduce the plain decode
 step token-for-token (same key folds, same distributions).
 
+**Paged KV pool** (``kv="paged"``): the slot-row pool is replaced by ONE
+block pool per layer (``[num_blocks, block_size, heads, head_dim]`` —
+serving/kv_pool.py) with a host-side allocator and per-slot block-table
+indirection.  The compiled programs change shape but not count or
+semantics: prefill writes the prompt's blocks through the slot's table
+(full-block overwrite — no stale KV survives re-serving), the
+decode/verify step gathers each slot's table into the contiguous view
+ONCE per call (the batched form of
+`ops.paged_attention.gather_block_rows` — on CPU this reconstruction
+keeps every float op identical to the fixed engine, so streams stay
+bit-identical to solo generate) and scatters the tick's freshly written
+rows back in one pass, zeroing any block it enters (scrub-on-recycle).
+Honest cost note: the gathered view is a TRANSIENT per-call working set
+of up to fixed-pool size, so on an accelerator the density win is in
+the PERSISTENT pool only until the pallas block-table kernel
+(`ops.paged_attention.paged_attention`, which reads O(live blocks) and
+never materializes the view) replaces the gather inside the decode
+program — the ROADMAP's named next step on a live slot.  Block exhaustion
+is backpressure: admission waits for free blocks, mid-decode shortfall
+preempts the newest lowest-priority run into a host snapshot (the PR-6
+preempt machinery) and resumes it when the pool drains, and a run that
+can no longer fit at all fails with the typed `KVPoolExhaustedError`.
+``PDTPU_FAULT_KV_EXHAUST=N`` caps the live pool to force every path.
+
+**Tensor parallelism** (``mesh=``): the whole engine runs SPMD over a
+`jax.sharding.Mesh` — params laid out by `parallel.sharding.param_specs`
+(column-parallel qkv/ffn_in, row-parallel proj/ffn_out, vocab-sharded
+embeddings), the KV pool sharded over heads on the ``tp`` axis, and the
+same prefill/decode/verify programs compiled ONCE under the mesh (XLA
+GSPMD inserts the collectives).  The 8-virtual-device CPU mesh makes the
+whole thing tier-1 testable: streams match the single-device engine
+token-for-token for the same seeds.
+
 Greedy requests are bit-identical to a solo
 `generation.generate(decode_strategy='greedy_search')` run of the same
 prompt: prefill logits at the prompt's last position are unaffected by
@@ -61,16 +94,158 @@ from ..core.errors import FatalError, InvalidArgumentError, UnavailableError
 from ..generation import process_logits_dynamic
 from ..utils import faults
 from ..utils.monitor import stat_add
+from .kv_pool import KVPoolExhaustedError, PagedKVPool
 from .request import Request, Response, RequestCancelled
 from .scheduler import RequestScheduler, DeadlineExceededError
 
-__all__ = ["ServingEngine", "NonFiniteLogitsError", "PreemptedRun"]
+__all__ = ["ServingEngine", "NonFiniteLogitsError", "PreemptedRun",
+           "KVPoolExhaustedError"]
 
 
 class NonFiniteLogitsError(FatalError):
     """Decode produced NaN/Inf logits for this request's slot; the request
     is errored individually and its slot recycled."""
     code = "Fatal"
+
+
+def _first_token(logits, prompt_len, key, temp, top_k, top_p, greedy):
+    """Sample the first generated token from the prompt's last-position
+    logits (shared by the fixed and paged prefill programs).  Right
+    padding never touches that position (causal mask), so this matches
+    the solo generate prefill; the key is folded at (prompt_len - 1) and
+    decode step j folds at prompt_len + j — counters never collide."""
+    last = jax.lax.dynamic_index_in_dim(
+        logits[0].astype(jnp.float32), prompt_len - 1, axis=0,
+        keepdims=False)
+    finite = jnp.isfinite(last).all()
+    proc = process_logits_dynamic(
+        last[None], temp[None], top_k[None], top_p[None], greedy[None])[0]
+    sampled = jax.random.categorical(
+        jax.random.fold_in(key, prompt_len - 1), proc)
+    tok = jnp.where(greedy, jnp.argmax(proc, axis=-1),
+                    sampled).astype(jnp.int32)
+    logp = jax.nn.log_softmax(proc)[tok]
+    return tok, logp, finite
+
+
+def _sample_step(last, keys, pos, temp, top_k, top_p, greedy):
+    """One per-slot sampling decision over (S, V) logits — shared by the
+    fixed and paged decode steps so the bit-identical-stream contract has
+    a single implementation site.  All-greedy fast path: the full dynamic
+    sampling pipeline (two (S, V) sorts + threefry draw) costs real time
+    per iteration; a pure-greedy batch — the common serving mix — skips
+    it at runtime via lax.cond, INSIDE the single decode trace (no extra
+    program, identical tokens: with greedy all-True
+    process_logits_dynamic returns the raw logits, so both branches
+    argmax the same array)."""
+    def mixed(last):
+        proc = process_logits_dynamic(last, temp, top_k, top_p, greedy)
+        folded = jax.vmap(jax.random.fold_in)(keys, pos)
+        sampled = jax.vmap(jax.random.categorical)(folded, proc)
+        tok = jnp.where(greedy, jnp.argmax(proc, axis=-1),
+                        sampled).astype(jnp.int32)
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(proc, axis=-1), tok[:, None],
+            axis=-1)[:, 0]
+        return tok, logp
+
+    def all_greedy(last):
+        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        logp = jnp.take_along_axis(
+            jax.nn.log_softmax(last, axis=-1), tok[:, None],
+            axis=-1)[:, 0]
+        return tok, logp
+
+    return jax.lax.cond(jnp.all(greedy), all_greedy, mixed, last)
+
+
+def _draft_propose(dlast, keys, pos, temp, top_k, top_p, greedy, i):
+    """One per-slot draft proposal from (S, V) draft logits — shared by
+    the fixed and paged verify steps (same single-site rationale and
+    all-greedy fast path as _sample_step).  Returns (proposal, q)."""
+    from ..generation.speculative import draft_proposal_key
+
+    def mixed(dlast):
+        proc = process_logits_dynamic(dlast, temp, top_k, top_p, greedy)
+        kd = jax.vmap(lambda kk, pp: draft_proposal_key(kk, pp, i))(
+            keys, pos)
+        sampled = jax.vmap(jax.random.categorical)(kd, proc)
+        prop = jnp.where(greedy, jnp.argmax(proc, axis=-1),
+                         sampled).astype(jnp.int32)
+        return prop, jax.nn.softmax(proc, axis=-1)
+
+    def all_greedy(dlast):
+        return (jnp.argmax(dlast, axis=-1).astype(jnp.int32),
+                jax.nn.softmax(dlast, axis=-1))
+
+    return jax.lax.cond(jnp.all(greedy), all_greedy, mixed, dlast)
+
+
+def _extract_rows(ctx, start, n):
+    """Per-slot (n,) row windows from gathered (S, T, ...) KV views —
+    the write-back side of the paged decode/verify builders."""
+    return [
+        (jax.vmap(lambda c, p: jax.lax.dynamic_slice_in_dim(
+            c, p, n))(kc, start),
+         jax.vmap(lambda c, p: jax.lax.dynamic_slice_in_dim(
+             c, p, n))(vc, start))
+        for (kc, vc) in ctx]
+
+
+def _gather_ctx(pool, tables):
+    """Batched `ops.paged_attention.gather_block_rows` (ONE
+    implementation site for the clip/sentinel contract): (S, nb_max)
+    block tables over a (num_blocks, block_size, ...) pool -> every
+    slot's contiguous (T, ...) KV view — the SAME length the fixed
+    engine's slot row would have (to the block boundary), so the paged
+    attention pays nothing extra.  Shared by the paged decode and verify
+    builders."""
+    from ..ops.paged_attention import gather_block_rows
+    return jax.vmap(gather_block_rows, in_axes=(None, 0))(pool, tables)
+
+
+def _window_start(pos, n_rows, total_rows):
+    """Write-back window start for extracting `n_rows` rows from a
+    (*, total_rows, ...) gathered view: `pos` clamped so the window
+    never runs off the end.  A clamped window re-writes up to
+    (pos - start) rows BELOW pos with the values the gather read for
+    them — idempotent by construction — instead of paying a permanently
+    longer view just to keep dynamic_slice from clamping."""
+    return jnp.maximum(0, jnp.minimum(pos, total_rows - n_rows))
+
+
+def _paged_row_writer(block_size, sentinel, pool_len):
+    """Builds the traced write-back for paged decode/verify: scatter
+    `n_rows` freshly produced KV rows per slot (positions pos..pos+n-1)
+    through the block tables, zeroing every block a slot ENTERS (write
+    offset 0) before the rows land — the scrub-on-recycle guarantee.
+    Inactive slots and rows past pool_len route through the sentinel id
+    and are dropped."""
+    from ..ops.paged_attention import scatter_block_rows, scrub_blocks
+
+    def write(pools, tables, pos, rows_list, active, n_rows):
+        pvals = pos[:, None] + jnp.arange(n_rows)[None, :]      # (S, R)
+        bidx = jnp.clip(pvals // block_size, 0, tables.shape[1] - 1)
+        blk = jnp.take_along_axis(tables, bidx, axis=1)
+        off = (pvals % block_size).reshape(-1)
+        ok = active[:, None] & (pvals < pool_len)
+        blk_w = jnp.where(ok, blk, sentinel).reshape(-1)
+        # a block's first row IS the entering position, so every already
+        # committed row of the entering slot lives in earlier blocks —
+        # zeroing here can only erase recycled/stale speculative rows
+        scrub = jnp.where(ok & (pvals % block_size == 0), blk,
+                          sentinel).reshape(-1)
+        new_pools = []
+        for (kp, vp), (kr, vr) in zip(pools, rows_list):
+            kr = kr.reshape((-1,) + kr.shape[2:])               # (S*R, ...)
+            vr = vr.reshape((-1,) + vr.shape[2:])
+            kp = scrub_blocks(kp, scrub)
+            vp = scrub_blocks(vp, scrub)
+            new_pools.append((scatter_block_rows(kp, blk_w, off, kr),
+                              scatter_block_rows(vp, blk_w, off, vr)))
+        return new_pools
+
+    return write
 
 
 def _default_buckets(max_len: int):
@@ -141,7 +316,9 @@ class ServingEngine:
                  prefill_buckets=None, max_queue_depth: int = 64,
                  pad_token_id: int = 0, dtype=None, profile: bool = False,
                  decode_chunk: int = 4, draft_model=None,
-                 spec_tokens: int = 4):
+                 spec_tokens: int = 4, kv: str = "fixed",
+                 block_size: int = 16, num_blocks: Optional[int] = None,
+                 mesh=None):
         from ..generation import _model_fns
         self.model = model
         self.max_slots = int(max_slots)
@@ -186,10 +363,58 @@ class ServingEngine:
         # validation stays at plen+max_new <= max_len.
         self._pool_len = self.max_len + (
             self.spec_tokens if draft_model is not None else 0)
-        # THE pool: one gen_fixed_cache(max_slots, pool_len) allocation,
-        # reused for the engine's lifetime
-        self._pools = model.gen_fixed_cache(self.max_slots, self._pool_len,
-                                            dtype)
+        # tensor parallelism: lay the params out over the mesh BEFORE any
+        # program traces — prefill/decode/verify then compile once under
+        # the mesh and XLA GSPMD owns the collectives
+        self.mesh = mesh
+        self._kv_put = None
+        if mesh is not None:
+            self._init_mesh(mesh)
+            self._state = self._shard_state(self._state)
+        if kv not in ("fixed", "paged"):
+            raise InvalidArgumentError(
+                f"kv must be 'fixed' or 'paged', got {kv!r}")
+        self.kv = kv
+        self.block_size = int(block_size)
+        if kv == "paged" and self.block_size < 1:
+            raise InvalidArgumentError(
+                f"block_size must be >= 1, got {self.block_size}")
+        # rows one compiled tick may write per slot (capacity ensured
+        # host-side before each paged call)
+        self._rows_per_tick = (self.spec_tokens + 1
+                               if draft_model is not None
+                               else self.decode_chunk)
+        if kv == "paged":
+            if num_blocks is None:
+                # default capacity parity with the fixed pool: paged is
+                # opt-in HBM shaping, not a silent budget cut
+                num_blocks = self.max_slots * (
+                    -(-self._pool_len // self.block_size))
+            # THE pool: one [num_blocks, block_size, heads, dim] block
+            # pool per layer + the host-side allocator (kv_pool.py)
+            self.kv_pool = PagedKVPool(int(num_blocks), self.block_size,
+                                       self._pool_len)
+            self._pools = self.kv_pool.build_pools(model, dtype,
+                                                   put=self._kv_put)
+            # OOM preemption state: runs parked when the block pool runs
+            # dry mid-decode, resumed as it drains (bounded — overflow is
+            # the typed KVPoolExhaustedError path)
+            self._oom_paused: List[PreemptedRun] = []
+            self._max_oom_paused = max(2, 2 * self.max_slots)
+            self._paged_cache = None  # (allocator version, tables, active)
+            self._oom_preempts = 0
+            self._oom_failed = 0
+        else:
+            self.kv_pool = None
+            # THE pool: one gen_fixed_cache(max_slots, pool_len)
+            # allocation, reused for the engine's lifetime
+            self._pools = model.gen_fixed_cache(self.max_slots,
+                                                self._pool_len, dtype)
+            if self._kv_put is not None:
+                self._pools = [(self._kv_put(k), self._kv_put(v))
+                               for k, v in self._pools]
+        self._assert_kv_sharded(self._pools, "KV pool")
+        self._warm = False
         self._slots: Dict[int, _SlotRun] = {}
         # device-resident decode batch state; rebuilt from host _SlotRun
         # state only when membership changes (admission / slot release)
@@ -216,8 +441,21 @@ class ServingEngine:
         # compiled-program bound stays len(buckets) + 1
         if draft_model is not None:
             self._dstate, self._dapply = _model_fns(draft_model)
-            self._draft_pools = draft_model.gen_fixed_cache(
-                self.max_slots, self._pool_len, dtype)
+            if mesh is not None:
+                self._dstate = self._shard_state(self._dstate)
+            if self.kv == "paged":
+                # the draft pool pages too, SHARING the target's block
+                # tables (one allocator): a slot's draft KV lives at the
+                # same block ids in the draft leaf arrays
+                self._draft_pools = self.kv_pool.build_pools(
+                    draft_model, dtype, put=self._kv_put)
+            else:
+                self._draft_pools = draft_model.gen_fixed_cache(
+                    self.max_slots, self._pool_len, dtype)
+                if self._kv_put is not None:
+                    self._draft_pools = [(self._kv_put(k), self._kv_put(v))
+                                         for k, v in self._draft_pools]
+            self._assert_kv_sharded(self._draft_pools, "draft KV pool")
             # draft_diverge fault: presence decided NOW (trace time); the
             # per-tick flag is a dynamic input
             self._diverge_every = faults.draft_diverge_every()
@@ -228,11 +466,19 @@ class ServingEngine:
                 "accepted draft proposals / spec_tokens, per slot per tick")
             self._spec_proposed = 0
             self._spec_accepted = 0
-            self._decode_fn = self._build_verify()
+            self._decode_fn = (self._build_verify_paged()
+                               if self.kv == "paged"
+                               else self._build_verify())
         else:
-            self._decode_fn = self._build_decode()
-        self._prefill_fns = {b: self._build_prefill(b)
-                             for b in self.buckets}
+            self._decode_fn = (self._build_decode_paged()
+                               if self.kv == "paged"
+                               else self._build_decode())
+        if self.kv == "paged":
+            self._prefill_fns = {b: self._build_prefill_paged(b)
+                                 for b in self.buckets}
+        else:
+            self._prefill_fns = {b: self._build_prefill(b)
+                                 for b in self.buckets}
         # observability: latency histograms shared with the unified
         # report / Prometheus endpoint (handles cached; registry.reset()
         # zeroes values in place)
@@ -257,6 +503,58 @@ class ServingEngine:
         self._work = threading.Event()
         self._closed = False
         self._dead: Optional[BaseException] = None
+
+    # ------------------------------------------------------------------
+    # tensor parallelism over the mesh
+    # ------------------------------------------------------------------
+    def _init_mesh(self, mesh):
+        """Resolve the KV-pool placement for `mesh`: KV leaves are
+        (*, rows, heads, head_dim)-shaped, so the heads axis (axis 2)
+        shards over ``tp`` — each device holds its heads' slice of every
+        slot/block, the layout heads-sharded attention consumes with zero
+        collectives.  A single leaf whose head count does not divide tp
+        stays replicated; if EVERY leaf ends up replicated, __init__
+        raises (the no-silent-full-replication guard)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        tp = mesh.shape.get("tp", 1)
+        self._mesh_tp = int(tp)
+
+        def place_kv(leaf):
+            if leaf.ndim >= 3 and tp > 1 and leaf.shape[2] % tp == 0:
+                spec = P(*((None, None, "tp") + (None,) * (leaf.ndim - 3)))
+            else:
+                spec = P()
+            return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+        self._kv_put = place_kv
+
+    def _assert_kv_sharded(self, pools, what: str):
+        """The loud no-silent-replication guard: a head count that does
+        not divide tp would otherwise replicate the whole pool on every
+        device (tp x the HBM) without a word.  Applied to the target AND
+        draft pools."""
+        if (self._kv_put is not None and self._mesh_tp > 1
+                and all(k.sharding.is_fully_replicated
+                        and v.sharding.is_fully_replicated
+                        for k, v in pools)):
+            raise InvalidArgumentError(
+                f"tensor-parallel {what} fully replicated: no KV leaf's "
+                f"head axis divides tp={self._mesh_tp} — fix the head "
+                "count or the mesh (a replicated pool costs tp x the "
+                "HBM and defeats the sharding)")
+
+    def _shard_state(self, state):
+        """Megatron layout via parallel.sharding.param_specs: column-
+        parallel qkv/ffn_in, row-parallel proj/ffn_out, vocab-sharded
+        embeddings; anything unmatched (norms, biases of row layers)
+        replicates."""
+        from jax.sharding import NamedSharding
+        from ..parallel.sharding import param_specs
+        specs = param_specs(
+            {k: tuple(np.shape(v)) for k, v in state.items()},
+            self.mesh, tensor_parallel=True)
+        return {k: jax.device_put(v, NamedSharding(self.mesh, specs[k]))
+                for k, v in state.items()}
 
     # ------------------------------------------------------------------
     # compiled programs
@@ -290,26 +588,7 @@ class ServingEngine:
                     jax.lax.dynamic_update_slice(vp, vrow, (slot, 0, 0, 0))))
             return new_pools
 
-        def first_token(logits, prompt_len, key, temp, top_k, top_p,
-                        greedy):
-            # right-padding never touches the prompt's last-position
-            # logits (causal mask), so this matches the solo generate
-            # prefill
-            last = jax.lax.dynamic_index_in_dim(
-                logits[0].astype(jnp.float32), prompt_len - 1, axis=0,
-                keepdims=False)
-            finite = jnp.isfinite(last).all()
-            proc = process_logits_dynamic(
-                last[None], temp[None], top_k[None], top_p[None],
-                greedy[None])[0]
-            # the first token's key is folded at (prompt_len - 1); decode
-            # step j folds at prompt_len + j — counters never collide
-            sampled = jax.random.categorical(
-                jax.random.fold_in(key, prompt_len - 1), proc)
-            tok = jnp.where(greedy, jnp.argmax(proc, axis=-1),
-                            sampled).astype(jnp.int32)
-            logp = jax.nn.log_softmax(proc)[tok]
-            return tok, logp, finite
+        first_token = _first_token
 
         def count_trace():
             self._compiles["prefill"][bucket] += 1  # trace-count (host)
@@ -370,35 +649,8 @@ class ServingEngine:
                 if poison_armed:
                     last = faults.poison_logits(last, poison)
                 finite = jnp.isfinite(last).all(axis=-1)
-
-                # all-greedy fast path: the full dynamic sampling pipeline
-                # (two (S, V) sorts + threefry draw) costs real time per
-                # iteration; a pure-greedy batch — the common serving mix —
-                # skips it at runtime via lax.cond, INSIDE the single
-                # decode trace (no extra program, identical tokens: with
-                # greedy all-True process_logits_dynamic returns the raw
-                # logits, so both branches argmax the same array)
-                def mixed(last):
-                    proc = process_logits_dynamic(last, temp, top_k, top_p,
-                                                  greedy)
-                    folded = jax.vmap(jax.random.fold_in)(keys, pos)
-                    sampled = jax.vmap(jax.random.categorical)(folded, proc)
-                    tok = jnp.where(greedy, jnp.argmax(proc, axis=-1),
-                                    sampled).astype(jnp.int32)
-                    logp = jnp.take_along_axis(
-                        jax.nn.log_softmax(proc, axis=-1), tok[:, None],
-                        axis=-1)[:, 0]
-                    return tok, logp
-
-                def all_greedy(last):
-                    tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
-                    logp = jnp.take_along_axis(
-                        jax.nn.log_softmax(last, axis=-1), tok[:, None],
-                        axis=-1)[:, 0]
-                    return tok, logp
-
-                tok, logp = jax.lax.cond(jnp.all(greedy), all_greedy,
-                                         mixed, last)
+                tok, logp = _sample_step(last, keys, pos, temp, top_k,
+                                         top_p, greedy)
                 return (tok, pos + 1, pools), (tok, logp, finite)
 
             # chunked decode: `chunk` iterations per compiled call, the
@@ -425,8 +677,7 @@ class ServingEngine:
         trace, ever: sampling params, spec on/off, poison and diverge are
         all dynamic per-slot/per-tick inputs."""
         from ..generation.speculative import (commit_speculative_greedy,
-                                              commit_speculative_sampled,
-                                              draft_proposal_key)
+                                              commit_speculative_sampled)
         apply_fixed, dapply = self._apply, self._dapply
         poison_armed = self._poison_target is not None
         diverge_armed = self._diverge_every is not None
@@ -451,26 +702,8 @@ class ServingEngine:
                     dlast = faults.poison_draft_logits(dlast, diverge)
                 dfin = jnp.isfinite(dlast).all(axis=-1)
 
-                # all-greedy fast path, same rationale as the plain decode
-                # step: a pure-greedy batch skips the per-proposal sort
-                # pipeline + threefry inside the one shared trace
-                def mixed(dlast):
-                    proc = process_logits_dynamic(dlast, temp, top_k,
-                                                  top_p, greedy)
-                    kd = jax.vmap(
-                        lambda kk, pp: draft_proposal_key(kk, pp, i))(
-                            keys, pos)
-                    sampled = jax.vmap(jax.random.categorical)(kd, proc)
-                    prop = jnp.where(greedy, jnp.argmax(proc, axis=-1),
-                                     sampled).astype(jnp.int32)
-                    return prop, jax.nn.softmax(proc, axis=-1)
-
-                def all_greedy(dlast):
-                    return (jnp.argmax(dlast, axis=-1).astype(jnp.int32),
-                            jax.nn.softmax(dlast, axis=-1))
-
-                prop, q = jax.lax.cond(jnp.all(greedy), all_greedy, mixed,
-                                       dlast)
+                prop, q = _draft_propose(dlast, keys, pos, temp, top_k,
+                                         top_p, greedy, i)
                 return (prop, dp), (prop, q, dfin)
 
             # K+1 draft steps, not K: step K feeds the LAST proposal d_K
@@ -506,6 +739,242 @@ class ServingEngine:
             # draft non-finiteness only matters for slots actually
             # speculating — a spec-off slot must never die for garbage in
             # a pool it does not consume
+            finite = (jnp.isfinite(tlog).all(axis=(1, 2))
+                      & (dfin | ~spec_on))
+
+            def proc_all(t):
+                flat = t.reshape(-1, t.shape[-1])
+
+                def rep(a):
+                    return jnp.repeat(a, k_spec + 1, axis=0)
+                return process_logits_dynamic(
+                    flat, rep(temp), rep(top_k), rep(top_p),
+                    rep(greedy)).reshape(t.shape)
+
+            plog = jax.lax.cond(jnp.all(greedy), lambda t: t, proc_all,
+                                tlog)
+            ops = (props, qs, plog, keys, pos, greedy, spec_on)
+            out, count, accepted, last, logps = jax.lax.cond(
+                jnp.all(greedy),
+                lambda o: commit_speculative_greedy(*o, pad),
+                lambda o: commit_speculative_sampled(*o, pad), ops)
+            return (out, logps, finite, count, accepted, last, pos + count,
+                    pools, dpools)
+
+        from ..observability import track
+        return track("serving_verify",
+                     jax.jit(verify, donate_argnums=(2, 3)))
+
+    # ------------------------------------------------------------------
+    # paged programs (kv="paged"): same count, same contracts — blocks
+    # gathered/scattered through per-slot tables instead of slot rows
+    # ------------------------------------------------------------------
+    def _build_prefill_paged(self, bucket: int):
+        """Per-bucket prefill against the block pool: the prompt runs
+        through the same bucket-sized scratch cache, then every block the
+        slot's table covers for the bucket is overwritten END-TO-END
+        (prompt KV + zeros to the block boundary) — scrub-on-recycle for
+        prompt blocks is the overwrite itself.  Sentinel table entries
+        (warmup) drop the write."""
+        apply_fixed = self._apply
+        model, draft = self.model, self.draft_model
+        dtype = self._dtype
+        bs = self.block_size
+        nb_b = -(-bucket // bs)
+        dapply = self._dapply if draft is not None else None
+
+        def write_blocks(pools, kv, table):
+            ids = table[:nb_b]
+            new_pools = []
+            for (kp, vp), (kc, vc) in zip(pools, kv):
+                def as_blocks(chunk, pool):
+                    rows = chunk[0].astype(pool.dtype)      # (bucket, ...)
+                    padn = nb_b * bs - bucket
+                    if padn:
+                        rows = jnp.concatenate(
+                            [rows, jnp.zeros((padn,) + rows.shape[1:],
+                                             pool.dtype)])
+                    return rows.reshape((nb_b, bs) + rows.shape[1:])
+                new_pools.append(
+                    (kp.at[ids].set(as_blocks(kc, kp), mode="drop"),
+                     vp.at[ids].set(as_blocks(vc, vp), mode="drop")))
+            return new_pools
+
+        def count_trace():
+            self._compiles["prefill"][bucket] += 1  # trace-count (host)
+            stat_add("STAT_serving_compiles")
+
+        if draft is None:
+            def prefill(state, pools, ids, table, prompt_len, key, temp,
+                        top_k, top_p, greedy):
+                count_trace()
+                scratch = model.gen_fixed_cache(1, bucket, dtype)
+                logits, kv = apply_fixed(state, ids, scratch, 0)
+                new_pools = write_blocks(pools, kv, table)
+                tok, logp, finite = _first_token(
+                    logits, prompt_len, key, temp, top_k, top_p, greedy)
+                return tok, logp, finite, new_pools
+
+            name, donate = f"serving_prefill_b{bucket}", (1,)
+        else:
+            def prefill(state, dstate, pools, dpools, ids, table,
+                        prompt_len, key, temp, top_k, top_p, greedy):
+                count_trace()
+                scratch = model.gen_fixed_cache(1, bucket, dtype)
+                logits, kv = apply_fixed(state, ids, scratch, 0)
+                new_pools = write_blocks(pools, kv, table)
+                dscratch = draft.gen_fixed_cache(1, bucket, dtype)
+                _, dkv = dapply(dstate, ids, dscratch, 0)
+                new_dpools = write_blocks(dpools, dkv, table)
+                tok, logp, finite = _first_token(
+                    logits, prompt_len, key, temp, top_k, top_p, greedy)
+                return tok, logp, finite, new_pools, new_dpools
+
+            name, donate = f"serving_prefill_spec_b{bucket}", (2, 3)
+
+        from ..observability import track
+        return track(name, jax.jit(prefill, donate_argnums=donate))
+
+    def _build_decode_paged(self):
+        """THE paged decode step: gather every slot's block table into its
+        contiguous KV view ONCE per compiled call (value-identical to the
+        fixed slot row — streams stay bit-identical), run the whole
+        decode chunk against the gathered view exactly as the fixed step
+        runs against its pool rows, then scatter the chunk's freshly
+        written rows back through the tables in one pass (entering blocks
+        zeroed first).  One gather + one scatter per call amortizes the
+        indirection across chunk * slots tokens.  Sampling, the
+        all-greedy fast path, chunking and fault branches are the fixed
+        decode step verbatim."""
+        apply_fixed = self._apply
+        poison_armed = self._poison_target is not None
+        chunk = self.decode_chunk
+        write_rows = _paged_row_writer(self.block_size,
+                                       self.kv_pool.num_blocks,
+                                       self._pool_len)
+
+        gather_ctx = _gather_ctx
+
+        def decode(state, pools, tables, active, tokens, pos, keys, temp,
+                   top_k, top_p, greedy, poison):
+            self._compiles["decode"] += 1  # trace-count (host side effect)
+            stat_add("STAT_serving_compiles")
+            ctx = [(gather_ctx(kp, tables), gather_ctx(vp, tables))
+                   for (kp, vp) in pools]
+            pos0 = pos
+
+            def one(carry, _):
+                tokens, pos, ctx = carry
+
+                def row(tok, caches, p):
+                    c = [(k[None], v[None]) for (k, v) in caches]
+                    logits, new = apply_fixed(state, tok[None, None], c, p)
+                    return (logits[0, -1].astype(jnp.float32),
+                            [(k[0], v[0]) for (k, v) in new])
+
+                last, ctx = jax.vmap(row)(tokens, ctx, pos)
+                if poison_armed:
+                    last = faults.poison_logits(last, poison)
+                finite = jnp.isfinite(last).all(axis=-1)
+                tok, logp = _sample_step(last, keys, pos, temp, top_k,
+                                         top_p, greedy)
+                return (tok, pos + 1, ctx), (tok, logp, finite)
+
+            (tokens, pos, ctx), (toks, logps, finites) = jax.lax.scan(
+                one, (tokens, pos0, ctx), None, length=chunk)
+            # one scatter publishes the chunk's written rows back into
+            # the block pool; near the end of the view the window clamps
+            # and harmlessly re-writes a few already-published rows
+            start = _window_start(pos0, chunk, ctx[0][0].shape[1])
+            pools = write_rows(pools, tables, start,
+                               _extract_rows(ctx, start, chunk), active,
+                               chunk)
+            return toks, logps, finites, tokens, pos, pools
+
+        from ..observability import track
+        return track("serving_decode",
+                     jax.jit(decode, donate_argnums=(1,)))
+
+    def _build_verify_paged(self):
+        """The speculative tick over the block pool: draft and target
+        contexts are gathered from the per-slot tables ONCE per call, the
+        draft proposal scan and batched target verify run against the
+        gathered views exactly as the fixed verify runs against its pool
+        rows, and each side's freshly written rows scatter back in one
+        pass — the commit math is the fixed verify verbatim.  The draft
+        pool pages with the SAME tables."""
+        from ..generation.speculative import (commit_speculative_greedy,
+                                              commit_speculative_sampled)
+        apply_fixed, dapply = self._apply, self._dapply
+        poison_armed = self._poison_target is not None
+        diverge_armed = self._diverge_every is not None
+        k_spec = self.spec_tokens
+        pad = self.pad_token_id
+        write_rows = _paged_row_writer(self.block_size,
+                                       self.kv_pool.num_blocks,
+                                       self._pool_len)
+
+        gather_ctx = _gather_ctx
+        extract_rows = _extract_rows
+
+        def verify(state, dstate, pools, dpools, tables, active, tokens,
+                   pos, keys, temp, top_k, top_p, greedy, spec_on, poison,
+                   diverge):
+            self._compiles["decode"] += 1  # trace-count (host side effect)
+            stat_add("STAT_serving_compiles")
+            dctx = [(gather_ctx(kb, tables), gather_ctx(vb, tables))
+                    for (kb, vb) in dpools]
+
+            def dstep(carry, i):
+                cur, dp = carry
+
+                def drow(tok, caches, p):
+                    c = [(kb[None], vb[None]) for (kb, vb) in caches]
+                    logits, new = dapply(dstate, tok[None, None], c, p)
+                    return (logits[0, -1].astype(jnp.float32),
+                            [(kb[0], vb[0]) for (kb, vb) in new])
+
+                dlast, dp = jax.vmap(drow)(cur, dp, pos + i)
+                if diverge_armed:
+                    dlast = faults.poison_draft_logits(dlast, diverge)
+                dfin = jnp.isfinite(dlast).all(axis=-1)
+
+                prop, q = _draft_propose(dlast, keys, pos, temp, top_k,
+                                         top_p, greedy, i)
+                return (prop, dp), (prop, q, dfin)
+
+            # K+1 draft steps for the same density reason as the fixed
+            # verify: step K feeds d_K at pos+K so an all-accept tick
+            # leaves the draft blocks dense
+            (_, dctx), (props, qs, dfins) = jax.lax.scan(
+                dstep, (tokens, dctx), jnp.arange(k_spec + 1))
+            # window clamped at the view's end (re-writes are idempotent)
+            start = _window_start(pos, k_spec + 1, dctx[0][0].shape[1])
+            dpools = write_rows(dpools, tables, start,
+                                extract_rows(dctx, start, k_spec + 1),
+                                active, k_spec + 1)
+            props = props[:k_spec].T             # (S, K)
+            qs = jnp.swapaxes(qs[:k_spec], 0, 1)  # (S, K, V)
+            dfin = dfins.all(axis=0)             # (S,)
+
+            ids = jnp.concatenate([tokens[:, None], props], axis=1)
+            tctx = [(gather_ctx(kb, tables), gather_ctx(vb, tables))
+                    for (kb, vb) in pools]
+
+            def trow(row_ids, caches, p):
+                c = [(kb[None], vb[None]) for (kb, vb) in caches]
+                logits, new = apply_fixed(state, row_ids[None], c, p)
+                return (logits[0].astype(jnp.float32),
+                        [(kb[0], vb[0]) for (kb, vb) in new])
+
+            tlog, tctx = jax.vmap(trow)(ids, tctx, pos)  # (S, K+1, V)
+            pools = write_rows(pools, tables, start,
+                               extract_rows(tctx, start, k_spec + 1),
+                               active, k_spec + 1)
+            if poison_armed:
+                factor = jnp.where(poison, jnp.float32(float("nan")),
+                                   jnp.float32(1.0))
+                tlog = tlog * factor[:, None, None]
             finite = (jnp.isfinite(tlog).all(axis=(1, 2))
                       & (dfin | ~spec_on))
 
@@ -586,6 +1055,22 @@ class ServingEngine:
             raise InvalidArgumentError(
                 f"prompt ({plen}) + max_new_tokens ({req.max_new_tokens}) "
                 f"exceeds the engine's max_len {self.max_len}")
+        if self.kv == "paged":
+            # a request whose full budget can never fit the pool EVEN
+            # ALONE is a caller error, not backpressure (with the default
+            # num_blocks — fixed-capacity parity — this cannot trip).
+            # The static need is the LARGER of the prefill bucket (what
+            # admission actually allocates — plen rounds UP to it) and
+            # the full row budget, so anything accepted here is
+            # admittable by the gate once the pool drains.
+            need = self._static_blocks_needed(req)
+            if need > self.kv_pool.num_blocks:
+                stat_add("STAT_serving_rejects")
+                raise InvalidArgumentError(
+                    f"request needs {need} KV blocks but the pool holds "
+                    f"{self.kv_pool.num_blocks} "
+                    f"(block_size={self.block_size}); raise num_blocks or "
+                    "shrink the request")
         if self._poison_target is not None and rid == self._poison_target:
             req.poison = True
         resp = Response(req)
@@ -624,9 +1109,21 @@ class ServingEngine:
         Returns whether any work was done."""
         did = False
         self._sweep()
-        self.scheduler.sweep_pending()
+        dropped = self.scheduler.sweep_pending(
+            drop=((self._queued_never_fits, self._queued_exhausted_exc)
+                  if self.kv == "paged" else None))
+        if dropped:
+            with self._m_lock:
+                self._errored += dropped
+        gate = None
+        if self.kv == "paged":
+            did = self._sweep_oom_paused() or did
+            # OOM-parked runs hold progress and arrived earlier: they get
+            # first claim on freed slots + blocks, before new admissions
+            did = self._restore_oom_paused() or did
+            gate = self._admission_gate
         while True:
-            adm = self.scheduler.next_admission()
+            adm = self.scheduler.next_admission(gate=gate)
             if adm is None:
                 break
             self._admit(*adm)
@@ -635,6 +1132,53 @@ class ServingEngine:
             self._decode_step()
             did = True
         return did
+
+    def _static_blocks_needed(self, req: Request) -> int:
+        """Blocks the request is GUARANTEED to need: its prefill bucket
+        (admission allocates bucket rows, plen rounds up) or the rows
+        the runtime will actually BACK (`_rows_needed` — the ensure
+        target; chunk/spec tail writes past it drop via the sentinel and
+        never allocate), whichever is larger.  Using anything bigger
+        here would spuriously reject requests the engine can serve."""
+        return max(
+            self.kv_pool.blocks_for(self._bucket_for(req.prompt.shape[0])),
+            self.kv_pool.blocks_for(self._rows_needed(req)))
+
+    def _queued_never_fits(self, req: Request) -> bool:
+        """True when the queued request's prefill bucket cannot fit the
+        pool even ALONE under the LIVE capacity (the fault cap) — it can
+        never admit, so waiting is a hang, not backpressure; the sweep
+        fails it with the typed KVPoolExhaustedError."""
+        return (self.kv_pool.blocks_for(
+                    self._bucket_for(req.prompt.shape[0]))
+                > self.kv_pool.capacity())
+
+    def _queued_exhausted_exc(self, req: Request) -> BaseException:
+        # runs INSIDE the scheduler lock (sweep_pending's drop callback):
+        # must not take _m_lock — metrics() holds _m_lock while reading
+        # scheduler depths, so that order would be an ABBA deadlock; the
+        # errored count is applied by step() from sweep's return value
+        stat_add("STAT_serving_kv_exhausted")
+        return KVPoolExhaustedError(
+            f"request {req.id}: prompt bucket needs "
+            f"{self.kv_pool.blocks_for(self._bucket_for(req.prompt.shape[0]))} "
+            f"KV blocks but only {self.kv_pool.capacity()} are usable "
+            "(PDTPU_FAULT_KV_EXHAUST or an undersized pool) — the "
+            "request can never admit")
+
+    def _admission_gate(self, req: Request) -> bool:
+        """Paged admission is block-aware backpressure: a request stays
+        queued until the pool can hold its prompt's bucket (the decode
+        growth is handled per tick by ensure/preempt).  Runs parked on
+        pool pressure hold FIRST claim on freed capacity — their resume
+        blocks are RESERVED, and new work only admits from the surplus
+        (work-conserving: a small request may still fill an idle slot,
+        but never at the price of starving a parked run)."""
+        reserve = (self.kv_pool.blocks_for(self._oom_paused[0].pos)
+                   if self._oom_paused else 0)
+        bucket = self._bucket_for(req.prompt.shape[0])
+        return (self.kv_pool.free_blocks()
+                >= self.kv_pool.blocks_for(bucket) + reserve)
 
     def _sweep(self):
         for slot in list(self._slots):
@@ -654,6 +1198,10 @@ class ServingEngine:
     def _release(self, slot: int):
         self._slots.pop(slot, None)
         self.scheduler.release(slot)
+        if self.kv == "paged":
+            # blocks return to the free-list; their content is scrubbed
+            # in-program the moment they are re-served (kv_pool docstring)
+            self.kv_pool.free(slot)
         self._batch_dirty = True
 
     def _bucket_for(self, plen: int) -> int:
@@ -674,6 +1222,23 @@ class ServingEngine:
         try:
             plen = req.prompt.shape[0]
             bucket = self._bucket_for(plen)
+            if self.kv == "paged":
+                # claim the prompt's blocks; only reachable without them
+                # when PDTPU_FAULT_KV_EXHAUST moved the cap between the
+                # admission gate and here — typed terminal, never a hang
+                if not self.kv_pool.alloc(slot, bucket):
+                    stat_add("STAT_serving_kv_exhausted")
+                    with self._m_lock:
+                        self._errored += 1
+                    resp._fail(KVPoolExhaustedError(
+                        f"request {req.id}: KV block pool exhausted at "
+                        f"admission ({self.kv_pool.free_blocks()} free of "
+                        f"{self.kv_pool.capacity()} usable)"))
+                    self.scheduler.release(slot)
+                    return
+                slot_arg = jnp.asarray(self.kv_pool.table_array(slot))
+            else:
+                slot_arg = jnp.int32(slot)
             ids = np.full((1, bucket), self.pad_token_id, np.int32)
             ids[0, :plen] = req.prompt
             key = self._request_key(req)
@@ -681,14 +1246,14 @@ class ServingEngine:
                 (tok, logp, finite, self._pools,
                  self._draft_pools) = self._prefill_fns[bucket](
                     self._state, self._dstate, self._pools,
-                    self._draft_pools, jnp.asarray(ids), jnp.int32(slot),
+                    self._draft_pools, jnp.asarray(ids), slot_arg,
                     jnp.int32(plen), jnp.asarray(key),
                     jnp.float32(req.temperature), jnp.int32(req.top_k),
                     jnp.float32(req.top_p), jnp.asarray(req.greedy))
             else:
                 tok, logp, finite, self._pools = self._prefill_fns[bucket](
                     self._state, self._pools, jnp.asarray(ids),
-                    jnp.int32(slot), jnp.int32(plen), jnp.asarray(key),
+                    slot_arg, jnp.int32(plen), jnp.asarray(key),
                     jnp.float32(req.temperature), jnp.int32(req.top_k),
                     jnp.float32(req.top_p), jnp.asarray(req.greedy))
             stat_add("STAT_serving_prefills")
@@ -714,8 +1279,12 @@ class ServingEngine:
         bypassing the FIFO queue — the gateway's admission path, which
         keeps its own priority lanes and only hands a request over once a
         slot is actually available.  Returns False when every slot is
-        occupied.  Must be called from the thread driving step() (the
-        engine loop is single-threaded by design)."""
+        occupied (or, paged, when the block pool cannot hold the prompt —
+        the gateway retries as the pool drains).  Must be called from the
+        thread driving step() (the engine loop is single-threaded by
+        design)."""
+        if self.kv == "paged" and not self._admission_gate(req):
+            return False
         slot = self.scheduler.acquire(req, resp)
         if slot is None:
             return False
@@ -742,18 +1311,49 @@ class ServingEngine:
         run = self._slots.get(slot)
         if run is None:
             raise InvalidArgumentError(f"slot {slot} holds no active run")
-        host = jax.device_get(self._pools)
-        kv_rows = [(np.array(k[slot, :run.pos]), np.array(v[slot, :run.pos]))
-                   for k, v in host]
-        draft_rows = None
-        if self.draft_model is not None:
-            dhost = jax.device_get(self._draft_pools)
-            draft_rows = [(np.array(k[slot, :run.pos]),
-                           np.array(v[slot, :run.pos])) for k, v in dhost]
+        if self.kv == "paged":
+            # the snapshot format is IDENTICAL to the fixed engine's —
+            # per-layer (pos, ...) row arrays — so PreemptedRun stays
+            # pool-layout-agnostic and a run preempted paged restores
+            # through the same restore_run contract.  Unlike the fixed
+            # path's documented O(pool) device_get, this moves only the
+            # slot's OWN blocks: paged OOM backpressure preempts
+            # routinely, so the snapshot gathers ids on device first and
+            # pulls O(slot blocks) to host (one cached eager gather per
+            # distinct block count, bounded by max_blocks_per_slot)
+            ids = np.asarray(self.kv_pool.block_ids(slot), np.int32)
+            ids_dev = jnp.asarray(ids) if ids.size else None
+
+            def rows_of(leaf):
+                if ids_dev is None:
+                    return np.zeros((0,) + tuple(leaf.shape[2:]),
+                                    leaf.dtype)
+                r = np.asarray(jax.device_get(
+                    jnp.take(leaf, ids_dev, axis=0)))
+                return np.array(r.reshape((-1,) + r.shape[2:])[:run.pos])
+
+            kv_rows = [(rows_of(k), rows_of(v)) for k, v in self._pools]
+            draft_rows = None
+            if self.draft_model is not None:
+                draft_rows = [(rows_of(k), rows_of(v))
+                              for k, v in self._draft_pools]
+        else:
+            host = jax.device_get(self._pools)
+            kv_rows = [(np.array(k[slot, :run.pos]),
+                        np.array(v[slot, :run.pos]))
+                       for k, v in host]
+            draft_rows = None
+            if self.draft_model is not None:
+                dhost = jax.device_get(self._draft_pools)
+                draft_rows = [(np.array(k[slot, :run.pos]),
+                               np.array(v[slot, :run.pos]))
+                              for k, v in dhost]
         paused = PreemptedRun(run, kv_rows, draft_rows)
         run.req.preempts += 1
         self._slots.pop(slot, None)
         self.scheduler.release(slot)
+        if self.kv == "paged":
+            self.kv_pool.free(slot)
         self._batch_dirty = True
         stat_add("STAT_serving_preemptions")
         return paused
@@ -763,10 +1363,25 @@ class ServingEngine:
         written back into the pool (host-side copy + upload — no compiled
         program) and decode continues from the saved position with the
         saved RNG key, so the remaining stream is bit-identical to a run
-        that was never preempted.  Returns False when no slot is free."""
+        that was never preempted.  Returns False when no slot is free —
+        or, paged, when the block pool cannot hold the saved rows yet
+        (the caller retries as it drains)."""
         slot = self.scheduler.acquire(paused.req, paused.resp)
         if slot is None:
             return False
+        if self.kv == "paged":
+            if not self.kv_pool.alloc(slot, paused.pos):
+                self.scheduler.release(slot)
+                return False
+            self._pools = self._paged_upload(self._pools, slot,
+                                             paused.kv_rows, paused.pos)
+            if (self.draft_model is not None
+                    and paused.draft_kv_rows is not None):
+                self._draft_pools = self._paged_upload(
+                    self._draft_pools, slot, paused.draft_kv_rows,
+                    paused.pos)
+            return self._finish_restore(slot, paused)
+
         def write_rows(pools, rows):
             new_pools = []
             for (hk, hv), (rk, rv) in zip(jax.device_get(pools), rows):
@@ -780,13 +1395,25 @@ class ServingEngine:
                 hv = np.array(hv)
                 hk[slot, :paused.pos] = rk
                 hv[slot, :paused.pos] = rv
-                new_pools.append((jnp.asarray(hk), jnp.asarray(hv)))
+                nk, nv = jnp.asarray(hk), jnp.asarray(hv)
+                if self._kv_put is not None:
+                    # mesh engines must re-place the uploaded pool with
+                    # its heads sharding — a default-device array here
+                    # would silently de-shard the pool and retrace the
+                    # decode program on the next call
+                    nk, nv = self._kv_put(nk), self._kv_put(nv)
+                new_pools.append((nk, nv))
             return new_pools
 
         self._pools = write_rows(self._pools, paused.kv_rows)
         if self.draft_model is not None and paused.draft_kv_rows is not None:
             self._draft_pools = write_rows(self._draft_pools,
                                            paused.draft_kv_rows)
+        return self._finish_restore(slot, paused)
+
+    def _finish_restore(self, slot: int, paused: PreemptedRun) -> bool:
+        """Resume bookkeeping shared by both KV layouts: one copy, so a
+        future lifecycle counter cannot diverge between them."""
         run = _SlotRun(paused.req, paused.resp, pos=paused.pos,
                        first_token=paused.last_token, key=paused.key)
         run.produced = paused.produced
@@ -796,6 +1423,184 @@ class ServingEngine:
         self._batch_dirty = True
         stat_add("STAT_serving_resumes")
         return True
+
+    def _paged_upload(self, pools, slot: int, rows, pos: int):
+        """Publish snapshot rows into the slot's freshly allocated blocks
+        (host build + one eager scatter per leaf; block tails past `pos`
+        zero-filled, so the upload is also the scrub)."""
+        ids = jnp.asarray(np.asarray(self.kv_pool.block_ids(slot),
+                                     np.int32))
+        bs = self.block_size
+        nb_used = int(ids.shape[0])
+        new_pools = []
+        for (kp, vp), (rk, rv) in zip(pools, rows):
+            def blocks_of(r, pool):
+                buf = np.zeros((nb_used * bs,) + tuple(pool.shape[2:]),
+                               pool.dtype)
+                buf[:r.shape[0]] = r
+                return jnp.asarray(
+                    buf.reshape((nb_used, bs) + tuple(pool.shape[2:])))
+            kp = kp.at[ids].set(blocks_of(rk, kp), mode="drop")
+            vp = vp.at[ids].set(blocks_of(rv, vp), mode="drop")
+            if self._kv_put is not None:
+                kp, vp = self._kv_put(kp), self._kv_put(vp)
+            new_pools.append((kp, vp))
+        return new_pools
+
+    # ------------------------------------------------------------------
+    # paged block-pool pressure: ensure-or-preempt, park, resume
+    # ------------------------------------------------------------------
+    def _ensure_decode_blocks(self):
+        """Before a paged tick: grow every active slot's table to cover
+        the rows the compiled call may write.  A shortfall preempts the
+        newest lowest-priority run (its blocks return to the pool and it
+        parks host-side, resuming as the pool drains) — exhaustion is
+        backpressure, not a crash.  Runs that can no longer fit at all,
+        or overflow the parking budget, fail with the typed
+        KVPoolExhaustedError."""
+        for slot in sorted(self._slots):
+            run = self._slots.get(slot)
+            if run is None:
+                continue
+            target = self._oom_target(run.pos, run.req)
+            guard = 0
+            while (slot in self._slots
+                   and not self.kv_pool.ensure(slot, target)):
+                victim = self._pick_oom_victim(slot)
+                if victim is None:
+                    # nothing below the needy run to evict: park (or
+                    # fail) the needy run itself
+                    self._oom_evict(slot)
+                    break
+                self._oom_evict(victim)
+                guard += 1
+                if guard > self.max_slots + 2:
+                    break  # defensive: cannot loop forever
+
+    def _rows_needed(self, req: Request) -> int:
+        """Pool rows that must be BACKED for every consumed token of the
+        request: the final emitted token's logits come from in-program
+        ctx, so backing ends at plen + max_new - 1; chunk-tail writes
+        past it route through sentinel table entries and drop (their
+        tokens are discarded by the host anyway)."""
+        return min(self._pool_len,
+                   int(req.prompt.shape[0]) + int(req.max_new_tokens) - 1)
+
+    def _oom_target(self, pos: int, req: Request) -> int:
+        """Rows the next tick actually requires for this run."""
+        return min(pos + self._rows_per_tick,
+                   max(self._rows_needed(req), pos))
+
+    def _pick_oom_victim(self, needy_slot: int):
+        """The NEWEST run in the LOWEST priority class at or below the
+        needy run's priority (least progress lost, the PR-6 eviction
+        intuition), excluding the needy slot itself."""
+        needy = self._slots[needy_slot]
+        best_slot, best_key = None, None
+        for slot, run in self._slots.items():
+            if slot == needy_slot:
+                continue
+            if run.req.priority > needy.req.priority:
+                continue
+            key = (run.req.priority, -run.req.id)
+            if best_key is None or key < best_key:
+                best_key, best_slot = key, slot
+        return best_slot
+
+    def _oom_evict(self, slot: int):
+        run = self._slots.get(slot)
+        if run is None:
+            return
+        if (len(self._oom_paused) >= self._max_oom_paused
+                or not self.kv_pool.can_ever_fit(
+                    self._oom_target(run.pos, run.req))):
+            # parking would never end: the run's next tick cannot fit the
+            # pool even ALONE (the fault cap or a tiny pool) — the typed
+            # terminal state, not a silent hang
+            self._oom_fail(slot, run)
+            return
+        paused = self.preempt_slot(slot)
+        self._oom_paused.append(paused)
+        self._oom_preempts += 1
+        stat_add("STAT_serving_kv_oom_preempts")
+
+    def _oom_fail(self, slot: int, run: "_SlotRun"):
+        stat_add("STAT_serving_kv_exhausted")
+        self._oom_failed += 1
+        with self._m_lock:
+            self._errored += 1
+        run.resp._fail(KVPoolExhaustedError(
+            f"request {run.req.id}: KV block pool exhausted mid-decode "
+            f"({self.kv_pool.used_blocks()} used of "
+            f"{self.kv_pool.capacity()} usable blocks) and the run can "
+            "no longer be parked or resumed"))
+        self._release(slot)
+
+    def _sweep_oom_paused(self) -> bool:
+        """Parked runs still honor cancel/deadline, and one that can no
+        longer EVER fit (the fault cap shrank the pool under it) fails
+        typed instead of waiting forever."""
+        keep, changed = [], False
+        for p in self._oom_paused:
+            if p.resp.cancelled:
+                stat_add("STAT_serving_cancelled")
+                p.resp._fail(RequestCancelled(
+                    f"request {p.req.id} cancelled while parked on KV "
+                    "pool pressure"))
+                changed = True
+            elif p.req.deadline is not None and p.req.deadline.expired():
+                stat_add("STAT_serving_deadline_expired")
+                p.resp._fail(DeadlineExceededError(
+                    f"request {p.req.id} deadline "
+                    f"({p.req.deadline.seconds}s) expired while parked "
+                    "on KV pool pressure"))
+                changed = True
+            elif not self.kv_pool.can_ever_fit(
+                    self._oom_target(p.pos, p.req)):
+                stat_add("STAT_serving_kv_exhausted")
+                self._oom_failed += 1
+                with self._m_lock:
+                    self._errored += 1
+                p.resp._fail(KVPoolExhaustedError(
+                    f"request {p.req.id}: parked on KV pool pressure and "
+                    f"the pool ({self.kv_pool.capacity()} usable blocks) "
+                    "can no longer hold it at all"))
+                changed = True
+            else:
+                keep.append(p)
+        self._oom_paused = keep
+        return changed
+
+    def _restore_oom_paused(self) -> bool:
+        did = False
+        while self._oom_paused and self.scheduler.free_slot_count() > 0:
+            if not self.restore_run(self._oom_paused[0]):
+                break
+            self._oom_paused.pop(0)
+            stat_add("STAT_serving_kv_oom_resumes")
+            did = True
+        return did
+
+    def _paged_batch(self):
+        """(tables, active) dynamic inputs for the paged decode/verify
+        call: per-slot block tables (sentinel everywhere a slot is
+        unoccupied, so its writes drop) + the occupancy mask.  Cached
+        against the allocator's mutation version — tables only change
+        when a slot crosses a block boundary or membership churns, so
+        steady-state ticks re-upload nothing."""
+        ver = self.kv_pool.version
+        if self._paged_cache is not None and self._paged_cache[0] == ver:
+            return self._paged_cache[1], self._paged_cache[2]
+        s = self.max_slots
+        sentinel = self.kv_pool.num_blocks
+        tables = np.full((s, self.kv_pool.max_blocks_per_slot), sentinel,
+                         np.int32)
+        active = np.zeros((s,), bool)
+        for slot in self._slots:
+            tables[slot] = self.kv_pool.table_array(slot)
+            active[slot] = True
+        self._paged_cache = (ver, jnp.asarray(tables), jnp.asarray(active))
+        return self._paged_cache[1], self._paged_cache[2]
 
     def _rebuild_batch(self):
         s = self.max_slots
@@ -830,6 +1635,13 @@ class ServingEngine:
             return
         span = self._span("serving_decode")
         try:
+            if self.kv == "paged":
+                # grow block tables for this chunk's writes (may preempt
+                # or fail runs under pool pressure — membership can
+                # change, so this runs before the batch rebuild)
+                self._ensure_decode_blocks()
+                if not self._slots:
+                    return
             if self._batch_dirty:
                 self._rebuild_batch()
             # PDTPU_FAULT_SLOW_DECODE: host-side latency injection, read
@@ -838,9 +1650,19 @@ class ServingEngine:
             faults.maybe_slow_decode(self._decode_calls)
             self._decode_calls += 1
             keys, temp, top_k, top_p, greedy, poison, _ = self._dev_params
-            toks, logps, finites, ntok, npos, self._pools = self._decode_fn(
-                self._state, self._pools, self._dev_tokens, self._dev_pos,
-                keys, temp, top_k, top_p, greedy, poison)
+            if self.kv == "paged":
+                tables, active = self._paged_batch()
+                (toks, logps, finites, ntok, npos,
+                 self._pools) = self._decode_fn(
+                    self._state, self._pools, tables, active,
+                    self._dev_tokens, self._dev_pos, keys, temp, top_k,
+                    top_p, greedy, poison)
+            else:
+                (toks, logps, finites, ntok, npos,
+                 self._pools) = self._decode_fn(
+                    self._state, self._pools, self._dev_tokens,
+                    self._dev_pos, keys, temp, top_k, top_p, greedy,
+                    poison)
             self._dev_tokens, self._dev_pos = ntok, npos
             # one device->host pull for the whole (chunk, slots) burst
             toks, logps, finites = jax.device_get((toks, logps, finites))
@@ -893,6 +1715,10 @@ class ServingEngine:
         post-expiry token is ever delivered."""
         span = self._span("serving_verify")
         try:
+            if self.kv == "paged":
+                self._ensure_decode_blocks()
+                if not self._slots:
+                    return
             if self._batch_dirty:
                 self._rebuild_batch()
             tick_no = self._decode_calls  # lifetime stride counter: the
@@ -905,11 +1731,21 @@ class ServingEngine:
             diverge = bool(self._diverge_every is not None
                            and tick_no % self._diverge_every == 0)
             self._spec_ticks += 1
-            (toks, logps, finites, counts, accepts, last, npos,
-             self._pools, self._draft_pools) = self._decode_fn(
-                self._state, self._dstate, self._pools, self._draft_pools,
-                self._dev_tokens, self._dev_pos, keys, temp, top_k, top_p,
-                greedy, spec_on, poison, jnp.asarray(diverge))
+            if self.kv == "paged":
+                tables, active = self._paged_batch()
+                (toks, logps, finites, counts, accepts, last, npos,
+                 self._pools, self._draft_pools) = self._decode_fn(
+                    self._state, self._dstate, self._pools,
+                    self._draft_pools, tables, active, self._dev_tokens,
+                    self._dev_pos, keys, temp, top_k, top_p, greedy,
+                    spec_on, poison, jnp.asarray(diverge))
+            else:
+                (toks, logps, finites, counts, accepts, last, npos,
+                 self._pools, self._draft_pools) = self._decode_fn(
+                    self._state, self._dstate, self._pools,
+                    self._draft_pools, self._dev_tokens, self._dev_pos,
+                    keys, temp, top_k, top_p, greedy, spec_on, poison,
+                    jnp.asarray(diverge))
             self._dev_tokens, self._dev_pos = last, npos
             # one device->host pull for the whole (slots, K+1) tick
             toks, logps, finites, counts, accepts = jax.device_get(
@@ -1015,7 +1851,8 @@ class ServingEngine:
     # driving
     # ------------------------------------------------------------------
     def has_work(self) -> bool:
-        return bool(self._slots) or self.scheduler.has_work()
+        return (bool(self._slots) or self.scheduler.has_work()
+                or bool(self.kv == "paged" and self._oom_paused))
 
     def run_until_drained(self, timeout: Optional[float] = None):
         """Drive the loop in the caller's thread until queue and slots are
@@ -1034,9 +1871,15 @@ class ServingEngine:
         for slot in list(self._slots):
             run = self._slots.pop(slot)
             self.scheduler.release(slot)
+            if self.kv == "paged":
+                self.kv_pool.free(slot)
             run.resp._fail(make_exc(run.req))
         for req, resp in self.scheduler.drain_pending():
             resp._fail(make_exc(req))
+        if self.kv == "paged":
+            paused, self._oom_paused = self._oom_paused, []
+            for p in paused:
+                p.resp._fail(make_exc(p.req))
         self._batch_dirty = True
 
     def start(self):
@@ -1081,45 +1924,67 @@ class ServingEngine:
         self._abort_all(lambda req: RequestCancelled(
             f"request {req.id} aborted: serving engine closed"))
 
+    @property
+    def warm(self) -> bool:
+        """True once warmup() has precompiled every program the engine
+        will ever run — the gateway's /healthz readiness signal."""
+        return self._warm
+
     def warmup(self):
         """Compile every program the engine will ever run (one prefill per
-        bucket + the decode/verify step) so no request pays a trace.  Runs
-        dummy data through slot 0; safe any time no request is in
-        flight."""
+        bucket + the decode/verify step) so no request pays a trace — the
+        program-lifecycle warmup the gateway calls before admitting
+        traffic, after which the compiled-program registry must record
+        ZERO further serving compiles.  Fixed pools run dummy data through
+        slot 0; paged warmup routes every write through the allocator's
+        sentinel table (dropped), so nothing lands in the pool.  Safe any
+        time no request is in flight."""
         s = self.max_slots
         zero_key = jnp.asarray(np.zeros(self._key_width, np.uint32))
+        paged = self.kv == "paged"
+        if paged:
+            slot_arg = jnp.asarray(self.kv_pool.sentinel_table())
+            tables = jnp.asarray(np.tile(
+                self.kv_pool.sentinel_table(), (s, 1)))
+            inactive = jnp.zeros((s,), bool)
+        else:
+            slot_arg = jnp.int32(0)
         for b in self.buckets:
             ids = np.full((1, b), self.pad_token_id, np.int32)
             if self.draft_model is not None:
                 (_, _, _, self._pools,
                  self._draft_pools) = self._prefill_fns[b](
                     self._state, self._dstate, self._pools,
-                    self._draft_pools, jnp.asarray(ids), jnp.int32(0),
+                    self._draft_pools, jnp.asarray(ids), slot_arg,
                     jnp.int32(1), zero_key, jnp.float32(1.0), jnp.int32(0),
                     jnp.float32(1.0), jnp.asarray(True))
             else:
                 _, _, _, self._pools = self._prefill_fns[b](
                     self._state, self._pools, jnp.asarray(ids),
-                    jnp.int32(0), jnp.int32(1), zero_key, jnp.float32(1.0),
+                    slot_arg, jnp.int32(1), zero_key, jnp.float32(1.0),
                     jnp.int32(0), jnp.float32(1.0), jnp.asarray(True))
         if self.draft_model is not None:
-            (_, _, _, _, _, _, _, self._pools,
-             self._draft_pools) = self._decode_fn(
-                self._state, self._dstate, self._pools, self._draft_pools,
+            args = ([tables, inactive] if paged else []) + [
                 jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
                 jnp.zeros((s, self._key_width), jnp.uint32),
                 jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
                 jnp.ones((s,), jnp.float32), jnp.ones((s,), bool),
                 jnp.ones((s,), bool), jnp.zeros((s,), bool),
-                jnp.asarray(False))
+                jnp.asarray(False)]
+            (_, _, _, _, _, _, _, self._pools,
+             self._draft_pools) = self._decode_fn(
+                self._state, self._dstate, self._pools, self._draft_pools,
+                *args)
         else:
-            _, _, _, _, _, self._pools = self._decode_fn(
-                self._state, self._pools, jnp.zeros((s,), jnp.int32),
-                jnp.zeros((s,), jnp.int32),
+            args = ([tables, inactive] if paged else []) + [
+                jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
                 jnp.zeros((s, self._key_width), jnp.uint32),
                 jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
                 jnp.ones((s,), jnp.float32), jnp.ones((s,), bool),
-                jnp.zeros((s,), bool))
+                jnp.zeros((s,), bool)]
+            _, _, _, _, _, self._pools = self._decode_fn(
+                self._state, self._pools, *args)
+        self._warm = True
 
     # ------------------------------------------------------------------
     # observability
@@ -1159,7 +2024,21 @@ class ServingEngine:
                 "max_slots": self.max_slots,
                 "compile_counts": self.compile_counts(),
                 "spec": self._spec_metrics(),
+                "warm": self._warm,
+                "kv_pool": self._kv_pool_metrics(),
+                "mesh": (None if self.mesh is None else {
+                    "devices": int(self.mesh.devices.size),
+                    "tp": int(self.mesh.shape.get("tp", 1))}),
             }
+
+    def _kv_pool_metrics(self):
+        if self.kv != "paged":
+            return {"kind": "fixed", "max_slots": self.max_slots,
+                    "pool_len": self._pool_len}
+        return {"kind": "paged", **self.kv_pool.stats(),
+                "oom_preempts": self._oom_preempts,
+                "oom_failed": self._oom_failed,
+                "oom_paused": len(self._oom_paused)}
 
     def _spec_metrics(self):
         if self.draft_model is None:

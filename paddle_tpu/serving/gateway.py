@@ -448,21 +448,23 @@ class ServingGateway:
 
     def _pop_lane(self, priority: int):
         """Stride-fair pop across the lane's tenants: the tenant with the
-        smallest pass value goes, then its pass advances by 1/weight."""
+        smallest pass value goes, then its pass advances by 1/weight.
+        Returns (entry, tenant, previous_pass) so a failed admission can
+        roll the pass back."""
         with self._lock:
             tq = self._lanes.get(priority) or {}
             candidates = [(self._tenants[t].passes.get(priority, 0.0), t)
                           for t, dq in tq.items() if dq]
             if not candidates:
                 return None
-            _, tenant = min(candidates)
+            prev_pass, tenant = min(candidates)
             ts = self._tenants[tenant]
             entry = tq[tenant].popleft()
-            new_pass = ts.passes.get(priority, 0.0) + 1.0 / ts.cfg.weight
+            new_pass = prev_pass + 1.0 / ts.cfg.weight
             ts.passes[priority] = new_pass
             self._vtime[priority] = max(
                 self._vtime.get(priority, 0.0), new_pass)
-            return entry
+            return entry, tenant, prev_pass
 
     def _admit_one(self) -> bool:
         """Place ONE unit of waiting work into a free slot: the best
@@ -491,16 +493,21 @@ class ServingGateway:
                 return True
             self._paused.insert(0, p)  # no slot after all; retry later
             return False
-        entry = self._pop_lane(best_lane)
-        if entry is None:
+        popped = self._pop_lane(best_lane)
+        if popped is None:
             return False
+        entry, tenant, prev_pass = popped
         if not self.engine.try_admit(entry.req, entry.resp):
-            # raced out of the slot (shouldn't happen single-threaded);
-            # requeue at the front
+            # no slot (or, paged, no blocks — try_admit's block-aware
+            # gate makes this ROUTINE under pool pressure): requeue at
+            # the front and ROLL BACK the stride pass, so waiting on
+            # capacity never eats the tenant's configured fair share
             with self._lock:
                 self._lanes.setdefault(best_lane, {}).setdefault(
-                    entry.req.tenant or "default",
-                    deque()).appendleft(entry)
+                    tenant, deque()).appendleft(entry)
+                ts = self._tenants.get(tenant)
+                if ts is not None:
+                    ts.passes[best_lane] = prev_pass
             return False
         with self._lock:
             self._n["admitted"] += 1
@@ -799,6 +806,10 @@ class ServingGateway:
                 status = 503 if (self._closed or self._dead) else 200
                 return status, "application/json", json.dumps({
                     "ok": status == 200,
+                    # readiness: warm=True means every serving program is
+                    # precompiled (engine.warmup ran) — no admitted
+                    # request will ever pay a trace
+                    "warm": bool(getattr(self.engine, "warm", False)),
                     "gateway": {k: v for k, v in self.metrics().items()
                                 if k != "engine"}},
                     default=str).encode()

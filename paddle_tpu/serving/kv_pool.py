@@ -1,0 +1,271 @@
+"""Paged KV-cache pool: block-granular KV allocation for the serving engine.
+
+The PR-4 engine charges every resident request the full ``max_len`` of KV
+HBM (`gen_fixed_cache(max_slots, max_len)` — one row per slot).  At
+production scale that cap is the binding constraint: a 32-token request
+and a 512-token request pay the same HBM, so the number of resident slots
+is ``pool_bytes / max_len_bytes`` no matter what the traffic looks like.
+
+vLLM's PagedAttention observation (Kwon et al., 2023) applied to this
+engine: hold ONE device-resident block pool per layer —
+``[num_blocks, block_size, heads, head_dim]`` — and give each slot an
+indirection table of block ids covering exactly the rows it has actually
+written.  Long and short requests then share HBM, and the resident-slot
+count is bounded by *aggregate* live tokens, not ``slots * max_len``.
+
+Split of responsibilities:
+
+- **PagedKVPool** (here, host side): the block allocator — free-list,
+  slot -> block-table indirection, alloc/append/free, capacity
+  accounting (including the ``PDTPU_FAULT_KV_EXHAUST`` forced-exhaustion
+  cap), and construction of the device pools from any model speaking the
+  ``gen_fixed_cache`` protocol.  Pure host bookkeeping: nothing here is
+  ever traced.
+- **ops/paged_attention.py** (device side): the gather/scatter/scrub
+  primitives the compiled serving programs use against the pool, plus
+  the standalone paged-attention op (jnp gather fallback on CPU, pallas
+  block-table kernel for TPU).
+- **serving/engine.py**: `ServingEngine(kv="paged", block_size=...)`
+  wires both into the unchanged engine contracts (compile bound,
+  bit-identical streams, preempt/restore).
+
+Scrub-on-recycle
+----------------
+Freed blocks return to the free-list and are re-served with a hard
+no-stale-KV guarantee enforced INSIDE the compiled programs (zero extra
+programs, zero idle HBM passes): a prefill overwrites every block it
+claims end-to-end (prompt KV + zero padding to the block boundary), and
+the decode/verify programs zero a block in full the moment a slot's
+write position first enters it (``offset == 0``), before writing the new
+row.  A block is only ever readable through a slot's table, tables only
+cover rows the slot wrote, and the first write into a re-served block
+erases all of it — so no request can observe another tenant's KV, and
+the device state of a re-served block provably contains none
+(tests/test_dist_serving.py::test_recycled_block_is_scrubbed).
+
+Exhaustion is backpressure, not a crash: admission checks `free_blocks`
+before claiming a slot, `ensure` returning False mid-decode triggers
+preemption of the newest low-priority run (engine policy), and the typed
+`KVPoolExhaustedError` is the terminal state for runs that can no longer
+fit at all.  ``PDTPU_FAULT_KV_EXHAUST=N`` caps the live capacity to N
+blocks to force every one of those paths on CPU.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError, ResourceExhaustedError
+from ..utils import faults
+
+__all__ = ["PagedKVPool", "KVPoolExhaustedError"]
+
+
+class KVPoolExhaustedError(ResourceExhaustedError):
+    """The paged KV block pool cannot hold this run: every block is in
+    use (or the pool is capped by PDTPU_FAULT_KV_EXHAUST) and no
+    lower-priority victim can be preempted to make room.  The request is
+    terminal — resubmit when the pool drains, or raise num_blocks."""
+    code = "ResourceExhausted"
+
+
+_obs_handles = None
+
+
+def _obs():
+    """(blocks_used_gauge, blocks_free_gauge) — cached handles
+    (registry.reset() zeroes values in place)."""
+    global _obs_handles
+    if _obs_handles is None:
+        from ..observability import metrics as _m
+        _obs_handles = (
+            _m.gauge("serving_kv_blocks_used",
+                     "paged KV pool blocks currently allocated"),
+            _m.gauge("serving_kv_blocks_free",
+                     "paged KV pool blocks free (after any fault cap)"))
+    return _obs_handles
+
+
+class PagedKVPool:
+    """Host-side block allocator over a device-resident block pool.
+
+    ``build_pools(model, ...)`` constructs the per-layer device pools —
+    each KV leaf of ``model.gen_fixed_cache(1, block_size)`` becomes a
+    ``(num_blocks, block_size, *leaf.shape[2:])`` zero pool — and the
+    allocator hands out block ids: ``alloc``/``ensure`` grow a slot's
+    table to cover a row count, ``free`` recycles the slot's blocks,
+    ``table_array`` renders the table as the fixed-shape
+    ``(max_blocks_per_slot,)`` int32 input the compiled programs take
+    (unallocated entries hold the ``num_blocks`` sentinel: reads clip to
+    masked rows, writes drop).
+
+    All mutation happens on the engine loop thread; the lock only guards
+    the metric snapshots other threads read."""
+
+    def __init__(self, num_blocks: int, block_size: int, pool_len: int):
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.pool_len = int(pool_len)
+        if self.block_size < 1:
+            raise InvalidArgumentError(
+                f"block_size must be >= 1, got {self.block_size}")
+        if self.num_blocks < 1:
+            raise InvalidArgumentError(
+                f"num_blocks must be >= 1, got {self.num_blocks}")
+        # max blocks one slot can ever hold (its table's static width)
+        self.max_blocks_per_slot = -(-self.pool_len // self.block_size)
+        self._lock = threading.Lock()
+        # LIFO free-list: the most recently freed block is re-served
+        # first (deterministic recycling — the scrub proof relies on it)
+        self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
+        self._tables: Dict[int, List[int]] = {}
+        # debug/test aid: the most recent block ids handed out, in order —
+        # the scrub-on-recycle proof reads which blocks were RE-served
+        self.served_log: "deque[int]" = deque(maxlen=512)
+        # bumped on every table mutation (growth or free): the engine
+        # caches its device-side (tables, active) batch inputs against it
+        # so unchanged ticks re-upload nothing
+        self.version = 0
+
+    # -- capacity ------------------------------------------------------------
+    def capacity(self) -> int:
+        """Usable blocks RIGHT NOW: num_blocks, unless
+        PDTPU_FAULT_KV_EXHAUST caps it lower (consulted live)."""
+        cap = faults.kv_exhaust_cap()
+        return self.num_blocks if cap is None else min(self.num_blocks, cap)
+
+    def used_blocks(self) -> int:
+        with self._lock:
+            return sum(len(t) for t in self._tables.values())
+
+    def free_blocks(self) -> int:
+        return max(0, self.capacity() - self.used_blocks())
+
+    def blocks_for(self, rows: int) -> int:
+        """Blocks needed to hold `rows` KV rows."""
+        return -(-max(0, int(rows)) // self.block_size)
+
+    def can_ever_fit(self, rows: int) -> bool:
+        """Whether a run holding `rows` rows could occupy the pool even
+        ALONE (under the live capacity) — False means the run can never
+        resume and must fail typed instead of parking forever."""
+        return self.blocks_for(rows) <= min(self.capacity(),
+                                            self.max_blocks_per_slot)
+
+    # -- alloc/free ----------------------------------------------------------
+    def ensure(self, slot: int, rows: int) -> bool:
+        """Grow slot's table to cover `rows` rows (clamped to the
+        per-slot maximum).  Returns False — nothing allocated — when the
+        free-list (after the fault cap) cannot supply the growth."""
+        rows = min(int(rows), self.pool_len)
+        with self._lock:
+            table = self._tables.setdefault(slot, [])
+            need = min(self.blocks_for(rows),
+                       self.max_blocks_per_slot) - len(table)
+            if need <= 0:
+                return True
+            used = sum(len(t) for t in self._tables.values())
+            if used + need > self.capacity() or need > len(self._free):
+                return False
+            for _ in range(need):
+                b = self._free.pop()
+                table.append(b)
+                self.served_log.append(b)
+            self.version += 1
+        self._note_gauges()
+        return True
+
+    def alloc(self, slot: int, rows: int) -> bool:
+        """Fresh allocation for a slot that must not already hold blocks
+        (admission).  Same return contract as ensure."""
+        with self._lock:
+            if self._tables.get(slot):
+                raise InvalidArgumentError(
+                    f"slot {slot} already holds "
+                    f"{len(self._tables[slot])} blocks")
+        return self.ensure(slot, rows)
+
+    def free(self, slot: int) -> int:
+        """Recycle every block the slot holds; returns how many.  The
+        block CONTENT is scrubbed at re-serve time inside the compiled
+        programs (module docstring) — free itself is pure bookkeeping."""
+        with self._lock:
+            table = self._tables.pop(slot, [])
+            self._free.extend(table)
+            n = len(table)
+            if n:
+                self.version += 1
+        if n:
+            self._note_gauges()
+        return n
+
+    # -- views ---------------------------------------------------------------
+    def rows_capacity(self, slot: int) -> int:
+        with self._lock:
+            return len(self._tables.get(slot, ())) * self.block_size
+
+    def block_ids(self, slot: int) -> List[int]:
+        with self._lock:
+            return list(self._tables.get(slot, ()))
+
+    def table_array(self, slot: int) -> np.ndarray:
+        """(max_blocks_per_slot,) int32 program input; unallocated tail
+        entries hold the `num_blocks` sentinel (reads clip into masked
+        rows, writes drop)."""
+        out = np.full((self.max_blocks_per_slot,), self.num_blocks,
+                      np.int32)
+        with self._lock:
+            t = self._tables.get(slot, ())
+            out[:len(t)] = t
+        return out
+
+    def sentinel_table(self) -> np.ndarray:
+        """An all-sentinel table: every write through it is dropped —
+        what engine warmup uses so precompiling writes nothing."""
+        return np.full((self.max_blocks_per_slot,), self.num_blocks,
+                       np.int32)
+
+    def stats(self) -> Dict:
+        used = self.used_blocks()
+        return {"num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "capacity": self.capacity(),
+                "used_blocks": used,
+                "free_blocks": self.free_blocks(),
+                "max_blocks_per_slot": self.max_blocks_per_slot}
+
+    def _note_gauges(self):
+        used_g, free_g = _obs()
+        used_g.set(self.used_blocks())
+        free_g.set(self.free_blocks())
+
+    # -- device pool construction -------------------------------------------
+    @staticmethod
+    def leaf_shapes(model, dtype=None):
+        """Per-layer (k, v) leaf shapes/dtypes from one block's worth of
+        the model's own fixed-cache protocol."""
+        template = model.gen_fixed_cache(1, 1, dtype)
+        return [((tuple(k.shape[2:]), k.dtype), (tuple(v.shape[2:]), v.dtype))
+                for k, v in template]
+
+    def build_pools(self, model, dtype=None, put=None):
+        """The device-resident block pool: for each model KV leaf of
+        shape (B, T, *rest), one zero pool of shape
+        (num_blocks, block_size, *rest).  `put` (optional) places each
+        leaf — the mesh engine passes a heads-sharded device_put."""
+        import jax.numpy as jnp
+        pools = []
+        for (ks, kdt), (vs, vdt) in self.leaf_shapes(model, dtype):
+            k = jnp.zeros((self.num_blocks, self.block_size) + ks, kdt)
+            v = jnp.zeros((self.num_blocks, self.block_size) + vs, vdt)
+            if put is not None:
+                k, v = put(k), put(v)
+            pools.append((k, v))
+        return pools
+
+    def pool_bytes(self, pools) -> int:
+        return int(sum(k.size * k.dtype.itemsize + v.size * v.dtype.itemsize
+                       for k, v in pools))

@@ -108,17 +108,27 @@ class RequestScheduler:
         with self._lock:
             return bool(self._pending or self._active)
 
-    def next_admission(self):
+    def next_admission(self, gate=None):
         """Pop the next admissible (request, response, slot), failing
         cancelled/expired queued requests in passing.  None when the queue
         is empty or no slot is free (the popped-but-unadmittable case does
-        not exist: a slot is acquired before the pop commits)."""
+        not exist: a slot is acquired before the pop commits).  `gate`
+        (optional, `gate(req) -> bool`) adds a resource check on the HEAD
+        request before it pops — the paged engine's block-aware admission:
+        a False keeps FIFO order and leaves the head queued
+        (backpressure), it does not skip past it."""
         with self._space:
             occ_g, depth_g, _ = _obs()
             while self._pending:
                 if not self._free:
                     return None
-                req, resp = self._pending.popleft()
+                req, resp = self._pending[0]
+                disposable = (resp.cancelled
+                              or (req.deadline is not None
+                                  and req.deadline.expired()))
+                if not disposable and gate is not None and not gate(req):
+                    return None
+                self._pending.popleft()
                 self._space.notify()
                 stat_add("STAT_serving_queue_depth", -1)
                 depth_g.set(len(self._pending))
@@ -177,9 +187,18 @@ class RequestScheduler:
             self._space.notify_all()
             return drained
 
-    def sweep_pending(self):
+    def sweep_pending(self, drop=None):
         """Fail queued requests whose deadline expired or that were
-        cancelled, without waiting for a free slot."""
+        cancelled, without waiting for a free slot.  `drop` (optional) is
+        a ``(pred, make_exc)`` pair: requests with ``pred(req)`` True
+        fail with ``make_exc(req)`` — the paged engine's
+        can-never-admit check (a queued request whose blocks can never
+        exist under the live pool capacity must reach a typed terminal,
+        not wait forever).  Returns how many requests `drop` failed
+        (pred/make_exc run UNDER the scheduler lock and must not take
+        locks that are ever held around scheduler reads — the caller
+        applies its own accounting from the return value)."""
+        dropped = 0
         with self._space:
             keep = deque()
             for req, resp in self._pending:
@@ -192,6 +211,9 @@ class RequestScheduler:
                     resp._fail(DeadlineExceededError(
                         f"request {req.id} deadline "
                         f"({req.deadline.seconds}s) expired while queued"))
+                elif drop is not None and drop[0](req):
+                    resp._fail(drop[1](req))
+                    dropped += 1
                 else:
                     keep.append((req, resp))
                     continue
@@ -199,3 +221,4 @@ class RequestScheduler:
                 self._space.notify()
             self._pending = keep
             _obs()[1].set(len(self._pending))
+        return dropped

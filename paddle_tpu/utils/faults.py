@@ -49,6 +49,14 @@ Injection points (consumed elsewhere in the framework):
                   time (engine construction); whether the current tick
                   diverges is a dynamic input.
                   Env: PDTPU_FAULT_DRAFT_DIVERGE="N".
+  kv_exhaust      the paged KV-cache block allocator pretends the pool
+                  only holds N blocks (capacity capped live, host-side —
+                  nothing is baked into any trace), forcing the
+                  exhaustion paths on CPU without a big pool: admission
+                  backpressure, mid-decode preemption of the newest
+                  low-priority run, and the typed KVPoolExhaustedError
+                  terminal state.  Arm/disarm takes effect on the next
+                  allocator call.  Env: PDTPU_FAULT_KV_EXHAUST="N".
   slow_decode     the serving engine sleeps `ms` milliseconds on the host
                   before every `every_n`-th decode call (default every
                   call).  Purely host-side — the compiled decode program
@@ -72,7 +80,7 @@ __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "poison_grads", "worker_crash_config", "maybe_crash_worker",
            "maybe_kill_mid_save", "backend_down", "nan_logits_request",
            "poison_logits", "slow_decode_config", "maybe_slow_decode",
-           "draft_diverge_every", "poison_draft_logits"]
+           "draft_diverge_every", "poison_draft_logits", "kv_exhaust_cap"]
 
 _ENV = {
     "nan_grads": "PDTPU_FAULT_NAN_GRADS",
@@ -82,6 +90,7 @@ _ENV = {
     "nan_logits": "PDTPU_FAULT_NAN_LOGITS",
     "slow_decode": "PDTPU_FAULT_SLOW_DECODE",
     "draft_diverge": "PDTPU_FAULT_DRAFT_DIVERGE",
+    "kv_exhaust": "PDTPU_FAULT_KV_EXHAUST",
 }
 
 _lock = threading.Lock()
@@ -292,6 +301,19 @@ def maybe_slow_decode(call_no: int) -> float:
     secs = ms / 1000.0
     time.sleep(secs)
     return secs
+
+
+# -- kv_exhaust --------------------------------------------------------------
+
+def kv_exhaust_cap() -> Optional[int]:
+    """Forced block-pool capacity (the allocator pretends only N blocks
+    exist), or None when disarmed.  Consulted LIVE on every allocator
+    call — pure host bookkeeping, no trace ever sees it — so a running
+    engine reacts to arm/disarm immediately."""
+    raw = get("kv_exhaust")
+    if not raw:
+        return None
+    return max(0, int(raw))
 
 
 # -- backend_down ------------------------------------------------------------

@@ -19,6 +19,24 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture()
+def cpu8_env():
+    """Subprocess environment for mesh/probe tests: a CPU-pinned copy of
+    os.environ with the 8-virtual-device XLA flag set — the ONE place the
+    `xla_force_host_platform_device_count` incantation lives for tests
+    (probes/bench previously each hand-rolled it).  Subprocess-isolated:
+    mutating the returned dict never touches this process."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never grab the TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env_flags = env.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in env_flags:
+        env["XLA_FLAGS"] = (env_flags +
+                            " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    return env
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle

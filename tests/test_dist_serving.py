@@ -1,0 +1,714 @@
+"""Distributed serving (ISSUE-8): paged KV block pool + tensor-parallel
+prefill/decode over the mesh.
+
+Covers: the block allocator (alloc/free/recycle bookkeeping, fragmentation
+churn, fault-capped capacity), the scrub-on-recycle proof (a freed block
+re-served to a new request provably contains no prior KV on device),
+paged-engine stream parity (greedy bit-identical to solo generate, sampled
+bit-identical to the fixed-pool engine, compile count at the
+len(buckets)+1 bound with ZERO post-warmup compiles in the program
+registry), block-table overflow at max_len, paged preempt/restore
+round-trips, KV exhaustion as backpressure (PDTPU_FAULT_KV_EXHAUST:
+preempt-park-resume and the typed KVPoolExhaustedError terminal), the
+paged-attention op (jnp fallback parity + pallas kernel via interpreter),
+and the tensor-parallel engine on the 8-virtual-device CPU mesh
+(bit-identical streams vs single-device, params/KV shardings asserted)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models, parallel
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.nn.layer_base import Layer
+from paddle_tpu.nn.layer.common import Embedding
+from paddle_tpu.serving import (KVPoolExhaustedError, PagedKVPool,
+                                ServingEngine)
+from paddle_tpu.utils import faults
+
+pytestmark = pytest.mark.dist_serving
+
+
+def tiny_gpt():
+    cfg = models.GPTConfig(vocab_size=13, hidden_size=16,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0,
+                           max_position_embeddings=64)
+    paddle.seed(7)
+    m = models.GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def tp_gpt():
+    """8 heads / divisible dims so every tp=8 sharding rule engages."""
+    cfg = models.GPTConfig(vocab_size=64, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=8,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0,
+                           max_position_embeddings=64)
+    paddle.seed(7)
+    m = models.GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def solo(model, prompt, max_new, **kw):
+    out, _ = model.generate(paddle.to_tensor(
+        np.asarray(prompt, np.int32)[None]), max_new_tokens=max_new, **kw)
+    return np.asarray(out.numpy())[0].tolist()
+
+
+class MarkerModel(Layer):
+    """Protocol model whose KV is a (token+1)-valued marker per written
+    position: stale-KV leaks are directly visible in the block pool."""
+
+    def __init__(self, vocab=24):
+        super().__init__()
+        self.emb = Embedding(vocab, vocab)
+
+    def gen_fixed_cache(self, batch_size, max_length, dtype=None):
+        import jax.numpy as jnp
+        dt = dtype or jnp.float32
+        return [(jnp.zeros((batch_size, max_length, 1, 2), dt),
+                 jnp.zeros((batch_size, max_length, 1, 2), dt))]
+
+    def forward_fixed(self, input_ids, caches, pos):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import unwrap
+        ids = unwrap(input_ids)
+        p = unwrap(pos)
+        b, s = ids.shape
+        logits = unwrap(self.emb(input_ids)).astype(jnp.float32)
+        k, v = caches[0]
+        chunk = jnp.broadcast_to(
+            (ids.astype(k.dtype) + 1)[:, :, None, None], (b, s, 1, 2))
+        k = jax.lax.dynamic_update_slice(k, chunk, (0, p, 0, 0))
+        v = jax.lax.dynamic_update_slice(v, chunk, (0, p, 0, 0))
+        return logits, [(k, v)]
+
+
+# ---------------------------------------------------------------------------
+# block allocator units
+# ---------------------------------------------------------------------------
+
+def test_allocator_alloc_free_recycle():
+    pool = PagedKVPool(num_blocks=8, block_size=4, pool_len=32)
+    assert pool.max_blocks_per_slot == 8
+    assert pool.free_blocks() == 8
+    assert pool.alloc(0, rows=5)          # 2 blocks
+    assert pool.rows_capacity(0) == 8
+    assert pool.used_blocks() == 2
+    assert pool.ensure(0, rows=8)         # no growth needed
+    assert pool.used_blocks() == 2
+    assert pool.ensure(0, rows=9)         # third block
+    assert pool.used_blocks() == 3
+    first_tables = pool.block_ids(0)
+    assert len(set(first_tables)) == 3    # distinct blocks
+    # table rendering: sentinel tail
+    tbl = pool.table_array(0)
+    assert tbl.shape == (8,)
+    assert list(tbl[:3]) == first_tables
+    assert all(t == pool.num_blocks for t in tbl[3:])
+    # a second slot cannot steal slot 0's blocks
+    assert pool.alloc(1, rows=20)         # 5 blocks: pool now full
+    assert set(pool.block_ids(1)).isdisjoint(first_tables)
+    assert not pool.ensure(0, rows=13)    # exhausted: False, no change
+    assert pool.used_blocks() == 8
+    # LIFO recycle: freeing slot 1 re-serves its blocks
+    assert pool.free(1) == 5
+    assert pool.free_blocks() == 5
+    assert pool.ensure(0, rows=13)
+    assert pool.free(0) == 4
+    assert pool.free(0) == 0              # double free is a no-op
+    assert pool.used_blocks() == 0
+    with pytest.raises(InvalidArgumentError):
+        pool.alloc(0, rows=4) and pool.alloc(0, rows=4)
+
+
+def test_allocator_fragmentation_churn():
+    """Mixed-length Poisson alloc/free churn: tables stay disjoint, the
+    books always balance, and everything frees back to a full pool."""
+    rng = np.random.RandomState(0)
+    pool = PagedKVPool(num_blocks=24, block_size=4, pool_len=64)
+    live = {}
+    for step in range(400):
+        if live and (rng.rand() < 0.45 or len(live) == 12):
+            slot = int(rng.choice(sorted(live)))
+            pool.free(slot)
+            del live[slot]
+        else:
+            slot = next(i for i in range(100) if i not in live)
+            rows = int(rng.poisson(10)) + 1
+            if pool.ensure(slot, rows):
+                live[slot] = rows
+            else:
+                pool.free(slot)  # partial-failure path must stay clean
+        # invariants
+        all_ids = [b for s in live for b in pool.block_ids(s)]
+        assert len(all_ids) == len(set(all_ids)), "block double-served"
+        assert pool.used_blocks() + pool.free_blocks() == 24
+        for s, rows in live.items():
+            assert pool.rows_capacity(s) >= min(rows, 64)
+    for s in list(live):
+        pool.free(s)
+    assert pool.free_blocks() == 24 and pool.used_blocks() == 0
+
+
+@pytest.mark.faults
+def test_allocator_fault_cap_is_live():
+    pool = PagedKVPool(num_blocks=16, block_size=4, pool_len=32)
+    assert pool.capacity() == 16
+    faults.enable("kv_exhaust", "3")
+    try:
+        assert pool.capacity() == 3
+        assert pool.free_blocks() == 3
+        assert not pool.ensure(0, rows=16)   # 4 blocks > cap
+        assert pool.ensure(0, rows=12)       # 3 blocks == cap
+        assert pool.free_blocks() == 0
+        assert not pool.can_ever_fit(16)
+    finally:
+        faults.reset()
+    assert pool.capacity() == 16 and pool.free_blocks() == 13
+
+
+# ---------------------------------------------------------------------------
+# scrub-on-recycle: device proof
+# ---------------------------------------------------------------------------
+
+def test_recycled_block_is_scrubbed():
+    """Blocks freed by a long tenant and re-served to a short one must
+    contain NOTHING of the prior tenant on device: prefill blocks are
+    fully overwritten, decode-entered blocks are zeroed in-program."""
+    paddle.seed(3)
+    m = MarkerModel()
+    m.eval()
+    eng = ServingEngine(m, max_slots=1, max_len=32, prefill_buckets=(8,),
+                        decode_chunk=2, kv="paged", block_size=4,
+                        num_blocks=8)
+    r = eng.submit(np.arange(1, 7), max_new_tokens=20)  # rows up to ~25
+    eng.run_until_drained(timeout=60)
+    assert r.done() and eng.kv_pool.used_blocks() == 0
+    k_before = np.asarray(eng._pools[0][0])
+    dirty = {b for b in range(8) if np.any(k_before[b] != 0)}
+    assert len(dirty) >= 5, "sanity: the long tenant must have left KV"
+    # short tenant: 2 prompt blocks + one decode-entered block, recycled
+    mark = len(eng.kv_pool.served_log)
+    r2 = eng.submit(np.arange(7, 11), max_new_tokens=6)
+    eng.run_until_drained(timeout=60)
+    assert r2.done()
+    served = set(list(eng.kv_pool.served_log)[mark:])
+    assert served and served <= dirty, "sanity: re-served blocks were dirty"
+    k = np.asarray(eng._pools[0][0])
+    # every re-served block may hold ONLY the short tenant's markers
+    # (1 + token for its prompt/pads/decodes) and scrub zeros — any other
+    # value is the prior tenant's KV leaking through recycling
+    allowed = ({0.0, 1.0} | {float(v + 1) for v in [7, 8, 9, 10]}
+               | {float(t + 1) for t in r2.tokens()})
+    for b in served:
+        vals = set(np.unique(k[b]).tolist())
+        leaked = vals - allowed
+        assert not leaked, f"block {b} leaked prior-tenant KV {leaked}"
+
+
+# ---------------------------------------------------------------------------
+# paged engine: parity, compile bound, overflow, preempt/restore
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def paged_setup():
+    m = tiny_gpt()
+    eng = ServingEngine(m, max_slots=3, max_len=48, prefill_buckets=(8, 16),
+                        decode_chunk=4, kv="paged", block_size=8)
+    eng.warmup()
+    fixed = ServingEngine(m, max_slots=3, max_len=48,
+                          prefill_buckets=(8, 16), decode_chunk=4)
+    fixed.warmup()
+    return m, eng, fixed
+
+
+def test_paged_streams_bit_identical_and_zero_post_warmup_compiles(
+        paged_setup):
+    """Greedy paged streams == solo generate; sampled paged streams ==
+    the fixed-pool engine (same per-slot key folds); mixed traffic after
+    warmup() adds ZERO compiles — engine counters AND the compiled-
+    program registry agree."""
+    from paddle_tpu import observability
+    from paddle_tpu.core import op as core_op
+    model, eng, fixed = paged_setup
+    reg = observability.get_program_registry()
+
+    def serving_compiles():
+        return {k: v["compiles"] for k, v in reg.snapshot().items()
+                if k.startswith("serving_")}
+
+    before = (eng.compile_counts(), serving_compiles(),
+              core_op.dispatch_cache_stats()["misses"])
+    rng = np.random.RandomState(1)
+    greedy_prompts = [rng.randint(0, 13, (n,)) for n in (4, 7, 11, 14)]
+    greedy = [eng.submit(p, max_new_tokens=6) for p in greedy_prompts]
+    sampled_kw = [
+        dict(max_new_tokens=7, decode_strategy="sampling", temperature=0.8,
+             top_k=4, seed=9),
+        dict(max_new_tokens=5, decode_strategy="sampling", top_p=0.9,
+             seed=3),
+    ]
+    sp = [rng.randint(0, 13, (5,)) for _ in sampled_kw]
+    sampled = [eng.submit(p, **kw) for p, kw in zip(sp, sampled_kw)]
+    eng.run_until_drained(timeout=240)
+    for p, r in zip(greedy_prompts, greedy):
+        assert r.tokens(timeout=5) == solo(model, p, 6)
+    oracle = [fixed.submit(p, **kw) for p, kw in zip(sp, sampled_kw)]
+    fixed.run_until_drained(timeout=240)
+    for r, o in zip(sampled, oracle):
+        assert r.tokens(timeout=5) == o.tokens(timeout=5)
+    after = (eng.compile_counts(), serving_compiles(),
+             core_op.dispatch_cache_stats()["misses"])
+    assert after == before, "paged traffic must never compile post-warmup"
+    cc = eng.compile_counts()
+    assert cc["total"] <= cc["bound"] == len(eng.buckets) + 1
+    assert eng.warm and eng.metrics()["kv_pool"]["kind"] == "paged"
+    assert eng.kv_pool.used_blocks() == 0
+
+
+def test_paged_slot_reuse_keeps_no_stale_kv(paged_setup):
+    model, eng, _ = paged_setup
+    rng = np.random.RandomState(5)
+    long_p = rng.randint(0, 13, (12,))
+    [eng.submit(long_p, max_new_tokens=20) for _ in range(eng.max_slots)]
+    eng.run_until_drained(timeout=240)
+    short_p = rng.randint(0, 13, (4,))
+    rs = [eng.submit(short_p, max_new_tokens=5)
+          for _ in range(eng.max_slots)]
+    eng.run_until_drained(timeout=240)
+    want = solo(model, short_p, 5)
+    for r in rs:
+        assert r.tokens() == want
+
+
+def test_block_table_overflow_at_max_len(paged_setup):
+    """A request filling max_len exactly runs to the last row without the
+    table overflowing; one past it is rejected up front."""
+    model, eng, _ = paged_setup
+    prompt = np.arange(1, 9)  # plen 8
+    r = eng.submit(prompt, max_new_tokens=eng.max_len - 8)  # == max_len
+    eng.run_until_drained(timeout=240)
+    assert r.tokens() == solo(model, prompt, eng.max_len - 8)
+    assert eng.kv_pool.used_blocks() == 0
+    with pytest.raises(InvalidArgumentError):
+        eng.submit(prompt, max_new_tokens=eng.max_len - 7)
+    # a table can never exceed its static width
+    assert eng.kv_pool.max_blocks_per_slot * eng.block_size >= eng.max_len
+
+
+def test_paged_preempt_restore_roundtrip(paged_setup):
+    """The dist_serving preempt/restore contract: a paged run preempted
+    mid-decode frees its blocks, parks host-side, and resumes into ANY
+    slot with the remaining stream bit-identical."""
+    model, eng, _ = paged_setup
+    prompt = [2, 4, 6]
+    r = eng.submit(prompt, max_new_tokens=20)
+    eng.step()
+    eng.step()
+    slot = next(iter(eng._slots))
+    used_before = eng.kv_pool.used_blocks()
+    paused = eng.preempt_slot(slot)
+    assert eng.kv_pool.used_blocks() == 0 < used_before
+    assert not r.done()
+    assert eng.restore_run(paused)
+    eng.run_until_drained(timeout=240)
+    assert r.tokens() == solo(model, prompt, 20)
+    assert r.request.preempts == 1 and r.request.resumes == 1
+
+
+# ---------------------------------------------------------------------------
+# exhaustion is backpressure, not a crash
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_kv_exhaustion_preempts_newest_and_stays_correct():
+    """An undersized pool: two long runs cannot both grow — the newest
+    preempts, parks, resumes as the pool drains, and BOTH streams finish
+    bit-identical to solo generate."""
+    m = tiny_gpt()
+    eng = ServingEngine(m, max_slots=2, max_len=48, prefill_buckets=(8,),
+                        decode_chunk=4, kv="paged", block_size=8,
+                        num_blocks=7)
+    eng.warmup()
+    p1, p2 = [1, 2, 3, 4], [5, 6, 7]
+    r1 = eng.submit(p1, max_new_tokens=30)
+    r2 = eng.submit(p2, max_new_tokens=30)
+    eng.run_until_drained(timeout=240)
+    assert r1.tokens() == solo(m, p1, 30)
+    assert r2.tokens() == solo(m, p2, 30)
+    assert eng._oom_preempts >= 1, "pressure must have preempted"
+    assert eng.metrics()["kv_pool"]["oom_preempts"] >= 1
+    assert eng.kv_pool.used_blocks() == 0
+
+
+@pytest.mark.faults
+def test_kv_exhaust_fault_reaches_typed_terminal():
+    """PDTPU_FAULT_KV_EXHAUST=1: the run's next tick can never fit even
+    alone -> KVPoolExhaustedError, never a hang; disarming restores full
+    service on the same engine."""
+    m = tiny_gpt()
+    eng = ServingEngine(m, max_slots=2, max_len=48, prefill_buckets=(8,),
+                        decode_chunk=4, kv="paged", block_size=8)
+    eng.warmup()
+    faults.enable("kv_exhaust", "1")
+    try:
+        r = eng.submit([1, 2, 3], max_new_tokens=30)
+        eng.run_until_drained(timeout=60)
+    finally:
+        faults.reset()
+    with pytest.raises(KVPoolExhaustedError):
+        r.tokens(timeout=5)
+    assert r.finish_reason == "error"
+    assert eng.scheduler.free_slot_count() == eng.max_slots
+    # the engine keeps serving once the fault clears
+    r2 = eng.submit([1, 2, 3], max_new_tokens=6)
+    eng.run_until_drained(timeout=60)
+    assert r2.tokens() == solo(m, [1, 2, 3], 6)
+
+
+@pytest.mark.faults
+def test_kv_exhaustion_admission_is_backpressure():
+    """With the pool capped below two prompts, the second request WAITS
+    (block-aware admission gate) and completes after the first drains —
+    no error, no hang, FIFO preserved."""
+    m = tiny_gpt()
+    eng = ServingEngine(m, max_slots=2, max_len=48,
+                        prefill_buckets=(8, 16), decode_chunk=4,
+                        kv="paged", block_size=8)
+    eng.warmup()
+    p1 = list(range(1, 10))   # bucket 16 = 2 blocks at admission
+    p2 = list(range(2, 11))
+    faults.enable("kv_exhaust", "2")  # exactly one such request at a time
+    try:
+        r1 = eng.submit(p1, max_new_tokens=8)   # rows 16: fits the cap
+        r2 = eng.submit(p2, max_new_tokens=8)
+        eng.step()
+        assert eng.scheduler.occupancy() == 1, "second must wait on blocks"
+        assert eng.scheduler.queue_depth() == 1
+        eng.run_until_drained(timeout=120)
+    finally:
+        faults.reset()
+    assert r1.tokens() == solo(m, p1, 8)
+    assert r2.tokens() == solo(m, p2, 8)
+
+
+def test_submit_rejects_bucket_that_can_never_admit():
+    """The submit-time fit check must use the PREFILL BUCKET the request
+    will actually allocate, not just its row budget — otherwise a tiny
+    request in a big bucket passes validation but can never pass the
+    admission gate (regression: permanent busy-spin)."""
+    m = tiny_gpt()
+    eng = ServingEngine(m, max_slots=2, max_len=32, prefill_buckets=(16,),
+                        kv="paged", block_size=8, num_blocks=1)
+    with pytest.raises(InvalidArgumentError, match="KV blocks"):
+        eng.submit([1, 2], max_new_tokens=2)  # 4 rows, but bucket 16
+
+
+@pytest.mark.faults
+def test_queued_request_fails_typed_when_fault_cap_blocks_admission():
+    """A queued request whose prompt bucket can never fit the LIVE
+    (fault-capped) pool must reach the typed KVPoolExhaustedError — not
+    wait in the queue forever (regression: run_until_drained spun)."""
+    m = tiny_gpt()
+    eng = ServingEngine(m, max_slots=2, max_len=48,
+                        prefill_buckets=(8, 16), kv="paged", block_size=8)
+    eng.warmup()
+    faults.enable("kv_exhaust", "1")  # bucket 16 needs 2 blocks: never
+    try:
+        r = eng.submit(list(range(1, 10)), max_new_tokens=4)  # no deadline
+        eng.run_until_drained(timeout=60)
+    finally:
+        faults.reset()
+    with pytest.raises(KVPoolExhaustedError):
+        r.tokens(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# the paged-attention op (jnp fallback + pallas kernel via interpreter)
+# ---------------------------------------------------------------------------
+
+def test_paged_attention_op_matches_contiguous_reference():
+    import jax.numpy as jnp
+    from paddle_tpu.ops import paged_attention as pa
+    rng = np.random.RandomState(0)
+    nb_pool, bs, h, d = 10, 4, 2, 8
+    kpool = jnp.asarray(rng.randn(nb_pool, bs, h, d).astype(np.float32))
+    vpool = jnp.asarray(rng.randn(nb_pool, bs, h, d).astype(np.float32))
+    # last entry is the allocator's out-of-range SENTINEL: both the jnp
+    # fallback (clip) and the pallas kernel (clamped index_map) must
+    # accept the engine's real tables
+    table = jnp.asarray(np.array([7, 2, 9, nb_pool], np.int32))
+    q = jnp.asarray(rng.randn(h, d).astype(np.float32))
+    pos = 9  # attends rows 0..9 of the 16-row gathered view
+
+    k = np.asarray(pa.gather_block_rows(kpool, table))
+    v = np.asarray(pa.gather_block_rows(vpool, table))
+    s = np.einsum("hd,thd->ht", np.asarray(q), k) / np.sqrt(d)
+    s[:, pos + 1:] = -np.inf
+    p = np.exp(s - s.max(axis=1, keepdims=True))
+    p /= p.sum(axis=1, keepdims=True)
+    want = np.einsum("ht,thd->hd", p, v)
+
+    got = np.asarray(pa.paged_attention(q, kpool, vpool, table, pos))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    # pallas kernel (interpreter) against the jnp fallback
+    pa._INTERPRET = True
+    try:
+        kern = np.asarray(pa.paged_attention(q, kpool, vpool, table, pos))
+    finally:
+        pa._INTERPRET = False
+    np.testing.assert_allclose(kern, got, rtol=1e-5, atol=1e-5)
+
+
+def test_scatter_and_scrub_primitives():
+    import jax.numpy as jnp
+    from paddle_tpu.ops import paged_attention as pa
+    pool = jnp.ones((4, 2, 3), jnp.float32)
+    # sentinel writes drop; real writes land
+    out = pa.scatter_block_rows(
+        pool, jnp.asarray([1, 4], jnp.int32), jnp.asarray([1, 0], jnp.int32),
+        jnp.asarray(np.full((2, 3), 5.0, np.float32)))
+    out = np.asarray(out)
+    assert np.all(out[1, 1] == 5.0) and np.all(out[3] == 1.0)
+    scr = np.asarray(pa.scrub_blocks(
+        jnp.asarray(out), jnp.asarray([2, 9], jnp.int32)))
+    assert np.all(scr[2] == 0.0) and np.all(scr[1, 1] == 5.0)
+
+
+# ---------------------------------------------------------------------------
+# tensor parallelism over the 8-virtual-device CPU mesh
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tp_setup():
+    m = tp_gpt()
+    mesh = parallel.create_mesh({"tp": 8})
+    tp_eng = ServingEngine(m, max_slots=2, max_len=32, prefill_buckets=(8,),
+                           decode_chunk=4, mesh=mesh)
+    tp_eng.warmup()
+    sd_eng = ServingEngine(m, max_slots=2, max_len=32,
+                           prefill_buckets=(8,), decode_chunk=4)
+    sd_eng.warmup()
+    return m, mesh, tp_eng, sd_eng
+
+
+def test_tp_engine_shardings_asserted(tp_setup):
+    """No silent full replication: the KV pool is heads-sharded over tp
+    and the Megatron param layout is live (col-parallel qkv, row-parallel
+    proj, vocab-sharded embedding)."""
+    from jax.sharding import PartitionSpec as P
+    _, mesh, tp_eng, _ = tp_setup
+    kpool = tp_eng._pools[0][0]
+    assert not kpool.sharding.is_fully_replicated, "KV pool replicated"
+    assert tuple(kpool.sharding.spec)[:3] == (None, None, "tp")
+    assert tp_eng._state["gpt.blocks.0.qkv.weight"].sharding.spec == \
+        P(None, "tp")
+    assert tp_eng._state["gpt.blocks.0.proj.weight"].sharding.spec == \
+        P("tp", None)
+    assert tp_eng._state["gpt.word_embeddings.weight"].sharding.spec == \
+        P("tp", None)
+    # norms replicate
+    assert tp_eng._state["gpt.ln_f.weight"].sharding.is_fully_replicated
+    assert tp_eng.metrics()["mesh"] == {"devices": 8, "tp": 8}
+
+
+def test_tp_engine_bit_identical_streams(tp_setup):
+    """Greedy AND sampled streams from the tp=8 engine match the
+    single-device engine token-for-token for the same seeds, with the
+    compile count at its bound (programs compiled once under the mesh)."""
+    _, _, tp_eng, sd_eng = tp_setup
+    rng = np.random.RandomState(3)
+    cases = [dict(max_new_tokens=8),
+             dict(max_new_tokens=8, decode_strategy="sampling",
+                  temperature=0.9, top_k=5, seed=11),
+             dict(max_new_tokens=6, decode_strategy="sampling",
+                  top_p=0.85, seed=4)]
+    for kw in cases:
+        p = rng.randint(0, 64, (int(rng.randint(3, 8)),))
+        a = tp_eng.submit(p, **kw)
+        tp_eng.run_until_drained(timeout=240)
+        b = sd_eng.submit(p, **kw)
+        sd_eng.run_until_drained(timeout=240)
+        assert a.tokens(timeout=5) == b.tokens(timeout=5), kw
+    cc = tp_eng.compile_counts()
+    assert cc["total"] <= cc["bound"]
+
+
+def test_tp_fixed_restore_keeps_pool_sharded(tp_setup):
+    """Preempt/restore on a mesh engine must re-place the uploaded pool
+    with its heads sharding — a default-device array would silently
+    de-shard it and retrace the decode program (regression)."""
+    m, _, tp_eng, _ = tp_setup
+    compiles = tp_eng.compile_counts()["total"]
+    r = tp_eng.submit([9, 8, 7], max_new_tokens=10)
+    tp_eng.step()
+    paused = tp_eng.preempt_slot(next(iter(tp_eng._slots)))
+    assert tp_eng.restore_run(paused)
+    assert not tp_eng._pools[0][0].sharding.is_fully_replicated, \
+        "restore de-sharded the KV pool"
+    tp_eng.run_until_drained(timeout=240)
+    assert r.tokens() == solo(m, [9, 8, 7], 10)
+    assert tp_eng.compile_counts()["total"] == compiles, \
+        "restore must not force a retrace"
+
+
+def test_tp_rejects_fully_replicated_kv_pool():
+    """2 heads cannot shard over tp=8: every KV leaf would replicate —
+    the engine refuses loudly instead of paying tp x the HBM silently."""
+    m = tiny_gpt()  # 2 heads
+    mesh = parallel.create_mesh({"tp": 8})
+    with pytest.raises(InvalidArgumentError, match="replicated"):
+        ServingEngine(m, max_slots=2, max_len=32, prefill_buckets=(8,),
+                      mesh=mesh)
+
+
+def test_tp_rejects_fully_replicated_draft_pool():
+    """The guard covers the DRAFT pool too: a draft whose heads cannot
+    shard over tp must not silently replicate behind a sharded target."""
+    target = tp_gpt()  # 8 heads: shards fine
+    dcfg = models.GPTConfig(vocab_size=64, hidden_size=16,
+                            num_hidden_layers=1, num_attention_heads=2,
+                            hidden_dropout_prob=0.0,
+                            attention_probs_dropout_prob=0.0,
+                            max_position_embeddings=64)
+    draft = models.GPTForPretraining(dcfg)
+    draft.eval()
+    mesh = parallel.create_mesh({"tp": 8})
+    with pytest.raises(InvalidArgumentError, match="draft KV pool"):
+        ServingEngine(target, max_slots=2, max_len=32,
+                      prefill_buckets=(8,), draft_model=draft,
+                      spec_tokens=2, mesh=mesh)
+
+
+@pytest.mark.gateway
+@pytest.mark.faults
+def test_gateway_stride_pass_rolls_back_on_block_pressure():
+    """try_admit refusing on block pressure is ROUTINE for paged engines;
+    the gateway must roll the tenant's stride pass back on the requeue
+    path or waiting on capacity eats the tenant's fair share
+    (regression)."""
+    from paddle_tpu.serving import ServingGateway, TenantConfig
+    m = tiny_gpt()
+    eng = ServingEngine(m, max_slots=2, max_len=32, prefill_buckets=(8,),
+                        kv="paged", block_size=8)
+    eng.warmup()
+    gw = ServingGateway(eng, tenants={"a": TenantConfig(weight=2.0)})
+    faults.enable("kv_exhaust", "0")  # no blocks: admission always waits
+    try:
+        gw.submit([1, 2, 3], 4, tenant="a")
+        for _ in range(5):
+            assert not gw._admit_one()
+        assert gw._tenants["a"].passes.get(0, 0.0) == 0.0, \
+            "failed admissions must not advance the stride pass"
+        assert gw.metrics()["lane_depth_lo"] == 1  # still queued
+    finally:
+        faults.reset()
+        gw.close()
+
+
+@pytest.mark.spec
+def test_static_fit_check_matches_runtime_backing():
+    """A pool sized exactly for the rows the runtime actually backs
+    (plen + max_new - 1) must ACCEPT and serve the request — the static
+    check may not add spec headroom the engine never allocates
+    (regression: spuriously rejected)."""
+    m = tiny_gpt()
+    draft = tiny_gpt()
+    eng = ServingEngine(m, max_slots=1, max_len=24, prefill_buckets=(8,),
+                        draft_model=draft, spec_tokens=3, kv="paged",
+                        block_size=8, num_blocks=2)
+    eng.warmup()
+    r = eng.submit([1, 2, 3, 4], max_new_tokens=13)  # rows 16 == 2 blocks
+    eng.run_until_drained(timeout=120)
+    assert r.tokens() == solo(m, [1, 2, 3, 4], 13)
+    assert eng.kv_pool.used_blocks() == 0
+
+
+def test_paged_rejects_bad_block_size():
+    with pytest.raises(InvalidArgumentError):
+        ServingEngine(tiny_gpt(), max_slots=2, max_len=32,
+                      prefill_buckets=(8,), kv="paged", block_size=0)
+
+
+@pytest.mark.slow
+def test_tp_paged_engine_matches_single_device():
+    """The full tentpole composition: paged KV pool + tensor parallelism,
+    bit-identical to the plain single-device engine."""
+    m = tp_gpt()
+    mesh = parallel.create_mesh({"tp": 8})
+    eng = ServingEngine(m, max_slots=4, max_len=32, prefill_buckets=(8,),
+                        decode_chunk=4, kv="paged", block_size=8,
+                        mesh=mesh)
+    eng.warmup()
+    sd = ServingEngine(m, max_slots=4, max_len=32, prefill_buckets=(8,),
+                       decode_chunk=4)
+    sd.warmup()
+    assert not eng._pools[0][0].sharding.is_fully_replicated
+    rng = np.random.RandomState(9)
+    prompts = [rng.randint(0, 64, (n,)) for n in (4, 6, 7)]
+    ra = [eng.submit(p, max_new_tokens=10) for p in prompts]
+    eng.run_until_drained(timeout=240)
+    rb = [sd.submit(p, max_new_tokens=10) for p in prompts]
+    sd.run_until_drained(timeout=240)
+    for a, b in zip(ra, rb):
+        assert a.tokens() == b.tokens()
+    assert eng.kv_pool.used_blocks() == 0
+
+
+@pytest.mark.slow
+@pytest.mark.spec
+def test_paged_spec_engine_greedy_parity():
+    """kv='paged' composes with speculative decoding: the draft pool
+    pages through the SAME block tables and greedy streams stay
+    bit-identical to solo generate at the unchanged compile bound."""
+    m = tiny_gpt()
+    draft = tiny_gpt()
+    eng = ServingEngine(m, max_slots=2, max_len=48, prefill_buckets=(8,),
+                        draft_model=draft, spec_tokens=3, kv="paged",
+                        block_size=8)
+    eng.warmup()
+    p = [3, 1, 4, 1]
+    r = eng.submit(p, max_new_tokens=12)
+    eng.run_until_drained(timeout=240)
+    assert r.tokens() == solo(m, p, 12)
+    cc = eng.compile_counts()
+    assert cc["total"] <= cc["bound"] == len(eng.buckets) + 1
+    assert eng.kv_pool.used_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# gateway over a paged engine: warm healthz + preempt/restore unchanged
+# ---------------------------------------------------------------------------
+
+@pytest.mark.gateway
+def test_gateway_healthz_warm_and_paged_preemption():
+    import json
+    from paddle_tpu.serving import (PRIORITY_HIGH, ServingGateway,
+                                    TenantConfig)
+    m = tiny_gpt()
+    eng = ServingEngine(m, max_slots=1, max_len=48, prefill_buckets=(8,),
+                        decode_chunk=2, kv="paged", block_size=8)
+    gw = ServingGateway(eng, tenants={"t": TenantConfig()})
+    status, _, body = gw.handle("GET", "/healthz")
+    assert status == 200 and json.loads(body)["warm"] is False
+    eng.warmup()
+    status, _, body = gw.handle("GET", "/healthz")
+    assert json.loads(body)["warm"] is True
+    # a high-priority arrival preempts the (paged) low run; the victim
+    # resumes bit-identical through the same gateway machinery
+    lo = gw.submit([1, 2, 3], 16, tenant="t")
+    gw._tick()
+    gw._tick()  # lo holds the only slot mid-decode
+    hi = gw.submit([4, 5], 4, tenant="t", priority=PRIORITY_HIGH)
+    gw.run_until_drained(timeout=240)
+    assert hi.tokens(timeout=5) == solo(m, [4, 5], 4)
+    assert lo.tokens(timeout=5) == solo(m, [1, 2, 3], 16)
+    assert lo.request.preempts >= 1 and lo.request.resumes >= 1
+    gw.close()

@@ -349,7 +349,7 @@ def test_weighted_fair_admission_order():
             gw.submit(np.arange(4), 2, tenant="light")
         order = []
         for _ in range(9):
-            entry = gw._pop_lane(PRIORITY_LOW)
+            entry, _tenant, _prev = gw._pop_lane(PRIORITY_LOW)
             order.append(entry.req.tenant)
         # stride scheduling: weight-2 tenant admitted ~2x as often
         assert order.count("heavy") == 6 and order.count("light") == 3, order

@@ -490,11 +490,9 @@ def test_one_shot_predictor_profile_report(tmp_path):
 # ---------------------------------------------------------------------------
 
 @pytest.mark.slow
-def test_serving_probe_smoke():
+def test_serving_probe_smoke(cpu8_env):
     import json
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)
-    env["JAX_PLATFORMS"] = "cpu"
+    env = cpu8_env
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "probes", "serving_probe.py"),
          "--steps", "3"],
