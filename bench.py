@@ -872,6 +872,37 @@ def measure_spec_decode():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_program_cache():
+    """ISSUE-9 acceptance artifact: probes/program_cache_probe.py in a
+    clean CPU subprocess.  Publishes the program-lifecycle story as
+    `detail.program_cache.{cold_start_ratio,post_warmup_compiles}` —
+    bars: second-process serving cold start (enable_serving -> first
+    token) >= 5x faster booting from a warm program store + AOT program
+    set than cold-compiling, zero post-warmup compiles under mixed
+    spec/sampling traffic in BOTH legs, warm-loaded streams bit-identical
+    to cold-compiled ones, compile counts at the len(buckets)+1 bound."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PDTPU_PROGRAM_CACHE_DIR", None)  # the probe owns its store
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes",
+                                      "program_cache_probe.py"),
+         "--steps", os.environ.get("PDTPU_PROGCACHE_PROBE_STEPS", "32")],
+        capture_output=True, text=True, timeout=1800, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("PROGCACHE"):
+            rec = json.loads(line[len("PROGCACHE"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"program-cache bars failed: "
+                                 f"{rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return rec
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_paged_serving():
     """ISSUE-8 acceptance artifact: probes/paged_serving_probe.py in a
     clean CPU subprocess.  Publishes the paged-vs-fixed KV pool density
@@ -1140,6 +1171,7 @@ def main():
                          ("eager_dispatch", measure_eager_dispatch),
                          ("serving", measure_serving),
                          ("paged", measure_paged_serving),
+                         ("program_cache", measure_program_cache),
                          ("spec_decode", measure_spec_decode),
                          ("gateway", measure_gateway),
                          ("resilience", measure_resilience),

@@ -67,6 +67,8 @@ from . import regularizer  # noqa: F401
 from . import text  # noqa: F401
 from . import inference  # noqa: F401
 from . import observability  # noqa: F401
+from . import programs  # noqa: F401
+programs.bootstrap()  # PDTPU_PROGRAM_CACHE_DIR: persistent program store
 from . import onnx  # noqa: F401
 from .nn.layer_base import ParamAttr  # noqa: F401
 from .distributed.parallel_layer import DataParallel  # noqa: F401

@@ -574,6 +574,7 @@ def _dispatch_fast(name, raw_fn, flat, treedef, tag_out):
         _stats["hits"] += 1
         return _run_entry(entry, name, raw_fn, flat, tag_out)
     _stats["misses"] += 1
+    _consult_program_store()
     t_compile = time.perf_counter()
     entry = _make_entry(name, raw_fn, flat, treedef, dyn_leaf_pos,
                         dyn_cell_pos, diff_pos, tensor_pos)
@@ -605,6 +606,27 @@ def _dispatch_fast(name, raw_fn, flat, treedef, tag_out):
         _cache.popitem(last=False)
         _stats["evictions"] += 1
     return result
+
+
+_store_consulted = False
+
+
+def _consult_program_store():
+    """Before the first dispatch-cache miss compiles anything, make sure
+    the persistent program store is live when the env opts in
+    (PDTPU_PROGRAM_CACHE_DIR): every per-op jit this cache builds then
+    reads/writes the shared on-disk cache, so a second process replays
+    the whole eager warm-up from disk instead of recompiling it.
+    Best-effort and once: the store must never gate dispatch."""
+    global _store_consulted
+    if _store_consulted:
+        return
+    _store_consulted = True
+    try:
+        from ..programs.store import ensure_enabled
+        ensure_enabled()
+    except Exception:
+        pass
 
 
 def _note_compile(name, seconds):

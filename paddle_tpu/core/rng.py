@@ -79,6 +79,18 @@ def next_key():
     return _default_generator.next_key()
 
 
+def example_key():
+    """A constant key aval-identical to `next_key()`'s output WITHOUT
+    advancing the stream — compile-only paths (TrainStep.warmup) need the
+    signature but must not consume a key a bit-exact resume depends on."""
+    ctx = _active_ctx()
+    if ctx is not None:
+        return jax.random.fold_in(ctx.key, 0)
+    gen = _default_generator
+    with gen._lock:
+        return jax.random.fold_in(gen._key, 0)
+
+
 def get_rng_state():
     return _default_generator.get_state()
 

@@ -147,6 +147,17 @@ class Config:
         model_name=, ...).  The predictor then routes submit() through
         the gateway (tenant=/priority= become available) and the gateway
         drives the engine loop.
+
+        Program lifecycle (README "Program lifecycle"):
+        `program_cache_dir=` enables the persistent program store for
+        this process (same as PDTPU_PROGRAM_CACHE_DIR) so every compile
+        — eager dispatch, warmup, serving programs — reads/writes the
+        shared on-disk cache.  `program_set=` boots the engine from an
+        AOT program-set artifact (`predictor.save_program_set(path)` /
+        `ServingEngine.save_program_set`) WITHOUT retracing any model
+        code; a stale or corrupt artifact is rejected with a warning
+        (counted as ``program_set_fallback_total``) and the engine falls
+        back to a fresh trace+compile — never silent reuse.
         """
         if (model is None) == (model_provider is None):
             raise ValueError(
@@ -306,6 +317,10 @@ class ServingPredictor:
         start = opts.pop("start", True)
         gateway = opts.pop("gateway", None)
         quantize = opts.pop("quantize", None)
+        program_cache_dir = opts.pop("program_cache_dir", None)
+        if program_cache_dir is not None:
+            from ..programs import store as _pstore
+            _pstore.enable(program_cache_dir)
         if model is None:
             model = provider()
             prefix = config.model_dir()
@@ -329,7 +344,29 @@ class ServingPredictor:
             if draft is not None:
                 opts["draft_model"] = quantize_for_serving(draft, quantize)
         self._config = config
-        self.engine = ServingEngine(model, profile=config._profile, **opts)
+        try:
+            self.engine = ServingEngine(model, profile=config._profile,
+                                        **opts)
+        except Exception as e:
+            from ..programs.program_set import ProgramSetError
+            if not isinstance(e, ProgramSetError) or "program_set" not in opts:
+                raise
+            # a stale/corrupt AOT program set must cost a recompile, not
+            # an outage: warn loudly, count it, trace fresh
+            import warnings
+            warnings.warn(
+                f"enable_serving(program_set=...): artifact rejected "
+                f"({e}); falling back to a fresh trace+compile")
+            try:
+                from ..observability.metrics import counter
+                counter("program_set_fallback_total",
+                        "serving boots that rejected their AOT program "
+                        "set and fell back to tracing").inc()
+            except Exception:
+                pass
+            opts.pop("program_set", None)
+            self.engine = ServingEngine(model, profile=config._profile,
+                                        **opts)
         if warmup:
             self.engine.warmup()
         self.gateway = None
@@ -359,6 +396,14 @@ class ServingPredictor:
         if self.gateway is not None:
             return self.gateway.metrics()
         return self.engine.metrics()
+
+    def save_program_set(self, path: str,
+                         extra_meta: Optional[dict] = None) -> str:
+        """Export the engine's whole compiled-program family as one AOT
+        artifact (see README "Program lifecycle"); other replicas boot
+        from it via ``enable_serving(..., program_set=path)`` without
+        retracing."""
+        return self.engine.save_program_set(path, extra_meta)
 
     def serve_http(self, port: int = 8000, addr: str = "127.0.0.1"):
         """Start the OpenAI-shaped streaming endpoint over the gateway

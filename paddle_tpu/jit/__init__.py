@@ -256,6 +256,30 @@ def _step_hist():
     return _obs_step_hist
 
 
+def warm_step_program(compiled_fn, state, opt_state, optimizer, raw_batch):
+    """Compile a train-step program for this signature WITHOUT executing
+    it — the shared half of `TrainStep.warmup` / `ShardedTrainStep.warmup`
+    (one place for the calling convention so the two step classes cannot
+    drift).  Stand-ins for the per-call dynamic scalars are
+    aval-identical to a real call's; the key is a CONSTANT (not
+    `_rng.next_key()`: warming must not consume the stream a bit-exact
+    resume depends on).  Returns whether a compile happened."""
+    from ..core import rng as _rng
+    args = (state, opt_state,
+            jnp.asarray(optimizer._step_count + 1, jnp.int32),
+            jnp.asarray(optimizer.get_lr(), jnp.float32),
+            _rng.example_key(), raw_batch)
+    if hasattr(compiled_fn, "warm"):              # TrackedJit
+        return bool(compiled_fn.warm(*args))
+    # PDTPU_OBS_PROGRAMS=0: compile without executing; the first call
+    # retraces but hits the persistent cache
+    try:
+        compiled_fn.lower(*args).compile()
+        return True
+    except Exception:
+        return False
+
+
 def guard_select(params, opt_state, new_params, new_opt, loss, grads):
     """Device-side step guard, shared by TrainStep / ShardedTrainStep.
 
@@ -653,6 +677,45 @@ class TrainStep:
             sd[k]._set_data(v)
         return Tensor(losses)
 
+    def _ensure_compiled(self, state, batch):
+        """Resolve the compiled step for this batch signature (the sparse
+        path keys per shape) — shared by __call__ and warmup()."""
+        if self._sparse:
+            # sparse lookup counts are baked into the compiled step, so
+            # each batch-shape signature needs its own build (the dense
+            # path just lets jax.jit retrace)
+            sig = tuple((tuple(unwrap(b).shape), str(unwrap(b).dtype))
+                        for b in batch)
+            self._compiled = self._sig_cache.get(sig)
+            if self._compiled is None:
+                self._compiled = self._sig_cache[sig] = self._build(
+                    state, self._opt_state, batch)
+        if self._compiled is None:
+            self._compiled = self._build(state, self._opt_state, batch)
+        return self._compiled
+
+    def warmup(self, *batch) -> dict:
+        """AOT-compile the step for this sample batch WITHOUT applying an
+        update: params, optimizer state, BN stats and the RNG stream are
+        untouched — the training analogue of `ServingEngine.warmup()`,
+        so a fleet worker (or a resumed preemption victim) pays its
+        compile before the first real batch instead of inside it.  With
+        the persistent program store enabled (PDTPU_PROGRAM_CACHE_DIR),
+        warmup in one process makes every other process's first step a
+        disk hit.  Returns {'seconds', 'compiled'} — compiled=False
+        means the signature was already warm (or the build is not
+        AOT-compilable; the first real call then compiles normally)."""
+        import time as _time
+        t0 = _time.perf_counter()
+        state = state_arrays(self.model)
+        if self._opt_state is None:
+            self._opt_state = self.init_opt_state(state)
+        compiled_fn = self._ensure_compiled(state, batch)
+        raw_batch = tuple(unwrap(b) for b in batch)
+        did = warm_step_program(compiled_fn, state, self._opt_state,
+                                self.optimizer, raw_batch)
+        return {"seconds": _time.perf_counter() - t0, "compiled": did}
+
     def __call__(self, *batch):
         from ..observability import span as _span
         with _span("train_step"), _step_hist().time():
@@ -662,18 +725,7 @@ class TrainStep:
         state = state_arrays(self.model)
         if self._opt_state is None:
             self._opt_state = self.init_opt_state(state)
-        if self._sparse:
-            # sparse lookup counts are baked into the compiled step, so each
-            # batch-shape signature needs its own build (the dense path just
-            # lets jax.jit retrace)
-            sig = tuple((tuple(unwrap(b).shape), str(unwrap(b).dtype))
-                        for b in batch)
-            self._compiled = self._sig_cache.get(sig)
-            if self._compiled is None:
-                self._compiled = self._sig_cache[sig] = self._build(
-                    state, self._opt_state, batch)
-        if self._compiled is None:
-            self._compiled = self._build(state, self._opt_state, batch)
+        self._ensure_compiled(state, batch)
         self.optimizer._step_count += 1
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step_no = jnp.asarray(self.optimizer._step_count, jnp.int32)

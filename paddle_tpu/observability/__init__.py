@@ -39,7 +39,7 @@ from __future__ import annotations
 from .metrics import (Counter, Gauge, Histogram, MetricsRegistry,  # noqa: F401
                       counter, gauge, get_registry, histogram)
 from .tracer import Span, Tracer, get_tracer, span  # noqa: F401
-from .programs import (ProgramRegistry, TrackedJit,  # noqa: F401
+from .programs import (ProgramRegistry, TrackedJit, aot_fallbacks,  # noqa: F401
                        get_program_registry, note_compile, track)
 from .exporters import (JsonlSink, MetricsServer, prometheus_text,  # noqa: F401
                         render_endpoint, report, serve_metrics)
@@ -49,7 +49,7 @@ __all__ = [
     "histogram", "get_registry",
     "Span", "Tracer", "get_tracer", "span",
     "ProgramRegistry", "TrackedJit", "get_program_registry", "note_compile",
-    "track",
+    "track", "aot_fallbacks",
     "JsonlSink", "MetricsServer", "prometheus_text", "render_endpoint",
     "report", "serve_metrics",
     "export_chrome_trace", "reset",

@@ -176,6 +176,16 @@ def report() -> dict:
         "preemptions": stats.get("STAT_gateway_preemptions", 0),
         "resumes": stats.get("STAT_gateway_resumes", 0),
     }
+    # program lifecycle: the persistent program store + the AOT-fallback
+    # line (a TrackedJit that silently downgraded used to be invisible)
+    try:
+        from ..programs.store import store_stats
+        program_store = store_stats()
+    except Exception:
+        program_store = None
+    from .programs import aot_fallbacks as _aot_fallbacks
+    fallbacks = _aot_fallbacks()
+
     return {
         "generated_at": time.time(),
         "dispatch_cache": dispatch,
@@ -185,6 +195,8 @@ def report() -> dict:
         "serving": serving,
         "gateway": gateway,
         "programs": get_program_registry().snapshot(),
+        "program_store": program_store,
+        "programs_aot_fallbacks": fallbacks,
         "spans": get_tracer().aggregates(),
         "stats": stats,
         "metrics": get_registry().snapshot(),

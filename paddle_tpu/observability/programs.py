@@ -22,13 +22,30 @@ Two entry points:
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
 from typing import Dict, Optional
 
 __all__ = ["ProgramRegistry", "get_program_registry", "track",
-           "note_compile", "TrackedJit"]
+           "note_compile", "TrackedJit", "aot_fallbacks"]
+
+_log = logging.getLogger("paddle_tpu.observability.programs")
+
+
+def _count_aot_fallback():
+    """programs_aot_fallback_total: every permanent AOT->passthrough
+    downgrade is counted — a TrackedJit that silently stops produce
+    cost/memory telemetry used to be invisible (ISSUE 9 satellite)."""
+    try:
+        from .metrics import counter
+        counter("programs_aot_fallback_total",
+                "TrackedJit programs permanently fallen back from the "
+                "AOT compile path (no cost/memory analysis recorded)"
+                ).inc()
+    except Exception:
+        pass  # telemetry must never break dispatch
 
 
 def _tracking_enabled() -> bool:
@@ -93,6 +110,18 @@ class ProgramRegistry:
                 rec.update(cost)
             if meta:
                 rec.setdefault("meta", {}).update(meta)
+
+    def note_meta(self, name: str, meta: dict):
+        """Attach/overwrite metadata WITHOUT counting a compile (the AOT
+        fallback marker on an already-recorded program)."""
+        with self._lock:
+            rec = self._programs.get(name)
+            if rec is None:
+                rec = self._programs[name] = {
+                    "name": name, "compiles": 0,
+                    "compile_seconds_total": 0.0, "last_compile_ms": None,
+                    "first_compiled_at": time.time()}
+            rec.setdefault("meta", {}).update(meta)
 
     def get(self, name: str) -> Optional[dict]:
         with self._lock:
@@ -184,14 +213,15 @@ class TrackedJit:
             t0 = time.perf_counter()
             try:
                 exe = self._jitted.lower(*args, **kwargs).compile()
-            except Exception:
+            except Exception as e:
                 # not AOT-able (symbolic shapes, backend quirk): permanent
                 # pass-through; estimate this compile from the first call
-                self._direct = True
+                self._fallback("aot-compile", e)
                 out = self._jitted(*args, **kwargs)
                 self._registry.note_compile(
                     self._name, time.perf_counter() - t0,
-                    meta={"aot": False})
+                    meta={"aot": False,
+                          "fallback_error": f"{type(e).__name__}: {e}"[:300]})
                 return out
             dt = time.perf_counter() - t0
             self._registry.note_compile(self._name, dt, _cost_dict(exe),
@@ -200,14 +230,68 @@ class TrackedJit:
         self._last = exe
         try:
             return exe(*args, **kwargs)
-        except TypeError:
+        except TypeError as e:
             # aval-validation mismatch (raised before donation/execution):
             # our signature key was too coarse for this call pattern — run
             # the safe path and stop tracking; semantics over telemetry
-            self._direct = True
+            self._fallback("signature", e)
+            self._registry.note_meta(
+                self._name,
+                {"aot": False,
+                 "fallback_error": f"{type(e).__name__}: {e}"[:300]})
             self._exe.clear()
             self._last = None
             return self._jitted(*args, **kwargs)
+
+    def _fallback(self, why: str, exc: BaseException):
+        self._direct = True
+        _count_aot_fallback()
+        _log.debug("TrackedJit %r: permanent AOT fallback (%s)",
+                   self._name, why, exc_info=exc)
+
+    # -- AOT warmup / export hooks (paddle_tpu.programs) -------------------
+    def warm(self, *args, **kwargs) -> bool:
+        """Compile for this signature WITHOUT executing (TrainStep/engine
+        warmup: priming must not apply an update or donate live buffers).
+        Returns True when a compile happened, False when already warm or
+        not AOT-able (the first real call then takes the normal path)."""
+        if self._direct:
+            return False
+        import jax
+        flat, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sig = (treedef, tuple(_sig_leaf(x) for x in flat))
+        if sig in self._exe:
+            return False
+        t0 = time.perf_counter()
+        try:
+            exe = self._jitted.lower(*args, **kwargs).compile()
+        except Exception as e:
+            self._fallback("warmup", e)
+            self._registry.note_compile(
+                self._name, time.perf_counter() - t0,
+                meta={"aot": False,
+                      "fallback_error": f"{type(e).__name__}: {e}"[:300]})
+            return False
+        self._registry.note_compile(self._name, time.perf_counter() - t0,
+                                    _cost_dict(exe), meta={"aot": True})
+        self._exe[sig] = exe
+        self._last = exe
+        return True
+
+    def compiled_for(self, *args, **kwargs):
+        """The compiled executable for this signature (compiling if
+        needed), or None when not AOT-able — the program-set exporter
+        reuses a warm engine's executables instead of recompiling."""
+        if self._direct:
+            return None
+        import jax
+        flat, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sig = (treedef, tuple(_sig_leaf(x) for x in flat))
+        exe = self._exe.get(sig)
+        if exe is None:
+            self.warm(*args, **kwargs)
+            exe = self._exe.get(sig)
+        return exe
 
     def __getattr__(self, attr):
         return getattr(self._jitted, attr)
@@ -219,3 +303,11 @@ def track(name: str, jitted, registry: ProgramRegistry = None):
     if not _tracking_enabled():
         return jitted
     return TrackedJit(name, jitted, registry)
+
+
+def aot_fallbacks(registry: ProgramRegistry = None) -> list:
+    """Names of programs that permanently fell back from the AOT path —
+    the report line that makes a silent telemetry downgrade visible."""
+    snap = (registry or _default_programs).snapshot()
+    return sorted(n for n, rec in snap.items()
+                  if (rec.get("meta") or {}).get("aot") is False)

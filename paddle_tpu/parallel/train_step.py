@@ -237,6 +237,33 @@ class ShardedTrainStep:
             self._opt_state_shardings = self._opt_shardings(shapes)
         return self._opt_state_shardings
 
+    def warmup(self, *batch) -> dict:
+        """AOT-compile the sharded step for this sample batch WITHOUT
+        applying an update (mirrors `TrainStep.warmup`): params are
+        placed on the mesh, the optimizer state is materialized, the
+        step is built and compiled — but no gradients flow, no state
+        changes, and the RNG stream is not consumed.  With the
+        persistent program store enabled, one worker's warmup makes the
+        whole fleet's first step a disk hit."""
+        import time as _time
+        t0 = _time.perf_counter()
+        if not self._placed:
+            self.place_params()
+        state = state_arrays(self.model)
+        if self._opt_state is None:
+            raw = self.init_opt_state(state)
+            shardings = self._ensure_opt_shardings()
+            self._opt_state = jax.device_put(raw, shardings)
+        if self._compiled is None:
+            self._n_batch = len(batch)
+            self._compiled = self._build(self._opt_state_shardings)
+        raw_batch = tuple(jax.device_put(unwrap(b), self._batch_sharding)
+                          for b in batch)
+        from ..jit import warm_step_program
+        did = warm_step_program(self._compiled, state, self._opt_state,
+                                self.optimizer, raw_batch)
+        return {"seconds": _time.perf_counter() - t0, "compiled": did}
+
     def __call__(self, *batch):
         from ..jit import _step_hist
         from ..observability import span as _span

@@ -1,0 +1,83 @@
+"""paddle_tpu.programs — the program-lifecycle layer.
+
+The reference framework's whole reason for a static ``ProgramDesc`` world
+was that programs are built once and executed many times (PAPER.md §1);
+this package restores that property ACROSS PROCESSES for the JAX rebuild.
+Three subsystems compile XLA programs independently — the eager dispatch
+cache (`core/op.py`), `TrainStep`/`jit` builds, and the serving
+prefill/decode/verify family — and before this layer every process paid
+full cold-start tracing + XLA compilation for each of them: the
+fleet-scale poison when thousands of serving replicas boot.
+
+Two pillars:
+
+- **store** — one process-wide program store wrapping JAX's persistent
+  compilation cache.  `store.enable(dir)` (or the
+  ``PDTPU_PROGRAM_CACHE_DIR`` env knob, picked up automatically at
+  import) points every XLA compile in the process — dispatch-cache
+  misses, TrainStep builds, serving programs — at a shared on-disk
+  cache.  The cache directory is CONTENT-ADDRESSED: the paddle_tpu
+  version and the full `utils/op_version` snapshot are folded into a
+  fingerprint subdirectory, so an artifact compiled under different op
+  semantics can never be reused silently — a version bump simply lands
+  in a fresh subdir and recompiles.  Corrupt entries fall back to a
+  fresh compile (never a crash).  Hit/miss counters feed
+  `observability.report()` and the gateway's `/healthz`.
+- **program_set** — AOT serialization of a serving engine's ENTIRE
+  program family (per-bucket prefill, decode or speculative verify,
+  paged variants) as ONE on-disk artifact with its bucket/mesh/
+  quantize/spec configuration manifest.  Each program is stored twice:
+  as a serialized native XLA executable (zero tracing + zero compile on
+  load; exact jax-version + topology match required) and as portable
+  StableHLO (`jax.export`; compiled on load, persistent-cache
+  accelerated).  `ServingEngine(..., program_set=path)` /
+  `Config.enable_serving(..., program_set=path)` boot warm without
+  retracing; a manifest mismatch (weights, buckets, spec/quantize/mesh
+  config, op versions) is a typed `ProgramSetError`, never silent
+  reuse.
+
+Warmup rides on top: `ServingEngine.warmup()` precompiles every program
+in the set before traffic and snapshots the compiled-program registry so
+`post_warmup_compiles()` can assert the fleet contract (zero compiles
+under mixed traffic); `TrainStep.warmup(batch)` /
+`ShardedTrainStep.warmup(batch)` AOT-compile the step for a sample batch
+without applying an update.
+
+Quick use::
+
+    export PDTPU_PROGRAM_CACHE_DIR=/var/cache/paddle_tpu   # fleet knob
+
+    # or in-process:
+    from paddle_tpu import programs
+    programs.enable("/var/cache/paddle_tpu")
+    ...
+    print(programs.store_stats())   # {hits, misses, entries, bytes, ...}
+
+    # AOT program set for a serving replica fleet:
+    predictor.save_program_set("gpt.pdprograms")        # once, anywhere
+    cfg.enable_serving(model_provider=build,
+                       program_set="gpt.pdprograms")    # every replica
+"""
+from __future__ import annotations
+
+from .store import (ProgramStore, enable, disable, ensure_enabled,  # noqa: F401
+                    get_program_store, cache_fingerprint, store_stats)
+from .program_set import (ProgramSetError, save_program_set,  # noqa: F401
+                          load_program_set, read_manifest)
+
+__all__ = [
+    "ProgramStore", "enable", "disable", "ensure_enabled",
+    "get_program_store", "cache_fingerprint", "store_stats",
+    "ProgramSetError", "save_program_set", "load_program_set",
+    "read_manifest", "bootstrap",
+]
+
+
+def bootstrap():
+    """Import-time hook (called from paddle_tpu/__init__): enable the
+    store when ``PDTPU_PROGRAM_CACHE_DIR`` is set — a no-op otherwise,
+    so processes that never opt in pay nothing."""
+    try:
+        ensure_enabled()
+    except Exception:  # the store must never break import
+        pass

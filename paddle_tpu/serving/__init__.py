@@ -97,6 +97,15 @@ admission outcome — shed, rate-limited, expired, preempted-then-cancelled
 — is a terminal Response: no consumer ever hangs.  See the README
 "Gateway" section.
 
+Program lifecycle
+-----------------
+`engine.warmup()` precompiles the whole program family before traffic
+(returns a compile report; `post_warmup_compiles()` asserts ZERO compiles
+under any later traffic mix), `engine.save_program_set(path)` serializes
+the family as one AOT artifact, and ``ServingEngine(program_set=path)`` /
+``enable_serving(program_set=path)`` boots from it without retracing —
+see `paddle_tpu.programs` and the README "Program lifecycle" section.
+
 Metrics (all live under `metrics()`, the STAT_serving_* monitor counters,
 and — with profiling enabled — the profiler report): ttft_p50_ms,
 inter_token_ms, tokens_per_sec, queue_depth, slot_occupancy,

@@ -318,7 +318,7 @@ class ServingEngine:
                  decode_chunk: int = 4, draft_model=None,
                  spec_tokens: int = 4, kv: str = "fixed",
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 mesh=None):
+                 mesh=None, program_set=None):
         from ..generation import _model_fns
         self.model = model
         self.max_slots = int(max_slots)
@@ -479,6 +479,25 @@ class ServingEngine:
         else:
             self._prefill_fns = {b: self._build_prefill(b)
                                  for b in self.buckets}
+        # AOT program set (paddle_tpu.programs.program_set): swap the
+        # freshly built — but never yet traced — program family for
+        # deserialized ones.  'exe' programs are already-compiled native
+        # executables (zero trace + zero compile at warmup); 'stablehlo'
+        # ones compile their portable module on first call.  A manifest
+        # mismatch or corrupt artifact raises ProgramSetError here —
+        # the predictor layer catches it and falls back to tracing.
+        self.program_set_info = None
+        self._warm_marks = None
+        if program_set is not None:
+            from ..programs.program_set import load_program_set
+            loaded = load_program_set(program_set, self)
+            self._decode_fn = loaded["decode"]
+            for b in self.buckets:
+                self._prefill_fns[b] = loaded[f"prefill_b{b}"]
+            self.program_set_info = {
+                "path": program_set if isinstance(program_set, str)
+                else None,
+                "kinds": {k: v.kind for k, v in loaded.items()}}
         # observability: latency histograms shared with the unified
         # report / Prometheus endpoint (handles cached; registry.reset()
         # zeroes values in place)
@@ -1930,61 +1949,165 @@ class ServingEngine:
         will ever run — the gateway's /healthz readiness signal."""
         return self._warm
 
-    def warmup(self):
-        """Compile every program the engine will ever run (one prefill per
-        bucket + the decode/verify step) so no request pays a trace — the
-        program-lifecycle warmup the gateway calls before admitting
-        traffic, after which the compiled-program registry must record
-        ZERO further serving compiles.  Fixed pools run dummy data through
-        slot 0; paged warmup routes every write through the allocator's
-        sentinel table (dropped), so nothing lands in the pool.  Safe any
-        time no request is in flight."""
-        s = self.max_slots
-        zero_key = jnp.asarray(np.zeros(self._key_width, np.uint32))
-        paged = self.kv == "paged"
-        if paged:
+    # ------------------------------------------------------------------
+    # program lifecycle: example args, warmup, AOT program sets
+    # ------------------------------------------------------------------
+    def _example_prefill_args(self, bucket: int):
+        """The exact argument tuple a live admission passes to this
+        bucket's prefill program (same avals, CURRENT pools) — one
+        builder shared by warmup and the program-set exporter so their
+        signatures can never drift.  Fixed pools target slot 0 (warmup
+        junk dies at the slot's next prefill); paged args route every
+        write through the allocator's sentinel table (dropped)."""
+        if self.kv == "paged":
             slot_arg = jnp.asarray(self.kv_pool.sentinel_table())
-            tables = jnp.asarray(np.tile(
-                self.kv_pool.sentinel_table(), (s, 1)))
-            inactive = jnp.zeros((s,), bool)
         else:
             slot_arg = jnp.int32(0)
-        for b in self.buckets:
-            ids = np.full((1, b), self.pad_token_id, np.int32)
-            if self.draft_model is not None:
-                (_, _, _, self._pools,
-                 self._draft_pools) = self._prefill_fns[b](
-                    self._state, self._dstate, self._pools,
-                    self._draft_pools, jnp.asarray(ids), slot_arg,
-                    jnp.int32(1), zero_key, jnp.float32(1.0), jnp.int32(0),
-                    jnp.float32(1.0), jnp.asarray(True))
-            else:
-                _, _, _, self._pools = self._prefill_fns[b](
-                    self._state, self._pools, jnp.asarray(ids),
-                    slot_arg, jnp.int32(1), zero_key, jnp.float32(1.0),
-                    jnp.int32(0), jnp.float32(1.0), jnp.asarray(True))
+        ids = np.full((1, bucket), self.pad_token_id, np.int32)
+        zero_key = jnp.asarray(np.zeros(self._key_width, np.uint32))
+        common = (jnp.asarray(ids), slot_arg, jnp.int32(1), zero_key,
+                  jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0),
+                  jnp.asarray(True))
         if self.draft_model is not None:
-            args = ([tables, inactive] if paged else []) + [
-                jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
+            return (self._state, self._dstate, self._pools,
+                    self._draft_pools) + common
+        return (self._state, self._pools) + common
+
+    def _example_decode_args(self):
+        """The exact argument tuple a live tick passes to the decode (or
+        speculative verify) program — shared by warmup and the exporter."""
+        s = self.max_slots
+        pre = []
+        if self.kv == "paged":
+            pre = [jnp.asarray(np.tile(self.kv_pool.sentinel_table(),
+                                       (s, 1))),
+                   jnp.zeros((s,), bool)]
+        base = [jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
                 jnp.zeros((s, self._key_width), jnp.uint32),
                 jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
-                jnp.ones((s,), jnp.float32), jnp.ones((s,), bool),
-                jnp.ones((s,), bool), jnp.zeros((s,), bool),
-                jnp.asarray(False)]
-            (_, _, _, _, _, _, _, self._pools,
-             self._draft_pools) = self._decode_fn(
-                self._state, self._dstate, self._pools, self._draft_pools,
-                *args)
+                jnp.ones((s,), jnp.float32), jnp.ones((s,), bool)]
+        if self.draft_model is not None:
+            args = pre + base + [jnp.ones((s,), bool),
+                                 jnp.zeros((s,), bool), jnp.asarray(False)]
+            return (self._state, self._dstate, self._pools,
+                    self._draft_pools, *args)
+        args = pre + base + [jnp.zeros((s,), bool)]
+        return (self._state, self._pools, *args)
+
+    def _program_family(self):
+        """[(name, fn, example_args, donate_argnums)] for every compiled
+        program this engine configuration will ever run — the unit the
+        program store and AOT program sets operate on.  Names are
+        layout-agnostic (`prefill_b{bucket}`, `decode`) so a paged
+        artifact can never be confused with a fixed one except through
+        the manifest, which records the layout explicitly.  The donation
+        indices ride along because `jax.export` does not preserve
+        donation — the program-set loader re-applies them (losing them
+        silently would turn every tick into a full KV-pool copy)."""
+        donate = (2, 3) if self.draft_model is not None else (1,)
+        family = [(f"prefill_b{b}", self._prefill_fns[b],
+                   self._example_prefill_args(b), donate)
+                  for b in self.buckets]
+        family.append(("decode", self._decode_fn,
+                       self._example_decode_args(), donate))
+        return family
+
+    def warmup(self) -> Dict:
+        """Compile every program the engine will ever run (one prefill per
+        bucket + the decode/verify step — on speculative engines the
+        verify program and the draft halves of each bucket prefill ride
+        the same calls; paged variants route writes through the sentinel
+        table) so no request pays a trace — the program-lifecycle warmup
+        the gateway calls before admitting traffic.  After it returns,
+        `post_warmup_compiles()` must stay 0 under ANY traffic mix —
+        spec on/off, greedy/sampling, preempt/restore.
+
+        Programs preloaded from an AOT program set in the native 'exe'
+        representation are already compiled and are NOT executed here
+        (their first execution is the first real request); 'stablehlo'
+        programs and freshly traced ones are invoked once to force the
+        compile now.  Safe any time no request is in flight.  Returns a
+        report: per-program compile source + wall seconds + store stats."""
+        from ..programs.program_set import LoadedProgram
+        t0 = time.perf_counter()
+        sources = {}
+        for b in self.buckets:
+            fn = self._prefill_fns[b]
+            if isinstance(fn, LoadedProgram) and fn.kind == "exe":
+                sources[f"prefill_b{b}"] = "program_set:exe"
+                continue
+            out = fn(*self._example_prefill_args(b))
+            if self.draft_model is not None:
+                self._pools, self._draft_pools = out[3], out[4]
+            else:
+                self._pools = out[3]
+            sources[f"prefill_b{b}"] = (
+                "program_set:stablehlo" if isinstance(fn, LoadedProgram)
+                else "traced")
+        fn = self._decode_fn
+        if isinstance(fn, LoadedProgram) and fn.kind == "exe":
+            sources["decode"] = "program_set:exe"
         else:
-            args = ([tables, inactive] if paged else []) + [
-                jnp.zeros((s,), jnp.int32), jnp.zeros((s,), jnp.int32),
-                jnp.zeros((s, self._key_width), jnp.uint32),
-                jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
-                jnp.ones((s,), jnp.float32), jnp.ones((s,), bool),
-                jnp.zeros((s,), bool)]
-            _, _, _, _, _, self._pools = self._decode_fn(
-                self._state, self._pools, *args)
+            out = fn(*self._example_decode_args())
+            if self.draft_model is not None:
+                self._pools, self._draft_pools = out[-2], out[-1]
+            else:
+                self._pools = out[-1]
+            sources["decode"] = (
+                "program_set:stablehlo" if isinstance(fn, LoadedProgram)
+                else "traced")
         self._warm = True
+        self._warm_marks = self._compile_marks()
+        report = {"seconds": time.perf_counter() - t0,
+                  "programs": sources,
+                  "compile_counts": self.compile_counts()}
+        try:
+            from ..programs.store import store_stats
+            report["store"] = store_stats()
+        except Exception:
+            pass
+        return report
+
+    def _compile_marks(self) -> Dict:
+        """Snapshot of every serving-compile counter: the engine's own
+        trace counts AND the observability program registry (loaded
+        program sets never touch the former; TrackedJit programs report
+        to the latter)."""
+        try:
+            from ..observability import get_program_registry
+            reg = {name: rec["compiles"] for name, rec
+                   in get_program_registry().snapshot().items()
+                   if name.startswith("serving_")}
+        except Exception:
+            reg = {}
+        return {"engine": (self._compiles["decode"]
+                           + sum(self._compiles["prefill"].values())),
+                "registry": reg}
+
+    def post_warmup_compiles(self) -> int:
+        """Compiles observed since warmup() finished — the fleet
+        contract is that this stays 0 under ANY traffic mix (probes and
+        tier-1 assert it).  Counts both engine trace counters and new
+        `serving_*` registry compiles; returns -1 if warmup never ran."""
+        if self._warm_marks is None:
+            return -1
+        now = self._compile_marks()
+        extra = now["engine"] - self._warm_marks["engine"]
+        base = self._warm_marks["registry"]
+        for name, compiles in now["registry"].items():
+            extra += compiles - base.get(name, 0)
+        return extra
+
+    def save_program_set(self, path: str,
+                         extra_meta: Optional[dict] = None) -> str:
+        """Export this engine's whole program family (+ config manifest)
+        as one artifact loadable by ``ServingEngine(program_set=...)`` /
+        ``enable_serving(program_set=...)`` — see
+        paddle_tpu/programs/program_set.py.  Call after `warmup()` to
+        reuse the already-compiled executables (saving then compiles
+        nothing)."""
+        from ..programs.program_set import save_program_set as _save
+        return _save(self, path, extra_meta)
 
     # ------------------------------------------------------------------
     # observability
@@ -2025,6 +2148,9 @@ class ServingEngine:
                 "compile_counts": self.compile_counts(),
                 "spec": self._spec_metrics(),
                 "warm": self._warm,
+                "post_warmup_compiles": (self.post_warmup_compiles()
+                                         if self._warm else None),
+                "program_set": self.program_set_info,
                 "kv_pool": self._kv_pool_metrics(),
                 "mesh": (None if self.mesh is None else {
                     "devices": int(self.mesh.devices.size),

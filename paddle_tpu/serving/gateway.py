@@ -804,12 +804,22 @@ class ServingGateway:
                               "owned_by": "paddle_tpu"}]}).encode()
             if route == "/healthz":
                 status = 503 if (self._closed or self._dead) else 200
+                try:
+                    from ..programs.store import store_stats
+                    pstore = store_stats()
+                except Exception:
+                    pstore = None
                 return status, "application/json", json.dumps({
                     "ok": status == 200,
                     # readiness: warm=True means every serving program is
                     # precompiled (engine.warmup ran) — no admitted
                     # request will ever pay a trace
                     "warm": bool(getattr(self.engine, "warm", False)),
+                    # the persistent program store's hit/miss/entry
+                    # stats: a fleet health scraper can see whether this
+                    # replica booted from the shared cache (hits > 0) or
+                    # paid cold compiles (misses written)
+                    "program_store": pstore,
                     "gateway": {k: v for k, v in self.metrics().items()
                                 if k != "engine"}},
                     default=str).encode()

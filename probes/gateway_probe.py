@@ -122,6 +122,11 @@ def main():
                            decode_chunk=args.chunk,
                            max_queue_depth=max(64, n_req))
     engine.warmup()
+    # zero-post-warmup-compiles contract (ISSUE-9 satellite): the whole
+    # gateway run — preemption, restore, shedding, chaos — must add no
+    # serving compiles after warmup, engine counters AND the compiled-
+    # program registry agreeing (the test_dist_serving assertion, under
+    # gateway traffic)
     gw = ServingGateway(
         engine,
         tenants={"gold": TenantConfig(weight=4.0, max_priority=1),
@@ -198,6 +203,7 @@ def main():
             hung.append(i)
     gw_metrics = gw.metrics()
     cc = engine.compile_counts()
+    post_warmup = engine.post_warmup_compiles()
     gw.close()
 
     # -- classify ---------------------------------------------------------
@@ -256,6 +262,7 @@ def main():
         "cancelled_targets": len(cancel_set),
         "deadline_targets": len(deadline_set),
         "compile_counts": cc,
+        "post_warmup_compiles": post_warmup,
         "arrival_rate_per_sec": round(rate, 1),
         "overload_factor": args.overload,
         "gateway_metrics": {k: v for k, v in gw_metrics.items()
@@ -280,6 +287,10 @@ def main():
     if cc["total"] > cc["bound"]:
         failures.append(f"compiled {cc['total']} programs > bound "
                         f"{cc['bound']} (preempt/resume must add none)")
+    if post_warmup != 0:
+        failures.append(f"{post_warmup} post-warmup serving compiles "
+                        "under gateway traffic (registry-asserted; "
+                        "must be 0)")
     if not poison_ok:
         failures.append("poisoned request errored with the wrong type: "
                         f"{type(resps[poison_i].error).__name__}")
