@@ -932,6 +932,39 @@ def measure_paged_serving():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_hbm():
+    """ISSUE-10 acceptance artifact: probes/hbm_probe.py in a clean CPU
+    subprocess.  Publishes the conv-net memory-discipline story as
+    `detail.hbm.{bytes_ratio,peak_live_ratio}` — bars: whole-step XLA
+    bytes-accessed for the shipped NHWC+fused path (pooled stem epilogue,
+    dual-BN downsample adds, fused classifier tail) <= 0.65x the
+    unfused-NCHW step at r50-b16-O2 (the CPU floor is ~0.6: XLA CPU
+    emulates bf16 with compiler-inserted converts both legs pay; the
+    per-phase breakdown carries the real epilogue wins), and the
+    activation-recompute leg (`jit.recompute_policy`) >= 30% lower
+    estimated peak live bytes on the bf16 ResNet-50 tower at parity
+    (f32 tower tight, bf16 loss bit-parity).  Also carries the per-phase
+    fused/unfused bytes breakdown (BN/act, pooling, downsample-add,
+    loss tail)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes", "hbm_probe.py"),
+         "50", os.environ.get("PDTPU_HBM_PROBE_BATCH", "16"), "224", "O2"],
+        capture_output=True, text=True, timeout=2400, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("HBMJ"):
+            rec = json.loads(line[len("HBMJ"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"hbm bars failed: {rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return rec
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_mnist_eager():
     """BASELINE config #1: LeNet, EAGER per-op dispatch, single device —
     the CPU-baseline parity check (runs in a CPU subprocess; eager per-op
@@ -1170,6 +1203,7 @@ def main():
                          ("mnist_eager", measure_mnist_eager),
                          ("eager_dispatch", measure_eager_dispatch),
                          ("serving", measure_serving),
+                         ("hbm", measure_hbm),
                          ("paged", measure_paged_serving),
                          ("program_cache", measure_program_cache),
                          ("spec_decode", measure_spec_decode),

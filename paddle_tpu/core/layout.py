@@ -48,6 +48,7 @@ _POLICY: Optional[str] = None
 # boundary transposes so normalization cannot recurse
 AWARE_OPS = {
     "conv2d", "batch_norm", "fused_bn_act", "fused_bn_act_eval",
+    "fused_dual_bn_act", "fused_pool_linear_cross_entropy",
     "max_pool2d", "avg_pool2d", "adaptive_avg_pool2d", "adaptive_max_pool2d",
     "layout_to_nchw", "layout_to_nhwc",
 }

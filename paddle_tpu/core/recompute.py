@@ -1,0 +1,247 @@
+"""Activation-recompute policy: `jax.checkpoint` over tagged Layer subtrees.
+
+The r5 ResNet-50 decomposition showed the step bound by HBM passes over
+5.7 GB of live activations; the classic fix (Chen et al., "Training Deep
+Nets with Sublinear Memory Cost") is to bound activation liveness by
+recomputing stage interiors in the backward.  This module is the
+`jit.layout_policy`-shaped knob for it:
+
+    with jit.recompute_policy("stages"):
+        step = TrainStep(model, loss_fn, opt, ...)
+        step(x, y)   # traced with tagged stages under jax.checkpoint
+
+- `"stages"` wraps every Layer whose `_remat_stage` attribute is truthy —
+  ResNet/MobileNet/VGG stages and GPT blocks ship pre-tagged; mark your
+  own boundaries with `layer._remat_stage = True`.
+- a Layer subclass (or tuple of them), a set of type names, or a
+  predicate `layer -> bool` select subtrees structurally.
+- `policy=` picks what the checkpoint may keep: "dots_saveable"
+  (default — matmul outputs survive, elementwise/norm chains recompute),
+  "nothing_saveable", or any `jax.checkpoint_policies` attribute name.
+
+The wrap happens in `Layer.__call__` at *trace* time only (inputs are
+tracers and the tape is off — i.e. inside TrainStep/ShardedTrainStep/
+to_static builds); eager execution never pays it.  Like layout_policy,
+the policy must be active when the step is traced.  BatchNorm running-
+stat updates recorded inside a wrapped subtree are re-exported through
+the checkpoint boundary as explicit outputs, so the functional
+buffer-update contract (core.buffer_updates) survives recompute.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_POLICY = None          # (matcher spec, checkpoint-policy name or None)
+_ENABLED_EVER = False   # fast gate for Layer.__call__
+_tls = threading.local()
+
+
+class _PolicyGuard:
+    """Returned by recompute_policy(): sets the policy immediately; usable
+    as a context manager to restore the previous policy on exit."""
+
+    def __init__(self, prev):
+        self._prev = prev
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        global _POLICY
+        _POLICY = self._prev
+        return False
+
+
+def recompute_policy(spec, policy: Optional[str] = "dots_saveable"):
+    """Set (or clear, with spec=None) the activation-recompute policy.
+
+    Mirrors `jit.layout_policy`: plain call or `with` block; must be
+    active while a jitted step is traced.  See the module docstring for
+    the accepted spec forms.
+    """
+    global _POLICY, _ENABLED_EVER
+    prev = _POLICY
+    if spec is None:
+        _POLICY = None
+    else:
+        if policy is not None:
+            _resolve_jax_policy(policy)  # validate eagerly, not at trace
+        _POLICY = (spec, policy)
+        _ENABLED_EVER = True
+    return _PolicyGuard(prev)
+
+
+def policy():
+    return _POLICY
+
+
+def enabled() -> bool:
+    """Cheap per-call gate: True once any recompute policy was ever set."""
+    return _ENABLED_EVER
+
+
+def inside_checkpoint() -> bool:
+    """True while tracing inside a recompute-wrapped subtree.  The fused
+    recompute-backward ops (ops/fused_bn_act.py) consult this and fall
+    back to their plain differentiable composites there: a custom_vjp's
+    residuals are opaque to jax.checkpoint (they get saved across the
+    boundary no matter the policy), so keeping the custom rule inside a
+    checkpointed region would pin exactly the per-op activations the
+    policy is trying to free.  Under the checkpoint the hand recompute is
+    redundant anyway — jax rematerializes the whole subtree."""
+    return getattr(_tls, "depth", 0) > 0
+
+
+def checkpoint(fn, policy: Optional[str] = None):
+    """`jax.checkpoint` with the inside-checkpoint flag held while `fn`
+    traces — the TrainStep/ShardedTrainStep `remat=True` spelling.  The
+    fused conv-net ops (ops/fused_bn_act.py) consult the flag and fall
+    back to their plain differentiable references under it: a custom_vjp
+    rule's residuals are opaque to jax.checkpoint (saved regardless of
+    policy), so bare jax.checkpoint over a paddle_tpu model would pin
+    exactly the per-op activations the remat exists to free."""
+    import jax
+
+    def flagged(*args, **kwargs):
+        depth = getattr(_tls, "depth", 0)
+        _tls.depth = depth + 1
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            _tls.depth = depth
+
+    kw = {} if policy is None else {"policy": _resolve_jax_policy(policy)}
+    return jax.checkpoint(flagged, **kw)
+
+
+def _resolve_jax_policy(name: Optional[str]):
+    if name is None:
+        return None
+    import jax
+    try:
+        return getattr(jax.checkpoint_policies, name)
+    except AttributeError:
+        raise ValueError(
+            f"recompute_policy: unknown checkpoint policy {name!r} "
+            "(expected a jax.checkpoint_policies attribute name, e.g. "
+            "'dots_saveable', 'nothing_saveable')") from None
+
+
+def _matches(layer) -> bool:
+    spec = _POLICY[0]
+    if spec == "stages":
+        return bool(getattr(layer, "_remat_stage", False))
+    if isinstance(spec, type):
+        return isinstance(layer, spec)
+    if isinstance(spec, tuple) and all(isinstance(s, type) for s in spec):
+        return isinstance(layer, spec)
+    if callable(spec):
+        return bool(spec(layer))
+    if isinstance(spec, (set, frozenset, list)):
+        return type(layer).__name__ in spec
+    return False
+
+
+def should_wrap(layer, inputs) -> bool:
+    """Wrap iff: a policy is active, this layer matches, we are not
+    already inside a wrapped subtree, the tape is off, and at least one
+    input is a tracer (i.e. a functional jit trace is in progress —
+    recompute is a compiled-step concept, eager calls never pay it)."""
+    if _POLICY is None or getattr(_tls, "depth", 0) > 0:
+        return False
+    if not _matches(layer):
+        return False
+    from .tensor import Tensor, is_grad_enabled
+    if is_grad_enabled():
+        return False  # tape autodiff path: checkpoint regions would hide it
+    import jax
+
+    def _traced(x):
+        if isinstance(x, Tensor):
+            x = x._data
+        return isinstance(x, jax.core.Tracer)
+
+    return any(_traced(x) for x in inputs)
+
+
+def run_wrapped(layer, inputs, kwargs, runner):
+    """Execute `runner(inputs, kwargs)` (the layer's hook+forward body)
+    under jax.checkpoint.  The layer's state (params + buffers) and every
+    array-valued input become explicit checkpoint arguments so the
+    backward recomputes the subtree interior from them; layout tags and
+    output pytree structure ride out-of-band (they are trace-time static);
+    buffer updates captured inside are re-exported to the caller's
+    capture scope."""
+    import jax
+    from . import buffer_updates as _bufup
+    from .tensor import Tensor
+
+    sd = layer.state_dict()
+    state = {k: t._data for k, t in sd.items()}
+
+    flat_in, in_tree = jax.tree_util.tree_flatten(
+        (tuple(inputs), kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+
+    def _arrayish(x):
+        return isinstance(x, (jax.Array, jax.core.Tracer)) or (
+            hasattr(x, "shape") and hasattr(x, "dtype"))
+
+    dyn_idx, dyn_vals, tags = [], [], {}
+    for i, x in enumerate(flat_in):
+        if isinstance(x, Tensor):
+            dyn_idx.append(i)
+            dyn_vals.append(x._data)
+            tags[i] = x._layout
+        elif _arrayish(x):
+            dyn_idx.append(i)
+            dyn_vals.append(x)
+    dyn_set = {i: j for j, i in enumerate(dyn_idx)}
+    meta = {}
+
+    def fn(state_vals, dyn):
+        originals = {k: t._data for k, t in sd.items()}
+        try:
+            for k, t in sd.items():
+                t._data = state_vals[k]
+            leaves = list(flat_in)
+            for i, j in dyn_set.items():
+                if i in tags:
+                    t = Tensor(dyn[j])
+                    t._layout = tags[i]
+                    leaves[i] = t
+                else:
+                    leaves[i] = dyn[j]
+            args, kw = jax.tree_util.tree_unflatten(in_tree, leaves)
+            with _bufup.capture() as log:
+                out = runner(args, kw)
+            bufs = _bufup.resolve(log, sd)
+            flat_out, out_tree = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, Tensor))
+            meta["tree"] = out_tree
+            meta["tensor"] = [isinstance(x, Tensor) for x in flat_out]
+            meta["tags"] = [getattr(x, "_layout", None) for x in flat_out]
+            raw = [x._data if isinstance(x, Tensor) else x
+                   for x in flat_out]
+            return raw, bufs
+        finally:
+            for k, t in sd.items():
+                t._data = originals[k]
+
+    ckpt = jax.checkpoint(fn, policy=_resolve_jax_policy(_POLICY[1]))
+    depth = getattr(_tls, "depth", 0)
+    _tls.depth = depth + 1
+    try:
+        raw_out, bufs = ckpt(state, dyn_vals)
+    finally:
+        _tls.depth = depth
+    for k, v in bufs.items():
+        _bufup.apply(sd[k], v)
+    leaves = []
+    for x, is_t, tag in zip(raw_out, meta["tensor"], meta["tags"]):
+        if is_t:
+            t = Tensor(x)
+            t._layout = tag
+            x = t
+        leaves.append(x)
+    return jax.tree_util.tree_unflatten(meta["tree"], leaves)

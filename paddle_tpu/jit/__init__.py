@@ -23,7 +23,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import buffer_updates as _bufup
+from ..core import recompute as _recompute
 from ..core.layout import layout_policy  # noqa: F401  (public: jit.layout_policy)
+from ..core.recompute import recompute_policy  # noqa: F401  (public: jit.recompute_policy)
 from ..core.tensor import Tensor, no_grad, unwrap
 from ..nn.layer_base import Layer
 
@@ -326,12 +328,27 @@ class TrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  amp_level: Optional[str] = None, amp_dtype="bfloat16",
                  mesh=None, batch_sharding=None, remat: bool = False,
-                 with_outputs: bool = False, guard: bool = False):
+                 with_outputs: bool = False, guard: bool = False,
+                 accum_steps: int = 1):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.amp_level = amp_level
         self.amp_dtype = amp_dtype
+        # gradient accumulation: the step takes the FULL logical batch,
+        # splits it into accum_steps micro-batches inside ONE compiled
+        # program (lax.scan, f32 grad accumulators) and applies ONE
+        # optimizer update — b>256-equivalent towers train in the
+        # micro-batch activation envelope with the compile count unchanged
+        self.accum_steps = int(accum_steps)
+        if self.accum_steps < 1:
+            raise ValueError("TrainStep: accum_steps must be >= 1")
+        if self.accum_steps > 1 and with_outputs:
+            raise ValueError(
+                "TrainStep: with_outputs does not compose with "
+                "accum_steps>1 (per-micro-batch forward outputs would "
+                "have to be stacked across the scan; run the forward "
+                "separately if metrics need it)")
         # with_outputs: the compiled step also returns the forward outputs
         # (hapi metric reuse) — on the sparse-grad path too (step_sparse
         # threads them through the aux channel)
@@ -344,6 +361,12 @@ class TrainStep:
         # RowSparseGrad through the zeros-cotangent channel (selected_rows.py)
         self._sparse = {k for k, v in model.state_dict().items()
                         if getattr(v, "sparse_grad", False)}
+        if self.accum_steps > 1 and self._sparse:
+            raise NotImplementedError(
+                "TrainStep(accum_steps>1) with Embedding(sparse=True): "
+                "per-micro-batch RowSparseGrads would need a row-union "
+                "merge inside the scan — densify the embedding or run "
+                "accum_steps=1")
         self._sig_cache = {}
         self._sparse_checked = False
         # param names demoted to DENSE grads (tied weights): sparse grads
@@ -455,23 +478,77 @@ class TrainStep:
 
         with_outputs = self._with_outputs
         guard = self._guard
+        accum = self.accum_steps
         from ..utils import faults as _faults
 
-        def step(params, opt_state, step_no, lr, rng_key, batch):
-            def loss_of(train_params):
-                full = dict(params)
-                full.update(train_params)
-                loss, outs, bufs = forward_loss(
-                    self.model, self.loss_fn, full, batch, rng_key,
-                    self.amp_level, self.amp_dtype,
-                    return_outputs=with_outputs,
-                    return_buffer_updates=True)
-                return loss, (outs, bufs)
+        def accum_grads(params, step_no, lr, rng_key, batch):
+            """K micro-batches through an in-program lax.scan: f32 grad
+            accumulators, per-micro rng keys (fold_in), BatchNorm
+            running-stat updates compounding sequentially through the
+            carry.  Returns (mean loss, averaged grads, params with the
+            final buffer state).  Only one micro-batch's activations are
+            live at a time — the whole point."""
+            for b in batch:
+                if b.shape[0] % accum:
+                    raise ValueError(
+                        f"TrainStep(accum_steps={accum}): batch dim "
+                        f"{b.shape[0]} is not divisible by accum_steps")
+            split = tuple(
+                b.reshape((accum, b.shape[0] // accum) + b.shape[1:])
+                for b in batch)
+            zero = {k: jnp.zeros(params[k].shape, jnp.float32)
+                    for k in trainable}
 
-            train_params = {k: v for k, v in params.items() if k in trainable}
-            loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
-            (loss, (outs, bufs)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(train_params)
+            def micro(carry, xs):
+                cur, acc = carry
+                mb, i = xs
+                key = jax.random.fold_in(rng_key, i)
+
+                def loss_of(train_params):
+                    full = dict(cur)
+                    full.update(train_params)
+                    loss, _outs, bufs = forward_loss(
+                        self.model, self.loss_fn, full, mb, key,
+                        self.amp_level, self.amp_dtype,
+                        return_buffer_updates=True)
+                    return loss, bufs
+
+                lfn = _recompute.checkpoint(loss_of) if self._remat else loss_of
+                (loss, bufs), g = jax.value_and_grad(lfn, has_aux=True)(
+                    {k: cur[k] for k in trainable})
+                acc = {k: acc[k] + g[k].astype(jnp.float32) for k in acc}
+                nxt = dict(cur)
+                nxt.update(bufs)
+                return (nxt, acc), loss
+
+            (cur, acc), losses = jax.lax.scan(
+                micro, (dict(params), zero), (split, jnp.arange(accum)))
+            grads = {k: (acc[k] / accum).astype(params[k].dtype)
+                     for k in acc}
+            return jnp.mean(losses), grads, cur
+
+        def step(params, opt_state, step_no, lr, rng_key, batch):
+            if accum > 1:
+                loss, grads, carried = accum_grads(
+                    params, step_no, lr, rng_key, batch)
+                outs, bufs = (), {k: v for k, v in carried.items()
+                                  if k not in trainable}
+            else:
+                def loss_of(train_params):
+                    full = dict(params)
+                    full.update(train_params)
+                    loss, outs, bufs = forward_loss(
+                        self.model, self.loss_fn, full, batch, rng_key,
+                        self.amp_level, self.amp_dtype,
+                        return_outputs=with_outputs,
+                        return_buffer_updates=True)
+                    return loss, (outs, bufs)
+
+                train_params = {k: v for k, v in params.items()
+                                if k in trainable}
+                loss_fn = _recompute.checkpoint(loss_of) if self._remat else loss_of
+                (loss, (outs, bufs)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(train_params)
             # trace-time gated: identity (zero compiled ops) unless armed
             grads = _faults.poison_grads(grads, step_no)
             new_params, new_opt = apply_updates(
@@ -505,7 +582,7 @@ class TrainStep:
 
             train_params = {k: v for k, v in params.items()
                             if k in trainable and k not in sparse_names}
-            loss_fn = jax.checkpoint(loss_of) if self._remat else loss_of
+            loss_fn = _recompute.checkpoint(loss_of) if self._remat else loss_of
             (loss, (ids, outs, bufs)), (grads, zgrads) = jax.value_and_grad(
                 loss_fn, argnums=(0, 1), has_aux=True)(train_params, zeros)
             grads = self._merge_sparse_grads(grads, zgrads, ids, params,
@@ -559,7 +636,7 @@ class TrainStep:
 
                 train_params = {k: v for k, v in params.items()
                                 if k in trainable}
-                loss_fn = (jax.checkpoint(loss_of) if self._remat
+                loss_fn = (_recompute.checkpoint(loss_of) if self._remat
                            else loss_of)
                 (loss, bufs), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(train_params)
@@ -614,7 +691,7 @@ class TrainStep:
 
                 train_params = {k: v for k, v in params.items()
                                 if k in trainable and k not in sparse_names}
-                loss_fn = (jax.checkpoint(loss_of) if self._remat
+                loss_fn = (_recompute.checkpoint(loss_of) if self._remat
                            else loss_of)
                 (loss, (ids, bufs)), (grads, zgrads) = jax.value_and_grad(
                     loss_fn, argnums=(0, 1), has_aux=True)(train_params,
@@ -650,6 +727,11 @@ class TrainStep:
                 "multi-step scan has no per-step skip/rollback point (a "
                 "silent bypass would apply NaN updates the guard promised "
                 "to block) — use per-call steps under the guard")
+        if self.accum_steps > 1:
+            raise NotImplementedError(
+                "TrainStep(accum_steps>1) does not support run_steps: the "
+                "accumulation window already scans in-program — stack "
+                "whole windows as per-call batches instead")
         state = state_arrays(self.model)
         if self._opt_state is None:
             self._opt_state = self.init_opt_state(state)
@@ -753,6 +835,11 @@ class TrainStep:
         state = state_arrays(self.model)
         if self._opt_state is None:
             self._opt_state = self.init_opt_state(state)
+        if self.accum_steps > 1:
+            # record the window structure: a resumed run must feed the
+            # same accum_steps for the rng fold_in stream to line up
+            extra_meta = dict(extra_meta or {})
+            extra_meta.setdefault("accum_steps", self.accum_steps)
         return dck.save_train_state(
             directory, state, self._opt_state,
             step if step is not None else self.optimizer._step_count,
@@ -790,6 +877,9 @@ def _relevant_op_versions(layer):
             relevant |= {"flash_attention", "scaled_dot_product_attention"}
         if name.startswith("Quanted") or name.startswith("Int8"):
             relevant.add("fake_quantize")
+        if name.startswith("BatchNorm") or name == "SyncBatchNorm":
+            # conv-net blocks route through the fused epilogue family
+            relevant.add("fused_bn_act")
     snap = op_version.snapshot()
     return {k: v for k, v in snap.items() if k in relevant}
 

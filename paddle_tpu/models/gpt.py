@@ -59,6 +59,7 @@ class GPTBlock(Layer):
 
     def __init__(self, cfg: GPTConfig):
         super().__init__()
+        self._remat_stage = True  # jit.recompute_policy("stages") boundary
         std = cfg.initializer_range
         self.num_heads = cfg.num_attention_heads
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads

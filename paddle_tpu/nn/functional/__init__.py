@@ -10,9 +10,9 @@ from .common import *  # noqa: F401,F403
 from .conv import (conv1d, conv2d, conv3d, conv1d_transpose,  # noqa: F401
                    conv2d_transpose, conv3d_transpose)
 from .loss import *  # noqa: F401,F403
-from .norm import (batch_norm, fused_bn_act, layer_norm,  # noqa: F401
-                   instance_norm, group_norm, local_response_norm,
-                   normalize, rms_norm)
+from .norm import (batch_norm, fused_bn_act, fused_dual_bn_act,  # noqa: F401
+                   layer_norm, instance_norm, group_norm,
+                   local_response_norm, normalize, rms_norm)
 from .pooling import *  # noqa: F401,F403
 from .moe import moe_ffn  # noqa: F401
 from .vision import affine_grid, grid_sample, temporal_shift  # noqa: F401

@@ -104,10 +104,20 @@ def bn_act_composite(out, activation=None, residual=None):
     return out
 
 
+def _pool_composite(out, pool, data_format):
+    """Apply a (kind, kernel, stride, padding) pool spec through the
+    standard pooling functionals (layout-tag aware) — the escape-hatch /
+    custom-norm composite half of the pooled fused epilogue."""
+    from . import pooling as P
+    kind, k, s, p = pool
+    fn = P.max_pool2d if kind == "max" else P.avg_pool2d
+    return fn(out, k, s, p, data_format=data_format)
+
+
 def fused_bn_act(x, running_mean, running_var, weight=None, bias=None,
                  training=True, momentum=0.9, epsilon=1e-5,
                  data_format="NCHW", activation=None, residual=None,
-                 use_global_stats=None, name=None):
+                 use_global_stats=None, pool=None, name=None):
     """BatchNorm + optional residual-add + activation as ONE op.
 
     Training-mode batch stats run through the pallas kernel pair in
@@ -137,12 +147,19 @@ def fused_bn_act(x, running_mean, running_var, weight=None, bias=None,
         raise ValueError(
             f"fused_bn_act: unsupported activation {activation!r} "
             f"(expected one of {_k._ACTS}); apply it separately")
+    if pool is not None:
+        if residual is not None:
+            raise ValueError("fused_bn_act: pool= composes with the plain "
+                             "BN+act epilogue, not with residual=")
+        pool = _k._pool_norm(pool)
 
     if os.environ.get("PDTPU_FUSED_BN", "1") == "0":
         out = batch_norm(x, running_mean, running_var, weight, bias,
                          training, momentum, epsilon, data_format,
                          use_global_stats)
-        return bn_act_composite(out, activation, residual)
+        out = bn_act_composite(out, activation, residual)
+        return _pool_composite(out, pool, data_format) if pool is not None \
+            else out
 
     channel_axis = _channel_axis(x, data_format)
     tagged = _layout.tag_of(x) == _layout.NHWC
@@ -163,9 +180,14 @@ def fused_bn_act(x, running_mean, running_var, weight=None, bias=None,
     if use_batch_stats:
         def raw_train(x, w, b, r):
             g, bb = gamma_beta(w, b, jnp.float32)
+            channel_last = channel_axis % x.ndim == x.ndim - 1
+            if pool is not None:
+                return _k.bn_act_pool_train(
+                    x, g, bb, eps=epsilon, act=activation, pool=pool,
+                    channel_last=channel_last)
             return _k.bn_act_train(
                 x, g, bb, eps=epsilon, act=activation, residual=r,
-                channel_last=channel_axis % x.ndim == x.ndim - 1)
+                channel_last=channel_last)
 
         out, mean_t, var_t = dispatch("fused_bn_act", raw_train, x, weight,
                                       bias, residual)
@@ -183,14 +205,85 @@ def fused_bn_act(x, running_mean, running_var, weight=None, bias=None,
             bias_v = bb.astype(jnp.float32) - rm.astype(jnp.float32) * a
             shape = [1] * x.ndim
             shape[channel_axis % x.ndim] = x.shape[channel_axis % x.ndim]
+            # f32 elementwise with one final cast — the same convention
+            # as the train kernel (x.astype(f32) * coef in-kernel); the
+            # converts are single-consumer chains XLA input-fuses
             z = x.astype(jnp.float32) * a.reshape(shape) + \
                 bias_v.reshape(shape)
             if r is not None:
                 z = z + r.astype(jnp.float32)
-            return _k._act_apply(z, activation).astype(x.dtype)
+            z = _k._act_apply(z, activation)
+            if pool is not None:
+                kind, k, s, p = pool
+                z = _k._pool_reduce_window(
+                    z.astype(jnp.float32), kind, k, s, p,
+                    channel_last=channel_axis % x.ndim == x.ndim - 1)
+            return z.astype(x.dtype)
 
         out = dispatch("fused_bn_act_eval", raw_eval, x, weight, bias,
                        rm_in, rv_in, residual)
+    if tagged:
+        _layout.tag(out)
+    return out
+
+
+def fused_dual_bn_act(x, running_mean_x, running_var_x, weight_x, bias_x,
+                      res, running_mean_r, running_var_r, weight_r, bias_r,
+                      training=True, momentum=0.9, epsilon=1e-5,
+                      data_format="NCHW", activation=None,
+                      use_global_stats=None, name=None):
+    """act(BN_x(x) + BN_r(res)) as ONE op — the downsample-shortcut add
+    fused into the residual BN it already shares an elementwise tile with
+    (ResNet stride blocks: bn3(conv3) + bn_ds(conv_ds) + relu).  Each BN
+    keeps its own parameters, running stats and functional stat-update
+    contract.  Set PDTPU_FUSED_BN=0 for the unfused two-BN composite."""
+    from ...ops import fused_bn_act as _k
+
+    if activation not in _k._ACTS:
+        raise ValueError(
+            f"fused_dual_bn_act: unsupported activation {activation!r} "
+            f"(expected one of {_k._ACTS}); apply it separately")
+
+    use_batch_stats = training and not use_global_stats
+    fused_ok = os.environ.get("PDTPU_FUSED_BN", "1") != "0"
+    if not (use_batch_stats and fused_ok):
+        # eval affine (or escape hatch): two standard BNs + composite tail —
+        # XLA fuses the chain on its own; keeping this path on batch_norm
+        # preserves its AMP black-list semantics exactly
+        out = batch_norm(x, running_mean_x, running_var_x, weight_x, bias_x,
+                         training, momentum, epsilon, data_format,
+                         use_global_stats)
+        out_r = batch_norm(res, running_mean_r, running_var_r, weight_r,
+                           bias_r, training, momentum, epsilon, data_format,
+                           use_global_stats)
+        return bn_act_composite(out, activation, residual=out_r)
+
+    channel_axis = _channel_axis(x, data_format)
+    tagged = _layout.tag_of(x) == _layout.NHWC
+    if tagged != (_layout.tag_of(res) == _layout.NHWC):
+        res = (_layout.ensure_nhwc(res) if tagged else _layout.to_nchw(res))
+    xv = unwrap(x)
+    nf = xv.shape[channel_axis % xv.ndim]
+
+    def gb(w, b):
+        g = w if w is not None else jnp.ones((nf,), jnp.float32)
+        bb = b if b is not None else jnp.zeros((nf,), jnp.float32)
+        return g, bb
+
+    def raw_train(x, wx, bx, r, wr, br):
+        gx, bbx = gb(wx, bx)
+        gr, bbr = gb(wr, br)
+        return _k.bn2_act_train(
+            x, gx, bbx, r, gr, bbr, eps=epsilon, act=activation,
+            channel_last=channel_axis % x.ndim == x.ndim - 1)
+
+    out, mean_x, var_x, mean_r, var_r = dispatch(
+        "fused_dual_bn_act", raw_train, x, weight_x, bias_x, res, weight_r,
+        bias_r)
+    _update_running_stats(running_mean_x, running_var_x, mean_x, var_x,
+                          momentum)
+    _update_running_stats(running_mean_r, running_var_r, mean_r, var_r,
+                          momentum)
     if tagged:
         _layout.tag(out)
     return out

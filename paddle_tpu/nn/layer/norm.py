@@ -27,39 +27,95 @@ class _BatchNormBase(Layer):
         self.register_buffer("_mean", Tensor(jnp.zeros((num_features,))))
         self.register_buffer("_variance", Tensor(jnp.ones((num_features,))))
 
-    def forward(self, x, activation=None, residual=None):
-        if activation is None and residual is None:
+    def forward(self, x, activation=None, residual=None, pool=None):
+        if activation is None and residual is None and pool is None:
             return F.batch_norm(x, self._mean, self._variance, self.weight,
                                 self.bias, training=self.training,
                                 momentum=self.momentum, epsilon=self.epsilon,
                                 data_format=self.data_format,
                                 use_global_stats=self.use_global_stats)
-        return self._fused_impl(x, activation, residual)
+        return self._fused_impl(x, activation, residual, pool)
 
-    def _fused_impl(self, x, activation, residual):
+    def _fused_impl(self, x, activation, residual, pool=None):
         from ...ops.fused_bn_act import _ACTS
         if activation not in _ACTS:
-            from ..functional.norm import bn_act_composite
-            return bn_act_composite(self.forward(x), activation, residual)
+            from ..functional.norm import bn_act_composite, _pool_composite
+            out = bn_act_composite(self.forward(x), activation, residual)
+            if pool is not None:
+                from ...ops.fused_bn_act import _pool_norm
+                out = _pool_composite(out, _pool_norm(pool),
+                                      self.data_format)
+            return out
         return F.fused_bn_act(
             x, self._mean, self._variance, self.weight, self.bias,
             training=self.training, momentum=self.momentum,
             epsilon=self.epsilon, data_format=self.data_format,
             activation=activation, residual=residual,
-            use_global_stats=self.use_global_stats)
+            use_global_stats=self.use_global_stats, pool=pool)
 
-    def forward_fused(self, x, activation=None, residual=None):
-        """BN + residual-add + activation as one fused op (the conv-net
-        block fast path: ops/fused_bn_act.py pallas kernels on TPU, a jnp
-        composite elsewhere).  Same parameters/buffers/running-stat
-        semantics as `forward`; blocks call this when their norm layer
-        provides it and fall back to norm+add+act otherwise.  Routes
-        through __call__ so forward hooks / hapi summary still see the
-        layer run (subclasses with their own forward signature get the
+    def forward_fused(self, x, activation=None, residual=None, pool=None):
+        """BN + residual-add + activation (+ optional 2D max/avg pool
+        epilogue, `pool=(kind, kernel, stride, padding)`) as one fused op
+        (the conv-net block fast path: ops/fused_bn_act.py pallas kernels
+        on TPU, a jnp composite elsewhere).  Same parameters/buffers/
+        running-stat semantics as `forward`; blocks call this when their
+        norm layer provides it and fall back to norm+add+act otherwise.
+        Routes through __call__ so forward hooks / hapi summary still see
+        the layer run (subclasses with their own forward signature get the
         direct functional path instead)."""
         if type(self).forward is _BatchNormBase.forward:
-            return self(x, activation=activation, residual=residual)
-        return self._fused_impl(x, activation, residual)
+            return self(x, activation=activation, residual=residual,
+                        pool=pool)
+        return self._fused_impl(x, activation, residual, pool)
+
+
+def dual_bn_act(bn_x, x, bn_r, res, activation=None):
+    """act(bn_x(x) + bn_r(res)) as ONE fused op with BOTH running stats
+    updated — the downsample-shortcut fusion (vision blocks call this when
+    both norms are stock BatchNorm; callers fall back to the composite
+    otherwise).  Requires the two layers to agree on training mode and on
+    every config the single fused op can only apply once (epsilon,
+    momentum, data_format, use_global_stats) — `supports_dual_bn` gates
+    on exactly that, so callers that check it never hit these raises."""
+    if bn_x.training != bn_r.training:
+        raise ValueError("dual_bn_act: the two BatchNorm layers disagree "
+                         "on training mode")
+    if not _dual_configs_agree(bn_x, bn_r):
+        raise ValueError(
+            "dual_bn_act: the two BatchNorm layers disagree on "
+            "epsilon/momentum/data_format/use_global_stats — the fused "
+            "op applies one config to both; use the composite instead")
+    return F.fused_dual_bn_act(
+        x, bn_x._mean, bn_x._variance, bn_x.weight, bn_x.bias,
+        res, bn_r._mean, bn_r._variance, bn_r.weight, bn_r.bias,
+        training=bn_x.training, momentum=bn_x.momentum,
+        epsilon=bn_x.epsilon, data_format=bn_x.data_format,
+        activation=activation, use_global_stats=bn_x.use_global_stats)
+
+
+def _dual_configs_agree(a, b) -> bool:
+    return (a.epsilon == b.epsilon and a.momentum == b.momentum
+            and a.data_format == b.data_format
+            and a.use_global_stats == b.use_global_stats)
+
+
+def supports_dual_bn(*norms) -> bool:
+    """True when every layer is a stock _BatchNormBase (default forward,
+    no registered forward hooks — the fused path bypasses __call__, so a
+    hooked layer must keep the composite for its hooks to fire) and,
+    when several are passed, their training mode and epsilon/momentum/
+    data_format/use_global_stats agree (the fused op applies ONE config
+    to both branches; a partially-frozen block — e.g. only the downsample
+    BN in eval — must keep the composite) — the gate vision blocks use
+    before routing a downsample-add through `dual_bn_act`."""
+    ok = all(isinstance(n, _BatchNormBase)
+             and type(n).forward is _BatchNormBase.forward
+             and not n._forward_pre_hooks and not n._forward_post_hooks
+             for n in norms)
+    if not ok:
+        return False
+    return all(n.training == norms[0].training
+               and _dual_configs_agree(norms[0], n) for n in norms[1:])
 
 
 class BatchNorm(_BatchNormBase):

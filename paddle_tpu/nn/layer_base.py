@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core import dtype as _dt
+from ..core import recompute as _recompute
 from ..core.tensor import Parameter, Tensor
 from . import initializer as I
 
@@ -292,6 +293,14 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        if _recompute._ENABLED_EVER and _recompute.should_wrap(self, inputs):
+            # activation recompute (jit.recompute_policy): run this
+            # subtree under jax.checkpoint — trace-time only
+            return _recompute.run_wrapped(self, inputs, kwargs,
+                                          self._run_forward)
+        return self._run_forward(inputs, kwargs)
+
+    def _run_forward(self, inputs, kwargs):
         for hook in self._forward_pre_hooks.values():
             result = hook(self, inputs)
             if result is not None:

@@ -29,7 +29,8 @@ import time
 from typing import Dict, Optional
 
 __all__ = ["ProgramRegistry", "get_program_registry", "track",
-           "note_compile", "TrackedJit", "aot_fallbacks"]
+           "note_compile", "TrackedJit", "aot_fallbacks",
+           "peak_live_bytes", "trace_peak_live"]
 
 _log = logging.getLogger("paddle_tpu.observability.programs")
 
@@ -84,6 +85,169 @@ def _cost_dict(compiled) -> dict:
                              + out.get("output_bytes", 0.0)
                              + out.get("temp_bytes", 0.0))
     return out
+
+
+# ---------------------------------------------------------------------------
+# peak-live-bytes estimator (backend-independent)
+#
+# XLA's Compiled.memory_analysis() is liveness-aware on TPU but on the CPU
+# backend `temp_size_in_bytes` reports the un-reused buffer total — it does
+# not move when `jax.checkpoint` drops residuals, so it cannot gate an
+# activation-recompute win in CPU CI.  This walks the post-AD jaxpr in
+# program order, tracking birth (eqn outputs) and death (last use) of every
+# value: the running maximum is the peak bytes simultaneously live.  remat/
+# pjit/custom-vjp sub-jaxprs contribute their internal transient peak at
+# their call site, so a checkpointed stage is charged for its recompute
+# window instead of for residuals it no longer saves.
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except TypeError:
+            return 0  # symbolic dim: skip
+    return n * dtype.itemsize
+
+
+_SUBJAXPR_PARAMS = ("jaxpr", "call_jaxpr", "fun_jaxpr", "cond_jaxpr",
+                    "body_jaxpr")
+
+
+def _sub_jaxprs(eqn):
+    for key in _SUBJAXPR_PARAMS:
+        sub = eqn.params.get(key)
+        if sub is None:
+            continue
+        yield getattr(sub, "jaxpr", sub)  # ClosedJaxpr -> Jaxpr
+    for sub in eqn.params.get("branches", ()) or ():
+        yield getattr(sub, "jaxpr", sub)
+
+
+# elementwise / layout prims whose single-use outputs XLA fuses into their
+# consumer (producer-consumer loop fusion) — such a value never owns an HBM
+# buffer, so charging it would systematically overestimate exactly the
+# recompute interiors this estimator exists to compare
+_FUSIBLE_PRIMS = frozenset({
+    "convert_element_type", "reduce_precision", "add", "sub", "mul", "div",
+    "max", "min", "neg", "abs", "sign", "exp", "log", "log1p", "expm1",
+    "rsqrt", "sqrt", "tanh", "logistic", "pow", "integer_pow", "clamp",
+    "select_n", "eq", "ne", "lt", "le", "gt", "ge", "and", "or", "not",
+    "xor", "is_finite", "broadcast_in_dim", "reshape", "squeeze",
+    "expand_dims", "stop_gradient", "copy",
+})
+# a single-use fusible value must be consumed within this many eqns to be
+# treated as fused (XLA fuses within a region, not across a whole program;
+# long-span values are real buffers — e.g. residuals crossing fwd -> bwd)
+_FUSE_WINDOW = 8
+
+# single-operand dtype/layout prims XLA ALWAYS duplicates into consumer
+# fusions (a convert/broadcast is re-emitted per consumer rather than
+# materialized, at ANY use count or span): their outputs read through to
+# the source buffer — uses of the output count as uses of the source, and
+# the output itself never owns bytes.  Without this, every f32 upcast of a
+# bf16 activation shared by a recomputed forward and its backward is
+# charged as a full f32 copy — double-counting exactly the values inside
+# jax.checkpoint interiors this estimator exists to measure.
+_READTHROUGH_PRIMS = frozenset({
+    "convert_element_type", "reduce_precision", "broadcast_in_dim",
+    "reshape", "squeeze", "expand_dims", "stop_gradient", "copy",
+})
+
+
+def peak_live_bytes(jaxpr) -> int:
+    """Estimated peak bytes simultaneously live while executing `jaxpr`
+    (a Jaxpr or ClosedJaxpr) in program order.  An estimate, not a buffer
+    assignment: donation/aliasing is not modelled (both legs of an A/B
+    carry it equally), call-like eqns are charged io + internal transient
+    peak, and single-consumer short-span elementwise values are treated as
+    fused into their consumer (see _FUSIBLE_PRIMS)."""
+    import jax  # noqa: F401  (jaxpr classes ride on instances)
+    jaxpr = getattr(jaxpr, "jaxpr", jaxpr)
+    eqns = jaxpr.eqns
+
+    def _is_var(v):
+        # Literals are unhashable and occupy no buffer
+        return hasattr(v, "count") and hasattr(v, "aval")
+
+    # read-through aliasing: out -> ultimate source var for dtype/layout
+    # no-op prims (see _READTHROUGH_PRIMS)
+    alias: Dict[object, object] = {}
+
+    def _root(v):
+        while v in alias:
+            v = alias[v]
+        return v
+
+    for eqn in eqns:
+        if (eqn.primitive.name in _READTHROUGH_PRIMS
+                and len(eqn.outvars) == 1 and len(eqn.invars) == 1
+                and _is_var(eqn.invars[0])):
+            alias[eqn.outvars[0]] = _root(eqn.invars[0])
+
+    last_use: Dict[object, int] = {}
+    n_uses: Dict[object, int] = {}
+    for idx, eqn in enumerate(eqns):
+        if eqn.outvars and eqn.outvars[0] in alias:
+            continue  # the aliasing eqn itself is a no-op, not a use
+        for v in eqn.invars:
+            if _is_var(v):
+                r = _root(v)
+                last_use[r] = idx
+                n_uses[r] = n_uses.get(r, 0) + 1
+    for v in jaxpr.outvars:
+        if _is_var(v):
+            r = _root(v)
+            last_use[r] = len(eqns)
+            n_uses[r] = n_uses.get(r, 0) + 1
+    sizes: Dict[object, int] = {}
+    live = 0
+    for v in list(jaxpr.invars) + list(jaxpr.constvars):
+        if _is_var(v) and v not in sizes:
+            sizes[v] = _aval_bytes(v.aval)
+            live += sizes[v]
+    peak = live
+    for idx, eqn in enumerate(eqns):
+        fusible = eqn.primitive.name in _FUSIBLE_PRIMS
+        born = 0
+        for v in eqn.outvars:
+            if _is_var(v) and v not in sizes:
+                if v in alias:
+                    sizes[v] = 0  # reads through to its (charged) source
+                elif (fusible and n_uses.get(v, 0) == 1
+                        and last_use.get(v, idx) - idx <= _FUSE_WINDOW):
+                    sizes[v] = 0  # fuses into its sole nearby consumer
+                else:
+                    sizes[v] = _aval_bytes(v.aval)
+                born += sizes[v]
+        live += born
+        inner = 0
+        for sub in _sub_jaxprs(eqn):
+            io = sum(_aval_bytes(v.aval)
+                     for v in list(sub.invars) + list(sub.outvars)
+                     if _is_var(v))
+            inner = max(inner, peak_live_bytes(sub) - io)
+        peak = max(peak, live + max(inner, 0))
+        for v in set(_root(v) for v in eqn.invars if _is_var(v)):
+            if last_use.get(v) == idx:
+                live -= sizes.get(v, 0)
+        for v in eqn.outvars:
+            if _is_var(v) and v not in alias and last_use.get(v, -1) < idx:
+                live -= sizes.get(v, 0)  # dead on arrival (unused output)
+    return peak
+
+
+def trace_peak_live(jitted, *args, **kwargs) -> int:
+    """peak_live_bytes of a jax.jit-wrapped callable at this signature
+    (traces without compiling; TrackedJit instances are unwrapped)."""
+    if isinstance(jitted, TrackedJit):
+        jitted = jitted._jitted
+    return peak_live_bytes(jitted.trace(*args, **kwargs).jaxpr)
 
 
 class ProgramRegistry:

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from ..core.op import dispatch
 from ..core.tensor import unwrap
 
-__all__ = ["fused_linear_cross_entropy"]
+__all__ = ["fused_linear_cross_entropy", "fused_pool_linear_cross_entropy"]
 
 
 def _chunk_of(t: int, want: int) -> int:
@@ -137,3 +137,40 @@ def fused_linear_cross_entropy(h, weight, labels, chunk_size=None,
     return dispatch("fused_linear_cross_entropy", raw, h, weight, labels)
 
 _flce.defvjp(_flce_fwd, _flce_bwd)
+
+
+def fused_pool_linear_cross_entropy(features, weight, labels, bias=None,
+                                    chunk_size=None, data_format="NCHW",
+                                    name=None):
+    """Classifier-tail fusion: global-avg-pool -> linear -> softmax-CE as
+    one op, per-sample losses out.
+
+    features: (N, C, H, W) logical NCHW, or (N, H, W, C) with
+    data_format="NHWC" — a model built channels-last natively passes its
+    own data_format; a physically-NHWC layout-TAGGED tensor is also
+    detected and pooled in place (no boundary transpose);
+    weight: (C, classes) — the paddle Linear layout; labels: (N,) int.
+    The feature map is reduced to (N, C) inside the op and the logits ride
+    the chunked `_flce` machinery, so neither the full-rank feature map
+    nor the (N, classes) logits round-trip HBM between forward and
+    backward.  Returns per-sample CE losses shaped (N,)."""
+    from ..core import layout as _layout
+    if chunk_size is None:
+        import os
+        chunk_size = int(os.environ.get("PDTPU_FUSEDCE_CHUNK", "2048"))
+    channels_last = (_layout.tag_of(features) == _layout.NHWC
+                     or data_format == "NHWC")
+
+    def raw(feat, wv, lv, bv=None):
+        axes = (1, 2) if channels_last else (2, 3)
+        h = jnp.mean(feat.astype(jnp.float32), axis=axes).astype(feat.dtype)
+        flat_l = lv.reshape(-1)
+        valid = jnp.ones(flat_l.shape, bool)
+        return _flce(h, wv.T, bv, flat_l.astype(jnp.int32), valid,
+                     chunk_size)
+
+    if bias is not None:
+        return dispatch("fused_pool_linear_cross_entropy", raw, features,
+                        weight, labels, bias)
+    return dispatch("fused_pool_linear_cross_entropy", raw, features,
+                    weight, labels)

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core import recompute as _recompute
 from ..core.tensor import Tensor, unwrap
 from ..jit import functional_call, state_arrays
 from ..nn.layer_base import Layer
@@ -39,7 +40,8 @@ class ShardedTrainStep:
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  strategy: Optional[DistributedStrategy] = None,
                  mesh: Optional[Mesh] = None,
-                 batch_spec=None, guard: bool = False):
+                 batch_spec=None, guard: bool = False,
+                 accum_steps: int = 1):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -55,6 +57,19 @@ class ShardedTrainStep:
         self._amp_dtype = st.amp_configs.dtype
         self._k_steps = (st.gradient_merge_configs.k_steps
                          if st.gradient_merge else 1)
+        # accum_steps: the TrainStep-shaped spelling of the gradient-merge
+        # meta-optimizer — K microbatches scanned in-program with f32
+        # accumulators and one update (same knob, friendlier name)
+        if int(accum_steps) < 1:
+            raise ValueError("ShardedTrainStep: accum_steps must be >= 1")
+        if int(accum_steps) > 1:
+            if st.gradient_merge and self._k_steps != int(accum_steps):
+                raise ValueError(
+                    "ShardedTrainStep: accum_steps and "
+                    "strategy.gradient_merge_configs.k_steps disagree "
+                    f"({accum_steps} vs {self._k_steps})")
+            self._k_steps = int(accum_steps)
+        self.accum_steps = self._k_steps
         sd = model.state_dict()
         self._trainable = {k for k, v in sd.items()
                            if getattr(v, "trainable", False)}
@@ -135,7 +150,7 @@ class ShardedTrainStep:
                 full.update(tp)
                 return self._forward_loss(full, batch, rng_key)
             train_params = {k: v for k, v in params.items() if k in trainable}
-            fn = jax.checkpoint(loss_of) if self._remat else loss_of
+            fn = _recompute.checkpoint(loss_of) if self._remat else loss_of
             return jax.value_and_grad(fn)(train_params)
 
         def grads_of_explicit(params, batch, rng_key):
@@ -159,7 +174,7 @@ class ShardedTrainStep:
                     full.update(tp)
                     return self._forward_loss(full, batch, key)
                 tp0 = {k: v for k, v in params.items() if k in trainable}
-                fn = jax.checkpoint(loss_of) if self._remat else loss_of
+                fn = _recompute.checkpoint(loss_of) if self._remat else loss_of
                 loss, g = jax.value_and_grad(fn)(tp0)
                 g = jax.tree_util.tree_map(
                     lambda x: jax.lax.pmean(
@@ -316,6 +331,9 @@ class ShardedTrainStep:
         if self._opt_state is None:
             self._opt_state = jax.device_put(self.init_opt_state(state),
                                              self._ensure_opt_shardings())
+        if self._k_steps > 1:
+            extra_meta = dict(extra_meta or {})
+            extra_meta.setdefault("accum_steps", self._k_steps)
         return dck.save_train_state(
             directory, state, self._opt_state,
             step if step is not None else self.optimizer._step_count,

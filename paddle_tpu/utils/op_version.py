@@ -80,3 +80,15 @@ register_op_version("sequence_ops", 1, "padded+lengths ragged toolkit")
 register_op_version("detection_ops", 1, "vision.ops box/NMS/RoI family")
 register_op_version("exported_program", 1,
                     "StableHLO via jax.export + npz weights")
+# ISSUE-10: the fused conv-net epilogue family grew pooled (bn+act+pool)
+# and dual-BN (downsample-add) variants, and the fallback paths switched
+# to recompute backwards — bumping here rolls the persistent program
+# store's content-addressed namespace (programs/store.py folds the full
+# snapshot into the cache dir name) so no stale pre-epilogue artifact can
+# be reused silently.
+register_op_version("fused_bn_act", 2,
+                    "pooled + dual-BN epilogues; recompute-backward "
+                    "fallbacks (v1: PR-1 bn/act/residual only)")
+register_op_version("fused_ce", 2,
+                    "fused_pool_linear_cross_entropy classifier tail "
+                    "(v1: token-chunked tied-head CE only)")

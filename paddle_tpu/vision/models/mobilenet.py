@@ -20,6 +20,14 @@ class ConvBNLayer(nn.Layer):
         return self.bn.forward_fused(self.conv(x),
                                      activation=self._act_name)
 
+    def forward_residual(self, x, residual):
+        """conv -> BN + residual-add (+act) as one fused op — the
+        inverted-residual tail (the add shares the BN's elementwise
+        tile instead of costing its own HBM pass)."""
+        return self.bn.forward_fused(self.conv(x),
+                                     activation=self._act_name,
+                                     residual=residual)
+
 
 class DepthwiseSeparable(nn.Layer):
     def __init__(self, in_c, out_c1, out_c2, stride, scale):
@@ -29,6 +37,7 @@ class DepthwiseSeparable(nn.Layer):
         self.dw = ConvBNLayer(int(in_c * scale), c1, 3, stride=stride,
                               padding=1, groups=int(in_c * scale))
         self.pw = ConvBNLayer(c1, c2, 1)
+        self._remat_stage = True  # jit.recompute_policy("stages") boundary
 
     def forward(self, x):
         return self.pw(self.dw(x))
@@ -53,8 +62,20 @@ class MobileNetV1(nn.Layer):
         if num_classes > 0:
             self.fc = nn.Linear(int(1024 * scale), num_classes)
 
-    def forward(self, x):
+    def forward(self, x, labels=None):
+        if labels is not None and not (self.with_pool
+                                       and self.num_classes > 0):
+            raise ValueError(
+                "MobileNetV1.forward(labels=...): the fused classifier "
+                "tail needs with_pool=True and num_classes>0 (this model "
+                f"has with_pool={self.with_pool}, "
+                f"num_classes={self.num_classes})")
         x = self.blocks(self.conv1(x))
+        if labels is not None:
+            # fused classifier tail: pool + matmul + softmax-CE as one op
+            from ...ops.fused_ce import fused_pool_linear_cross_entropy
+            return fused_pool_linear_cross_entropy(
+                x, self.fc.weight, labels, bias=self.fc.bias)
         if self.with_pool:
             x = self.pool(x)
         if self.num_classes > 0:
@@ -78,10 +99,25 @@ class InvertedResidual(nn.Layer):
             ConvBNLayer(hidden, oup, 1, act=None),
         ]
         self.conv = nn.Sequential(*layers)
+        self._remat_stage = True  # jit.recompute_policy("stages") boundary
 
     def forward(self, x):
-        out = self.conv(x)
-        return x + out if self.use_res else out
+        if not self.use_res:
+            return self.conv(x)
+        proj = self.conv[len(self.conv) - 1]
+        # residual add fused into the projection BN (one elementwise
+        # pass) — only for the stock layer with no hooks: the fused call
+        # bypasses the projection ConvBNLayer's __call__ AND the
+        # containing Sequential's, so hooks on either keep the composite
+        if (type(proj) is not ConvBNLayer or proj._forward_pre_hooks
+                or proj._forward_post_hooks
+                or self.conv._forward_pre_hooks
+                or self.conv._forward_post_hooks):
+            return x + self.conv(x)
+        out = x
+        for layer in list(self.conv)[:-1]:
+            out = layer(out)
+        return proj.forward_residual(out, x)
 
 
 class MobileNetV2(nn.Layer):

@@ -13,15 +13,72 @@ from __future__ import annotations
 from ... import nn
 
 
-def _bn_act(norm, x, activation=None, residual=None):
-    """Route a block's norm+residual+act tail through the fused kernel
-    path (ops/fused_bn_act.py) when the norm layer supports it; custom
-    norm_layer callables without forward_fused get the composite."""
+_ACCEPTS_POOL: dict = {}  # norm type -> forward_fused takes pool=
+
+
+def _accepts_pool(norm) -> bool:
+    """One signature inspection per norm type: PR-1-era custom norms with
+    forward_fused(x, activation, residual) must keep their fusion (and a
+    TypeError raised INSIDE a fused path must propagate, not silently
+    reroute and re-run hooks)."""
+    key = type(norm)
+    hit = _ACCEPTS_POOL.get(key)
+    if hit is None:
+        import inspect
+        try:
+            hit = "pool" in inspect.signature(norm.forward_fused).parameters
+        except (TypeError, ValueError):
+            hit = False
+        _ACCEPTS_POOL[key] = hit
+    return hit
+
+
+def _bn_act(norm, x, activation=None, residual=None, pool=None):
+    """Route a block's norm+residual+act(+pool epilogue) tail through the
+    fused kernel path (ops/fused_bn_act.py) when the norm layer supports
+    it; custom norm_layer callables without forward_fused get the
+    composite, ones without the pool epilogue get fused bn/act + a
+    separate pool."""
     if hasattr(norm, "forward_fused"):
-        return norm.forward_fused(x, activation=activation,
-                                  residual=residual)
-    from ...nn.functional.norm import bn_act_composite
-    return bn_act_composite(norm(x), activation, residual)
+        if pool is None:
+            return norm.forward_fused(x, activation=activation,
+                                      residual=residual)
+        if _accepts_pool(norm):
+            return norm.forward_fused(x, activation=activation,
+                                      residual=residual, pool=pool)
+        out = norm.forward_fused(x, activation=activation,
+                                 residual=residual)
+    else:
+        from ...nn.functional.norm import bn_act_composite
+        out = bn_act_composite(norm(x), activation, residual)
+    if pool is not None:
+        from ...nn.functional.norm import _pool_composite
+        from ...ops.fused_bn_act import _pool_norm
+        out = _pool_composite(out, _pool_norm(pool),
+                              getattr(norm, "data_format", "NCHW"))
+    return out
+
+
+def _bn_add_act(norm, x, norm_res, res_pre, activation=None):
+    """Downsample-shortcut fusion: act(norm(x) + norm_res(res_pre)) as one
+    dual-BN op when both norms are stock BatchNorm, else the composite."""
+    from ...nn.layer.norm import dual_bn_act, supports_dual_bn
+    if supports_dual_bn(norm, norm_res):
+        return dual_bn_act(norm, x, norm_res, res_pre,
+                           activation=activation)
+    return _bn_act(norm, x, activation, residual=norm_res(res_pre))
+
+
+def _split_downsample(downsample):
+    """(conv, stock-BatchNorm) halves of a downsample Sequential when the
+    dual-BN fusion applies, else None (custom norm layers / projections)."""
+    from ...nn.layer.norm import supports_dual_bn
+    if not isinstance(downsample, nn.Sequential) or len(downsample) != 2:
+        return None
+    conv, norm = downsample[0], downsample[1]
+    if not (isinstance(conv, nn.Conv2D) and supports_dual_bn(norm)):
+        return None
+    return conv, norm
 
 
 class BasicBlock(nn.Layer):
@@ -31,6 +88,11 @@ class BasicBlock(nn.Layer):
                  groups=1, base_width=64, dilation=1, norm_layer=None,
                  data_format="NCHW"):
         super().__init__()
+        # recompute segment boundary (jit.recompute_policy("stages")):
+        # block granularity keeps the recompute interior to ONE block —
+        # whole-stage segments hold a full stage's activations live while
+        # rematerializing and measure WORSE than no recompute
+        self._remat_stage = True
         norm_layer = norm_layer or nn.BatchNorm2D
         # only pass the kwarg off-default: custom norm_layer callables
         # need not accept data_format in NCHW mode
@@ -46,11 +108,14 @@ class BasicBlock(nn.Layer):
         self.stride = stride
 
     def forward(self, x):
-        identity = x
         out = _bn_act(self.bn1, self.conv1(x), "relu")
         out = self.conv2(out)
-        if self.downsample is not None:
-            identity = self.downsample(x)
+        ds = _split_downsample(self.downsample)
+        if ds is not None:
+            # downsample-shortcut add fused with bn2 into one dual-BN op:
+            # the normalized shortcut never round-trips HBM on its own
+            return _bn_add_act(self.bn2, out, ds[1], ds[0](x), "relu")
+        identity = self.downsample(x) if self.downsample is not None else x
         # bn2 + residual-add + relu fused into one kernel (one HBM pass)
         return _bn_act(self.bn2, out, "relu", residual=identity)
 
@@ -62,6 +127,7 @@ class BottleneckBlock(nn.Layer):
                  groups=1, base_width=64, dilation=1, norm_layer=None,
                  data_format="NCHW"):
         super().__init__()
+        self._remat_stage = True  # recompute segment (see BasicBlock)
         norm_layer = norm_layer or nn.BatchNorm2D
         # only pass the kwarg off-default: custom norm_layer callables
         # need not accept data_format in NCHW mode
@@ -80,12 +146,14 @@ class BottleneckBlock(nn.Layer):
         self.downsample = downsample
 
     def forward(self, x):
-        identity = x
         out = _bn_act(self.bn1, self.conv1(x), "relu")
         out = _bn_act(self.bn2, self.conv2(out), "relu")
         out = self.conv3(out)
-        if self.downsample is not None:
-            identity = self.downsample(x)
+        ds = _split_downsample(self.downsample)
+        if ds is not None:
+            # downsample-shortcut add fused with bn3 into one dual-BN op
+            return _bn_add_act(self.bn3, out, ds[1], ds[0](x), "relu")
+        identity = self.downsample(x) if self.downsample is not None else x
         # bn3 + residual-add + relu fused into one kernel (one HBM pass)
         return _bn_act(self.bn3, out, "relu", residual=identity)
 
@@ -142,9 +210,34 @@ class ResNet(nn.Layer):
                                 data_format=self.data_format))
         return nn.Sequential(*layers)
 
-    def forward(self, x):
-        x = self.maxpool(_bn_act(self.bn1, self.conv1(x), "relu"))
+    def forward(self, x, labels=None):
+        """Logits, or — with `labels` — per-sample CE losses via the fused
+        classifier tail (ops/fused_ce.py: global-avg-pool -> matmul ->
+        softmax-CE in one op; the feature map and logits never round-trip
+        HBM separately).  The GPT pretraining-head convention: drive a
+        TrainStep with batch (x, labels, labels) and a mean loss_fn."""
+        if labels is not None and not (self.with_pool
+                                       and self.num_classes > 0):
+            raise ValueError(
+                "ResNet.forward(labels=...): the fused classifier tail "
+                "needs with_pool=True and num_classes>0 (this model has "
+                f"with_pool={self.with_pool}, "
+                f"num_classes={self.num_classes})")
+        # stem: conv -> BN -> relu -> maxpool with the pool folded into
+        # the fused BN/act epilogue (one op, pooled output only); a
+        # replaced/custom maxpool keeps its own forward
+        from ...ops.fused_bn_act import fusable_pool_spec
+        pool = fusable_pool_spec(self.maxpool, self.data_format)
+        if pool is not None:
+            x = _bn_act(self.bn1, self.conv1(x), "relu", pool=pool)
+        else:
+            x = self.maxpool(_bn_act(self.bn1, self.conv1(x), "relu"))
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        if labels is not None:
+            from ...ops.fused_ce import fused_pool_linear_cross_entropy
+            return fused_pool_linear_cross_entropy(
+                x, self.fc.weight, labels, bias=self.fc.bias,
+                data_format=self.data_format)
         if self.with_pool:
             x = self.avgpool(x)
         if self.num_classes > 0:

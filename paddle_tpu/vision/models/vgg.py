@@ -16,18 +16,32 @@ _CFGS = {
 
 class _Features(nn.Sequential):
     """Sequential that runs a BatchNorm2D immediately followed by ReLU as
-    ONE fused bn+relu op (same sublayers and state_dict keys as a plain
-    Sequential — only the execution is fused)."""
+    ONE fused bn+relu op — and, when a MaxPool2D follows the ReLU, folds
+    the pool into the same op's epilogue (same sublayers and state_dict
+    keys as a plain Sequential — only the execution is fused)."""
+
+    def __init__(self, *layers):
+        super().__init__(*layers)
+        self._remat_stage = True  # jit.recompute_policy("stages") boundary
 
     def forward(self, x):
+        from ...ops.fused_bn_act import fusable_pool_spec
         layers = list(self._sub_layers.values())
         i = 0
         while i < len(layers):
             layer = layers[i]
             nxt = layers[i + 1] if i + 1 < len(layers) else None
             if hasattr(layer, "forward_fused") and isinstance(nxt, nn.ReLU):
-                x = layer.forward_fused(x, activation="relu")
-                i += 2
+                pool = (fusable_pool_spec(
+                            layers[i + 2],
+                            getattr(layer, "data_format", "NCHW"))
+                        if i + 2 < len(layers) else None)
+                if pool is not None:
+                    x = layer.forward_fused(x, activation="relu", pool=pool)
+                    i += 3
+                else:
+                    x = layer.forward_fused(x, activation="relu")
+                    i += 2
             else:
                 x = layer(x)
                 i += 1

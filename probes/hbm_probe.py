@@ -1,31 +1,60 @@
-"""HBM-traffic estimator (ISSUE 1): XLA cost-analysis `bytes accessed`
-per ResNet train step, unfused-NCHW vs NHWC+fused-BN.
+"""HBM-traffic + activation-liveness probe (ISSUE 1, extended by ISSUE 10).
 
-The r5 bench explained ResNet-50's 118 ms step as conv (~64 ms) plus
-"~8 HBM passes over 5.7 GB of bf16 activations" for the training-BN /
-elementwise chains (~55 ms) — asserted from bandwidth arithmetic, never
-tracked.  This probe turns that into a number: XLA's post-optimization
-cost analysis reports total bytes accessed for the compiled
-fwd+bwd+update step, so the layout-policy + fused-kernel delta is
-measurable on every run (and regression-guarded without a chip: the
-analysis is backend-independent arithmetic over the optimized HLO;
-note the CPU pipeline fuses/counts differently than the TPU one, so
-compare configs within one backend, not across).
+Three CPU-reproducible legs, all backend-independent arithmetic over the
+optimized HLO / traced jaxpr (compare configs within one backend):
 
-    python probes/hbm_probe.py [depth=50] [batch=32] [hw=224] [amp=O2]
+1. whole-step bytes accessed (XLA post-optimization cost analysis) for the
+   compiled fwd+bwd+update ResNet train step: unfused-NCHW vs fused-NCHW
+   vs the shipped NHWC+fused path (pooled stem epilogue, dual-BN
+   downsample adds, fused classifier tail);
+2. per-phase bytes-accessed breakdown — BN/act, pooling, downsample-add,
+   loss tail — each phase fused vs unfused at r50 stage shapes, so a
+   regression in one epilogue is visible on its own line;
+3. activation-recompute leg: estimated peak live bytes
+   (observability.programs.peak_live_bytes — jaxpr liveness with
+   producer-consumer fusion and dtype/layout read-through modelled; XLA
+   CPU's memory_analysis does not model liveness) of the bf16 tower with
+   and without `jit.recompute_policy("stages",
+   policy="nothing_saveable")`, plus fwd+bwd loss/grad parity checks: the
+   f32 tower is the semantics gate (tight tolerance), the bf16 tower
+   asserts loss bit-parity and sanity-bounds the grad delta (bf16
+   rounding amplified through two differently-scheduled XLA programs).
 
-Prints one line per config:
-    HBM <config> bytes_accessed=<B> gb=<B/1e9> flops=<F>
-and a final ratio line the round artifact can quote.
+    python probes/hbm_probe.py [depth=50] [batch=16] [hw=224] [amp=O2]
+
+Prints one line per config plus a final machine-readable `HBMJ{...}` line;
+exits 1 when an acceptance bar fails (bench.py quarantines that run under
+`unpublished_failed_bars`).
+
+Bars: whole-step nhwc_fused/nchw_unfused bytes ratio <= 0.65 (from PR-1's
+0.668; the residual is conv accounting plus the f32<->bf16 converts XLA
+CPU inserts to EMULATE bf16 — ~6 GB of compiler-inserted converts at
+r50-b16 that exist on neither leg on a real TPU, which is why the
+whole-step CPU ratio floors near 0.6 while the per-phase fused/unfused
+ratios below show the actual epilogue wins), per-phase fused/unfused
+bytes bars for the BN/act and downsample-add epilogues (<= 0.6 each) and
+pooling parity (<= 1.1 — the pooled CPU fallback must not cost more than
+the composite; its HBM win is the pallas kernel's pooled-write, a TPU
+measurement), and recompute peak-live ratio <= 0.70 at parity.
 """
 from __future__ import annotations
 
+import json
 import os
 import sys
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BYTES_RATIO_BAR = 0.65
+PHASE_BARS = {"bn_act": 0.60, "downsample_add": 0.60, "pooling": 1.10}
+PEAK_LIVE_RATIO_BAR = 0.70
+PARITY_RTOL_BAR = 1e-4
+# bf16 towers: the recompute-on/off grad delta is bf16 rounding amplified
+# through two differently-scheduled XLA programs (the f32 legs agree to
+# ~1e-6) — bounded as a sanity check, not a semantics gate
+BF16_GRAD_SANITY_BAR = 0.10
 
 
 def _cost(compiled):
@@ -36,7 +65,7 @@ def _cost(compiled):
 
 
 def measure(depth=50, batch=32, hw=224, amp="O2", layout="NCHW",
-            fused=True):
+            fused=True, fused_tail=False):
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -50,13 +79,20 @@ def measure(depth=50, batch=32, hw=224, amp="O2", layout="NCHW",
              50: vmodels.resnet50}[depth]()
     opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
                                     parameters=model.parameters())
-    step = TrainStep(model, lambda logits, label: F.cross_entropy(
-        logits, label), opt, amp_level=amp, amp_dtype="bfloat16")
+    if fused_tail:
+        # the shipped fast path: model computes per-sample CE through the
+        # fused pool->matmul->CE tail (forward(x, labels))
+        step = TrainStep(model, lambda losses, label: losses.mean(), opt,
+                         amp_level=amp, amp_dtype="bfloat16")
+    else:
+        step = TrainStep(model, lambda logits, label: F.cross_entropy(
+            logits, label), opt, amp_level=amp, amp_dtype="bfloat16")
     state = state_arrays(model)
     opt_state = step.init_opt_state(state)
     rng = np.random.RandomState(0)
-    batch_arrays = (jnp.asarray(rng.randn(batch, 3, hw, hw), jnp.float32),
-                    jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32))
+    x = jnp.asarray(rng.randn(batch, 3, hw, hw), jnp.float32)
+    y = jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32)
+    batch_arrays = (x, y, y) if fused_tail else (x, y)
 
     guard = layout_policy(layout if layout == "NHWC" else None)
     try:
@@ -71,27 +107,297 @@ def measure(depth=50, batch=32, hw=224, amp="O2", layout="NCHW",
             "flops": float(ca.get("flops", 0.0))}
 
 
+# ---------------------------------------------------------------------------
+# per-phase breakdown: each conv-net epilogue phase, fused op vs unfused
+# composite, as a standalone fwd+bwd program at r50 stage shapes
+
+
+def _phase_bytes(fn, *args):
+    import jax
+    import jax.numpy as jnp
+
+    def loss(*a):
+        return jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    lowered = jax.jit(jax.grad(loss, argnums=tuple(
+        range(len(args))))).lower(*args)
+    return float(_cost(lowered.compile()).get("bytes accessed", 0.0))
+
+
+def _plain_bn(x, g, b, eps=1e-5):
+    import jax.numpy as jnp
+    axes = (0, 1, 2)
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=axes)
+    v = jnp.var(xf, axis=axes)
+    out = (xf - m) / jnp.sqrt(v + eps)
+    return (out * g + b).astype(x.dtype)
+
+
+def measure_phases(batch=16, dtype_name="bfloat16"):
+    """{phase: {fused, unfused, ratio}} bytes-accessed at NHWC r50 stage
+    shapes: the four epilogue families the fusion sweep covers."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops import fused_bn_act as K
+    from paddle_tpu.ops.fused_ce import fused_pool_linear_cross_entropy
+
+    dt = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    rng = np.random.RandomState(0)
+
+    def t(*shape):
+        return jnp.asarray(rng.randn(*shape), dt)
+
+    out = {}
+    # BN/act (+residual): stage-1 block tail
+    x, r = t(batch, 56, 56, 256), t(batch, 56, 56, 256)
+    g = jnp.ones((256,), jnp.float32)
+    b = jnp.zeros((256,), jnp.float32)
+    fused = _phase_bytes(
+        lambda x, g, b, r: K.bn_act_train(x, g, b, 1e-5, "relu", r)[0],
+        x, g, b, r)
+    unfused = _phase_bytes(
+        lambda x, g, b, r: jnp.maximum(
+            _plain_bn(x, g, b).astype(jnp.float32)
+            + r.astype(jnp.float32), 0.0).astype(x.dtype), x, g, b, r)
+    out["bn_act"] = {"fused": fused, "unfused": unfused}
+
+    # pooling: the stem conv->BN->relu->maxpool epilogue
+    x = t(batch, 112, 112, 64)
+    g64 = jnp.ones((64,), jnp.float32)
+    b64 = jnp.zeros((64,), jnp.float32)
+    fused = _phase_bytes(
+        lambda x, g, b: K.bn_act_pool_train(x, g, b, 1e-5, "relu",
+                                            ("max", 3, 2, 1))[0],
+        x, g64, b64)
+
+    def unf_pool(x, g, b):
+        y = jnp.maximum(_plain_bn(x, g, b).astype(jnp.float32), 0.0)
+        return jax.lax.reduce_window(
+            y, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+            [(0, 0), (1, 1), (1, 1), (0, 0)]).astype(x.dtype)
+    unfused = _phase_bytes(unf_pool, x, g64, b64)
+    out["pooling"] = {"fused": fused, "unfused": unfused}
+
+    # downsample-add: dual-BN vs two BNs + add (stage-2 stride block)
+    x, r = t(batch, 28, 28, 512), t(batch, 28, 28, 512)
+    g5 = jnp.ones((512,), jnp.float32)
+    b5 = jnp.zeros((512,), jnp.float32)
+    fused = _phase_bytes(
+        lambda x, gx, bx, r, gr, br: K.bn2_act_train(
+            x, gx, bx, r, gr, br, 1e-5, "relu")[0], x, g5, b5, r, g5, b5)
+    unfused = _phase_bytes(
+        lambda x, gx, bx, r, gr, br: jnp.maximum(
+            _plain_bn(x, gx, bx).astype(jnp.float32)
+            + _plain_bn(r, gr, br).astype(jnp.float32), 0.0).astype(x.dtype),
+        x, g5, b5, r, g5, b5)
+    out["downsample_add"] = {"fused": fused, "unfused": unfused}
+
+    # loss tail: global-avg-pool -> matmul -> softmax-CE
+    feat = t(batch, 2048, 7, 7)       # logical NCHW (untagged raw array)
+    w = jnp.asarray(rng.randn(2048, 1000) * 0.01, jnp.float32)
+    bias = jnp.zeros((1000,), jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 1000, (batch,)), jnp.int32)
+    fused = _phase_bytes(
+        lambda f, w, b: jnp.sum(fused_pool_linear_cross_entropy(
+            f, w, labels, bias=b)), feat, w, bias)
+
+    def unf_tail(f, w, b):
+        h = jnp.mean(f.astype(jnp.float32), axis=(2, 3))
+        logits = h @ w + b
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+        return jnp.sum(lse - picked)
+    unfused = _phase_bytes(unf_tail, feat, w, bias)
+    out["loss_tail"] = {"fused": fused, "unfused": unfused}
+
+    for rec in out.values():
+        rec["ratio"] = (rec["fused"] / rec["unfused"]
+                        if rec["unfused"] else None)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# recompute leg: peak live bytes of the bf16 tower, policy off vs on
+
+
+def _tower_fns(depth, batch, hw, amp=True):
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import amp as amp_mod
+    from paddle_tpu.jit import functional_call, state_arrays
+    from paddle_tpu.vision import models as vmodels
+
+    paddle.seed(0)
+    model = {18: vmodels.resnet18, 50: vmodels.resnet50}[depth](
+        num_classes=0, with_pool=False)
+    state = state_arrays(model)
+    x = jnp.asarray(np.random.RandomState(0).randn(batch, 3, hw, hw),
+                    jnp.float32)
+
+    def make():
+        # fresh closure per leg: jax traces are cached on the function
+        # object, so sharing one closure across policy contexts would
+        # silently reuse the other leg's jaxpr
+        def f(state, x):
+            def run():
+                out = functional_call(model, state, x, training=True)
+                return jnp.sum(out.astype(jnp.float32) ** 2)
+            if not amp:
+                return run()
+            with amp_mod.auto_cast(level="O2", dtype="bfloat16"):
+                return run()
+
+        def g(state, x):
+            return jax.value_and_grad(f)(state, x)
+        return g
+    return make, state, x
+
+
+def measure_recompute(depth=50, batch=64, hw=224, parity_batch=2,
+                      parity_hw=64):
+    """Peak-live bytes of the bf16 tower fwd+bwd, recompute off/on, plus
+    a compiled loss+grad parity check at a small shape."""
+    import contextlib
+
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.jit import layout_policy, recompute_policy
+    from paddle_tpu.observability.programs import peak_live_bytes
+
+    make, state, x = _tower_fns(depth, batch, hw)
+
+    def peak(remat):
+        ctx = (recompute_policy("stages", policy="nothing_saveable")
+               if remat else contextlib.nullcontext())
+        with ctx, layout_policy("NHWC"):
+            tr = jax.jit(make()).trace(state, x)
+        return int(peak_live_bytes(tr.jaxpr))
+
+    base = peak(False)
+    remat = peak(True)
+
+    # parity: compiled loss AND grads must agree between the two programs.
+    # The f32 leg is the semantics gate (identical math, only reduction
+    # reassociation between differently-scheduled XLA programs -> tight
+    # tolerance); the bf16 leg reports loss bit-parity plus the measured
+    # grad delta, which is bf16 ROUNDING amplified through different
+    # schedules, not a recompute semantics change — gated loosely as a
+    # sanity bound.
+    def parity(amp):
+        make_p, state_p, xp = _tower_fns(depth, parity_batch, parity_hw,
+                                         amp=amp)
+
+        def run(remat):
+            ctx = (recompute_policy("stages", policy="nothing_saveable")
+                   if remat else contextlib.nullcontext())
+            with ctx, layout_policy("NHWC"):
+                loss, grads = jax.jit(make_p())(state_p, xp)
+            return float(loss), grads
+        l0, g0 = run(False)
+        l1, g1 = run(True)
+        loss_rel = abs(l0 - l1) / max(abs(l0), 1e-12)
+        # global-norm relative grad delta (a per-param max would divide
+        # tiny late-layer grads by their own tiny scale and report
+        # reassociation noise as disagreement)
+        num = den = 0.0
+        for k in g0:
+            a = np.asarray(g0[k], np.float64)
+            b = np.asarray(g1[k], np.float64)
+            num += float(np.sum((a - b) ** 2))
+            den += float(np.sum(a ** 2))
+        return loss_rel, (num / max(den, 1e-30)) ** 0.5
+
+    loss_rel_f32, grad_rel_f32 = parity(amp=False)
+    loss_rel, grad_rel = parity(amp=True)
+    return {"peak_live_base": base, "peak_live_recompute": remat,
+            "peak_live_ratio": remat / base if base else None,
+            "loss_rel_err_f32": loss_rel_f32,
+            "grad_rel_err_f32": grad_rel_f32,
+            "loss_rel_err": loss_rel, "grad_rel_err": grad_rel,
+            "config": f"r{depth}-b{batch}-{hw}-O2-nhwc-tower"}
+
+
 def main():
     depth = int(sys.argv[1]) if len(sys.argv) > 1 else 50
-    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 16
     hw = int(sys.argv[3]) if len(sys.argv) > 3 else 224
     amp = sys.argv[4] if len(sys.argv) > 4 else "O2"
-    configs = [("nchw_unfused", "NCHW", False),
-               ("nchw_fused", "NCHW", True),
-               ("nhwc_fused", "NHWC", True)]
+    configs = [("nchw_unfused", "NCHW", False, False),
+               ("nchw_fused", "NCHW", True, False),
+               ("nhwc_fused", "NHWC", True, True)]
     results = {}
-    for name, layout, fused in configs:
-        r = measure(depth, batch, hw, amp, layout, fused)
+    for name, layout, fused, fused_tail in configs:
+        r = measure(depth, batch, hw, amp, layout, fused, fused_tail)
         results[name] = r
         print(f"HBM {name} d{depth} b{batch} {hw} {amp} "
               f"bytes_accessed={r['bytes_accessed']:.3e} "
               f"gb={r['bytes_accessed'] / 1e9:.2f} "
               f"flops={r['flops']:.3e}", flush=True)
+    os.environ.pop("PDTPU_FUSED_BN", None)
     base = results["nchw_unfused"]["bytes_accessed"]
     best = results["nhwc_fused"]["bytes_accessed"]
-    if base > 0:
-        print(f"HBM ratio nhwc_fused/nchw_unfused={best / base:.4f} "
+    bytes_ratio = best / base if base > 0 else None
+    if bytes_ratio is not None:
+        print(f"HBM ratio nhwc_fused/nchw_unfused={bytes_ratio:.4f} "
               f"(saved {(base - best) / 1e9:.2f} GB/step)", flush=True)
+
+    phases = measure_phases(batch=batch)
+    for name, rec in phases.items():
+        print(f"HBM phase {name} fused={rec['fused']:.3e} "
+              f"unfused={rec['unfused']:.3e} ratio={rec['ratio']:.3f}",
+              flush=True)
+
+    rec_leg = measure_recompute(depth=depth if depth in (18, 50) else 50,
+                                batch=int(os.environ.get(
+                                    "PDTPU_HBM_RECOMPUTE_BATCH", "64")))
+    print(f"HBM recompute {rec_leg['config']} "
+          f"peak_live_base={rec_leg['peak_live_base'] / 1e9:.3f}GB "
+          f"peak_live_recompute="
+          f"{rec_leg['peak_live_recompute'] / 1e9:.3f}GB "
+          f"ratio={rec_leg['peak_live_ratio']:.3f} "
+          f"f32 loss_rel={rec_leg['loss_rel_err_f32']:.2e} "
+          f"grad_rel={rec_leg['grad_rel_err_f32']:.2e} | "
+          f"bf16 loss_rel={rec_leg['loss_rel_err']:.2e} "
+          f"grad_rel={rec_leg['grad_rel_err']:.2e}", flush=True)
+
+    failures = []
+    if bytes_ratio is None or bytes_ratio > BYTES_RATIO_BAR:
+        failures.append(f"bytes_ratio {bytes_ratio} > {BYTES_RATIO_BAR}")
+    for phase, bar in PHASE_BARS.items():
+        r = phases.get(phase, {}).get("ratio")
+        if r is None or r > bar:
+            failures.append(f"phase {phase} ratio {r} > {bar}")
+    plr = rec_leg["peak_live_ratio"]
+    if plr is None or plr > PEAK_LIVE_RATIO_BAR:
+        failures.append(f"peak_live_ratio {plr} > {PEAK_LIVE_RATIO_BAR}")
+    if (rec_leg["loss_rel_err_f32"] > PARITY_RTOL_BAR
+            or rec_leg["grad_rel_err_f32"] > PARITY_RTOL_BAR):
+        failures.append(
+            f"recompute f32 parity loss_rel="
+            f"{rec_leg['loss_rel_err_f32']:.2e} "
+            f"grad_rel={rec_leg['grad_rel_err_f32']:.2e}")
+    if (rec_leg["loss_rel_err"] > PARITY_RTOL_BAR
+            or rec_leg["grad_rel_err"] > BF16_GRAD_SANITY_BAR):
+        failures.append(
+            f"recompute bf16 parity loss_rel={rec_leg['loss_rel_err']:.2e} "
+            f"grad_rel={rec_leg['grad_rel_err']:.2e}")
+
+    record = {
+        "bytes_ratio": round(bytes_ratio, 4) if bytes_ratio else None,
+        "peak_live_ratio": round(plr, 4) if plr else None,
+        "config": f"r{depth}-b{batch}-{hw}-{amp}",
+        "phases": {k: {kk: (round(vv, 4) if kk == "ratio" else vv)
+                       for kk, vv in v.items()}
+                   for k, v in phases.items()},
+        "recompute": {k: (round(v, 6) if isinstance(v, float) else v)
+                      for k, v in rec_leg.items()},
+        "failures": failures,
+    }
+    print("HBMJ" + json.dumps(record), flush=True)
+    if failures:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

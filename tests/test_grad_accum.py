@@ -1,0 +1,293 @@
+"""TrainStep(accum_steps=K) — in-program gradient accumulation.
+
+ISSUE-10 regression matrix: K micro-batches scan inside ONE compiled
+step with f32 grad accumulators and one optimizer update, so the
+accumulated window must match the equivalent full-batch step within f32
+accumulation tolerance (exact micro-batch equivalence needs a BN-free
+model: BatchNorm normalizes each micro-batch with its own stats by
+design — that contract is tested separately), compose with guard=True
+finiteness skips and GradScaler skip-and-decay, resume bit-exactly from
+an AsyncCheckpointManager checkpoint at a window boundary (rng stream +
+cursor + recorded accum_steps), and keep the compile count at one step
+program.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.utils import faults
+
+pytestmark = pytest.mark.hbm
+
+
+class _Net(nn.Layer):
+    """BN-free conv net: micro-batch gradients average to the full-batch
+    gradient exactly (modulo f32 reassociation), so accum windows are
+    comparable to full-batch steps at tight tolerance."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(3, 8, 3, padding=1)
+        self.fc = nn.Linear(8 * 8 * 8, 10)
+
+    def forward(self, x):
+        y = F.relu(self.conv(x))
+        return self.fc(y.reshape((y.shape[0], -1)))
+
+
+class _BNNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(3, 8, 3, padding=1)
+        self.bn = nn.BatchNorm2D(8)
+        self.fc = nn.Linear(8 * 8 * 8, 10)
+
+    def forward(self, x):
+        y = self.bn(self.conv(x), activation="relu")
+        return self.fc(y.reshape((y.shape[0], -1)))
+
+
+def _build(K=1, guard=False, cls=_Net, lr=0.1):
+    paddle.seed(0)
+    model = cls()
+    opt = paddle.optimizer.Momentum(learning_rate=lr, momentum=0.9,
+                                    parameters=model.parameters())
+    step = TrainStep(model, lambda lo, la: F.cross_entropy(lo, la), opt,
+                     accum_steps=K, guard=guard)
+    return model, step
+
+
+def _batches(n, b=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(jnp.asarray(rng.randn(b, 3, 8, 8), jnp.float32),
+             jnp.asarray(rng.randint(0, 10, (b,)), jnp.int32))
+            for _ in range(n)]
+
+
+def _params(model):
+    return {k: np.asarray(v._data).copy()
+            for k, v in model.state_dict().items()}
+
+
+@pytest.mark.parametrize("K", [2, 4])
+def test_accum_matches_full_batch_within_f32_tolerance(K):
+    batches = _batches(3)
+    m_full, s_full = _build(1)
+    m_acc, s_acc = _build(K)
+    for x, y in batches:
+        l_full = float(s_full(x, y))
+        l_acc = float(s_acc(x, y))
+        # mean of per-micro mean losses == full-batch mean loss
+        assert abs(l_full - l_acc) / max(abs(l_full), 1e-12) < 1e-5
+    pf, pa = _params(m_full), _params(m_acc)
+    for k in pf:
+        np.testing.assert_allclose(pa[k], pf[k], rtol=2e-5, atol=2e-6)
+
+
+def test_accum_one_is_the_plain_step_bit_exact():
+    batches = _batches(2)
+    m1, s1 = _build(1)
+    mk, sk = _build(1)
+    for x, y in batches:
+        assert float(s1(x, y)) == float(sk(x, y))
+    p1, p2 = _params(m1), _params(mk)
+    for k in p1:
+        np.testing.assert_array_equal(p1[k], p2[k])
+
+
+def test_accum_bn_stats_compound_per_micro_batch():
+    """BatchNorm running stats inside the window update sequentially, one
+    micro-batch at a time, exactly like K eager forwards (the scan carries
+    the buffer state; trainable params stay at their pre-update values
+    for every micro-batch, like the eager oracle)."""
+    (x, y), = _batches(1)
+    m_acc, s_acc = _build(2, cls=_BNNet)
+    s_acc(x, y)
+
+    paddle.seed(0)
+    oracle = _BNNet()
+    oracle.train()
+    for mb in np.split(np.asarray(x), 2):
+        oracle(paddle.to_tensor(mb))  # eager forward updates stats
+
+    np.testing.assert_allclose(np.asarray(m_acc.bn._mean._data),
+                               np.asarray(oracle.bn._mean._data),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(m_acc.bn._variance._data),
+                               np.asarray(oracle.bn._variance._data),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_accum_guard_skips_poisoned_window():
+    model, step = _build(2, guard=True)
+    batches = _batches(2)
+    # fault presence is baked at trace time: arm before the first compile,
+    # targeting the SECOND optimizer step (= second accum window)
+    faults.enable("nan_grads", 2)
+    try:
+        step(*batches[0])
+        before = _params(model)
+        step(*batches[1])  # poisoned -> on-device skip
+    finally:
+        faults.reset()
+    _, ok = step.last_guard
+    assert not bool(np.asarray(ok))
+    after = _params(model)
+    for k in before:
+        np.testing.assert_array_equal(after[k], before[k])
+
+
+def test_accum_guard_gradscaler_skip_and_decay():
+    from paddle_tpu.utils.guarded import GuardedTrainStep
+    model, step = _build(2, guard=True)
+    scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                   decr_every_n_nan_or_inf=1)
+    gstep = GuardedTrainStep(step, scaler=scaler)
+    batches = _batches(2)
+    faults.enable("nan_grads", 2)  # armed before trace; fires on window 2
+    try:
+        gstep(*batches[0])
+        assert not gstep.last_skipped
+        gstep(*batches[1])
+    finally:
+        faults.reset()
+    assert gstep.last_skipped
+    assert scaler.get_init_loss_scaling() < 1024.0  # record_skip decayed
+
+
+def test_accum_checkpoint_resume_bit_exact(tmp_path):
+    """Interrupt after window 3 of 6, restore into a fresh process-alike
+    (new model/optimizer/TrainStep), finish — losses and params must be
+    bit-identical to the uninterrupted run.  The checkpoint records
+    accum_steps so the resumed rng fold_in stream lines up, and the
+    async manager publishes durably before the restore."""
+    from paddle_tpu.distributed.checkpoint import AsyncCheckpointManager
+    from paddle_tpu.jit import state_arrays
+
+    batches = _batches(6, seed=7)
+    m0, s0 = _build(4)
+    straight = [float(s0(x, y)) for x, y in batches]
+
+    m1, s1 = _build(4)
+    part1 = [float(s1(x, y)) for x, y in batches[:3]]
+    mgr = AsyncCheckpointManager(str(tmp_path))
+    mgr.save_train_state(state_arrays(m1), s1._opt_state,
+                         s1.optimizer._step_count,
+                         extra_meta={"accum_steps": s1.accum_steps},
+                         optimizer=s1.optimizer,
+                         data_cursor={"window": 3})
+    assert mgr.wait_until_finished(timeout=60.0)
+    mgr.close()
+
+    m2, s2 = _build(4)
+    meta = s2.restore_checkpoint(str(tmp_path))
+    assert meta is not None
+    assert meta["accum_steps"] == 4
+    assert meta["data_cursor"] == {"window": 3}
+    part2 = [float(s2(x, y)) for x, y in batches[3:]]
+    assert part1 + part2 == straight
+    pa, pb = _params(m0), _params(m2)
+    for k in pa:
+        np.testing.assert_array_equal(pa[k], pb[k])
+
+
+def test_accum_compile_count_one_program():
+    """The whole K-micro-batch window is ONE compiled step program: more
+    windows at the same signature never recompile."""
+    from paddle_tpu.observability import get_program_registry
+    model, step = _build(4)
+    batches = _batches(3)
+    step(*batches[0])
+    reg = get_program_registry()
+    name = f"train_step:{type(model).__name__}"
+    rec = reg.get(name)
+    compiles = rec["compiles"] if rec else None
+    for x, y in batches[1:]:
+        step(x, y)
+    rec = reg.get(name)
+    if rec is not None and compiles is not None:
+        assert rec["compiles"] == compiles
+    # the compiled-callable identity is stable either way
+    assert step._compiled is not None
+
+
+def test_accum_rejects_bad_configs():
+    model, step = _build(2)
+    x, y = _batches(1, b=7)[0]  # 7 % 2 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        step(x, y)
+
+    with pytest.raises(ValueError, match="with_outputs"):
+        paddle.seed(0)
+        m = _Net()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        TrainStep(m, lambda lo, la: F.cross_entropy(lo, la), opt,
+                  accum_steps=2, with_outputs=True)
+
+    with pytest.raises(ValueError, match="accum_steps"):
+        paddle.seed(0)
+        m = _Net()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=m.parameters())
+        TrainStep(m, lambda lo, la: F.cross_entropy(lo, la), opt,
+                  accum_steps=0)
+
+    model2, step2 = _build(2)
+    stacked = tuple(jnp.stack([b, b]) for b in _batches(1)[0])
+    with pytest.raises(NotImplementedError, match="run_steps"):
+        step2.run_steps(*stacked)
+
+
+def test_accum_sparse_embedding_rejected():
+    paddle.seed(0)
+
+    class _Emb(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = nn.Embedding(16, 8, sparse=True)
+            self.fc = nn.Linear(8, 4)
+
+        def forward(self, ids):
+            return self.fc(self.emb(ids).mean(axis=1))
+
+    m = _Emb()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters())
+    with pytest.raises(NotImplementedError, match="sparse"):
+        TrainStep(m, lambda lo, la: F.cross_entropy(lo, la), opt,
+                  accum_steps=2)
+
+
+def test_sharded_accum_spelling_and_conflict():
+    """ShardedTrainStep(accum_steps=K) is the gradient-merge meta-optimizer
+    with the TrainStep-shaped name; a disagreeing explicit
+    gradient_merge_configs.k_steps is a config error, an agreeing one is
+    fine."""
+    from paddle_tpu.parallel import ShardedTrainStep
+    from paddle_tpu.parallel.strategy import (DistributedStrategy,
+                                              GradientMergeConfig)
+
+    paddle.seed(0)
+    m = _Net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m.parameters())
+    st = DistributedStrategy(
+        gradient_merge=True,
+        gradient_merge_configs=GradientMergeConfig(k_steps=3))
+    with pytest.raises(ValueError, match="disagree"):
+        ShardedTrainStep(m, lambda lo, la: F.cross_entropy(lo, la), opt,
+                         strategy=st, accum_steps=2)
+    s = ShardedTrainStep(m, lambda lo, la: F.cross_entropy(lo, la), opt,
+                         strategy=st, accum_steps=3)
+    assert s.accum_steps == 3
+    s2 = ShardedTrainStep(m, lambda lo, la: F.cross_entropy(lo, la), opt,
+                          accum_steps=2)
+    assert s2.accum_steps == 2

@@ -1,0 +1,336 @@
+"""jit.recompute_policy — activation recompute under jit (ISSUE 10).
+
+Covers: policy spec forms and validation, trace-time-only wrapping (eager
+untouched), forward/grad parity on tagged ResNet-18 stages at f32 (the
+semantics gate — recompute must change liveness, never math), measured
+peak-live-bytes reduction on the bf16 tower via the
+observability.programs estimator, BatchNorm running-stat updates
+re-exported through the checkpoint boundary, TrainStep.warmup() still
+zero-compile with recompute tagged, and serving (GPT blocks ship
+pre-tagged) still warm + stream-identical with the policy active.
+"""
+import contextlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+from paddle_tpu.core import recompute as rc
+from paddle_tpu.jit import (TrainStep, functional_call, layout_policy,
+                            recompute_policy, state_arrays)
+from paddle_tpu.observability.programs import peak_live_bytes
+from paddle_tpu.vision import models as vmodels
+
+pytestmark = pytest.mark.hbm
+
+
+@pytest.fixture(autouse=True)
+def _clear_policy():
+    yield
+    recompute_policy(None)
+
+
+def _resnet18(seed=0):
+    paddle.seed(seed)
+    return vmodels.resnet18(num_classes=0, with_pool=False)
+
+
+def _tower(model, amp):
+    from paddle_tpu import amp as amp_mod
+
+    def f(state, x):
+        def run():
+            out = functional_call(model, state, x, training=True)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+        if not amp:
+            return run()
+        with amp_mod.auto_cast(level="O2", dtype="bfloat16"):
+            return run()
+
+    def g(state, x):
+        return jax.value_and_grad(f)(state, x)
+    return g
+
+
+# ---------------------------------------------------------------------------
+# policy plumbing
+# ---------------------------------------------------------------------------
+
+def test_policy_spec_forms():
+    from paddle_tpu.vision.models.resnet import BasicBlock
+    blk = vmodels.resnet18().layer1[0]
+    lin = nn.Linear(4, 4)
+    recompute_policy("stages")
+    assert rc._matches(blk)       # blocks ship pre-tagged
+    assert not rc._matches(lin)
+    recompute_policy(BasicBlock)
+    assert rc._matches(blk) and not rc._matches(lin)
+    recompute_policy((BasicBlock, nn.Linear))
+    assert rc._matches(lin)
+    recompute_policy({"BasicBlock"})
+    assert rc._matches(blk) and not rc._matches(lin)
+    recompute_policy(lambda l: isinstance(l, nn.Linear))
+    assert rc._matches(lin) and not rc._matches(blk)
+    recompute_policy(None)
+    assert rc.policy() is None
+
+
+def test_unknown_checkpoint_policy_rejected_eagerly():
+    with pytest.raises(ValueError, match="unknown checkpoint policy"):
+        recompute_policy("stages", policy="definitely_not_a_policy")
+
+
+def test_policy_context_manager_restores():
+    assert rc.policy() is None
+    with recompute_policy("stages"):
+        assert rc.policy() is not None
+        with recompute_policy(None):
+            assert rc.policy() is None
+        assert rc.policy() is not None
+    assert rc.policy() is None
+
+
+def test_eager_execution_never_wrapped():
+    """Eager calls (concrete arrays, tape available) bypass the wrap: the
+    policy is a compiled-step concept."""
+    m = _resnet18()
+    m.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(
+        1, 3, 32, 32).astype("float32"))
+    base = m(x).numpy()
+    with recompute_policy("stages", policy="nothing_saveable"):
+        out = m(x).numpy()
+    np.testing.assert_array_equal(out, base)
+
+
+# ---------------------------------------------------------------------------
+# parity + measured liveness
+# ---------------------------------------------------------------------------
+
+def _loss_grads(model, x, remat, amp=False):
+    g = _tower(model, amp)
+    state = state_arrays(model)
+    ctx = (recompute_policy("stages", policy="nothing_saveable")
+           if remat else contextlib.nullcontext())
+    with ctx, layout_policy("NHWC"):
+        loss, grads = jax.jit(g)(state, x)
+    return float(loss), grads
+
+
+def test_recompute_forward_grad_parity_f32():
+    model = _resnet18()
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 3, 64, 64),
+                    jnp.float32)
+    l0, g0 = _loss_grads(model, x, remat=False)
+    l1, g1 = _loss_grads(model, x, remat=True)
+    assert abs(l0 - l1) / max(abs(l0), 1e-12) < 1e-5
+    num = den = 0.0
+    for k in g0:
+        a = np.asarray(g0[k], np.float64)
+        b = np.asarray(g1[k], np.float64)
+        num += float(np.sum((a - b) ** 2))
+        den += float(np.sum(a ** 2))
+    assert (num / max(den, 1e-30)) ** 0.5 < 1e-4
+
+
+def test_recompute_reduces_peak_live_bf16_tower():
+    """The measured contract (not asserted by construction): checkpointing
+    ResNet-50 bottleneck blocks under nothing_saveable lowers estimated
+    peak live bytes of the fwd+bwd bf16 tower.  (BasicBlock towers at toy
+    shapes measure WORSE — the fused ops already recompute their own
+    backwards, so the base leg is light and the remat call-site io
+    dominates; the knob is opt-in for exactly this reason.  The full-size
+    r50-b64-224 leg lives in probes/hbm_probe.py: ratio ~0.50.)"""
+    paddle.seed(0)
+    model = vmodels.resnet50(num_classes=0, with_pool=False)
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 3, 112, 112),
+                    jnp.float32)
+    state = state_arrays(model)
+
+    def peak(remat):
+        ctx = (recompute_policy("stages", policy="nothing_saveable")
+               if remat else contextlib.nullcontext())
+        with ctx, layout_policy("NHWC"):
+            tr = jax.jit(_tower(model, amp=True)).trace(state, x)
+        return int(peak_live_bytes(tr.jaxpr))
+
+    base, remat = peak(False), peak(True)
+    assert remat < 0.85 * base, (base, remat)
+
+
+def test_bn_running_stats_cross_checkpoint_boundary():
+    """Buffer updates recorded inside a wrapped subtree re-export through
+    the checkpoint as explicit outputs: a compiled TrainStep under the
+    policy updates running stats exactly like the unwrapped step."""
+    def run(remat):
+        paddle.seed(0)
+        model = vmodels.resnet18(num_classes=10)
+        opt = paddle.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                        parameters=model.parameters())
+        step = TrainStep(model, lambda lo, la: F.cross_entropy(lo, la),
+                         opt)
+        rng = np.random.RandomState(3)
+        x = paddle.to_tensor(rng.randn(4, 3, 32, 32).astype("float32"))
+        y = paddle.to_tensor(rng.randint(0, 10, (4,)).astype("int64"))
+        ctx = (recompute_policy("stages") if remat
+               else contextlib.nullcontext())
+        with ctx:
+            loss = float(step(x, y))
+        return loss, model
+
+    l0, m0 = run(False)
+    l1, m1 = run(True)
+    assert abs(l0 - l1) / max(abs(l0), 1e-12) < 1e-5
+    for name in ("bn1", "layer1.0.bn1", "layer2.0.bn2"):
+        sub0 = m0
+        sub1 = m1
+        for part in name.split("."):
+            sub0 = sub0[int(part)] if part.isdigit() else getattr(sub0, part)
+            sub1 = sub1[int(part)] if part.isdigit() else getattr(sub1, part)
+        np.testing.assert_allclose(np.asarray(sub1._mean._data),
+                                   np.asarray(sub0._mean._data),
+                                   rtol=1e-5, atol=1e-6)
+        # the unwrapped leg left its stats moved off init too (the update
+        # actually happened)
+        assert float(np.abs(np.asarray(sub0._variance._data) - 1.0).max()) \
+            > 1e-6
+
+
+def test_fused_ops_fall_back_to_reference_inside_checkpoint():
+    """custom_vjp residuals are opaque to jax.checkpoint (saved regardless
+    of policy), so the fused BN entries must route to their plain
+    differentiable references inside a wrapped subtree."""
+    from paddle_tpu.ops import fused_bn_act as K
+    assert not rc.inside_checkpoint()
+    seen = []
+    orig = K.bn_act_reference
+
+    def spy(*a, **kw):
+        seen.append(rc.inside_checkpoint())
+        return orig(*a, **kw)
+
+    K.bn_act_reference = spy
+    try:
+        model = _resnet18()
+        state = state_arrays(model)
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 3, 32, 32),
+                        jnp.float32)
+        with recompute_policy("stages"):
+            jax.jit(_tower(model, amp=False)).trace(state, x)
+    finally:
+        K.bn_act_reference = orig
+    # block BNs hit the reference INSIDE the checkpoint; the stem (not a
+    # tagged stage) still runs outside it (custom_vjp recompute wrappers)
+    assert True in seen and False in seen
+
+
+# ---------------------------------------------------------------------------
+# warmup / zero-compile contracts with recompute tagged
+# ---------------------------------------------------------------------------
+
+def test_trainstep_warmup_zero_compile_with_recompute():
+    from paddle_tpu.observability import get_program_registry
+    paddle.seed(0)
+    model = vmodels.resnet18(num_classes=10)
+    opt = paddle.optimizer.Momentum(learning_rate=0.05,
+                                    parameters=model.parameters())
+    step = TrainStep(model, lambda lo, la: F.cross_entropy(lo, la), opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(2, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 10, (2,)).astype("int64"))
+    with recompute_policy("stages"):
+        rep = step.warmup(x, y)
+        before = _train_step_compiles(get_program_registry(), model)
+        loss = float(step(x, y))
+        after = _train_step_compiles(get_program_registry(), model)
+    assert np.isfinite(loss)
+    assert rep["seconds"] >= 0
+    assert after == before  # the real step reused the warm program
+
+
+def _train_step_compiles(reg, model):
+    rec = reg.get(f"train_step:{type(model).__name__}")
+    return rec["compiles"] if rec else 0
+
+
+@pytest.mark.slow
+def test_serving_warmup_and_streams_with_recompute_tagged():
+    """GPT blocks ship pre-tagged: an active recompute policy must not
+    change served tokens or break the zero-post-warmup-compiles
+    contract (forward-only checkpoint is a no-op for decode)."""
+    from paddle_tpu import models
+    from paddle_tpu.serving import ServingEngine
+
+    def tiny():
+        cfg = models.GPTConfig(vocab_size=13, hidden_size=16,
+                               num_hidden_layers=2, num_attention_heads=2,
+                               hidden_dropout_prob=0.0,
+                               attention_probs_dropout_prob=0.0,
+                               max_position_embeddings=64)
+        paddle.seed(7)
+        m = models.GPTForPretraining(cfg)
+        m.eval()
+        return m
+
+    def serve(policy):
+        ctx = (recompute_policy("stages") if policy
+               else contextlib.nullcontext())
+        with ctx:
+            eng = ServingEngine(tiny(), max_slots=2, max_len=32,
+                                prefill_buckets=(8,), decode_chunk=2)
+            eng.warmup()
+            r = eng.submit(np.arange(5) % 13, max_new_tokens=6)
+            eng.run_until_drained(timeout=240)
+            toks = r.tokens(timeout=5)
+            assert eng.post_warmup_compiles() == 0
+        return toks
+
+    assert serve(True) == serve(False)
+
+
+# ---------------------------------------------------------------------------
+# peak_live_bytes estimator basics
+# ---------------------------------------------------------------------------
+
+def test_peak_live_estimator_orders_liveness():
+    """A program that keeps N big tensors live simultaneously must
+    estimate higher than one that consumes each immediately."""
+    def fanout(x):
+        # all four scaled copies are alive at the final sum
+        a, b, c, d = x * 1.1, x * 1.2, x * 1.3, x * 1.4
+        return jnp.stack([a, b, c, d]).sum()
+
+    def chain(x):
+        for _ in range(4):
+            x = x * 1.1
+        return x.sum()
+
+    x = jnp.zeros((256, 256), jnp.float32)
+    hi = peak_live_bytes(jax.jit(fanout).trace(x).jaxpr)
+    lo = peak_live_bytes(jax.jit(chain).trace(x).jaxpr)
+    assert hi > lo
+
+
+def test_peak_live_estimator_reads_through_converts():
+    """An f32 upcast of a bf16 buffer reads through to its source: the
+    estimate must not double-charge the convert even when the f32 view is
+    used far apart (XLA duplicates converts into consumer fusions)."""
+    def f(x):
+        xf = x.astype(jnp.float32)       # multi-use, long-span
+        s = jnp.sum(xf)
+        big = jnp.tanh(xf)               # second use, later
+        return s + jnp.sum(big)
+
+    x = jnp.zeros((512, 512), jnp.bfloat16)
+    est = peak_live_bytes(jax.jit(f).trace(x).jaxpr)
+    src = x.size * 2          # 0.5 MB bf16 source
+    f32 = x.size * 4          # 1 MB per materialized f32 tensor
+    assert est >= src         # the source buffer itself is charged
+    # source + one f32 (tanh output) + slack; a charged f32 copy of x
+    # would add a second full f32
+    assert est < src + f32 + f32 // 2, est
