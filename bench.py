@@ -965,6 +965,36 @@ def measure_hbm():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_recsys():
+    """ISSUE-11 acceptance artifact: probes/recsys_probe.py in a clean CPU
+    subprocess.  Publishes the recommender-workload story as
+    `detail.recsys.{rows_per_sec,prefetch_hit_rate,
+    peak_device_table_bytes}` — bars: a DLRM whose host-resident table
+    (rows + adam moments) exceeds the device table budget trains with
+    async double-buffered row prefetch at >= 1.5x the rows/sec of
+    synchronous fetch AND bit-identical results, the mesh-row-sharded leg
+    is loss-bit-identical to the single-device Embedding(sparse=True)
+    oracle on the 8-virtual-device CPU mesh, and a SIGKILL-interrupted
+    run resumes from the checkpoint (table rows + moments + data cursor)
+    to bit-identical final state."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes", "recsys_probe.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("RECSYS"):
+            rec = json.loads(line[len("RECSYS"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"recsys bars failed: {rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return rec
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_mnist_eager():
     """BASELINE config #1: LeNet, EAGER per-op dispatch, single device —
     the CPU-baseline parity check (runs in a CPU subprocess; eager per-op
@@ -1208,6 +1238,7 @@ def main():
                          ("program_cache", measure_program_cache),
                          ("spec_decode", measure_spec_decode),
                          ("gateway", measure_gateway),
+                         ("recsys", measure_recsys),
                          ("resilience", measure_resilience),
                          ("observability", measure_observability),
                          ("pipeline", measure_pipeline_ratio)):

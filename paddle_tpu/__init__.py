@@ -100,6 +100,7 @@ def in_dynamic_mode():
     return True
 from . import generation  # noqa: F401,E402
 from . import serving  # noqa: F401,E402
+from . import embedding  # noqa: F401,E402
 from .compat import (tensordot, has_inf, has_nan,  # noqa: F401,E402
                      elementwise_floordiv, elementwise_mod, elementwise_pow,
                      reduce_max, reduce_min, reduce_mean, reduce_prod,

@@ -355,7 +355,7 @@ class Parameter(Tensor):
     """Trainable tensor (reference: framework.py Parameter / ParamBase)."""
 
     __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed",
-                 "sparse_grad")
+                 "sparse_grad", "row_shard_axis", "row_shard_mesh")
 
     def __init__(self, data, name=None, trainable=True):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -366,6 +366,13 @@ class Parameter(Tensor):
         self.need_clip = True
         self.is_distributed = False
         self.sparse_grad = False  # set by Embedding(sparse=True)
+        # row-sharded giant-table metadata, set by embedding.ShardedEmbedding:
+        # the mesh axis the leading (row) dim is sharded over + the Mesh.
+        # The lazy sparse optimizer update consults these to run PER SHARD
+        # (embedding.functional.sharded_lazy_row_update) instead of over the
+        # whole table.
+        self.row_shard_axis = None
+        self.row_shard_mesh = None
 
     def __repr__(self):
         return "Parameter " + super().__repr__()

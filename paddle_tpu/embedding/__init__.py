@@ -1,0 +1,38 @@
+"""paddle_tpu.embedding — sharded + host-resident giant embedding tables.
+
+The TPU-native successor to the reference's parameter-server sparse stack
+(SelectedRows grads, FleetWrapper pull/push, distributed_lookup_table_op,
+lazy-sparse adam): the first subsystem in this repo whose hot loop is
+memory-system choreography — which rows move, when, and who owns them —
+rather than matmuls.
+
+- **functional** — deduped-index gather (`dedup_ids`/`dedup_gather`), the
+  row-sharded psum gather, and the per-shard lazy row update that the
+  compiled train steps call through `optimizer.functional.apply_updates`.
+- **sharded**   — `ShardedEmbedding`: a device table row-sharded over a
+  mesh axis (parallel.sharding.row_spec); sparse grads feed the existing
+  lazy row-wise optimizer update PER SHARD — no densify, no all-gather of
+  the table.
+- **host_table** — `HostEmbeddingTable` (param rows + optimizer moments in
+  host RAM, bigger than device memory), `HostPrefetchPipeline` (depth-1
+  double-buffered async row prefetch, bit-identical to synchronous fetch),
+  `HostTableTrainStep` (one compiled step over dense params + the working
+  slab), with bit-exact SIGKILL resume (rows + moments + data cursor).
+- **serving**   — `RecsysPredictor`: micro-batched cross-request-deduped
+  scoring behind `inference.Config.enable_recsys_serving`.
+
+See README "Recommender workload" and probes/recsys_probe.py.
+"""
+from .functional import (dedup_ids, dedup_gather, psum_gather,  # noqa: F401
+                         sharded_lazy_row_update, sharded_lookup)
+from .sharded import ShardedEmbedding  # noqa: F401
+from .host_table import (HostEmbeddingTable, HostPrefetchPipeline,  # noqa: F401
+                         HostTableTrainStep, PreparedBatch)
+from .serving import RecsysPredictor, RecsysResponse  # noqa: F401
+
+__all__ = [
+    "dedup_ids", "dedup_gather", "psum_gather", "sharded_lazy_row_update",
+    "sharded_lookup", "ShardedEmbedding", "HostEmbeddingTable",
+    "HostPrefetchPipeline", "HostTableTrainStep", "PreparedBatch",
+    "RecsysPredictor", "RecsysResponse",
+]

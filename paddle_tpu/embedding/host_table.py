@@ -1,0 +1,547 @@
+"""Host-RAM-resident embedding tables bigger than device memory.
+
+Reference: the FleetWrapper parameter-server pull/push sparse cycle
+(fleet_wrapper.cc PullSparseVarsSync / PushSparseVarsWithLabelAsync): the
+trainer pulls only the current batch's deduped rows from the PS, trains on
+the pulled slab, and pushes the updated rows back.  TPU-native, the "PS" is
+host RAM on the same machine: the table (param rows + row-wise optimizer
+moments) lives as numpy arrays, and a double-buffered prefetch pipeline
+pulls the NEXT batch's deduped rows to device while the current compiled
+step runs, then writes the updated rows back — the dataloader-prefetch /
+async-checkpoint overlap discipline applied to parameters themselves.
+
+Correctness contract (tests/test_embedding_shard.py):
+- async prefetch is BIT-IDENTICAL to synchronous fetch: a prefetched slab
+  that overlaps the in-flight batch's rows is re-patched from the host
+  table after that batch's write-back lands (depth-1 double buffering, so
+  the only possibly-stale rows are exactly that intersection);
+- a poisoned fetched copy (PDTPU_FAULT_ROW_CORRUPT) is detected by the
+  fetch-side finiteness verify and refetched from the host table;
+- checkpoints carry table rows + optimizer moments + the data cursor, so a
+  SIGKILL-interrupted run resumes bit-exact (probes/recsys_probe.py).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+_obs_handles = None
+
+
+def _obs():
+    """(prefetch_wait_histogram, device_table_bytes_gauge) — created once
+    (registry.reset() zeroes values in place so the cache stays valid)."""
+    global _obs_handles
+    if _obs_handles is None:
+        from ..observability import metrics as _m
+        _obs_handles = (
+            _m.histogram("embedding_prefetch_wait_seconds",
+                         "time the train loop waited for the next batch's "
+                         "host-table row slab (0 ~= the prefetch fully "
+                         "overlapped the step)"),
+            _m.gauge("embedding_device_table_bytes",
+                     "bytes of host-table rows + moments resident on "
+                     "device for the current step (the working set, not "
+                     "the table)"))
+    return _obs_handles
+
+
+class HostEmbeddingTable:
+    """A (num_embeddings, embedding_dim) table in host RAM, with row-wise
+    optimizer-moment slabs beside it.  Only ever touched through deduped
+    row gathers/scatters — the device never holds more than one batch's
+    working set."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 dtype="float32", init_scale: float = 0.01, seed: int = 0,
+                 name: str = "host_table",
+                 rows: Optional[np.ndarray] = None):
+        self.num_embeddings = int(num_embeddings)
+        self.embedding_dim = int(embedding_dim)
+        self.dtype = np.dtype(dtype)
+        self.name = name
+        if rows is not None:
+            # adopt existing rows (serving wrap of a trained table): no
+            # random init — at giant-table sizes the discarded f64
+            # standard_normal would transiently cost 2x the table
+            rows = np.asarray(rows, self.dtype)
+            if rows.shape != (self.num_embeddings, self.embedding_dim):
+                raise ValueError(
+                    f"HostEmbeddingTable: rows shape {rows.shape} != "
+                    f"({self.num_embeddings}, {self.embedding_dim})")
+            self.rows = rows
+        else:
+            rng = np.random.RandomState(seed)
+            self.rows = (rng.standard_normal(
+                (num_embeddings, embedding_dim))
+                * init_scale).astype(self.dtype)
+        # optimizer-state slabs (e.g. adam moment1/moment2), allocated by
+        # ensure_opt_state from the optimizer's own init_state template
+        self.opt_slabs: Dict[str, np.ndarray] = {}
+
+    @property
+    def nbytes(self) -> int:
+        return self.rows.nbytes + sum(s.nbytes for s in
+                                      self.opt_slabs.values())
+
+    def ensure_opt_state(self, optimizer):
+        """Allocate the row-wise moment slabs for `optimizer` (idempotent).
+        Only row-shaped state leaves are supported — exactly the ones the
+        lazy row update touches."""
+        if self.opt_slabs:
+            return
+        template = optimizer.init_state(
+            jnp.zeros((1, self.embedding_dim), self.dtype))
+        for k, leaf in template.items():
+            if tuple(leaf.shape) != (1, self.embedding_dim):
+                raise NotImplementedError(
+                    f"HostEmbeddingTable: optimizer state leaf {k!r} is "
+                    f"not row-wise (shape {tuple(leaf.shape)}); host "
+                    "tables support row-wise-state optimizers (SGD/"
+                    "Momentum/Adam family)")
+            self.opt_slabs[k] = np.zeros(
+                (self.num_embeddings, self.embedding_dim),
+                np.dtype(str(leaf.dtype)))
+
+    # -- row-granular access -------------------------------------------------
+    def gather(self, uids: np.ndarray, cap: int
+               ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Copy the rows + moments for `uids` into fresh (cap, D) slabs
+        (slots past len(uids) stay zero — the static-shape bucket pad)."""
+        u = len(uids)
+        slab = np.zeros((cap, self.embedding_dim), self.dtype)
+        slab[:u] = self.rows[uids]
+        states = {}
+        for k, s in self.opt_slabs.items():
+            st = np.zeros((cap, self.embedding_dim), s.dtype)
+            st[:u] = s[uids]
+            states[k] = st
+        return slab, states
+
+    def scatter(self, uids: np.ndarray, slab: np.ndarray,
+                states: Dict[str, np.ndarray]):
+        """Write updated rows + moments back (only the first len(uids)
+        slab slots — bucket-pad rows never land)."""
+        u = len(uids)
+        self.rows[uids] = np.asarray(slab[:u], self.dtype)
+        for k, s in states.items():
+            self.opt_slabs[k][uids] = np.asarray(s[:u],
+                                                 self.opt_slabs[k].dtype)
+
+    # -- checkpoint subtree --------------------------------------------------
+    def state_tree(self) -> dict:
+        return {"rows": self.rows,
+                "opt": {k: v for k, v in self.opt_slabs.items()}}
+
+    def load_state_tree(self, tree: dict):
+        self.rows = np.array(tree["rows"], self.dtype)
+        self.opt_slabs = {k: np.array(v) for k, v in
+                          tree.get("opt", {}).items()}
+
+
+class PreparedBatch:
+    """One batch's device-resident working set (the PS 'pulled' rows)."""
+
+    __slots__ = ("index", "inputs", "label", "uids", "inv", "n_unique",
+                 "cap", "slab", "states", "waited_s", "was_hit")
+
+    def __init__(self, index, inputs, label, uids, inv, cap, slab, states):
+        self.index = index
+        self.inputs = inputs      # host arrays: model inputs before emb
+        self.label = label
+        self.uids = uids          # np (U,) unique global row ids
+        self.inv = inv            # np (B*F,) position -> slab slot
+        self.n_unique = len(uids)
+        self.cap = cap            # bucket-rounded slab rows
+        self.slab = slab          # host (cap, D) param rows
+        self.states = states      # host {leaf: (cap, D)} moment rows
+        self.waited_s = 0.0
+        self.was_hit = False
+
+
+def _round_bucket(n: int, bucket: int) -> int:
+    return max(bucket, ((n + bucket - 1) // bucket) * bucket)
+
+
+class HostPrefetchPipeline:
+    """Depth-1 double-buffered row prefetch over a deterministic batch
+    stream.
+
+    `batch_fn(i)` returns batch i as (inputs..., ids, label) numpy arrays
+    with ids of shape (B, F) — indexable by step so a checkpoint cursor
+    can fast-forward bit-exact.  While the caller runs the compiled step
+    on batch i, a worker thread is already pulling batch i+1's deduped
+    rows; `complete()` pushes batch i's updated rows back and the next
+    `next_prepared()` re-patches any overlap before handing the slab out.
+    """
+
+    def __init__(self, table: HostEmbeddingTable,
+                 batch_fn: Callable[[int], tuple], n_batches: int,
+                 optimizer=None, offsets: Optional[np.ndarray] = None,
+                 async_prefetch: bool = True, bucket: int = 1024,
+                 start_index: int = 0):
+        self.table = table
+        self.batch_fn = batch_fn
+        self.n_batches = int(n_batches)
+        self.offsets = (None if offsets is None
+                        else np.asarray(offsets, np.int64))
+        self.async_prefetch = bool(async_prefetch)
+        # slab rows round up to a bucket multiple AND never shrink below
+        # the run's high-water cap, so the per-batch unique count's jitter
+        # (which loves to hug a bucket boundary) cannot flap the compiled
+        # step's signature — a cap change is a recompile on the hot path
+        self.bucket = int(bucket)
+        self._cap_high_water = 0
+        self._next = int(start_index)      # next batch index to consume
+        self._outstanding: Optional[PreparedBatch] = None
+        # (uids, rows, states) of the newest write-back — the scatter into
+        # the table runs on the worker thread (off the step's critical
+        # path); the overlap patch reads THESE buffers, so it never waits
+        # on (or races with) the table write
+        self._pending_write = None
+        self._write_future = None
+        self._future = None
+        self._fetch_no = 0                 # 1-based, for row_corrupt
+        self.hits = 0
+        self.misses = 0
+        self.corrupt_refetches = 0
+        self.wait_seconds = 0.0
+        self.peak_device_table_bytes = 0
+        if optimizer is not None:
+            table.ensure_opt_state(optimizer)
+        self._executor = None
+        if self.async_prefetch:
+            from concurrent.futures import ThreadPoolExecutor
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="paddle_tpu-emb-prefetch")
+            if self._next < self.n_batches:
+                self._future = self._executor.submit(self._prepare,
+                                                     self._next)
+
+    # -- worker --------------------------------------------------------------
+    def _prepare(self, i: int) -> PreparedBatch:
+        from ..utils import faults as _faults
+        from ..utils.monitor import stat_add
+        self._fetch_no += 1
+        fetch_no = self._fetch_no
+        _faults.maybe_stall_prefetch(fetch_no - 1)
+        batch = self.batch_fn(i)
+        *inputs, ids, label = batch
+        ids = np.asarray(ids)
+        flat = ids.reshape(-1).astype(np.int64)
+        if self.offsets is not None:
+            flat = (ids.astype(np.int64)
+                    + self.offsets.reshape(1, -1)).reshape(-1)
+        uids, inv = np.unique(flat, return_inverse=True)
+        cap = max(_round_bucket(len(uids), self.bucket),
+                  self._cap_high_water)
+        self._cap_high_water = cap
+        slab, states = self.table.gather(uids, cap)
+        stat_add("STAT_embedding_rows_gathered", int(flat.size))
+        stat_add("STAT_embedding_rows_unique", int(len(uids)))
+        stat_add("STAT_embedding_host_to_device_bytes",
+                 int(slab.nbytes + sum(s.nbytes for s in states.values())))
+        if _faults.row_corrupt_fetch() == fetch_no and len(uids):
+            # poison the fetched COPY (never the table): a torn transfer
+            slab[0] = np.nan
+        # torn-transfer verify, fetch-side so it overlaps the running step:
+        # a poisoned copy is refetched from the host table (the source of
+        # truth was never touched; on the worker this is serialized with
+        # the scatter jobs, so it reads a consistent table)
+        if len(uids) and not np.isfinite(slab[:len(uids)]).all():
+            stat_add("STAT_embedding_corrupt_rows_detected")
+            self.corrupt_refetches += 1
+            slab[:len(uids)] = self.table.rows[uids]
+        return PreparedBatch(i, tuple(np.asarray(a) for a in inputs),
+                             np.asarray(label), uids,
+                             inv.astype(np.int32), cap, slab, states)
+
+    # -- consumer ------------------------------------------------------------
+    def __len__(self):
+        return max(0, self.n_batches - self._next)
+
+    def next_prepared(self) -> Optional[PreparedBatch]:
+        """Hand out the next batch's device-ready working set (None when
+        the stream is exhausted).  The previous batch must have been
+        complete()d — depth-1 double buffering is what makes the overlap
+        re-patch exact."""
+        from ..utils.monitor import stat_add
+        if self._outstanding is not None:
+            raise RuntimeError(
+                "HostPrefetchPipeline: complete() the previous batch "
+                "before requesting the next one")
+        if self._next >= self.n_batches:
+            return None
+        i = self._next
+        # queue the NEXT fetch first thing, so the worker picks it up the
+        # moment it is free (it runs behind any queued scatter, concurrent
+        # with the caller's verify/patch/stage AND the step itself)
+        next_future = None
+        if self._executor is not None and i + 1 < self.n_batches:
+            next_future = self._executor.submit(self._prepare, i + 1)
+        wait_h, bytes_g = _obs()
+        t0 = time.perf_counter()
+        if self._future is not None:
+            hit = self._future.done()
+            prep = self._future.result()
+            self._future = None
+        else:
+            hit = False
+            prep = self._prepare(i)
+        prep.waited_s = time.perf_counter() - t0
+        prep.was_hit = hit
+        self.wait_seconds += prep.waited_s
+        wait_h.observe(prep.waited_s)
+        if hit:
+            self.hits += 1
+            stat_add("STAT_embedding_prefetch_hits")
+        else:
+            self.misses += 1
+            stat_add("STAT_embedding_prefetch_misses")
+        u = prep.n_unique
+        # overlap re-patch: rows the in-flight batch just wrote back were
+        # stale in the prefetched copy — pull exactly those from the
+        # pending-write buffers (the table scatter may still be running on
+        # the worker thread; these host copies are already final)
+        if self._pending_write is not None and u:
+            w_uids, w_rows, w_states = self._pending_write
+            overlap = np.intersect1d(prep.uids, w_uids, assume_unique=True)
+            if overlap.size:
+                slots = np.searchsorted(prep.uids, overlap)
+                src = np.searchsorted(w_uids, overlap)
+                prep.slab[slots] = w_rows[src]
+                for k, s in prep.states.items():
+                    s[slots] = w_states[k][src]
+        # stage onto the device; kick off the NEXT prefetch so it overlaps
+        # the caller's step
+        prep.slab = jnp.asarray(prep.slab)
+        prep.states = {k: jnp.asarray(v) for k, v in prep.states.items()}
+        prep.inv = jnp.asarray(prep.inv)
+        resident = int(prep.slab.nbytes
+                       + sum(s.nbytes for s in prep.states.values()))
+        self.peak_device_table_bytes = max(self.peak_device_table_bytes,
+                                           resident)
+        bytes_g.set(resident)
+        self._next = i + 1
+        self._outstanding = prep
+        self._future = next_future
+        return prep
+
+    def complete(self, prep: PreparedBatch, new_slab, new_states: dict):
+        """Write batch `prep`'s updated rows + moments back to the host
+        table (the PS 'push').  Only the device->host copy runs here; the
+        table scatter itself goes to the worker thread, ORDERED after the
+        already-queued next prefetch — so that prefetch reads a consistent
+        pre-write table and the overlap patch supplies the new values."""
+        from ..utils.monitor import stat_add
+        if self._outstanding is not prep:
+            raise RuntimeError("HostPrefetchPipeline: complete() got a "
+                               "batch that is not the outstanding one")
+        u = prep.n_unique
+        rows = np.asarray(new_slab)[:u]
+        states = {k: np.asarray(v)[:u] for k, v in new_states.items()}
+        stat_add("STAT_embedding_device_to_host_bytes",
+                 int(rows.nbytes + sum(s.nbytes for s in states.values())))
+        self._pending_write = (prep.uids, rows, states)
+        if self._executor is not None:
+            self._write_future = self._executor.submit(
+                self.table.scatter, prep.uids, rows, states)
+        else:
+            self.table.scatter(prep.uids, rows, states)
+        self._outstanding = None
+
+    def flush(self):
+        """Block until every queued table write has landed (checkpoint
+        snapshots and end-of-run reads need the table, not the pending
+        buffers, to be the truth)."""
+        if self._write_future is not None:
+            self._write_future.result()
+            self._write_future = None
+
+    def cursor(self) -> dict:
+        """Checkpoint cursor: the next batch index to consume.  Refuses
+        while a batch is outstanding — its update has not reached the
+        table yet, so a snapshot now would record a cursor PAST a batch
+        whose rows were never written (a silently lossy resume)."""
+        if self._outstanding is not None:
+            raise RuntimeError(
+                "HostPrefetchPipeline: cannot checkpoint with batch "
+                f"{self._outstanding.index} outstanding — complete() it "
+                "first so its row updates are in the table the snapshot "
+                "captures")
+        return {"batch_index": self._next}
+
+    def metrics(self) -> dict:
+        total = self.hits + self.misses
+        return {"fetches": total, "hits": self.hits, "misses": self.misses,
+                "hit_rate": (self.hits / total) if total else None,
+                "wait_seconds": self.wait_seconds,
+                "corrupt_refetches": self.corrupt_refetches,
+                "peak_device_table_bytes": self.peak_device_table_bytes}
+
+    def close(self):
+        if self._executor is not None:
+            self.flush()
+            self._executor.shutdown(wait=True)
+            self._executor = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+_TABLE_KEY = "__host_table__"
+
+
+class HostTableTrainStep:
+    """One compiled step over (dense model params + the current batch's
+    table working set): forward on slab[inv], backward, ONE apply_updates
+    over dense params AND the slab (the slab is just another param for the
+    update math — bucket-pad rows are dropped at write-back, so their
+    junk moments never land).
+
+    The model runs in 'external-embedding' mode: forward(*inputs, emb)
+    where emb is the (B, F, D) gathered rows.
+    """
+
+    def __init__(self, model: Layer, loss_fn, optimizer,
+                 table: HostEmbeddingTable):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.table = table
+        table.ensure_opt_state(optimizer)
+        self._trainable = {k for k, v in model.state_dict().items()
+                           if getattr(v, "trainable", False)}
+        self._sig_cache = {}
+        self._opt_state = None
+
+    def init_opt_state(self, state):
+        return {k: self.optimizer.init_state(v) for k, v in state.items()
+                if k in self._trainable}
+
+    def _build(self, ids_shape):
+        from ..jit import forward_loss
+        from ..optimizer.functional import apply_updates, decay_flags
+        opt = self.optimizer
+        trainable = self._trainable
+        decay = decay_flags(opt, trainable)
+        decay[_TABLE_KEY] = opt._decay_applies(self.table.name)
+        b, f = ids_shape
+        d = self.table.embedding_dim
+
+        def step(params, opt_state, slab, slab_state, inv, step_no, lr,
+                 rng_key, batch):
+            *inputs, label = batch
+
+            def loss_of(tp, slab_v):
+                full = dict(params)
+                full.update(tp)
+                emb = jnp.take(slab_v, inv, axis=0).reshape(b, f, d)
+                loss, _outs, bufs = forward_loss(
+                    self.model, self.loss_fn, full,
+                    tuple(inputs) + (emb, label), rng_key,
+                    return_buffer_updates=True)
+                return loss, bufs
+
+            train_params = {k: v for k, v in params.items()
+                            if k in trainable}
+            (loss, bufs), (grads, gslab) = jax.value_and_grad(
+                loss_of, argnums=(0, 1), has_aux=True)(train_params, slab)
+            from ..utils import faults as _faults
+            grads = _faults.poison_grads(grads, step_no)
+            all_params = dict(params)
+            all_params[_TABLE_KEY] = slab
+            all_grads = dict(grads)
+            all_grads[_TABLE_KEY] = gslab
+            all_opt = dict(opt_state)
+            all_opt[_TABLE_KEY] = slab_state
+            new_params, new_opt = apply_updates(
+                opt, all_params, all_grads, all_opt, lr, step_no, decay)
+            new_slab = new_params.pop(_TABLE_KEY)
+            new_slab_state = new_opt.pop(_TABLE_KEY)
+            new_params.update(bufs)
+            return new_params, new_opt, loss, new_slab, new_slab_state
+
+        from ..observability import track
+        return track(f"host_table_step:{type(self.model).__name__}",
+                     jax.jit(step, donate_argnums=(0, 1, 2, 3)))
+
+    def run(self, prep: PreparedBatch, ids_shape):
+        """Execute one step on a prepared batch; returns (loss, new_slab,
+        new_slab_states) — hand the latter two to pipeline.complete()."""
+        from ..jit import state_arrays
+        from ..core import rng as _rng
+        state = state_arrays(self.model)
+        if self._opt_state is None:
+            self._opt_state = self.init_opt_state(state)
+        batch = tuple(prep.inputs) + (prep.label,)
+        sig = ((prep.cap,) + tuple(ids_shape)
+               + tuple((tuple(np.shape(a)), str(np.asarray(a).dtype))
+                       for a in batch))
+        compiled = self._sig_cache.get(sig)
+        if compiled is None:
+            compiled = self._sig_cache[sig] = self._build(tuple(ids_shape))
+        self.optimizer._step_count += 1
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        step_no = jnp.asarray(self.optimizer._step_count, jnp.int32)
+        rng_key = _rng.next_key()
+        new_state, self._opt_state, loss, new_slab, new_slab_state = \
+            compiled(state, self._opt_state, prep.slab, prep.states,
+                     prep.inv, step_no, lr, rng_key,
+                     tuple(jnp.asarray(a) for a in batch))
+        sd = self.model.state_dict()
+        for k, v in new_state.items():
+            sd[k]._set_data(v)
+        return Tensor(loss), new_slab, new_slab_state
+
+    # -- checkpointing (rows + moments + cursor: bit-exact resume) -----------
+    def save_checkpoint(self, directory: str,
+                        pipeline: Optional[HostPrefetchPipeline] = None,
+                        step: Optional[int] = None,
+                        extra_meta: Optional[dict] = None) -> str:
+        from ..distributed import checkpoint as dck
+        from ..jit import state_arrays
+        from ..utils.monitor import stat_add
+        stat_add("STAT_checkpoint_saves")
+        if pipeline is not None:
+            pipeline.flush()  # the table, not pending buffers, is snapshot
+        state = state_arrays(self.model)
+        if self._opt_state is None:
+            self._opt_state = self.init_opt_state(state)
+        extra = dck.train_state_extras(
+            self.optimizer, extra_meta, None,
+            pipeline.cursor() if pipeline is not None else None)
+        tree = {"params": state, "opt": self._opt_state,
+                "table": self.table.state_tree()}
+        return dck.save_sharded(
+            tree, directory,
+            step if step is not None else self.optimizer._step_count, extra)
+
+    def restore_checkpoint(self, directory: str) -> Optional[dict]:
+        from ..distributed import checkpoint as dck
+        from ..jit import state_arrays
+        res = dck.restore_sharded(directory)
+        if res is None:
+            return None
+        tree, step, extra = res
+        sd = self.model.state_dict()
+        for k, v in tree.get("params", {}).items():
+            sd[k]._set_data(v)
+        meta = dck.restore_train_extras(self.optimizer, step, extra)
+        fresh = self.init_opt_state(state_arrays(self.model))
+        self._opt_state = dck.merge_opt_state(fresh, tree.get("opt", {}))
+        self.table.load_state_tree(tree.get("table", {}))
+        return meta

@@ -1,0 +1,234 @@
+"""Serving-side recsys lookup path: micro-batched, cross-request-deduped
+CTR scoring.
+
+Reference: the PS serving path — distributed_lookup_table_op batching many
+inference lookups into one pull.  Here concurrent `submit()` calls are
+merged by a scorer loop (the continuous-batching discipline of
+serving.ServingEngine applied to scoring): ONE dedup over the union of all
+merged requests' ids, ONE host-table row fetch, ONE compiled forward.
+Admission mirrors the PR-6 gateway's contract: a full queue rejects with a
+typed, already-terminal response instead of raising in the caller.
+`inference.Config.enable_recsys_serving(...)` routes `create_predictor`
+here, so deployment looks like every other predictor.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .host_table import HostEmbeddingTable, _round_bucket
+
+
+class RecsysResponse:
+    """Terminal, thread-safe result handle for one scoring request."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._scores: Optional[np.ndarray] = None
+        self._error: Optional[str] = None
+
+    def _finish(self, scores=None, error=None):
+        self._scores = scores
+        self._error = error
+        self._event.set()
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    @property
+    def failed(self) -> bool:
+        return self._event.is_set() and self._error is not None
+
+    @property
+    def error(self) -> Optional[str]:
+        return self._error
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError("recsys scoring request still pending")
+        if self._error is not None:
+            raise RuntimeError(self._error)
+        return self._scores
+
+
+class RecsysPredictor:
+    """Batched deduped-lookup scorer over an external-embedding model.
+
+    `model` runs in external-embedding mode: forward(dense, emb) with emb
+    the gathered (B, F, D) rows; `table` holds the (giant) row store —
+    a HostEmbeddingTable or a raw (rows, dim) ndarray.  `offsets` maps
+    per-feature local ids into the concatenated table (DLRMConfig.offsets).
+    """
+
+    def __init__(self, model, table, offsets=None, max_batch: int = 256,
+                 window_ms: float = 2.0, max_queue: int = 1024,
+                 slab_bucket: int = 256, start: bool = True):
+        from ..jit import functional_call, state_arrays
+        if isinstance(table, np.ndarray):
+            table = HostEmbeddingTable(table.shape[0], table.shape[1],
+                                       dtype=table.dtype, rows=table)
+        self.model = model
+        self.table = table
+        self.offsets = (None if offsets is None
+                        else np.asarray(offsets, np.int64).reshape(1, -1))
+        self.max_batch = int(max_batch)
+        self.window_s = float(window_ms) / 1e3
+        self.slab_bucket = int(slab_bucket)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, max_queue))
+        self._state = state_arrays(model)
+        self._d = table.embedding_dim
+        self.requests = 0
+        self.rejected = 0
+        self.batches = 0
+        self.scored = 0
+
+        def pure(state, dense, inv, slab, n_feats):
+            emb = jnp.take(slab, inv, axis=0).reshape(
+                dense.shape[0], n_feats, self._d)
+            return functional_call(model, state, dense, emb, training=False)
+
+        from ..observability import track
+        self._score = track("recsys_score",
+                            jax.jit(pure, static_argnums=(4,)))
+        self._closed = False
+        # guards the closed-check + enqueue in submit() against close():
+        # without it a submit could land AFTER close()'s drain and its
+        # response would never turn terminal
+        self._submit_lock = threading.Lock()
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name="paddle_tpu-recsys-scorer",
+                daemon=True)
+            self._thread.start()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, dense, ids) -> RecsysResponse:
+        """Enqueue one request (dense (b, dense_dim), ids (b, F)); returns
+        a RecsysResponse.  A full queue or a closed predictor yields an
+        already-terminal FAILED response (gateway admission semantics) —
+        never an exception on the submit path."""
+        resp = RecsysResponse()
+        self.requests += 1
+        item = (np.asarray(dense), np.asarray(ids), resp)
+        with self._submit_lock:
+            if self._closed:
+                resp._finish(error="recsys predictor is closed")
+                return resp
+            try:
+                self._queue.put_nowait(item)
+            except queue.Full:
+                self.rejected += 1
+                from ..utils.monitor import stat_add
+                stat_add("STAT_embedding_serving_rejects")
+                resp._finish(error="recsys scoring queue full (shed)")
+        return resp
+
+    def predict(self, dense, ids, timeout: float = 30.0) -> np.ndarray:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(dense, ids).result(timeout)
+
+    # -- scorer loop ---------------------------------------------------------
+    def _drain_window(self):
+        try:
+            first = self._queue.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        items = [first]
+        deadline = time.perf_counter() + self.window_s
+        rows = first[0].shape[0]
+        while rows < self.max_batch and time.perf_counter() < deadline:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                time.sleep(self.window_s / 10)
+                continue
+            items.append(item)
+            rows += item[0].shape[0]
+        return items
+
+    def _loop(self):
+        while not self._closed:
+            items = self._drain_window()
+            if not items:
+                continue
+            try:
+                self._score_batch(items)
+            except Exception as e:  # terminal per-request, loop survives
+                for _, _, resp in items:
+                    if not resp.done:
+                        resp._finish(error=f"scoring failed: "
+                                           f"{type(e).__name__}: {e}")
+
+    def _score_batch(self, items):
+        from ..utils.monitor import stat_add
+        dense = np.concatenate([d for d, _, _ in items], axis=0)
+        ids = np.concatenate([i for _, i, _ in items], axis=0)
+        if self.offsets is not None:
+            ids = ids.astype(np.int64) + self.offsets
+        n, f = ids.shape
+        # ONE dedup across every merged request — the batched PS pull
+        uids, inv = np.unique(ids.reshape(-1), return_inverse=True)
+        stat_add("STAT_embedding_rows_gathered", int(ids.size))
+        stat_add("STAT_embedding_rows_unique", int(uids.size))
+        cap = _round_bucket(len(uids), self.slab_bucket)
+        slab = np.zeros((cap, self._d), self.table.rows.dtype)
+        slab[:len(uids)] = self.table.rows[uids]
+        stat_add("STAT_embedding_host_to_device_bytes", int(slab.nbytes))
+        # pad the merged batch to a bucket so compile count stays bounded
+        bcap = _round_bucket(n, 16)
+        if bcap != n:
+            dense = np.concatenate(
+                [dense, np.zeros((bcap - n,) + dense.shape[1:],
+                                 dense.dtype)], axis=0)
+            inv = np.concatenate(
+                [inv, np.zeros((bcap - n) * f, inv.dtype)])
+        out = self._score(self._state, jnp.asarray(dense),
+                          jnp.asarray(inv.astype(np.int32)),
+                          jnp.asarray(slab), f)
+        scores = np.asarray(out)[:n]
+        self.batches += 1
+        self.scored += n
+        at = 0
+        for d, _, resp in items:
+            b = d.shape[0]
+            resp._finish(scores=scores[at:at + b])
+            at += b
+
+    # -- lifecycle -----------------------------------------------------------
+    def metrics(self) -> dict:
+        total = self.requests
+        return {"requests": total, "rejected": self.rejected,
+                "batches": self.batches, "scored": self.scored,
+                "mean_merge": (self.scored / self.batches
+                               if self.batches else None),
+                "queue_depth": self._queue.qsize()}
+
+    def close(self):
+        with self._submit_lock:
+            self._closed = True
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        # drain: every queued request gets a terminal response
+        while True:
+            try:
+                _, _, resp = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not resp.done:
+                resp._finish(error="recsys predictor closed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

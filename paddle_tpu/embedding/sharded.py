@@ -1,0 +1,75 @@
+"""ShardedEmbedding — a mesh row-sharded device embedding table.
+
+Reference: distributed_lookup_table_op + the Fleet sparse table split across
+parameter-server shards (SURVEY.md L2/L8).  TPU-native: the "servers" are
+mesh shards — the weight lives row-sharded over one mesh axis
+(parallel.sharding.row_spec), the forward gather dedups lookup ids and
+psum-assembles only the live rows, and the sparse gradient feeds the lazy
+row-wise optimizer update PER SHARD (embedding.functional
+.sharded_lazy_row_update): no densify, no all-gather of the table, writes
+strictly local to each shard.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding
+
+from ..core.errors import enforce
+from ..nn.layer_base import Layer
+from ..nn import initializer as I
+from . import functional as EF
+
+
+class ShardedEmbedding(Layer):
+    """Embedding whose (num_embeddings, embedding_dim) weight is row-sharded
+    over `axis` of `mesh` (default: the global mesh's "tp" axis).
+
+    Gradients always flow as RowSparseGrad through the sparse channel
+    (sparse=True semantics — the whole point of the layer); the same
+    restriction applies: the weight must only be consumed via this lookup.
+    With no mesh (or axis size 1) the layer degrades to a single-shard
+    deduped-gather embedding, so model code is mesh-agnostic.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 mesh=None, axis: str = "tp", padding_idx: Optional[int] = None,
+                 weight_attr=None, name=None):
+        super().__init__()
+        if mesh is None:
+            from ..parallel.mesh import get_mesh
+            mesh = get_mesh()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = (None if padding_idx is None else
+                            padding_idx if padding_idx >= 0 else
+                            num_embeddings + padding_idx)
+        self.mesh = mesh
+        self.axis = axis
+        nshards = mesh.shape.get(axis, 1) if mesh is not None else 1
+        enforce(num_embeddings % max(1, nshards) == 0,
+                f"ShardedEmbedding: num_embeddings {num_embeddings} must "
+                f"divide evenly over mesh axis {axis!r} (size {nshards})")
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0))
+        self.weight.sparse_grad = True
+        if nshards > 1:
+            from ..parallel.sharding import row_spec
+            self.weight.row_shard_axis = axis
+            self.weight.row_shard_mesh = mesh
+            self.weight._set_data(jax.device_put(
+                self.weight._data, NamedSharding(mesh, row_spec(axis))))
+        if self.padding_idx is not None:
+            self.weight._set_data(
+                self.weight._data.at[self.padding_idx].set(0.0))
+
+    def forward(self, x):
+        return EF.sharded_lookup(x, self.weight, padding_idx=self.padding_idx)
+
+    def extra_repr(self):
+        nshards = (self.mesh.shape.get(self.axis, 1)
+                   if self.mesh is not None else 1)
+        return (f"{self.num_embeddings}, {self.embedding_dim}, "
+                f"axis={self.axis!r}, shards={nshards}")

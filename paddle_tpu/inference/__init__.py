@@ -39,6 +39,7 @@ class Config:
         self._device = "tpu"
         self._threads = 1
         self._serving = None
+        self._recsys = None
 
     # -- model ----------------------------------------------------------------
     def set_model(self, model_path: str, params_path: Optional[str] = None):
@@ -169,6 +170,35 @@ class Config:
 
     def serving_enabled(self) -> bool:
         return self._serving is not None
+
+    def enable_recsys_serving(self, model=None, table=None, offsets=None,
+                              **opts):
+        """Switch create_predictor() to the batched deduped-lookup recsys
+        scorer (embedding.RecsysPredictor).
+
+        model    an external-embedding-mode Layer (e.g. models.DLRM with
+                 embedding="external"): forward(dense, emb_rows)
+        table    the row store — embedding.HostEmbeddingTable or a raw
+                 (rows, dim) ndarray (host-resident: bigger than device
+                 memory is the point)
+        offsets  per-feature offsets into the concatenated table
+                 (models.DLRMConfig.offsets)
+
+        opts pass through to RecsysPredictor (max_batch, window_ms,
+        max_queue, slab_bucket).  Concurrent submit()s are merged into one
+        forward with ONE id-dedup + row fetch across all of them; a full
+        queue rejects with a typed terminal response — the PR-6 gateway's
+        admission contract applied to scoring traffic.
+        """
+        if model is None or table is None:
+            raise ValueError(
+                "enable_recsys_serving needs model= (external-embedding "
+                "Layer) and table= (HostEmbeddingTable or ndarray)")
+        self._recsys = {"model": model, "table": table, "offsets": offsets,
+                        **opts}
+
+    def recsys_enabled(self) -> bool:
+        return self._recsys is not None
 
     # -- profiling ------------------------------------------------------------
     def enable_profile(self):
@@ -441,6 +471,9 @@ class ServingPredictor:
 
 
 def create_predictor(config: Config):
+    if config.recsys_enabled():
+        from ..embedding import RecsysPredictor
+        return RecsysPredictor(**config._recsys)
     if config.serving_enabled():
         return ServingPredictor(config)
     return Predictor(config)

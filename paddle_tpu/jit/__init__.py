@@ -361,12 +361,22 @@ class TrainStep:
         # RowSparseGrad through the zeros-cotangent channel (selected_rows.py)
         self._sparse = {k for k, v in model.state_dict().items()
                         if getattr(v, "sparse_grad", False)}
+        # row-sharded tables (embedding.ShardedEmbedding): their sparse
+        # grads take the per-shard lazy update inside the compiled step
+        self._row_shard = {
+            k: (v.row_shard_axis, v.row_shard_mesh)
+            for k, v in model.state_dict().items()
+            if getattr(v, "row_shard_axis", None) is not None
+            and getattr(v, "row_shard_mesh", None) is not None}
         if self.accum_steps > 1 and self._sparse:
             raise NotImplementedError(
-                "TrainStep(accum_steps>1) with Embedding(sparse=True): "
-                "per-micro-batch RowSparseGrads would need a row-union "
-                "merge inside the scan — densify the embedding or run "
-                "accum_steps=1")
+                f"TrainStep(accum_steps={self.accum_steps}) does not "
+                f"compose with sparse-grad embedding weights "
+                f"{sorted(self._sparse)}: per-micro-batch RowSparseGrads "
+                "would need a row-union merge inside the accumulation "
+                "scan.  Rebuild the offending Embedding/ShardedEmbedding "
+                "layers with sparse=False (dense grads accumulate fine) "
+                "or run accum_steps=1")
         self._sig_cache = {}
         self._sparse_checked = False
         # param names demoted to DENSE grads (tied weights): sparse grads
@@ -589,7 +599,8 @@ class TrainStep:
                                              name_to_key)
             grads = _faults.poison_grads(grads, step_no)
             new_params, new_opt = apply_updates(
-                opt, params, grads, opt_state, lr, step_no, decay)
+                opt, params, grads, opt_state, lr, step_no, decay,
+                row_shard=self._row_shard)
             new_params.update(bufs)
             if guard:
                 new_params, new_opt, gnorm, ok = guard_select(
@@ -701,7 +712,8 @@ class TrainStep:
                 from ..utils import faults as _faults
                 grads = _faults.poison_grads(grads, step_no0 + i)
                 new_params, new_opt = apply_updates(
-                    opt, params, grads, opt_state, lr, step_no0 + i, decay)
+                    opt, params, grads, opt_state, lr, step_no0 + i, decay,
+                    row_shard=self._row_shard)
                 new_params.update(bufs)
                 return (new_params, new_opt, i + 1), loss
 
@@ -747,6 +759,8 @@ class TrainStep:
                     self._build_multi_sparse(state, one)
         if self._compiled_multi is None:
             self._compiled_multi = self._build_multi()
+        state, self._opt_state, raw = self._place_for_row_shard(
+            state, self._opt_state, raw)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         step_no0 = jnp.asarray(self.optimizer._step_count + 1, jnp.int32)
         from ..core import rng as _rng
@@ -758,6 +772,30 @@ class TrainStep:
         for k, v in new_state.items():
             sd[k]._set_data(v)
         return Tensor(losses)
+
+    def _place_for_row_shard(self, state, opt_state, raw_batch):
+        """With a mesh row-sharded table among the params, every input of
+        the compiled step must live on the mesh's device set (the per-shard
+        update is a shard_map): replicate anything not already there.  The
+        sharded table (and, after the first step, its moments) keeps its
+        row sharding — device_put is skipped for leaves already on the
+        mesh."""
+        if not self._row_shard:
+            return state, opt_state, raw_batch
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = next(iter(self._row_shard.values()))[1]
+        rep = NamedSharding(mesh, P())
+
+        def place(x):
+            s = getattr(x, "sharding", None)
+            if s is not None and getattr(s, "device_set", None) \
+                    == rep.device_set:
+                return x
+            return jax.device_put(x, rep)
+
+        return (jax.tree_util.tree_map(place, state),
+                jax.tree_util.tree_map(place, opt_state),
+                jax.tree_util.tree_map(place, raw_batch))
 
     def _ensure_compiled(self, state, batch):
         """Resolve the compiled step for this batch signature (the sparse
@@ -794,6 +832,8 @@ class TrainStep:
             self._opt_state = self.init_opt_state(state)
         compiled_fn = self._ensure_compiled(state, batch)
         raw_batch = tuple(unwrap(b) for b in batch)
+        state, self._opt_state, raw_batch = self._place_for_row_shard(
+            state, self._opt_state, raw_batch)
         did = warm_step_program(compiled_fn, state, self._opt_state,
                                 self.optimizer, raw_batch)
         return {"seconds": _time.perf_counter() - t0, "compiled": did}
@@ -814,6 +854,8 @@ class TrainStep:
         from ..core import rng as _rng
         rng_key = _rng.next_key()  # fresh per step: dropout masks differ
         raw_batch = tuple(unwrap(b) for b in batch)
+        state, self._opt_state, raw_batch = self._place_for_row_shard(
+            state, self._opt_state, raw_batch)
         out = self._compiled(
             state, self._opt_state, step_no, lr, rng_key, raw_batch)
         if self._guard:

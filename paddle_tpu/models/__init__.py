@@ -11,3 +11,5 @@ from .ernie import (ErnieConfig, ErnieModel, ErnieForPretraining,  # noqa: F401
                     ErniePretrainingCriterion,
                     ErnieForSequenceClassification,
                     ernie_base_config, ernie_large_config)
+from .dlrm import (DLRMConfig, DLRM, DLRMCriterion,  # noqa: F401
+                   dlrm_tiny_config)

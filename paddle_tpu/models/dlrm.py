@@ -1,0 +1,134 @@
+"""DLRM-style CTR model: dense MLP bottom + N sparse embedding features +
+pairwise-dot interaction + top MLP.
+
+Reference lineage: the reference framework's recsys heart — CTR models over
+distributed lookup tables (SURVEY.md L2/L8) — in the DLRM shape (Naumov et
+al.) the modern benchmarks standardized on.  One CONCATENATED table holds
+every sparse feature's vocab (per-feature id offsets into it), which is what
+makes the table giant and the embedding strategy the interesting choice:
+
+  embedding="sparse"   nn.Embedding(sparse=True): single-device table,
+                       RowSparseGrad lazy updates — the parity oracle.
+  embedding="dense"    nn.Embedding(sparse=False): dense grads; the only
+                       mode that composes with TrainStep(accum_steps>1).
+  embedding="sharded"  embedding.ShardedEmbedding: rows sharded over a mesh
+                       axis, per-shard lazy updates.
+  embedding="external" no table parameter at all — forward takes the
+                       already-gathered (B, F, D) rows, the host-resident
+                       HostEmbeddingTable/HostPrefetchPipeline contract.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap
+from .. import nn
+from ..nn import functional as F
+from ..tensor.linalg import matmul
+from ..tensor.manipulation import concat, index_select, reshape, unsqueeze
+
+
+class DLRMConfig:
+    def __init__(self, dense_dim: int = 4,
+                 vocab_sizes: Sequence[int] = (64, 64, 64, 64),
+                 embedding_dim: int = 8,
+                 bottom_mlp: Sequence[int] = (16,),
+                 top_mlp: Sequence[int] = (16,)):
+        self.dense_dim = int(dense_dim)
+        self.vocab_sizes = tuple(int(v) for v in vocab_sizes)
+        self.embedding_dim = int(embedding_dim)
+        self.bottom_mlp = tuple(int(h) for h in bottom_mlp)
+        self.top_mlp = tuple(int(h) for h in top_mlp)
+
+    @property
+    def num_features(self) -> int:
+        return len(self.vocab_sizes)
+
+    @property
+    def total_rows(self) -> int:
+        return sum(self.vocab_sizes)
+
+    @property
+    def offsets(self) -> np.ndarray:
+        """Per-feature row offsets into the concatenated table."""
+        return np.concatenate(
+            [[0], np.cumsum(self.vocab_sizes[:-1])]).astype(np.int64)
+
+
+def _mlp(sizes):
+    layers = []
+    for i in range(len(sizes) - 1):
+        layers.append(nn.Linear(sizes[i], sizes[i + 1]))
+        if i < len(sizes) - 2:
+            layers.append(nn.ReLU())
+    return nn.Sequential(*layers)
+
+
+class DLRM(nn.Layer):
+    """forward(dense_x, sparse) -> logits (B, 1).
+
+    `sparse` is int ids of shape (B, F) for the table-owning modes, or the
+    pre-gathered float rows (B, F, D) for embedding="external"."""
+
+    def __init__(self, config: DLRMConfig, embedding: str = "sparse",
+                 mesh=None, axis: str = "tp"):
+        super().__init__()
+        self.config = config
+        self.embedding_mode = embedding
+        d = config.embedding_dim
+        f = config.num_features
+        self.bottom = _mlp((config.dense_dim,) + config.bottom_mlp + (d,))
+        if embedding == "external":
+            self.table = None
+        elif embedding == "sharded":
+            from ..embedding import ShardedEmbedding
+            self.table = ShardedEmbedding(config.total_rows, d, mesh=mesh,
+                                          axis=axis)
+        elif embedding in ("sparse", "dense"):
+            self.table = nn.Embedding(config.total_rows, d,
+                                      sparse=(embedding == "sparse"))
+        else:
+            raise ValueError(
+                f"DLRM: unknown embedding mode {embedding!r}; expected "
+                "'sparse', 'dense', 'sharded' or 'external'")
+        # pairwise-dot interaction over F embeddings + the bottom output,
+        # then the concatenated [bottom, upper-triangle dots] feeds the top
+        self._n_vec = f + 1
+        iu, ju = np.triu_indices(self._n_vec, k=1)
+        self._pair_idx = (iu * self._n_vec + ju).astype(np.int64)
+        top_in = d + len(iu)
+        self.top = _mlp((top_in,) + config.top_mlp + (1,))
+
+    def forward(self, dense_x, sparse):
+        b = self.bottom(dense_x)                       # (B, D)
+        if self.table is None:
+            emb = sparse                               # (B, F, D) pre-gathered
+        else:
+            ids = sparse + Tensor(jnp.asarray(self.config.offsets)
+                                  .reshape(1, -1))
+            emb = self.table(ids)                      # (B, F, D)
+        z = concat([unsqueeze(b, 1), emb], axis=1)     # (B, F+1, D)
+        dots = matmul(z, z, transpose_y=True)          # (B, F+1, F+1)
+        flat = reshape(dots, (-1, self._n_vec * self._n_vec))
+        inter = index_select(flat, Tensor(jnp.asarray(self._pair_idx)),
+                             axis=1)                   # (B, F*(F+1)/2)
+        x = concat([b, inter], axis=1)
+        return self.top(x)
+
+
+class DLRMCriterion(nn.Layer):
+    """Click-through loss: mean sigmoid BCE over the (B, 1) logits."""
+
+    def forward(self, logits, label):
+        label = Tensor(unwrap(label).astype(unwrap(logits).dtype))
+        return F.binary_cross_entropy_with_logits(
+            logits, label.reshape(unwrap(logits).shape))
+
+
+def dlrm_tiny_config() -> DLRMConfig:
+    """Test/smoke config: fits the 8-virtual-device CPU mesh."""
+    return DLRMConfig(dense_dim=4, vocab_sizes=(64, 64, 64, 64),
+                      embedding_dim=8, bottom_mlp=(16,), top_mlp=(16,))

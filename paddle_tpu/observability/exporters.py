@@ -176,6 +176,29 @@ def report() -> dict:
         "preemptions": stats.get("STAT_gateway_preemptions", 0),
         "resumes": stats.get("STAT_gateway_resumes", 0),
     }
+    gathered = stats.get("STAT_embedding_rows_gathered", 0)
+    unique = stats.get("STAT_embedding_rows_unique", 0)
+    pf_hits = stats.get("STAT_embedding_prefetch_hits", 0)
+    pf_misses = stats.get("STAT_embedding_prefetch_misses", 0)
+    embedding = {
+        "prefetch_wait_seconds":
+            _hist_summary("embedding_prefetch_wait_seconds"),
+        "device_table_bytes": _gauge_value("embedding_device_table_bytes"),
+        "rows_gathered": gathered,
+        "rows_unique": unique,
+        "dedup_ratio": (gathered / unique) if unique else None,
+        "prefetch_hits": pf_hits,
+        "prefetch_misses": pf_misses,
+        "prefetch_hit_rate": (pf_hits / (pf_hits + pf_misses)
+                              if (pf_hits + pf_misses) else None),
+        "host_to_device_bytes":
+            stats.get("STAT_embedding_host_to_device_bytes", 0),
+        "device_to_host_bytes":
+            stats.get("STAT_embedding_device_to_host_bytes", 0),
+        "corrupt_rows_detected":
+            stats.get("STAT_embedding_corrupt_rows_detected", 0),
+        "serving_rejects": stats.get("STAT_embedding_serving_rejects", 0),
+    }
     # program lifecycle: the persistent program store + the AOT-fallback
     # line (a TrackedJit that silently downgraded used to be invisible)
     try:
@@ -194,6 +217,7 @@ def report() -> dict:
         "train": train,
         "serving": serving,
         "gateway": gateway,
+        "embedding": embedding,
         "programs": get_program_registry().snapshot(),
         "program_store": program_store,
         "programs_aot_fallbacks": fallbacks,

@@ -19,10 +19,15 @@ def decay_flags(optimizer, names) -> Dict[str, bool]:
 
 def apply_updates(optimizer, params: dict, grads: dict, opt_state: dict,
                   lr, step_no, decay: Dict[str, bool],
-                  lr_mults: Dict[str, float] = None):
+                  lr_mults: Dict[str, float] = None,
+                  row_shard: Dict[str, tuple] = None):
     """Pure: returns (new_params, new_opt_state) for the keys in `grads`.
 
-    Params without grads pass through unchanged.
+    Params without grads pass through unchanged.  `row_shard` maps param
+    names to (mesh_axis, mesh) for mesh row-sharded embedding tables
+    (embedding.ShardedEmbedding): their RowSparseGrads take the per-shard
+    lazy update (each shard touches only its own rows) instead of the
+    whole-table one.
     """
     from ..core.selected_rows import RowSparseGrad
     from .sparse import lazy_row_update
@@ -38,7 +43,16 @@ def apply_updates(optimizer, params: dict, grads: dict, opt_state: dict,
                 g = g.to_dense()  # Lamb/Lars need full-tensor norms
             else:
                 # SelectedRows path: lazy row-wise update (adam_op.h
-                # lazy_mode)
+                # lazy_mode); row-sharded tables update per mesh shard
+                rs = (row_shard or {}).get(k)
+                if rs is not None:
+                    from ..embedding.functional import sharded_lazy_row_update
+                    axis, mesh = rs
+                    new_params[k], new_opt[k] = sharded_lazy_row_update(
+                        optimizer, p, g, opt_state[k], lr, step_no, axis,
+                        mesh, decay.get(k, True),
+                        (lr_mults or {}).get(k, 1.0))
+                    continue
                 new_params[k], new_opt[k] = lazy_row_update(
                     optimizer, p, g, opt_state[k], lr, step_no,
                     decay.get(k, True), (lr_mults or {}).get(k, 1.0))

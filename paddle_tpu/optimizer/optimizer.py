@@ -126,12 +126,26 @@ class Optimizer:
                 db = self._decay_applies(getattr(p, "name", None))
                 oa = getattr(p, "optimize_attr", None)
                 m = float(oa.get("learning_rate", 1.0)) if oa else 1.0
-                key = ("sparse", db, m)
+                axis = getattr(p, "row_shard_axis", None)
+                mesh = getattr(p, "row_shard_mesh", None)
+                key = ("sparse", db, m, axis, id(mesh) if mesh else None)
                 fn = self._jit_cache.get(key)
                 if fn is None:
-                    fn = self._jit_cache[key] = jax.jit(
-                        lambda pv, gv, sv, lrv, stv, _db=db, _m=m:
-                        lazy_row_update(self, pv, gv, sv, lrv, stv, _db, _m))
+                    if axis is not None and mesh is not None:
+                        # mesh row-sharded table: per-shard lazy update
+                        from ..embedding.functional import \
+                            sharded_lazy_row_update
+                        fn = jax.jit(
+                            lambda pv, gv, sv, lrv, stv, _db=db, _m=m,
+                            _ax=axis, _me=mesh:
+                            sharded_lazy_row_update(self, pv, gv, sv, lrv,
+                                                    stv, _ax, _me, _db, _m))
+                    else:
+                        fn = jax.jit(
+                            lambda pv, gv, sv, lrv, stv, _db=db, _m=m:
+                            lazy_row_update(self, pv, gv, sv, lrv, stv,
+                                            _db, _m))
+                    self._jit_cache[key] = fn
                 new_p, ns = fn(p._data, g, self._get_state(p), lr, step)
                 p._set_data(new_p)
                 self._states[id(p)] = ns

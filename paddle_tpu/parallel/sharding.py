@@ -37,6 +37,13 @@ _EMB_W = re.compile(r"(word|position|token_type|task_type)_embeddings\.weight$")
 _EXPERT = re.compile(r"experts?_(w1|b1|w2|b2)$|\.experts\.")
 
 
+def row_spec(axis: str, ndim: int = 2) -> P:
+    """Row-sharded spec: leading dim over `axis`, the rest replicated —
+    the layout of embedding.ShardedEmbedding tables (and their row-wise
+    optimizer-moment leaves via state_sharding_like)."""
+    return P(*((axis,) + (None,) * (ndim - 1)))
+
+
 def ep_spec(name: str, shape) -> Optional[P]:
     """Expert-parallel PartitionSpec: shard the leading (expert) dim."""
     if _EXPERT.search(name) and len(shape) >= 1:

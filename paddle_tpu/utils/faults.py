@@ -28,6 +28,22 @@ Injection points (consumed elsewhere in the framework):
   backend_down    the bench backend probe reports the accelerator tunnel
                   unreachable without waiting out a real timeout.
                   Env: PDTPU_FAULT_BACKEND_DOWN="1".
+  prefetch_stall  the host-embedding-table prefetch worker sleeps `ms`
+                  milliseconds before every `every_n`-th row fetch
+                  (default every fetch) — a slow host memory system /
+                  storage tier.  Purely host-side and consulted live per
+                  fetch, so it can be armed on a running pipeline.  The
+                  async prefetch pipeline must degrade to synchronous-
+                  fetch throughput (the consumer waits; prefetch misses
+                  climb) WITHOUT changing any training result.
+                  Env: PDTPU_FAULT_PREFETCH_STALL="ms[:every_n]".
+  row_corrupt     poison ONE row (NaN) of the N-th (1-based) fetched row
+                  slab AFTER it leaves the host table — a torn DMA /
+                  bit-flipped transfer.  The pipeline's consume-side
+                  finiteness verify must detect the poisoned copy and
+                  refetch from the host table (the source of truth is
+                  untouched), so training stays bit-identical to a clean
+                  run.  Env: PDTPU_FAULT_ROW_CORRUPT="N".
   nan_logits      the serving engine's compiled decode step poisons the
                   logits of the request with submission sequence number N
                   (0-based) with NaN, exercising the engine's per-slot
@@ -80,7 +96,9 @@ __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "poison_grads", "worker_crash_config", "maybe_crash_worker",
            "maybe_kill_mid_save", "backend_down", "nan_logits_request",
            "poison_logits", "slow_decode_config", "maybe_slow_decode",
-           "draft_diverge_every", "poison_draft_logits", "kv_exhaust_cap"]
+           "draft_diverge_every", "poison_draft_logits", "kv_exhaust_cap",
+           "prefetch_stall_config", "maybe_stall_prefetch",
+           "row_corrupt_fetch"]
 
 _ENV = {
     "nan_grads": "PDTPU_FAULT_NAN_GRADS",
@@ -91,6 +109,8 @@ _ENV = {
     "slow_decode": "PDTPU_FAULT_SLOW_DECODE",
     "draft_diverge": "PDTPU_FAULT_DRAFT_DIVERGE",
     "kv_exhaust": "PDTPU_FAULT_KV_EXHAUST",
+    "prefetch_stall": "PDTPU_FAULT_PREFETCH_STALL",
+    "row_corrupt": "PDTPU_FAULT_ROW_CORRUPT",
 }
 
 _lock = threading.Lock()
@@ -314,6 +334,49 @@ def kv_exhaust_cap() -> Optional[int]:
     if not raw:
         return None
     return max(0, int(raw))
+
+
+# -- prefetch_stall ----------------------------------------------------------
+
+def prefetch_stall_config() -> Optional[Tuple[float, int]]:
+    """(sleep_ms, every_n) or None when disarmed.  Consulted live per host
+    row fetch (host-side only; nothing baked into any trace), so a running
+    prefetch pipeline reacts to arm/disarm immediately."""
+    raw = get("prefetch_stall")
+    if not raw:
+        return None
+    parts = raw.split(":", 1)
+    ms = float(parts[0])
+    every = int(parts[1]) if len(parts) == 2 else 1
+    return ms, max(1, every)
+
+
+def maybe_stall_prefetch(fetch_no: int) -> float:
+    """Host-side sleep before fetch number `fetch_no` (0-based) when
+    prefetch_stall is armed and the stride hits.  Returns seconds slept."""
+    cfg = prefetch_stall_config()
+    if cfg is None:
+        return 0.0
+    ms, every = cfg
+    if fetch_no % every:
+        return 0.0
+    import time
+    secs = ms / 1000.0
+    time.sleep(secs)
+    return secs
+
+
+# -- row_corrupt -------------------------------------------------------------
+
+def row_corrupt_fetch() -> Optional[int]:
+    """1-based fetch number whose prefetched row slab gets one row
+    poisoned with NaN (the fetched COPY, never the host table), or None
+    when disarmed.  The pipeline's consume-side verify must detect the
+    poison and refetch."""
+    raw = get("row_corrupt")
+    if not raw:
+        return None
+    return int(raw)
 
 
 # -- backend_down ------------------------------------------------------------

@@ -1,0 +1,330 @@
+"""ISSUE-11 acceptance probe: the recommender workload on the embedding
+subsystem.
+
+Three legs, one RECSYS{json} line on stdout:
+
+1. **sharded-device** — a DLRM with its concatenated table row-sharded
+   over the 8-virtual-device CPU mesh ("tp") trains LOSS-BIT-IDENTICAL to
+   the single-device Embedding(sparse=True) oracle (same init, same
+   batches, same rng stream).
+2. **host-resident** — a DLRM whose table (rows + adam moments in host
+   RAM) exceeds the device table budget trains through the
+   HostPrefetchPipeline; async double-buffered prefetch must reach
+   >= --bar x the rows/sec of synchronous fetch (bar 1.5 by default; the
+   --smoke run only checks mechanics).  Publishes rows_per_sec,
+   prefetch_hit_rate, peak_device_table_bytes.
+3. **SIGKILL resume** — a child process training the host leg with
+   periodic checkpoints (table rows + moments + data cursor) is SIGKILLed
+   mid-run; a fresh process resumes from the checkpoint and must finish
+   with BIT-IDENTICAL final params/rows/moments to an uninterrupted run.
+
+Run:  python probes/recsys_probe.py [--smoke]
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+
+
+def _sizes(smoke: bool):
+    if smoke:
+        return dict(vocab=512, n_feats=4, dim=8, batch=64, steps=6,
+                    device_budget=64 * 1024)
+    return dict(vocab=24_000, n_feats=8, dim=64, batch=1024, steps=14,
+                device_budget=8 * 1024 * 1024)
+
+
+def _make_batch_fn(cfg, batch, seed0=1000):
+    """Deterministic, index-keyed stream (resume fast-forwards by index).
+    20% of lookups hit a hot head per feature, so the dedup/working-set
+    story is realistic rather than uniform."""
+    import numpy as np
+    f = cfg.num_features
+    vocab = cfg.vocab_sizes[0]
+
+    def batch_fn(i):
+        rng = np.random.RandomState(seed0 + i)
+        dense = rng.randn(batch, cfg.dense_dim).astype("float32")
+        ids = rng.randint(0, vocab, (batch, f))
+        hot = rng.rand(batch, f) < 0.2
+        ids = np.where(hot, rng.randint(0, max(2, vocab // 200),
+                                        (batch, f)), ids).astype("int64")
+        label = rng.randint(0, 2, (batch, 1)).astype("float32")
+        return dense, ids, label
+    return batch_fn
+
+
+def _dlrm_cfg(s):
+    from paddle_tpu.models import DLRMConfig
+    return DLRMConfig(dense_dim=8, vocab_sizes=(s["vocab"],) * s["n_feats"],
+                      embedding_dim=s["dim"], bottom_mlp=(32,),
+                      top_mlp=(32,))
+
+
+# ---------------------------------------------------------------------------
+# leg 1: sharded-device parity
+# ---------------------------------------------------------------------------
+
+def leg_sharded(smoke: bool) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import jit as pjit
+    from paddle_tpu.models import DLRM, DLRMCriterion, DLRMConfig
+    from paddle_tpu.parallel.mesh import create_mesh
+
+    cfg = DLRMConfig(dense_dim=8, vocab_sizes=(256,) * 4, embedding_dim=16,
+                     bottom_mlp=(32,), top_mlp=(32,))
+    batch_fn = _make_batch_fn(cfg, 64)
+    steps = 3 if smoke else 6
+
+    paddle.seed(0)
+    oracle = DLRM(cfg, embedding="sparse")
+    init = {k: np.asarray(v._data) for k, v in oracle.state_dict().items()}
+    opt1 = paddle.optimizer.Adam(0.01, parameters=oracle.parameters())
+    step1 = pjit.TrainStep(oracle, DLRMCriterion(), opt1)
+
+    mesh = create_mesh({"tp": 8})
+    paddle.seed(0)
+    sharded = DLRM(cfg, embedding="sharded", mesh=mesh)
+    sd = sharded.state_dict()
+    for k, v in init.items():
+        sd[k]._set_data(jax.device_put(jnp.asarray(v), sd[k]._data.sharding)
+                        if k == "table.weight" else jnp.asarray(v))
+    opt2 = paddle.optimizer.Adam(0.01, parameters=sharded.parameters())
+    step2 = pjit.TrainStep(sharded, DLRMCriterion(), opt2)
+
+    batches = [batch_fn(i) for i in range(steps)]
+    paddle.seed(7)
+    l1 = [np.asarray(step1(*map(paddle.to_tensor, b))._data)
+          for b in batches]
+    paddle.seed(7)
+    l2 = [np.asarray(step2(*map(paddle.to_tensor, b))._data)
+          for b in batches]
+    bit = all(np.array_equal(a, b) for a, b in zip(l1, l2))
+    w_bit = np.array_equal(
+        np.asarray(oracle.state_dict()["table.weight"]._data),
+        np.asarray(sharded.state_dict()["table.weight"]._data))
+    return {"sharded_parity_bit_exact": bool(bit and w_bit),
+            "sharded_steps": steps,
+            "sharded_losses": [float(x) for x in l2]}
+
+
+# ---------------------------------------------------------------------------
+# leg 2: host-resident throughput (async vs sync fetch)
+# ---------------------------------------------------------------------------
+
+def _host_run(s, async_prefetch, steps=None):
+    import paddle_tpu as paddle
+    from paddle_tpu.embedding import (HostEmbeddingTable,
+                                      HostPrefetchPipeline,
+                                      HostTableTrainStep)
+    from paddle_tpu.models import DLRM, DLRMCriterion
+
+    cfg = _dlrm_cfg(s)
+    steps = steps or s["steps"]
+    batch_fn = _make_batch_fn(cfg, s["batch"])
+    paddle.seed(0)
+    model = DLRM(cfg, embedding="external")
+    opt = paddle.optimizer.Adam(0.01, parameters=model.parameters())
+    table = HostEmbeddingTable(cfg.total_rows, cfg.embedding_dim, seed=7)
+    step = HostTableTrainStep(model, DLRMCriterion(), opt, table)
+    pipe = HostPrefetchPipeline(table, batch_fn, steps, optimizer=opt,
+                                offsets=cfg.offsets,
+                                async_prefetch=async_prefetch)
+    warm = 2  # exclude compile + first-fill from the timed window
+    done = 0
+    t0 = None
+    losses = []
+    while True:
+        prep = pipe.next_prepared()
+        if prep is None:
+            break
+        loss, new_slab, new_states = step.run(prep, (s["batch"],
+                                                     cfg.num_features))
+        pipe.complete(prep, new_slab, new_states)
+        losses.append(float(np.asarray(loss._data)))
+        done += 1
+        if done == warm:
+            t0 = time.perf_counter()
+    dt = time.perf_counter() - t0
+    pipe.close()
+    lookups = (done - warm) * s["batch"] * cfg.num_features
+    return {"rows_per_sec": lookups / dt if dt > 0 else 0.0,
+            "losses": losses, "table_bytes": table.nbytes,
+            "metrics": pipe.metrics(),
+            "table": table}
+
+
+def leg_host(s, bar: float, smoke: bool) -> dict:
+    sync = _host_run(s, async_prefetch=False)
+    async_ = _host_run(s, async_prefetch=True)
+    speedup = (async_["rows_per_sec"] / sync["rows_per_sec"]
+               if sync["rows_per_sec"] else 0.0)
+    bit = (sync["losses"] == async_["losses"]
+           and np.array_equal(sync["table"].rows, async_["table"].rows))
+    m = async_["metrics"]
+    return {
+        "rows_per_sec": round(async_["rows_per_sec"], 1),
+        "rows_per_sec_sync": round(sync["rows_per_sec"], 1),
+        "async_speedup": round(speedup, 3),
+        "prefetch_hit_rate": m["hit_rate"],
+        "peak_device_table_bytes": m["peak_device_table_bytes"],
+        "table_bytes": async_["table_bytes"],
+        "device_budget_bytes": s["device_budget"],
+        "host_async_bit_identical_to_sync": bool(bit),
+    }
+
+
+# ---------------------------------------------------------------------------
+# leg 3: SIGKILL resume (child process mode)
+# ---------------------------------------------------------------------------
+
+def child_main(args):
+    """Train the host leg with periodic checkpoints; print STEPDONE lines
+    (the parent kills on one of them); dump the final state as npz."""
+    import paddle_tpu as paddle
+    from paddle_tpu.embedding import (HostEmbeddingTable,
+                                      HostPrefetchPipeline,
+                                      HostTableTrainStep)
+    from paddle_tpu.models import DLRM, DLRMCriterion
+
+    s = _sizes(args.smoke)
+    s = dict(s, vocab=min(s["vocab"], 2048), batch=min(s["batch"], 128))
+    cfg = _dlrm_cfg(s)
+    batch_fn = _make_batch_fn(cfg, s["batch"])
+    paddle.seed(0)
+    model = DLRM(cfg, embedding="external")
+    opt = paddle.optimizer.Adam(0.01, parameters=model.parameters())
+    table = HostEmbeddingTable(cfg.total_rows, cfg.embedding_dim, seed=7)
+    step = HostTableTrainStep(model, DLRMCriterion(), opt, table)
+    start = 0
+    meta = step.restore_checkpoint(args.ckpt)
+    if meta is not None:
+        start = meta["data_cursor"]["batch_index"]
+        print(f"RESUMED {start}", flush=True)
+    pipe = HostPrefetchPipeline(table, batch_fn, args.steps, optimizer=opt,
+                                offsets=cfg.offsets, start_index=start)
+    while True:
+        prep = pipe.next_prepared()
+        if prep is None:
+            break
+        loss, new_slab, new_states = step.run(prep, (s["batch"],
+                                                     cfg.num_features))
+        pipe.complete(prep, new_slab, new_states)
+        if (prep.index + 1) % args.save_every == 0:
+            step.save_checkpoint(args.ckpt, pipeline=pipe)
+        print(f"STEPDONE {prep.index}", flush=True)
+    pipe.close()
+    out = {"rows": table.rows}
+    out.update({f"m_{k}": v for k, v in table.opt_slabs.items()})
+    out.update({f"p_{k}": np.asarray(v._data)
+                for k, v in model.state_dict().items()})
+    np.savez(args.out, **out)
+    print("CHILD_DONE", flush=True)
+
+
+def _spawn_child(ckpt, out, steps, save_every, smoke, kill_after=None):
+    cmd = [sys.executable, os.path.abspath(__file__), "--child",
+           "--ckpt", ckpt, "--out", out, "--steps", str(steps),
+           "--save-every", str(save_every)]
+    if smoke:
+        cmd.append("--smoke")
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True,
+                            env=dict(os.environ),
+                            cwd=os.path.dirname(os.path.dirname(
+                                os.path.abspath(__file__))))
+    killed = False
+    for line in proc.stdout:
+        line = line.strip()
+        if kill_after is not None and line == f"STEPDONE {kill_after}":
+            os.kill(proc.pid, signal.SIGKILL)  # no cleanup — the real thing
+            killed = True
+            break
+    proc.stdout.close()
+    proc.wait()
+    return killed or proc.returncode == 0
+
+
+def leg_resume(smoke: bool) -> dict:
+    steps, save_every = (8, 2) if smoke else (12, 3)
+    kill_after = steps // 2  # after a checkpoint landed, before the end
+    with tempfile.TemporaryDirectory() as tmp:
+        ref_out = os.path.join(tmp, "ref.npz")
+        got_out = os.path.join(tmp, "got.npz")
+        ok1 = _spawn_child(os.path.join(tmp, "ck_ref"), ref_out, steps,
+                           save_every, smoke)
+        ok2 = _spawn_child(os.path.join(tmp, "ck"), got_out, steps,
+                           save_every, smoke, kill_after=kill_after)
+        ok3 = _spawn_child(os.path.join(tmp, "ck"), got_out, steps,
+                           save_every, smoke)  # resume to completion
+        if not (ok1 and ok2 and ok3 and os.path.exists(ref_out)
+                and os.path.exists(got_out)):
+            return {"resume_bit_exact": False,
+                    "resume_error": "child run failed"}
+        ref = np.load(ref_out)
+        got = np.load(got_out)
+        bit = (set(ref.files) == set(got.files)
+               and all(np.array_equal(ref[k], got[k]) for k in ref.files))
+        return {"resume_bit_exact": bool(bit),
+                "resume_steps": steps, "resume_killed_at": kill_after}
+
+
+# ---------------------------------------------------------------------------
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="small sizes; skips the throughput bar")
+    ap.add_argument("--bar", type=float, default=1.5,
+                    help="async-vs-sync rows/sec bar")
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--ckpt")
+    ap.add_argument("--out")
+    ap.add_argument("--steps", type=int, default=12)
+    ap.add_argument("--save-every", type=int, default=3)
+    args = ap.parse_args()
+    if args.child:
+        child_main(args)
+        return
+
+    s = _sizes(args.smoke)
+    rec = {"smoke": bool(args.smoke)}
+    rec.update(leg_sharded(args.smoke))
+    rec.update(leg_host(s, args.bar, args.smoke))
+    rec.update(leg_resume(args.smoke))
+
+    failures = []
+    if not rec.get("sharded_parity_bit_exact"):
+        failures.append("sharded leg diverged from the single-device "
+                        "sparse oracle")
+    if not rec.get("host_async_bit_identical_to_sync"):
+        failures.append("async prefetch changed training results")
+    if not rec.get("resume_bit_exact"):
+        failures.append("SIGKILL resume was not bit-exact")
+    if rec["table_bytes"] <= rec["device_budget_bytes"]:
+        failures.append("table does not exceed the device table budget")
+    if not args.smoke and rec["async_speedup"] < args.bar:
+        failures.append(
+            f"async prefetch speedup {rec['async_speedup']} < {args.bar}x")
+    rec["failures"] = failures
+    print("RECSYS" + json.dumps(rec), flush=True)
+    sys.exit(1 if failures else 0)
+
+
+if __name__ == "__main__":
+    main()
