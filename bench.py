@@ -840,6 +840,41 @@ def measure_gateway():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_fleet():
+    """ISSUE-12 acceptance artifact: probes/fleet_probe.py in a clean CPU
+    subprocess.  Publishes the multi-replica serving story as
+    `detail.fleet.{failover_p99_ms,dropped_streams,rollout_dropped}` —
+    bars: under Poisson traffic on a 3-replica fleet, a
+    SIGKILL-equivalent replica loss mid-decode leaves ZERO hung
+    consumers (every stream completes bit-identical to its solo-generate
+    oracle via migration/resubmission or ends in a typed terminal
+    error), a browned-out replica is fenced by step-time health and its
+    residents migrate bit-identical, and a full rolling restart (every
+    replica rebooted from an AOT program set under continuous traffic)
+    drops zero requests with zero post-warmup compiles on the rolled
+    fleet."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes", "fleet_probe.py"),
+         "--steps", os.environ.get("PDTPU_FLEET_PROBE_STEPS", "36")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("FLEET"):
+            rec = json.loads(line[len("FLEET"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"fleet bars failed: {rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return {"failover_p99_ms": rec.get("failover_p99_ms"),
+                    "dropped_streams": rec.get("dropped_streams"),
+                    "rollout_dropped": rec.get("rollout_dropped"),
+                    "detail": rec}
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_spec_decode():
     """ISSUE-7 acceptance artifact: probes/spec_decode_probe.py in a clean
     CPU subprocess.  Publishes speculative decoding and int8 weight-only
@@ -1238,6 +1273,7 @@ def main():
                          ("program_cache", measure_program_cache),
                          ("spec_decode", measure_spec_decode),
                          ("gateway", measure_gateway),
+                         ("fleet", measure_fleet),
                          ("recsys", measure_recsys),
                          ("resilience", measure_resilience),
                          ("observability", measure_observability),

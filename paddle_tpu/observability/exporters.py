@@ -163,6 +163,15 @@ def report() -> dict:
         "tokens_out": stats.get("STAT_serving_tokens", 0),
         "requests": stats.get("STAT_serving_requests", 0),
     }
+    fleet = {
+        "replicas_up": _gauge_value("fleet_replicas_up"),
+        "failovers": stats.get("STAT_fleet_failovers", 0),
+        "migrated_runs": stats.get("STAT_fleet_migrated_runs", 0),
+        "resubmits": stats.get("STAT_fleet_resubmits", 0),
+        "lost_runs": stats.get("STAT_fleet_lost_runs", 0),
+        "reroutes": stats.get("STAT_fleet_reroutes", 0),
+        "drains": stats.get("STAT_fleet_drains", 0),
+    }
     gateway = {
         "ttft_hi_seconds": _hist_summary("gateway_ttft_hi_seconds"),
         "ttft_lo_seconds": _hist_summary("gateway_ttft_lo_seconds"),
@@ -217,6 +226,7 @@ def report() -> dict:
         "train": train,
         "serving": serving,
         "gateway": gateway,
+        "fleet": fleet,
         "embedding": embedding,
         "programs": get_program_registry().snapshot(),
         "program_store": program_store,

@@ -97,6 +97,20 @@ admission outcome — shed, rate-limited, expired, preempted-then-cancelled
 — is a terminal Response: no consumer ever hangs.  See the README
 "Gateway" section.
 
+Fleet
+-----
+`FleetRouter` + `ReplicaManager` (fleet.py) front N engine replicas:
+least-loaded routing with session affinity, health from
+warmup/step-time/heartbeat evidence, crash/brownout fencing with
+failover — in-flight runs migrate between replicas bit-identical
+through the run-transfer codec (transfer.py, the PR-6 preempt/restore
+snapshot made replica-portable), runs whose snapshot died with a
+crashed replica are re-prefilled from the prompt (``resubmit=True``,
+greedy-only) or fail with the typed `ReplicaLostError` — and
+`drain()`/`rollout()` give zero-downtime weight/program rollouts.
+``ServingGateway(fleet, ...)`` turns the multi-tenant front door into a
+cluster front door.  See the README "Fleet serving" section.
+
 Program lifecycle
 -----------------
 `engine.warmup()` precompiles the whole program family before traffic
@@ -124,6 +138,9 @@ from .slo import ShedPolicy, Signals, SLOTracker, TenantConfig, TokenBucket
 from .gateway import (ServingGateway, GatewayServer, RateLimitedError,
                       SheddedError, serve_gateway, PRIORITY_HIGH,
                       PRIORITY_LOW)
+from .fleet import FleetRouter, ReplicaManager, Replica, ReplicaLostError
+from .transfer import (RunTransferError, encode_run, decode_run,
+                       run_to_bytes, run_from_bytes)
 
 __all__ = [
     "ServingEngine", "Request", "Response", "RequestScheduler",
@@ -135,4 +152,9 @@ __all__ = [
     "ServingGateway", "GatewayServer", "serve_gateway", "TenantConfig",
     "TokenBucket", "ShedPolicy", "Signals", "SLOTracker",
     "RateLimitedError", "SheddedError", "PRIORITY_HIGH", "PRIORITY_LOW",
+    # fleet (multi-replica router: health-driven failover, run
+    # migration, zero-downtime rollout)
+    "FleetRouter", "ReplicaManager", "Replica", "ReplicaLostError",
+    "RunTransferError", "encode_run", "decode_run", "run_to_bytes",
+    "run_from_bytes",
 ]

@@ -306,6 +306,26 @@ class PreemptedRun:
         self.draft_kv_rows = draft_kv_rows
         self.preempted_at = time.monotonic()
 
+    @classmethod
+    def from_state(cls, req, resp, pos: int, produced: int,
+                   last_token: int, key, kv_rows, draft_kv_rows=None):
+        """Build a PreemptedRun from raw snapshot state instead of a live
+        _SlotRun — the run-transfer codec's decode side
+        (serving/transfer.py): a snapshot that crossed a replica (or, via
+        its byte form, a process) boundary restores through the SAME
+        `restore_run` contract a locally preempted run uses."""
+        paused = cls.__new__(cls)
+        paused.req = req
+        paused.resp = resp
+        paused.pos = int(pos)
+        paused.produced = int(produced)
+        paused.last_token = int(last_token)
+        paused.key = np.asarray(key)
+        paused.kv_rows = kv_rows
+        paused.draft_kv_rows = draft_kv_rows
+        paused.preempted_at = time.monotonic()
+        return paused
+
 
 class ServingEngine:
     """Continuous-batching engine over a model implementing the
@@ -521,6 +541,12 @@ class ServingEngine:
         self._stop = threading.Event()
         self._work = threading.Event()
         self._closed = False
+        # close() is idempotent and safe under concurrent double-close:
+        # the fleet's replica manager fences and closes aggressively
+        # (drain completion, crash handling, rollout teardown and the
+        # user's own close can race), so exactly ONE caller runs the
+        # join + abort sequence and everyone else returns once it's done
+        self._close_lock = threading.Lock()
         self._dead: Optional[BaseException] = None
 
     # ------------------------------------------------------------------
@@ -1029,11 +1055,24 @@ class ServingEngine:
                      seed: Optional[int] = None,
                      deadline: Optional[float] = None, priority: int = 0,
                      tenant: Optional[str] = None,
-                     spec: Optional[bool] = None):
+                     spec: Optional[bool] = None,
+                     session: Optional[str] = None,
+                     resubmit: bool = False):
         """Validate + build one (Request, Response) pair WITHOUT enqueuing
         it — the gateway's admission layer owns its own lanes and hands
         requests to `try_admit` directly.  Raises InvalidArgumentError for
-        a prompt/budget the engine can never serve."""
+        a prompt/budget the engine can never serve.
+
+        `session` is the fleet router's affinity key; `resubmit=True`
+        (greedy-only) opts into re-prefill-from-prompt recovery when the
+        serving replica crashes and the run's KV snapshot dies with it —
+        greedy decode is deterministic in the prompt alone, so the
+        replayed stream is bit-identical and the fleet forwards only the
+        not-yet-delivered suffix.  A sampled resubmit is rejected here,
+        typed: a sampled replay is only reproducible through the engine's
+        internal per-position key-fold schedule, which is not a contract —
+        greedy-only keeps "the delivered prefix never changes" a property
+        of the model, not of an implementation detail."""
         if self._closed:
             raise UnavailableError("serving engine is closed")
         if self._dead is not None:
@@ -1053,6 +1092,11 @@ class ServingEngine:
             raise InvalidArgumentError(
                 "spec=True requires the engine to be built with a "
                 "draft_model (speculative decoding)")
+        if resubmit and decode_strategy != "greedy_search":
+            raise InvalidArgumentError(
+                "resubmit=True (re-prefill-from-prompt crash recovery) is "
+                "greedy-only: a replayed sampled stream is not covered by "
+                "any engine contract — drop resubmit or use greedy_search")
         with self._submit_lock:
             rid = self._rid
             self._rid += 1
@@ -1062,7 +1106,8 @@ class ServingEngine:
                       eos_token_id=eos_token_id,
                       seed=seed if seed is not None else rid,
                       deadline=deadline, priority=priority, tenant=tenant,
-                      spec=bool(spec))
+                      spec=bool(spec), session=session,
+                      resubmit=resubmit)
         plen = req.prompt.shape[0]
         if plen > self.buckets[-1]:
             stat_add("STAT_serving_rejects")
@@ -1933,15 +1978,20 @@ class ServingEngine:
     def close(self):
         """Stop the loop and fail any still-outstanding requests (a
         Response consumer must never be left blocked on a closed
-        engine)."""
+        engine).  Idempotent and safe under concurrent double-close: the
+        flag flips before the lock so racing submitters reject early, and
+        the join/abort sequence runs under _close_lock so a second closer
+        can never join a half-torn-down thread or re-abort a drain in
+        progress."""
         self._closed = True
         self._stop.set()
         self._work.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
-        self._abort_all(lambda req: RequestCancelled(
-            f"request {req.id} aborted: serving engine closed"))
+        with self._close_lock:
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None
+            self._abort_all(lambda req: RequestCancelled(
+                f"request {req.id} aborted: serving engine closed"))
 
     @property
     def warm(self) -> bool:

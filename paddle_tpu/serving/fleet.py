@@ -1,0 +1,1018 @@
+"""Fleet-grade serving: a replica manager + router over N ServingEngines.
+
+The gateway (PR 6) made ONE engine production-shaped; this module makes
+the engine COUNT a runtime variable.  `FleetRouter` fronts N
+`ServingEngine` replicas — in-process for tier-1/CPU, with every
+interaction funneled through a surface a subprocess replica could
+implement over IPC — and `ReplicaManager` owns their lifecycle:
+
+- **Routing** is least-loaded (occupancy + queue depth) with session
+  affinity: requests sharing a ``session`` key stick to one replica
+  while it stays healthy (KV-prefix locality once the radix cache
+  lands), and re-home automatically when it is fenced.
+- **Health** is positive evidence, not hope: a replica is routable only
+  after `warmup()` reports every program compiled (`engine.warm`), its
+  per-step wall time feeds an EWMA that fences a browned-out replica
+  (`slow_threshold_ms`), and a step that RAISES is a crash — the
+  replica is fenced immediately.  Every successful step also beats a
+  heartbeat, exported as `heartbeat_age_s` telemetry: in-process the
+  raising step IS the liveness verdict (one thread drives everyone), so
+  age-based fencing is the subprocess deployment's job, alarmed on this
+  signal.
+- **Failover** generalizes the PR-6 preempt/restore snapshot into the
+  run-transfer codec (serving/transfer.py): a fenced-but-alive replica's
+  residents are preempted, encoded, and restored onto surviving
+  replicas, resuming bit-identical to an uninterrupted run.  A CRASHED
+  replica's snapshots die with it: each lost run is re-prefilled from
+  its prompt on a healthy replica when the request opted in
+  (``resubmit=True``, greedy-only — the fleet forwards only the
+  not-yet-delivered suffix, so the stream stays bit-identical
+  end-to-end), otherwise it fails with the typed `ReplicaLostError`.
+  Either way: NEVER a hung consumer.
+- **Draining** (`drain(rid)`) stops admissions, migrates residents to
+  peers (or lets them finish in place when the fleet is full), then
+  closes the empty replica — which makes rollout zero-downtime: boot a
+  replacement from a PR-9 program set (seconds, zero compiles), warm
+  it, add it, drain the old one (`rollout()` sequences this across the
+  whole fleet).
+
+The in-process threading contract mirrors the gateway's: ONE thread
+drives `step()` — either the fleet's own `start()` loop or a
+`ServingGateway` fronting the router (the router implements the
+engine-facing surface the gateway consumes: `make_request`,
+`try_admit`, `preempt_slot`/`restore_run`, `scheduler` depth/occupancy
+views, `step`, `_abort_all`).  `submit` is safe from any thread.
+
+Chaos knobs (utils.faults): ``PDTPU_FAULT_REPLICA_CRASH=replica:tick``
+(SIGKILL-equivalent mid-decode loss) and
+``PDTPU_FAULT_REPLICA_SLOW=ms[:every_n[:replica]]`` (brownout) — the
+fleet probe (probes/fleet_probe.py) drives both under Poisson traffic
+plus a full rolling restart.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..core.errors import InvalidArgumentError, UnavailableError
+from ..utils import faults
+from ..utils.monitor import stat_add
+from .engine import PreemptedRun, ServingEngine
+from .request import Request, Response, RequestCancelled
+from .scheduler import DeadlineExceededError, QueueFullError
+from .transfer import (RunTransferError, check_compatible, decode_run,
+                       encode_run)
+
+__all__ = ["FleetRouter", "ReplicaManager", "Replica", "ReplicaLostError"]
+
+# replica lifecycle states
+BOOTING = "booting"      # added, not yet warm — never routed to
+HEALTHY = "healthy"      # warm + fast: routable
+DEGRADED = "degraded"    # fenced by slow-step health; residents migrate
+DRAINING = "draining"    # admissions stopped; residents migrate/finish
+CRASHED = "crashed"      # step raised / injected kill; state abandoned
+CLOSED = "closed"        # engine closed (drain finished or shutdown)
+
+_LIVE = (BOOTING, HEALTHY, DEGRADED, DRAINING)
+
+
+class ReplicaLostError(UnavailableError):
+    """The replica serving this run crashed and its KV snapshot was lost
+    with it; the request did not opt into resubmission (or no capacity
+    was left to resubmit into).  The typed terminal state — retry the
+    request if it is idempotent for you."""
+    code = "Unavailable"
+
+
+class _InjectedReplicaCrash(RuntimeError):
+    """PDTPU_FAULT_REPLICA_CRASH fired: the SIGKILL-equivalent for an
+    in-process replica (raised BEFORE the engine can fail its runs)."""
+
+
+class _ForwardingResponse(Response):
+    """The resubmission bridge: a crashed replica's lost greedy run is
+    replayed from its prompt on a survivor, and this response receives
+    the replay — swallowing the first `skip` tokens (already delivered
+    to the consumer before the crash) and forwarding the rest into the
+    ORIGINAL response object the consumer is iterating.  Greedy decode
+    is deterministic in the prompt, so the swallowed prefix is
+    bit-identical to what was already delivered and the consumer sees
+    one seamless, bit-identical stream.
+
+    It is itself a full Response (the serving engine's emit/sweep
+    bookkeeping runs against it), and chains: if the replay's replica
+    crashes too, the next resubmission targets the original response
+    with a recomputed skip."""
+
+    def __init__(self, request: Request, target: Response, skip: int):
+        super().__init__(request)
+        self._target = target
+        self._skip = int(skip)
+
+    @property
+    def cancelled(self) -> bool:
+        # the consumer cancels the ORIGINAL stream; the engine sweeping
+        # the replay must honor it
+        return self._cancel_requested or self._target.cancelled
+
+    def _push_token(self, tok: int, logp: float = 0.0):
+        super()._push_token(tok, logp)
+        if self._skip > 0:
+            self._skip -= 1
+            return
+        self._target._push_token(tok, logp)
+
+    def _finish(self, reason: str):
+        super()._finish(reason)
+        self._target._finish(reason)
+
+    def _fail(self, exc: BaseException):
+        super()._fail(exc)
+        self._target._fail(exc)
+
+
+_obs_handles = None
+
+
+def _obs():
+    """Cached fleet observability handles (registry.reset() zeroes the
+    values in place, handles stay valid)."""
+    global _obs_handles
+    if _obs_handles is None:
+        from ..observability import metrics as _m
+        _obs_handles = {
+            "up": _m.gauge(
+                "serving_replica_up",
+                "1 while the replica is routable (healthy + warm), else 0",
+                labelnames=("replica",)),
+            "inflight": _m.gauge(
+                "serving_replica_inflight",
+                "decoding slots + queued requests on the replica",
+                labelnames=("replica",)),
+            "replicas_up": _m.gauge(
+                "fleet_replicas_up", "routable replicas in the fleet"),
+            "failovers": _m.counter(
+                "fleet_failovers_total",
+                "replica fences (crash or brownout) that triggered "
+                "failover handling"),
+            "migrated": _m.counter(
+                "fleet_migrated_runs_total",
+                "in-flight runs moved between replicas via the run "
+                "transfer codec"),
+        }
+    return _obs_handles
+
+
+class Replica:
+    """One managed ServingEngine + its health state.  `rid` is a
+    monotonically increasing integer, never reused — it is also the
+    index the replica fault knobs target."""
+
+    def __init__(self, rid: int, engine: ServingEngine):
+        self.id = rid
+        self.engine = engine
+        self.state = HEALTHY if engine.warm else BOOTING
+        self.steps = 0
+        self.last_beat = time.monotonic()
+        self.step_ewma: Optional[float] = None  # seconds
+        self.fast_steps = 0
+        self.fence_reason: Optional[str] = None
+        self.created_at = time.monotonic()
+
+    def routable(self) -> bool:
+        return self.state == HEALTHY and self.engine.warm
+
+    def load(self) -> int:
+        s = self.engine.scheduler
+        return s.occupancy() + s.queue_depth()
+
+    def note_step_time(self, dt: float, threshold: Optional[float]):
+        a = 0.3
+        self.step_ewma = (dt if self.step_ewma is None
+                          else a * dt + (1 - a) * self.step_ewma)
+        if threshold is not None:
+            if dt < 0.5 * threshold:
+                self.fast_steps += 1
+            else:
+                self.fast_steps = 0
+
+    def snapshot(self) -> Dict:
+        return {
+            "state": self.state,
+            "warm": bool(self.engine.warm),
+            "occupancy": self.engine.scheduler.occupancy(),
+            "queue_depth": self.engine.scheduler.queue_depth(),
+            "steps": self.steps,
+            "step_ewma_ms": (None if self.step_ewma is None
+                             else round(self.step_ewma * 1e3, 3)),
+            "heartbeat_age_s": round(time.monotonic() - self.last_beat, 3),
+            "fence_reason": self.fence_reason,
+            "post_warmup_compiles": (self.engine.post_warmup_compiles()
+                                     if self.engine.warm else None),
+        }
+
+
+class ReplicaManager:
+    """Replica lifecycle: stepping, health, fencing, migration, drain.
+
+    All mutation of replica state runs on the driving thread (the fleet
+    loop or the gateway loop) except `add`/`drain`/`close`, which only
+    flip state flags under the lock — the driving thread picks the
+    change up on its next tick."""
+
+    def __init__(self, slow_threshold_ms: Optional[float] = None,
+                 probation_steps: int = 5):
+        self._replicas: Dict[int, Replica] = {}
+        self._next_id = 0
+        self._lock = threading.Lock()
+        self._ticks = 0
+        self.slow_threshold_s = (None if slow_threshold_ms is None
+                                 else float(slow_threshold_ms) / 1e3)
+        self.probation_steps = int(probation_steps)
+        # runs preempted off a fenced replica that no peer could hold
+        # yet (paged-block shortfall): retried every tick, swept for
+        # cancel/deadline, failed terminally at close
+        self._parked: List[PreemptedRun] = []
+        self._n = {"failovers": 0, "migrated": 0, "resubmits": 0,
+                   "lost": 0, "reroutes": 0, "drains": 0}
+
+    # -- membership ---------------------------------------------------
+    def add(self, engine: ServingEngine) -> Replica:
+        if engine._thread is not None:
+            raise InvalidArgumentError(
+                "replica engine loop already started; the fleet drives "
+                "engine.step() itself — construct the engine without "
+                "start()")
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            rep = Replica(rid, engine)
+            self._replicas[rid] = rep
+        self._publish_up(rep)
+        return rep
+
+    def get(self, rid: int) -> Optional[Replica]:
+        with self._lock:
+            return self._replicas.get(rid)
+
+    def replicas(self, states=None) -> List[Replica]:
+        with self._lock:
+            reps = list(self._replicas.values())
+        if states is None:
+            return reps
+        return [r for r in reps if r.state in states]
+
+    def routable(self) -> List[Replica]:
+        return [r for r in self.replicas((HEALTHY,)) if r.routable()]
+
+    def remove(self, rid: int):
+        """Forget a closed/crashed replica (rollout teardown)."""
+        with self._lock:
+            rep = self._replicas.get(rid)
+            if rep is None:
+                return
+            if rep.state not in (CLOSED, CRASHED):
+                raise InvalidArgumentError(
+                    f"replica {rid} is {rep.state}; drain it before "
+                    "remove (or let crash handling finish)")
+            del self._replicas[rid]
+        _obs()["up"].labels(replica=str(rid)).set(0)
+        self._publish_counts()
+
+    def warm_all(self) -> Dict[int, Dict]:
+        """warmup() every not-yet-warm replica; booting replicas become
+        healthy (routable) once every program is compiled."""
+        reports = {}
+        for rep in self.replicas(_LIVE):
+            if not rep.engine.warm:
+                reports[rep.id] = rep.engine.warmup()
+            if rep.state == BOOTING and rep.engine.warm:
+                rep.state = HEALTHY
+                self._publish_up(rep)
+        self.refresh_warm_marks()
+        return reports
+
+    def refresh_warm_marks(self):
+        """Re-baseline every warm replica's post-warmup compile marks.
+        The observability program registry is process-global, so replica
+        B's warmup compiles would otherwise count against replica A's
+        post-warmup-zero contract (`serving_decode` is one registry
+        entry, N replicas).  Called after every membership warm event
+        (warm_all, rollout boot), which makes
+        `post_warmup_compiles()` mean: compiles since the fleet's most
+        recent warmup — still exactly the zero-compiles-under-traffic
+        fleet contract."""
+        for rep in self.replicas(_LIVE):
+            if rep.engine.warm:
+                rep.engine._warm_marks = rep.engine._compile_marks()
+
+    def drain(self, rid: int):
+        """Fence `rid` for graceful removal: no new admissions; queued
+        requests re-route now, residents migrate (or finish in place)
+        over the next ticks, then the engine closes."""
+        rep = self.get(rid)
+        if rep is None:
+            raise InvalidArgumentError(f"no replica {rid}")
+        if rep.state not in (BOOTING, HEALTHY, DEGRADED):
+            return
+        rep.state = DRAINING
+        rep.fence_reason = "drain"
+        self._n["drains"] += 1
+        stat_add("STAT_fleet_drains")
+        self._publish_up(rep)
+        # queued-but-never-prefilled work lost nothing: hand it to peers
+        # — but the draining replica is ALIVE, so when no peer has queue
+        # space the entry goes back on its own queue and is served
+        # before the drain completes (the same finish-in-place policy
+        # residents get; zero-drop rollout must hold under queue
+        # pressure too)
+        for req, resp in rep.engine.scheduler.drain_pending():
+            self._reroute(req, resp, exclude_id=rid,
+                          fallback_engine=rep.engine)
+
+    # -- the driving tick ---------------------------------------------
+    def tick(self) -> bool:
+        """One fleet iteration on the driving thread: step every live
+        replica (crash fault + brownout fault consulted per step, wall
+        time fed to health), fence what the health verdicts demand,
+        migrate residents off fenced replicas, retry parked runs, close
+        drained-empty replicas."""
+        self._ticks += 1
+        did = False
+        crash_cfg = faults.replica_crash_config()
+        for rep in self.replicas(_LIVE):
+            if rep.state == BOOTING:
+                continue
+            if (rep.state == DEGRADED and not rep.engine.has_work()
+                    and self._ticks % 16):
+                # probation sampling: an idle fenced replica is stepped
+                # only occasionally, so a browned-out replica's injected
+                # step latency cannot keep stalling the shared loop
+                continue
+            try:
+                # the brownout sleep counts INTO the measured step time
+                # (it models a slow replica; health must see it)
+                t0 = time.perf_counter()
+                faults.maybe_slow_replica(rep.id, rep.steps)
+                if crash_cfg is not None and crash_cfg == (rep.id,
+                                                           rep.steps):
+                    rep.steps += 1
+                    raise _InjectedReplicaCrash(
+                        f"replica {rep.id} lost at tick {rep.steps - 1} "
+                        "(PDTPU_FAULT_REPLICA_CRASH)")
+                stepped = rep.engine.step()
+                dt = time.perf_counter() - t0
+                rep.steps += 1
+                rep.last_beat = time.monotonic()
+                rep.note_step_time(dt, self.slow_threshold_s)
+                did = stepped or did
+            except BaseException as e:  # noqa: BLE001 — fence, never hang
+                self._on_crash(rep, e)
+                did = True
+        self._update_health()
+        did = self._pump_migrations() or did
+        did = self._pump_parked() or did
+        self._sweep_parked()
+        did = self._finish_drains() or did
+        self._publish_inflight()
+        return did
+
+    # -- health --------------------------------------------------------
+    def _update_health(self):
+        thr = self.slow_threshold_s
+        if thr is None:
+            return
+        for rep in self.replicas((HEALTHY, DEGRADED)):
+            if (rep.state == HEALTHY and rep.steps >= 3
+                    and rep.step_ewma is not None and rep.step_ewma > thr):
+                rep.state = DEGRADED
+                rep.fence_reason = (
+                    f"slow: step EWMA {rep.step_ewma * 1e3:.1f}ms > "
+                    f"{thr * 1e3:.1f}ms")
+                self._n["failovers"] += 1
+                stat_add("STAT_fleet_failovers")
+                _obs()["failovers"].inc()
+                self._publish_up(rep)
+            elif (rep.state == DEGRADED and rep.step_ewma is not None
+                    and rep.step_ewma < 0.5 * thr
+                    and rep.fast_steps >= self.probation_steps):
+                # brownout over: probation passed, return to rotation
+                rep.state = HEALTHY
+                rep.fence_reason = None
+                self._publish_up(rep)
+
+    def _on_crash(self, rep: Replica, exc: BaseException):
+        """SIGKILL-equivalent loss: the engine had no chance to fail its
+        runs and its device state is gone.  Fence it, then give every
+        resident stream a future — resubmission for greedy opt-ins,
+        the typed ReplicaLostError for the rest, a plain re-route for
+        queued work that never started.  Parked OOM snapshots count as
+        lost too: in the real deployment they lived in the dead
+        process."""
+        rep.state = CRASHED
+        rep.fence_reason = repr(exc)
+        self._n["failovers"] += 1
+        stat_add("STAT_fleet_failovers")
+        _obs()["failovers"].inc()
+        self._publish_up(rep)
+        engine = rep.engine
+        lost = [(run.req, run.resp) for run in engine._slots.values()]
+        # release the scheduler's host-side slot bookkeeping too: the
+        # engine is abandoned, but its occupancy gauge / slots-active
+        # stat / Request refs must not be pinned forever by a dead
+        # replica that stays listed until remove()
+        for slot in list(engine._slots):
+            engine.scheduler.release(slot)
+        engine._slots.clear()
+        if engine.kv == "paged":
+            lost.extend((p.req, p.resp) for p in engine._oom_paused)
+            engine._oom_paused = []
+        for req, resp in lost:
+            self._failover_lost(req, resp, rep.id)
+        # queued-but-never-prefilled: nothing was delivered, re-route
+        # (the in-process queue survives; a subprocess router holds the
+        # same queue on ITS side of the wire, so the semantics carry)
+        for req, resp in engine.scheduler.drain_pending():
+            self._reroute(req, resp, exclude_id=rep.id)
+
+    def _failover_lost(self, req: Request, resp: Response, crashed_id: int):
+        produced = len(resp.tokens_so_far())
+        if req.resubmit and req.greedy:
+            if self._resubmit(req, resp, produced, crashed_id):
+                self._n["resubmits"] += 1
+                stat_add("STAT_fleet_resubmits")
+                return
+        self._n["lost"] += 1
+        stat_add("STAT_fleet_lost_runs")
+        resp._fail(ReplicaLostError(
+            f"request {req.id}: replica {crashed_id} crashed mid-decode "
+            f"and its run snapshot was lost ({produced} tokens were "
+            "delivered); "
+            + ("no surviving replica could take the resubmission"
+               if req.resubmit and req.greedy else
+               "submit with resubmit=True (greedy) to opt into "
+               "re-prefill-from-prompt recovery")))
+
+    def _resubmit(self, req: Request, resp: Response, produced: int,
+                  crashed_id: int) -> bool:
+        """Replay a lost greedy run from its prompt on a survivor; the
+        forwarding response swallows the `produced` already-delivered
+        tokens so the consumer's stream continues bit-identically."""
+        # chains: if resp is itself a forwarding bridge (second crash),
+        # target the ORIGINAL stream with a recomputed skip — the
+        # bridge's internal token count equals what the original has
+        # seen end-to-end
+        target = resp._target if isinstance(resp, _ForwardingResponse) \
+            else resp
+        for rep in self._targets(exclude_id=crashed_id):
+            engine = rep.engine
+            try:
+                shadow, _ = engine.make_request(
+                    req.prompt, req.max_new_tokens,
+                    decode_strategy="greedy_search",
+                    eos_token_id=req.eos_token_id, seed=req.seed,
+                    priority=req.priority, tenant=req.tenant,
+                    spec=(req.spec if engine.draft_model is not None
+                          else False),
+                    session=req.session, resubmit=True)
+            except Exception:
+                continue
+            # the original deadline keeps ticking from the original
+            # submission — a crash must not silently extend a budget
+            shadow.deadline = req.deadline
+            fwd = _ForwardingResponse(shadow, target, skip=produced)
+            try:
+                engine.scheduler.submit(shadow, fwd)
+            except QueueFullError:
+                continue
+            return True
+        return False
+
+    def _reroute(self, req: Request, resp: Response, exclude_id: int,
+                 fallback_engine=None):
+        """Re-home a queued (never-prefilled) request.  `fallback_engine`
+        is the still-alive source engine of a DRAIN: with no peer queue
+        space the request stays on it and is served before the drain
+        completes.  A CRASH has no fallback — the engine is gone — so
+        exhausting the peers is the typed terminal state."""
+        for rep in self._targets(exclude_id=exclude_id):
+            try:
+                rep.engine.scheduler.submit(req, resp)
+            except QueueFullError:
+                continue
+            self._n["reroutes"] += 1
+            stat_add("STAT_fleet_reroutes")
+            return
+        if fallback_engine is not None:
+            try:
+                # its queue was just drained, so space exists
+                fallback_engine.scheduler.submit(req, resp)
+                return
+            except QueueFullError:
+                pass
+        self._n["lost"] += 1
+        stat_add("STAT_fleet_lost_runs")
+        resp._fail(ReplicaLostError(
+            f"request {req.id}: replica {exclude_id} was fenced before "
+            "prefill and no surviving replica had queue space"))
+
+    def _targets(self, exclude_id: Optional[int] = None) -> List[Replica]:
+        reps = [r for r in self.routable() if r.id != exclude_id]
+        reps.sort(key=lambda r: (r.load(), r.id))
+        return reps
+
+    # -- migration -----------------------------------------------------
+    def _pump_migrations(self) -> bool:
+        """Move residents off fenced-but-alive replicas (drain or
+        brownout) through the run-transfer codec.  A run is only
+        preempted once a peer with a free slot exists; a paged-block
+        shortfall at restore parks the snapshot for retry instead of
+        dropping it."""
+        did = False
+        for rep in self.replicas((DRAINING, DEGRADED)):
+            for slot in sorted(rep.engine._slots):
+                target = self._pick_slot_target(exclude_id=rep.id)
+                if target is None:
+                    break  # fleet full: residents finish in place
+                run = rep.engine._slots.get(slot)
+                if run is None:
+                    continue
+                paused = rep.engine.preempt_slot(slot)
+                blob = encode_run(paused)
+                try:
+                    snap = decode_run(blob, req=paused.req,
+                                      resp=paused.resp,
+                                      engine=target.engine)
+                except RunTransferError as e:
+                    # incompatible peer: the run must fail typed, not be
+                    # written into a pool it does not fit
+                    self._n["lost"] += 1
+                    stat_add("STAT_fleet_lost_runs")
+                    paused.resp._fail(e)
+                    did = True
+                    continue
+                if target.engine.restore_run(snap):
+                    snap.req.migrations += 1
+                    self._n["migrated"] += 1
+                    stat_add("STAT_fleet_migrated_runs")
+                    _obs()["migrated"].inc()
+                else:
+                    self._parked.append(snap)
+                did = True
+        return did
+
+    def _pick_slot_target(self, exclude_id: int) -> Optional[Replica]:
+        cands = [r for r in self._targets(exclude_id)
+                 if r.engine.scheduler.free_slot_count() > 0]
+        return cands[0] if cands else None
+
+    def _pump_parked(self) -> bool:
+        did = False
+        still = []
+        for snap in self._parked:
+            placed = False
+            for rep in self._targets():
+                if rep.engine.scheduler.free_slot_count() <= 0:
+                    continue
+                if rep.engine.restore_run(snap):
+                    snap.req.migrations += 1
+                    self._n["migrated"] += 1
+                    stat_add("STAT_fleet_migrated_runs")
+                    _obs()["migrated"].inc()
+                    placed = did = True
+                    break
+            if not placed:
+                still.append(snap)
+        self._parked = still
+        return did
+
+    def _sweep_parked(self):
+        """Parked snapshots still honor cancel/deadline — a run waiting
+        out a full fleet must reach its terminal state on time."""
+        keep = []
+        for p in self._parked:
+            if p.resp.cancelled:
+                p.resp._fail(RequestCancelled(
+                    f"request {p.req.id} cancelled while parked for "
+                    "replica migration"))
+            elif p.req.deadline is not None and p.req.deadline.expired():
+                p.resp._fail(DeadlineExceededError(
+                    f"request {p.req.id} deadline "
+                    f"({p.req.deadline.seconds}s) expired while parked "
+                    "for replica migration"))
+            else:
+                keep.append(p)
+        self._parked = keep
+
+    def _finish_drains(self) -> bool:
+        did = False
+        for rep in self.replicas((DRAINING,)):
+            if not rep.engine.has_work():
+                rep.engine.close()
+                rep.state = CLOSED
+                self._publish_up(rep)
+                did = True
+        return did
+
+    # -- shutdown ------------------------------------------------------
+    def abort_all(self, make_exc: Callable):
+        for rep in self.replicas(_LIVE):
+            rep.engine._abort_all(make_exc)
+        parked, self._parked = self._parked, []
+        for p in parked:
+            p.resp._fail(make_exc(p.req))
+
+    def close_all(self):
+        for rep in self.replicas(_LIVE):
+            rep.engine.close()
+            rep.state = CLOSED
+            self._publish_up(rep)
+        parked, self._parked = self._parked, []
+        for p in parked:
+            p.resp._fail(RequestCancelled(
+                f"request {p.req.id} aborted: fleet closed while the run "
+                "was parked for migration"))
+
+    # -- observability -------------------------------------------------
+    def _publish_up(self, rep: Replica):
+        _obs()["up"].labels(replica=str(rep.id)).set(
+            1 if rep.routable() else 0)
+        self._publish_counts()
+
+    def _publish_counts(self):
+        _obs()["replicas_up"].set(len(self.routable()))
+
+    def _publish_inflight(self):
+        obs = _obs()
+        for rep in self.replicas(_LIVE):
+            obs["inflight"].labels(replica=str(rep.id)).set(rep.load())
+
+    def counters(self) -> Dict:
+        return dict(self._n, parked=len(self._parked))
+
+
+class _FleetSchedulerView:
+    """The slice of RequestScheduler the gateway's signals consume,
+    aggregated over the fleet: free slots on ROUTABLE replicas only
+    (fenced capacity must not attract admissions), occupancy and queue
+    depth over everything still alive (that work is real)."""
+
+    def __init__(self, manager: ReplicaManager):
+        self._m = manager
+
+    def free_slot_count(self) -> int:
+        return sum(r.engine.scheduler.free_slot_count()
+                   for r in self._m.routable())
+
+    def occupancy(self) -> int:
+        return sum(r.engine.scheduler.occupancy()
+                   for r in self._m.replicas(_LIVE))
+
+    def queue_depth(self) -> int:
+        return sum(r.engine.scheduler.queue_depth()
+                   for r in self._m.replicas(_LIVE))
+
+    def has_work(self) -> bool:
+        return any(r.engine.scheduler.has_work()
+                   for r in self._m.replicas(_LIVE))
+
+
+class FleetRouter:
+    """N replicas behind one front door.
+
+    ::
+
+        fleet = FleetRouter([make_engine() for _ in range(3)],
+                            slow_threshold_ms=50)
+        fleet.warmup()                  # all replicas routable
+        fleet.start()                   # or front it with ServingGateway
+        r = fleet.submit(prompt, 64, session="user-7", resubmit=True)
+        for tok in r: ...
+        fleet.rollout(lambda: ServingEngine(model, program_set=path, ...))
+        fleet.close()
+
+    Implements the engine-facing surface `ServingGateway` consumes, so
+    ``ServingGateway(fleet, ...)`` turns the PR-6 multi-tenant front
+    door into a cluster front door — the gateway's loop drives
+    `fleet.step()` exactly as it drove a single engine's."""
+
+    def __init__(self, replicas=(),
+                 slow_threshold_ms: Optional[float] = None,
+                 affinity: bool = True, max_sessions: int = 4096):
+        self.manager = ReplicaManager(slow_threshold_ms=slow_threshold_ms)
+        for engine in replicas:
+            self.manager.add(engine)
+        self._affinity_enabled = bool(affinity)
+        # LRU-bounded: one entry per live session key, refreshed on use —
+        # a long-lived fleet serving millions of distinct users must not
+        # grow an entry per user ever seen
+        self._affinity: Dict[str, int] = {}
+        self._max_sessions = max(1, int(max_sessions))
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._work = threading.Event()
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._dead: Optional[BaseException] = None
+
+    # -- membership / lifecycle ---------------------------------------
+    def add_replica(self, engine: ServingEngine) -> int:
+        """Add a replica (warm it first, or call `warmup()`); returns its
+        id.  A not-yet-warm replica is never routed to."""
+        if self._closed:
+            raise UnavailableError("fleet is closed")
+        return self.manager.add(engine).id
+
+    def drain(self, rid: int):
+        self.manager.drain(rid)
+        with self._lock:
+            self._affinity = {s: r for s, r in self._affinity.items()
+                              if r != rid}
+        self._work.set()
+
+    def remove(self, rid: int):
+        self.manager.remove(rid)
+
+    def warmup(self) -> Dict[int, Dict]:
+        return self.manager.warm_all()
+
+    def rollout(self, factory: Callable[[], ServingEngine],
+                timeout: float = 300.0, drive: bool = False) -> List[int]:
+        """Zero-downtime rolling restart: for each current replica, boot
+        a replacement via `factory` (typically
+        ``ServingEngine(model, program_set=...)`` — seconds, zero
+        compiles), warm it, add it, drain the old one and wait for its
+        residents to migrate or finish, then remove it.  Traffic keeps
+        flowing the whole time.  `drive=True` steps the fleet from this
+        thread while waiting (ONLY when nothing else drives the loop —
+        no `start()`, no gateway); the default polls."""
+        old_ids = [r.id for r in self.manager.replicas(_LIVE)]
+        new_ids = []
+        for rid in old_ids:
+            engine = factory()
+            if not engine.warm:
+                engine.warmup()
+            new_ids.append(self.add_replica(engine))
+            # the boot's warmup compiles (zero when factory loads a
+            # program set) must not count against the PEERS' post-warmup
+            # marks — the registry is process-global
+            self.manager.refresh_warm_marks()
+            self.drain(rid)
+            t0 = time.monotonic()
+            while True:
+                rep = self.manager.get(rid)
+                if rep is None or rep.state in (CLOSED, CRASHED):
+                    break
+                if drive:
+                    self.step()
+                else:
+                    time.sleep(0.005)
+                if time.monotonic() - t0 > timeout:
+                    raise TimeoutError(
+                        f"replica {rid} did not drain in {timeout}s "
+                        f"({rep.engine.scheduler.occupancy()} residents)")
+            self.remove(rid)
+        return new_ids
+
+    # -- engine-compatible surface (what ServingGateway consumes) -----
+    @property
+    def scheduler(self) -> _FleetSchedulerView:
+        return _FleetSchedulerView(self.manager)
+
+    @property
+    def max_slots(self) -> int:
+        return sum(r.engine.max_slots for r in self.manager.replicas(_LIVE))
+
+    @property
+    def warm(self) -> bool:
+        live = self.manager.replicas(_LIVE)
+        return bool(live) and all(r.engine.warm for r in live)
+
+    @property
+    def _slots(self) -> Dict:
+        """Merged {(replica_id, slot): run} view over live replicas —
+        the gateway's preemption victim scan."""
+        merged = {}
+        for rep in self.manager.replicas(_LIVE):
+            for slot, run in rep.engine._slots.items():
+                merged[(rep.id, slot)] = run
+        return merged
+
+    def make_request(self, prompt, max_new_tokens: int, **kwargs):
+        """Validate against a live replica's limits (the fleet is
+        homogeneous by contract: every replica serves the same model
+        with the same engine config)."""
+        if self._closed:
+            raise UnavailableError("fleet is closed")
+        if self._dead is not None:
+            raise UnavailableError(f"fleet loop died: {self._dead!r}")
+        reps = self.manager.routable() or self.manager.replicas(_LIVE)
+        if not reps:
+            raise UnavailableError("fleet has no live replicas")
+        return reps[0].engine.make_request(prompt, max_new_tokens,
+                                           **kwargs)
+
+    def try_admit(self, req: Request, resp: Response) -> bool:
+        """Place the request NOW on the best replica (affinity, then
+        least-loaded) — the gateway's admission path; must run on the
+        driving thread."""
+        for rep in self._route_order(req.session):
+            if rep.engine.try_admit(req, resp):
+                self._note_affinity(req.session, rep.id)
+                return True
+        return False
+
+    def preempt_slot(self, key) -> PreemptedRun:
+        rid, slot = key
+        rep = self.manager.get(rid)
+        if rep is None or rep.state not in _LIVE:
+            raise InvalidArgumentError(f"replica {rid} is not live")
+        return rep.engine.preempt_slot(slot)
+
+    def restore_run(self, paused: PreemptedRun) -> bool:
+        """Resume a preempted run on ANY replica with capacity — the
+        gateway's restore path, now fleet-wide (the snapshot format is
+        replica-portable by construction)."""
+        for rep in self.manager._targets():
+            if rep.engine.scheduler.free_slot_count() <= 0:
+                continue
+            try:
+                check_compatible(encode_run(paused), rep.engine)
+            except RunTransferError:
+                continue
+            if rep.engine.restore_run(paused):
+                return True
+        return False
+
+    def step(self) -> bool:
+        if self._closed:
+            return False
+        return self.manager.tick()
+
+    def has_work(self) -> bool:
+        return (any(r.engine.has_work()
+                    for r in self.manager.replicas(_LIVE))
+                or bool(self.manager._parked))
+
+    def _abort_all(self, make_exc):
+        self.manager.abort_all(make_exc)
+
+    # -- submission (caller threads) ----------------------------------
+    def submit(self, prompt, max_new_tokens: int, block: bool = False,
+               timeout: Optional[float] = None, **kwargs) -> Response:
+        """Route one request: session-affine when `session=` was given
+        and its replica is still healthy, least-loaded otherwise.  Raises
+        the same typed errors `ServingEngine.submit` raises; every
+        accepted request's Response reaches a terminal state even if its
+        replica later dies (failover / resubmit / typed error)."""
+        req, resp = self.make_request(prompt, max_new_tokens, **kwargs)
+        last_exc = None
+        for rep in self._route_order(req.session):
+            try:
+                rep.engine.scheduler.submit(req, resp, block=block,
+                                            timeout=timeout)
+            except QueueFullError as e:
+                last_exc = e
+                continue
+            self._note_affinity(req.session, rep.id)
+            self._work.set()
+            return resp
+        raise last_exc or UnavailableError(
+            "no routable replica accepted the request")
+
+    def _route_order(self, session: Optional[str]) -> List[Replica]:
+        reps = self.manager._targets()
+        if not (self._affinity_enabled and session):
+            return reps
+        with self._lock:
+            rid = self._affinity.get(session)
+        if rid is not None:
+            for i, rep in enumerate(reps):
+                if rep.id == rid:
+                    if i:
+                        reps.insert(0, reps.pop(i))
+                    return reps
+            # the pinned replica is gone/fenced: re-home below
+            with self._lock:
+                self._affinity.pop(session, None)
+        return reps
+
+    def _note_affinity(self, session: Optional[str], rid: int):
+        if self._affinity_enabled and session:
+            with self._lock:
+                # dict order is insertion order: delete-then-insert makes
+                # this an LRU touch, and overflow evicts the oldest entry
+                self._affinity.pop(session, None)
+                self._affinity[session] = rid
+                while len(self._affinity) > self._max_sessions:
+                    self._affinity.pop(next(iter(self._affinity)))
+
+    # -- driving -------------------------------------------------------
+    def run_until_drained(self, timeout: Optional[float] = None):
+        t0 = time.monotonic()
+        while self.has_work():
+            self.step()
+            if timeout is not None and time.monotonic() - t0 > timeout:
+                raise TimeoutError(f"fleet did not drain in {timeout}s")
+
+    def start(self):
+        """Background fleet loop.  Not for use under a gateway — the
+        gateway's loop drives `step()` itself."""
+        if self._thread is not None:
+            return
+        if self._closed:
+            raise UnavailableError("fleet is closed")
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    did = self.step()
+                except BaseException as e:  # noqa: BLE001 — no hangs
+                    self._dead = e
+                    self._abort_all(lambda req: UnavailableError(
+                        f"request {req.id} aborted: fleet loop died: "
+                        f"{e!r}"))
+                    return
+                if not did:
+                    self._work.wait(0.002)
+                    self._work.clear()
+
+        self._thread = threading.Thread(target=loop, name="serving-fleet",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        """Close every replica; every outstanding request reaches a
+        terminal state.  Idempotent and safe under concurrent
+        double-close (same contract as the engine/gateway)."""
+        self._closed = True
+        self._stop.set()
+        self._work.set()
+        with self._close_lock:
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None
+            self.manager.close_all()
+        with self._lock:
+            self._affinity.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- introspection -------------------------------------------------
+    def health(self) -> Dict:
+        """Per-replica health + fleet aggregates — the gateway's
+        /healthz fleet block."""
+        reps = self.manager.replicas()
+        return {
+            "replicas": {str(r.id): r.snapshot() for r in reps},
+            "routable": len(self.manager.routable()),
+            "total": len(reps),
+            "warm": self.warm,
+            **self.manager.counters(),
+        }
+
+    def post_warmup_compiles(self) -> int:
+        """Worst replica's post-warmup compile count (the fleet contract
+        is 0 on every replica); -1 if any live replica never warmed."""
+        vals = [r.engine.post_warmup_compiles()
+                for r in self.manager.replicas(_LIVE)]
+        return max(vals) if vals else -1
+
+    def metrics(self) -> Dict:
+        live = self.manager.replicas(_LIVE)
+        totals = {"requests_completed": 0, "requests_errored": 0,
+                  "tokens_out": 0}
+        per = {}
+        for rep in self.manager.replicas():
+            try:
+                m = rep.engine.metrics()
+            except Exception:
+                m = {}
+            if rep.state in _LIVE:
+                for k in totals:
+                    totals[k] += m.get(k) or 0
+            per[str(rep.id)] = {"state": rep.state,
+                                "occupancy": m.get("slot_occupancy"),
+                                "queue_depth": m.get("queue_depth"),
+                                "completed": m.get("requests_completed"),
+                                "errored": m.get("requests_errored")}
+        return {
+            **totals,
+            "replicas": per,
+            "routable": len(self.manager.routable()),
+            "live": len(live),
+            "sessions": len(self._affinity),
+            "max_slots": self.max_slots,
+            "warm": self.warm,
+            "post_warmup_compiles": (self.post_warmup_compiles()
+                                     if self.warm else None),
+            **{f"fleet_{k}": v for k, v in self.manager.counters().items()},
+        }

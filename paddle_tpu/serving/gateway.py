@@ -199,6 +199,9 @@ class ServingGateway:
         self._stop = threading.Event()
         self._work = threading.Event()
         self._closed = False
+        # concurrent double-close safety (see ServingEngine.close): the
+        # join + fail-everything sequence runs exactly once at a time
+        self._close_lock = threading.Lock()
         self._dead: Optional[BaseException] = None
         # counters surfaced by metrics() (registry handles shared with
         # Prometheus; these are the gateway-local snapshot copies)
@@ -627,18 +630,24 @@ class ServingGateway:
 
     def close(self, close_engine: bool = True):
         """Stop the loop; every outstanding request — queued, paused, or
-        decoding — reaches a terminal error (never a hang)."""
+        decoding — reaches a terminal error (never a hang).  Idempotent
+        and safe under concurrent double-close (the fleet replica manager
+        and the caller's own shutdown can race): the flag flips first so
+        racing submits reject, and the join/drain sequence serializes
+        under _close_lock."""
         self._closed = True
         self._stop.set()
         self._work.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
-        self._fail_everything(lambda req: RequestCancelled(
-            f"request {req.id} aborted: gateway closed"
-            + (" (was preempted)"
-               if getattr(req, "preempts", 0) > getattr(req, "resumes", 0)
-               else "")))
+        with self._close_lock:
+            if self._thread is not None:
+                self._thread.join(timeout=5.0)
+                self._thread = None
+            self._fail_everything(lambda req: RequestCancelled(
+                f"request {req.id} aborted: gateway closed"
+                + (" (was preempted)"
+                   if getattr(req, "preempts", 0) > getattr(req, "resumes",
+                                                            0)
+                   else "")))
         if close_engine:
             self.engine.close()
 
@@ -809,8 +818,18 @@ class ServingGateway:
                     pstore = store_stats()
                 except Exception:
                     pstore = None
+                # fleet-fronted gateways aggregate per-replica health:
+                # state, warm, step-time EWMA, heartbeat age and
+                # post-warmup compiles per replica, plus the routable
+                # count — the signals a cluster scheduler needs to decide
+                # whether THIS front door still has capacity behind it
+                health_fn = getattr(self.engine, "health", None)
+                fleet = health_fn() if callable(health_fn) else None
+                if fleet is not None and fleet.get("routable", 0) == 0:
+                    status = 503
                 return status, "application/json", json.dumps({
                     "ok": status == 200,
+                    "fleet": fleet,
                     # readiness: warm=True means every serving program is
                     # precompiled (engine.warmup ran) — no admitted
                     # request will ever pay a trace

@@ -34,7 +34,8 @@ class Request:
     __slots__ = ("id", "prompt", "max_new_tokens", "greedy", "temperature",
                  "top_k", "top_p", "eos_token_id", "seed", "deadline",
                  "poison", "priority", "tenant", "preempts", "resumes",
-                 "paused_seconds", "spec")
+                 "paused_seconds", "spec", "session", "resubmit",
+                 "migrations")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  greedy: bool = True, temperature: float = 1.0,
@@ -43,7 +44,8 @@ class Request:
                  seed: Optional[int] = None,
                  deadline: Optional[float] = None,
                  priority: int = 0, tenant: Optional[str] = None,
-                 spec: bool = False):
+                 spec: bool = False, session: Optional[str] = None,
+                 resubmit: bool = False):
         self.id = int(rid)
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if self.prompt.size == 0:
@@ -78,6 +80,15 @@ class Request:
         self.preempts = 0
         self.resumes = 0
         self.paused_seconds = 0.0  # total wall time spent preempted
+        # fleet routing (serving/fleet.py): requests sharing a session
+        # key stick to one replica while it stays healthy; resubmit=True
+        # (greedy-only, validated at make_request) opts the request into
+        # re-prefill-from-prompt recovery when its replica crashes and
+        # the run snapshot is lost with it.  migrations counts completed
+        # cross-replica run transfers (drain/brownout failover).
+        self.session = session
+        self.resubmit = bool(resubmit)
+        self.migrations = 0
 
 
 _TOK, _END, _ERR = 0, 1, 2

@@ -1,0 +1,229 @@
+"""Replica-portable run transfer codec.
+
+The PR-6 `engine.preempt_slot` snapshot (`PreemptedRun`: per-layer KV
+row arrays + RNG key / write position / produced count / last token)
+was designed for pause-and-resume on ONE engine.  This module
+generalizes it into the unit of fleet failover: a preempted run encodes
+to a pure-numpy blob (optionally to bytes — npz — for subprocess
+replicas) that any CONFIGURATION-COMPATIBLE replica decodes back into a
+`PreemptedRun` and feeds to its own `restore_run`, so a stream migrated
+mid-decode resumes bit-identical to a run that never moved:
+
+- the KV rows are the layout-agnostic ``(pos, heads, head_dim)`` row
+  arrays both the fixed and paged snapshot paths already produce, so a
+  run can migrate between fixed- and paged-pool replicas of the same
+  model;
+- the sampling state (raw RNG key + write position + produced count +
+  last committed token) is exactly what decode step ``pos`` needs to
+  fold the same key it would have folded uninterrupted;
+- the request descriptor (prompt, budget, sampling knobs, seed, tenant,
+  session, REMAINING deadline) rides along so a subprocess replica can
+  rebuild the Request on its side of the wire — in-process migration passes the original
+  Request/Response straight through instead (the consumer keeps
+  iterating the same stream object).
+
+Every compatibility axis is checked loudly: layer count, per-layer row
+shapes and dtypes against the target engine's live pools, and the
+position budget against the target's max_len.  A mismatch raises the
+typed `RunTransferError` — a run must never be written into a pool it
+does not fit, and a quiet shape cast would corrupt the stream it was
+supposed to save.
+"""
+from __future__ import annotations
+
+import io
+import json
+from typing import Optional
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from .engine import PreemptedRun
+from .request import Request, Response
+
+__all__ = ["RunTransferError", "encode_run", "decode_run", "run_to_bytes",
+           "run_from_bytes", "check_compatible", "TRANSFER_VERSION"]
+
+TRANSFER_VERSION = 1
+
+# Request fields the codec carries so a subprocess replica can rebuild
+# the request on its side of the wire (json-serializable scalars only)
+_REQ_FIELDS = ("id", "max_new_tokens", "greedy", "temperature", "top_k",
+               "top_p", "eos_token_id", "seed", "priority", "tenant",
+               "spec", "session", "resubmit")
+
+
+class RunTransferError(InvalidArgumentError):
+    """The snapshot cannot be restored on the target replica: version,
+    layer-count, shape, dtype, or length-budget mismatch.  Typed so the
+    fleet can fail the stream terminally instead of corrupting it."""
+    code = "InvalidArgument"
+
+
+def encode_run(paused: PreemptedRun) -> dict:
+    """PreemptedRun -> portable blob: pure numpy + scalars, no live
+    object references.  The blob alone (via `run_to_bytes`) is enough to
+    resume the stream in another process; in-process callers pass the
+    original req/resp back to `decode_run` so the consumer's stream
+    object survives the move."""
+    kv = [(np.asarray(k), np.asarray(v)) for k, v in paused.kv_rows]
+    draft = None
+    if paused.draft_kv_rows is not None:
+        draft = [(np.asarray(k), np.asarray(v))
+                 for k, v in paused.draft_kv_rows]
+    req = paused.req
+    req_desc = {f: getattr(req, f) for f in _REQ_FIELDS}
+    # the deadline crosses the wire as its REMAINING budget at encode
+    # time (the Deadline object is anchored to this process's clock): a
+    # migrated run must keep counting down, not get a fresh budget
+    req_desc["deadline_remaining_s"] = (
+        None if req.deadline is None else req.deadline.remaining())
+    return {
+        "version": TRANSFER_VERSION,
+        "pos": int(paused.pos),
+        "produced": int(paused.produced),
+        "last_token": int(paused.last_token),
+        "key": np.asarray(paused.key),
+        "kv_rows": kv,
+        "draft_kv_rows": draft,
+        "prompt": np.asarray(req.prompt, np.int32),
+        "req": req_desc,
+        "manifest": {
+            "layers": len(kv),
+            "draft_layers": None if draft is None else len(draft),
+            "kv_shapes": [(list(k.shape), list(v.shape)) for k, v in kv],
+            "kv_dtypes": [(str(k.dtype), str(v.dtype)) for k, v in kv],
+        },
+    }
+
+
+def check_compatible(blob: dict, engine) -> None:
+    """Raise RunTransferError unless `blob` can restore into `engine`'s
+    pools bit-exactly: same layer count, same per-row trailing shape and
+    dtype per layer (target AND draft halves), remaining budget within
+    the target's max_len, and a codec version this build understands."""
+    if blob.get("version") != TRANSFER_VERSION:
+        raise RunTransferError(
+            f"run snapshot codec version {blob.get('version')!r} != "
+            f"{TRANSFER_VERSION} — refusing a format this build does not "
+            "understand")
+    man = blob["manifest"]
+
+    def check_side(rows, pools, what):
+        if len(rows) != len(pools):
+            raise RunTransferError(
+                f"{what}: snapshot has {len(rows)} layers, target engine "
+                f"has {len(pools)} — replicas must serve the same model")
+        for i, ((rk, rv), (pk, pv)) in enumerate(zip(rows, pools)):
+            for r, p, half in ((rk, pk, "k"), (rv, pv, "v")):
+                # pool leaves are (slots|blocks, rows, heads, dim); a
+                # snapshot row array is (pos, heads, dim) — trailing
+                # dims must agree exactly
+                if tuple(r.shape[1:]) != tuple(p.shape[2:]):
+                    raise RunTransferError(
+                        f"{what} layer {i}/{half}: snapshot row shape "
+                        f"{tuple(r.shape[1:])} != target pool row shape "
+                        f"{tuple(p.shape[2:])}")
+                if r.dtype != p.dtype:
+                    raise RunTransferError(
+                        f"{what} layer {i}/{half}: snapshot dtype "
+                        f"{r.dtype} != target pool dtype {p.dtype} — a "
+                        "silent cast would break bit-identity")
+
+    check_side(blob["kv_rows"], engine._pools, "KV rows")
+    if blob["draft_kv_rows"] is not None:
+        if engine.draft_model is None:
+            raise RunTransferError(
+                "snapshot carries draft KV but the target engine has no "
+                "draft model")
+        check_side(blob["draft_kv_rows"], engine._draft_pools,
+                   "draft KV rows")
+    elif engine.draft_model is not None:
+        # restorable (the draft pool just starts cold — correctness never
+        # depends on draft KV), but the accept rate of the resumed stream
+        # would silently collapse; the fleet treats this as a mismatch
+        raise RunTransferError(
+            "target engine is speculative but the snapshot has no draft "
+            "KV rows — resume would decay to target-only throughput")
+    pos = int(blob["pos"])
+    plen = int(blob["prompt"].shape[0])
+    budget = int(blob["req"]["max_new_tokens"])
+    if plen + budget > engine.max_len:
+        raise RunTransferError(
+            f"run needs {plen} prompt + {budget} new tokens but the "
+            f"target engine's max_len is {engine.max_len}")
+    if pos > engine.max_len:
+        raise RunTransferError(
+            f"snapshot position {pos} exceeds target max_len "
+            f"{engine.max_len}")
+    if man["layers"] != len(blob["kv_rows"]):
+        raise RunTransferError(
+            f"manifest says {man['layers']} layers, blob carries "
+            f"{len(blob['kv_rows'])} — corrupt snapshot")
+
+
+def decode_run(blob: dict, req: Optional[Request] = None,
+               resp: Optional[Response] = None,
+               engine=None) -> PreemptedRun:
+    """Blob -> PreemptedRun ready for `engine.restore_run`.
+
+    In-process migration passes the ORIGINAL `req`/`resp` so the
+    consumer's open stream continues uninterrupted; a subprocess replica
+    omits them and the Request is rebuilt from the blob (the caller owns
+    bridging the fresh Response back over its IPC).  Passing `engine`
+    runs `check_compatible` first."""
+    if engine is not None:
+        check_compatible(blob, engine)
+    if req is None:
+        r = blob["req"]
+        req = Request(r["id"], blob["prompt"], r["max_new_tokens"],
+                      greedy=r["greedy"], temperature=r["temperature"],
+                      top_k=r["top_k"], top_p=r["top_p"],
+                      eos_token_id=r["eos_token_id"], seed=r["seed"],
+                      deadline=r.get("deadline_remaining_s"),
+                      priority=r["priority"], tenant=r["tenant"],
+                      spec=r["spec"], session=r["session"],
+                      resubmit=r["resubmit"])
+    if resp is None:
+        resp = Response(req)
+    return PreemptedRun.from_state(
+        req, resp, pos=blob["pos"], produced=blob["produced"],
+        last_token=blob["last_token"], key=blob["key"],
+        kv_rows=blob["kv_rows"], draft_kv_rows=blob["draft_kv_rows"])
+
+
+def run_to_bytes(blob: dict) -> bytes:
+    """Serialize a blob to one npz byte string (the subprocess wire
+    format): arrays under indexed keys, scalars in a json header."""
+    arrays = {"key": blob["key"], "prompt": blob["prompt"]}
+    for i, (k, v) in enumerate(blob["kv_rows"]):
+        arrays[f"k{i}"] = k
+        arrays[f"v{i}"] = v
+    if blob["draft_kv_rows"] is not None:
+        for i, (k, v) in enumerate(blob["draft_kv_rows"]):
+            arrays[f"dk{i}"] = k
+            arrays[f"dv{i}"] = v
+    header = {kk: blob[kk] for kk in ("version", "pos", "produced",
+                                      "last_token", "req", "manifest")}
+    arrays["header"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8).copy()
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def run_from_bytes(data: bytes) -> dict:
+    """Inverse of `run_to_bytes`."""
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        try:
+            header = json.loads(bytes(z["header"].tobytes()).decode())
+        except Exception as e:
+            raise RunTransferError(f"corrupt run snapshot header: {e!r}")
+        n = header["manifest"]["layers"]
+        kv = [(z[f"k{i}"], z[f"v{i}"]) for i in range(n)]
+        dn = header["manifest"]["draft_layers"]
+        draft = (None if dn is None
+                 else [(z[f"dk{i}"], z[f"dv{i}"]) for i in range(dn)])
+        blob = dict(header, key=z["key"], prompt=z["prompt"],
+                    kv_rows=kv, draft_kv_rows=draft)
+    return blob

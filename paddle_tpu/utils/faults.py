@@ -81,6 +81,24 @@ Injection points (consumed elsewhere in the framework):
                   Makes overload, SLO-miss, and mid-decode-deadline paths
                   testable on CPU without a big model.
                   Env: PDTPU_FAULT_SLOW_DECODE="ms[:every_n]".
+  replica_crash   the fleet replica with index `replica` dies abruptly at
+                  its `tick`-th step (0-based) — the SIGKILL-equivalent
+                  for in-process replicas: the step raises mid-loop, the
+                  engine gets no chance to fail its runs, and the
+                  ReplicaManager must fence the replica and fail over
+                  every resident stream (resubmit or typed terminal;
+                  never a hang).  Live-read per replica step, like
+                  slow_decode.  Env: PDTPU_FAULT_REPLICA_CRASH=
+                  "replica:tick".
+  replica_slow    a fleet replica's step loop sleeps `ms` milliseconds on
+                  the host before every `every_n`-th step — the brownout:
+                  a browned-out replica serves, just far too slowly, and
+                  the ReplicaManager's step-time health tracking must
+                  fence it and migrate its residents to fast replicas.
+                  The optional third field targets one replica index
+                  (default: every replica).  Live-read per step, nothing
+                  baked into any trace.  Env: PDTPU_FAULT_REPLICA_SLOW=
+                  "ms[:every_n[:replica]]".
 
 Deliberately import-light (no jax at module scope): DataLoader worker
 processes and the bench orchestrator consult it before any backend exists.
@@ -98,7 +116,8 @@ __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "poison_logits", "slow_decode_config", "maybe_slow_decode",
            "draft_diverge_every", "poison_draft_logits", "kv_exhaust_cap",
            "prefetch_stall_config", "maybe_stall_prefetch",
-           "row_corrupt_fetch"]
+           "row_corrupt_fetch", "replica_crash_config",
+           "replica_slow_config", "maybe_slow_replica"]
 
 _ENV = {
     "nan_grads": "PDTPU_FAULT_NAN_GRADS",
@@ -111,6 +130,8 @@ _ENV = {
     "kv_exhaust": "PDTPU_FAULT_KV_EXHAUST",
     "prefetch_stall": "PDTPU_FAULT_PREFETCH_STALL",
     "row_corrupt": "PDTPU_FAULT_ROW_CORRUPT",
+    "replica_crash": "PDTPU_FAULT_REPLICA_CRASH",
+    "replica_slow": "PDTPU_FAULT_REPLICA_SLOW",
 }
 
 _lock = threading.Lock()
@@ -377,6 +398,54 @@ def row_corrupt_fetch() -> Optional[int]:
     if not raw:
         return None
     return int(raw)
+
+
+# -- replica_crash / replica_slow --------------------------------------------
+
+def replica_crash_config() -> Optional[Tuple[int, int]]:
+    """(replica_index, tick) at which the targeted fleet replica dies
+    abruptly, or None when disarmed.  Consulted live per replica step by
+    the ReplicaManager (host-side only), so it can be armed on a running
+    fleet."""
+    raw = get("replica_crash")
+    if not raw:
+        return None
+    replica, tick = raw.split(":", 1)
+    return int(replica), int(tick)
+
+
+def replica_slow_config() -> Optional[Tuple[float, int, Optional[int]]]:
+    """(sleep_ms, every_n, replica_or_None) — the brownout knob, or None
+    when disarmed.  A None replica field slows EVERY replica; an index
+    slows only that one (the probe's targeted brownout).  Consulted live
+    per replica step, nothing baked into any trace."""
+    raw = get("replica_slow")
+    if not raw:
+        return None
+    parts = raw.split(":", 2)
+    ms = float(parts[0])
+    every = int(parts[1]) if len(parts) >= 2 else 1
+    replica = int(parts[2]) if len(parts) == 3 else None
+    return ms, max(1, every), replica
+
+
+def maybe_slow_replica(replica_idx: int, step_no: int) -> float:
+    """Host-side sleep before step `step_no` (0-based) of replica
+    `replica_idx` when replica_slow is armed, the stride hits, and the
+    replica matches (or no replica is targeted).  Returns seconds
+    slept."""
+    cfg = replica_slow_config()
+    if cfg is None:
+        return 0.0
+    ms, every, target = cfg
+    if target is not None and target != replica_idx:
+        return 0.0
+    if step_no % every:
+        return 0.0
+    import time
+    secs = ms / 1000.0
+    time.sleep(secs)
+    return secs
 
 
 # -- backend_down ------------------------------------------------------------

@@ -1,0 +1,424 @@
+#!/usr/bin/env python
+"""Fleet serving chaos probe (ISSUE-12 acceptance artifact).
+
+Three phases against a 3-replica in-process fleet (FleetRouter over
+ServingEngines, tiny GPT, CPU):
+
+1. **Failover** — Poisson greedy traffic (most requests opted into
+   ``resubmit=True``), then a SIGKILL-equivalent loss of the busiest
+   replica mid-decode (``PDTPU_FAULT_REPLICA_CRASH``).  Bars: ZERO hung
+   consumers; every stream either completes bit-identical to its
+   uninterrupted solo-generate oracle (survivors untouched, lost
+   opt-ins resubmitted and seamlessly continued) or — for the
+   deliberate non-opt-ins resident on the dead replica — ends in the
+   typed ReplicaLostError; failover stall (crash -> first
+   post-crash token of every affected stream) p99 under the bar.
+2. **Brownout** — ``PDTPU_FAULT_REPLICA_SLOW`` stretches one replica's
+   steps far past the fleet's slow threshold; health fences it and its
+   residents MIGRATE through the run-transfer codec.  Bars: fenced
+   (degraded), >= 1 migration, every stream bit-identical, zero drops.
+3. **Rolling restart** — save one warm replica's AOT program set, then
+   ``fleet.rollout()`` boots a replacement from it for every replica
+   (warm, shift traffic, drain, remove) under continuous submissions.
+   Bars: zero dropped requests, all streams bit-identical, every new
+   replica boots with every program from the program set
+   (``program_set:exe``) and the fleet reports ZERO post-warmup
+   compiles under post-rollout traffic.
+
+`--steps N` (N <= 5) is the CI smoke: phase 1 only, parity + terminal
+states, no perf bars.  Prints one `FLEET{json}` line; exits 1 on any
+bar miss.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=36,
+                    help="phase-1 requests (<=5 switches to smoke mode)")
+    ap.add_argument("--replicas", type=int, default=3)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--chunk", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--failover-bar-ms", type=float, default=4000.0,
+                    help="p99 crash->first-post-crash-token stall bar")
+    args = ap.parse_args()
+
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+    from paddle_tpu.serving import (FleetRouter, ReplicaLostError,
+                                    ServingEngine)
+    from paddle_tpu.utils import faults
+
+    n_req = max(1, args.steps)
+    smoke = n_req <= 5
+
+    rng = np.random.RandomState(args.seed)
+    vocab = 64
+    cfg = models.GPTConfig(vocab_size=vocab, hidden_size=32,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0,
+                           max_position_embeddings=128)
+    paddle.seed(11)
+    model = models.GPTForPretraining(cfg)
+    model.eval()
+
+    def make_engine(**kw):
+        return ServingEngine(model, max_slots=args.slots, max_len=64,
+                             prefill_buckets=(8,),
+                             decode_chunk=args.chunk,
+                             max_queue_depth=max(64, n_req), **kw)
+
+    plens = [4, 7]
+    budgets = [12, 16, 20]
+
+    def draw_prompt():
+        return rng.randint(0, vocab, (plens[int(rng.randint(len(plens)))],)
+                           ).astype(np.int32)
+
+    oracle = {}
+
+    def want(prompt, max_new):
+        key = (prompt.tobytes(), max_new)
+        if key not in oracle:
+            out, _ = model.generate(paddle.to_tensor(prompt[None]),
+                                    max_new_tokens=max_new)
+            oracle[key] = np.asarray(out.numpy())[0].tolist()
+        return oracle[key]
+
+    failures = []
+    out = {"smoke": smoke, "replicas": args.replicas, "slots": args.slots,
+           "decode_chunk": args.chunk,
+           "workload": f"greedy, prompt_len in {plens}, max_new in "
+                       f"{budgets}, Poisson arrivals, GPT (32h/2L/{vocab}v), "
+                       "cpu"}
+
+    fleet = FleetRouter([make_engine() for _ in range(args.replicas)],
+                        slow_threshold_ms=None if smoke else 40.0)
+    fleet.warmup()
+
+    # ------------------------------------------------------------------
+    # phase 1: Poisson traffic + SIGKILL-equivalent replica loss
+    # ------------------------------------------------------------------
+    plan = []
+    for i in range(n_req):
+        plan.append({
+            "prompt": draw_prompt(),
+            "max_new": budgets[int(rng.randint(len(budgets)))],
+            # a couple of deliberate non-opt-ins prove the typed
+            # terminal path; everything else opts into resubmission
+            "resubmit": not (i % max(4, n_req // 3) == 1),
+        })
+    # two long ANCHOR streams pinned (session affinity) to one replica:
+    # the crash targets their replica on its next step, so the loss is
+    # guaranteed to land mid-decode — failover is exercised every run,
+    # not only when the Poisson timing cooperates
+    n_anchor = 2
+    for _ in range(n_anchor):
+        plan.append({"prompt": draw_prompt(), "max_new": max(budgets) + 4,
+                     "resubmit": True})
+    for r in plan:
+        want(r["prompt"], r["max_new"])
+
+    n_all = n_req + n_anchor
+    resps = [None] * n_all
+    progress = [[] for _ in range(n_all)]  # (t, token_count) on change
+    last_counts = [0] * n_all
+    watch_stop = threading.Event()
+
+    def watcher():
+        while not watch_stop.is_set():
+            now = time.monotonic()
+            for i, r in enumerate(resps):
+                if r is None:
+                    continue
+                n = len(r.tokens_so_far())
+                if n != last_counts[i]:
+                    last_counts[i] = n
+                    progress[i].append((now, n))
+            time.sleep(0.002)
+
+    fleet.start()
+    gaps_mean = 0.0 if smoke else 1.0 / 50.0
+    arrivals = (np.zeros(n_req) if smoke
+                else np.cumsum(rng.exponential(gaps_mean, size=n_req)))
+    t0 = time.monotonic()
+
+    def submitter():
+        for i in range(n_req):
+            r = plan[i]
+            wait = arrivals[i] - (time.monotonic() - t0)
+            if wait > 0:
+                time.sleep(wait)
+            resps[i] = fleet.submit(
+                r["prompt"], r["max_new"], resubmit=r["resubmit"],
+                session=f"u{i % 5}")
+
+    watch = threading.Thread(target=watcher, daemon=True)
+    sub = threading.Thread(target=submitter)
+    watch.start()
+    sub.start()
+
+    # pin the anchors to one replica, wait until they are decoding,
+    # then kill exactly that replica on its next steps
+    for j in range(n_anchor):
+        i = n_req + j
+        resps[i] = fleet.submit(plan[i]["prompt"], plan[i]["max_new"],
+                                resubmit=True, session="crash-anchor")
+    crash_t = None
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if all(len(resps[n_req + j].tokens_so_far()) > 0
+               for j in range(n_anchor)):
+            break
+        time.sleep(0.002)
+    victim = fleet._affinity.get("crash-anchor")
+    affected_ids = [run.req.id for (rid, _s), run in fleet._slots.items()
+                    if rid == victim]
+    if victim is None or not affected_ids:
+        failures.append("anchor streams never became resident — nothing "
+                        "to crash into")
+    else:
+        for _ in range(20):
+            rep = fleet.manager.get(victim)
+            faults.enable("replica_crash", f"{victim}:{rep.steps + 1}")
+            t_arm = time.monotonic()
+            while time.monotonic() - t_arm < 1.0:
+                if fleet.manager.get(victim).state == "crashed":
+                    crash_t = time.monotonic()
+                    break
+                time.sleep(0.002)
+            if crash_t is not None:
+                break
+        faults.disable("replica_crash")
+        if crash_t is None:
+            failures.append("replica_crash fault never fired")
+    sub.join()
+
+    # every consumer must reach a terminal state — never a hang
+    hung = []
+    term_deadline = time.monotonic() + 120
+    for i, r in enumerate(resps):
+        if r is None or not r._done.wait(
+                timeout=max(0.0, term_deadline - time.monotonic())):
+            hung.append(i)
+    watch_stop.set()
+    watch.join(timeout=2)
+
+    parity_failures, typed_lost, wrong_errors, completed = [], [], [], 0
+    req_ids = {resps[i].request.id: i for i in range(n_all)
+               if resps[i] is not None}
+    for i, r in enumerate(resps):
+        if r is None or i in hung:
+            continue
+        if r.error is None:
+            completed += 1
+            if r.tokens(timeout=5) != want(plan[i]["prompt"],
+                                           plan[i]["max_new"]):
+                parity_failures.append(i)
+        elif isinstance(r.error, ReplicaLostError):
+            typed_lost.append(i)
+            if plan[i]["resubmit"]:
+                wrong_errors.append(
+                    f"req {i} opted into resubmit but was lost: "
+                    f"{r.error}")
+        else:
+            wrong_errors.append(f"req {i}: {type(r.error).__name__}: "
+                                f"{r.error}")
+
+    # failover stall: crash -> first post-crash token per affected stream
+    failover_gaps = []
+    if crash_t is not None:
+        for rid_ in affected_ids:
+            i = req_ids.get(rid_)
+            if i is None:
+                continue
+            post = [t for (t, _n) in progress[i] if t > crash_t]
+            if post:
+                failover_gaps.append((post[0] - crash_t) * 1e3)
+    failover_gaps.sort()
+    p99 = (failover_gaps[min(len(failover_gaps) - 1,
+                             int(0.99 * len(failover_gaps)))]
+           if failover_gaps else None)
+    c1 = fleet.manager.counters()
+    out.update({
+        "requests": n_req,
+        "anchors": n_anchor,
+        "completed": completed,
+        "hung": len(hung),
+        "typed_lost": len(typed_lost),
+        "affected_streams": len(affected_ids),
+        "resubmits": c1["resubmits"],
+        "failover_p99_ms": None if p99 is None else round(p99, 1),
+        "dropped_streams": len(hung) + len(wrong_errors)
+        + len(parity_failures),
+    })
+    if hung:
+        failures.append(f"requests {hung[:5]} never reached a terminal "
+                        "state (hang)")
+    if parity_failures:
+        failures.append(f"parity: requests {parity_failures[:5]} diverged "
+                        "from solo generate")
+    if wrong_errors:
+        failures.append("unexpected terminal errors: "
+                        + "; ".join(wrong_errors[:3]))
+    if crash_t is not None and c1["resubmits"] + len(typed_lost) < 1:
+        failures.append("crash lost no resident run — failover "
+                        "unexercised (anchors finished early?)")
+    if not smoke:
+        if crash_t is not None and not failover_gaps:
+            failures.append("no affected stream produced a post-crash "
+                            "token (failover unmeasured)")
+        if p99 is not None and p99 >= args.failover_bar_ms:
+            failures.append(f"failover p99 {p99:.0f}ms >= "
+                            f"{args.failover_bar_ms}ms bar")
+
+    # ------------------------------------------------------------------
+    # phase 2: brownout — slow replica fenced, residents migrate
+    # ------------------------------------------------------------------
+    if not smoke and not hung:
+        b_plan = [{"prompt": draw_prompt(), "max_new": 20}
+                  for _ in range(6)]
+        for r in b_plan:
+            want(r["prompt"], r["max_new"])
+        b_resps = [fleet.submit(r["prompt"], r["max_new"], session="pin")
+                   for r in b_plan]
+        # brown out the replica the pinned session actually landed on
+        target = fleet._affinity["pin"]
+        t_wait = time.monotonic() + 30
+        while (fleet.manager.get(target).engine.scheduler.occupancy() == 0
+               and time.monotonic() < t_wait):
+            time.sleep(0.002)
+        faults.enable("replica_slow", f"120:1:{target}")
+        b_hung = [i for i, r in enumerate(b_resps)
+                  if not r._done.wait(timeout=120)]
+        faults.disable("replica_slow")
+        b_parity = [i for i, r in enumerate(b_resps)
+                    if i not in b_hung and (
+                        r.error is not None
+                        or r.tokens(timeout=5) != want(
+                            b_plan[i]["prompt"], b_plan[i]["max_new"]))]
+        c2 = fleet.manager.counters()
+        out.update({
+            "brownout_target": target,
+            "brownout_state": fleet.manager.get(target).state,
+            "brownout_migrated": c2["migrated"] - c1["migrated"],
+            "brownout_streams": len(b_plan),
+        })
+        if b_hung:
+            failures.append(f"brownout: requests {b_hung[:5]} hung")
+        if b_parity:
+            failures.append(f"brownout: requests {b_parity[:5]} dropped "
+                            "or diverged")
+        if fleet.manager.get(target).state not in ("degraded", "healthy"):
+            failures.append("brownout: replica neither fenced nor "
+                            f"recovered ({fleet.manager.get(target).state})")
+        if c2["migrated"] - c1["migrated"] < 1:
+            failures.append("brownout: no run migrated off the slow "
+                            "replica")
+
+    # ------------------------------------------------------------------
+    # phase 3: rolling restart from a program set, zero drops
+    # ------------------------------------------------------------------
+    if not smoke and not hung:
+        tmp = tempfile.mkdtemp(prefix="fleet_probe_ps_")
+        donor = next(r for r in fleet.manager.replicas()
+                     if r.state in ("healthy", "degraded")
+                     and r.engine.warm)
+        ps_path = donor.engine.save_program_set(
+            os.path.join(tmp, "serving.ptps"))
+        boot_sources = []
+
+        def factory():
+            eng = make_engine(program_set=ps_path)
+            boot_sources.append(eng.warmup()["programs"])
+            return eng
+
+        r_plan = [{"prompt": draw_prompt(), "max_new": 12}
+                  for _ in range(10)]
+        for r in r_plan:
+            want(r["prompt"], r["max_new"])
+        r_resps = []
+
+        def r_submitter():
+            for i, r in enumerate(r_plan):
+                r_resps.append(fleet.submit(r["prompt"], r["max_new"],
+                                            session=f"v{i % 4}"))
+                time.sleep(0.03)
+
+        rt = threading.Thread(target=r_submitter)
+        rt.start()
+        time.sleep(0.06)
+        try:
+            fleet.rollout(factory, timeout=180)
+            rollout_err = None
+        except Exception as e:
+            rollout_err = f"{type(e).__name__}: {e}"
+        rt.join()
+        r_hung = [i for i, r in enumerate(r_resps)
+                  if not r._done.wait(timeout=120)]
+        r_bad = [i for i, r in enumerate(r_resps)
+                 if i not in r_hung and (
+                     r.error is not None
+                     or r.tokens(timeout=5) != want(
+                         r_plan[i]["prompt"], r_plan[i]["max_new"]))]
+        # post-rollout traffic must compile nothing on the booted fleet
+        tail = fleet.submit(r_plan[0]["prompt"], r_plan[0]["max_new"])
+        tail_ok = (tail._done.wait(timeout=60) and tail.error is None
+                   and tail.tokens() == want(r_plan[0]["prompt"],
+                                             r_plan[0]["max_new"]))
+        pwc = fleet.post_warmup_compiles()
+        exe_boots = sum(1 for src in boot_sources
+                        if all(v == "program_set:exe"
+                               for v in src.values()))
+        out.update({
+            "rollout_dropped": len(r_hung) + len(r_bad)
+            + (0 if rollout_err is None else 1),
+            "rollout_streams": len(r_plan),
+            "rollout_post_warmup_compiles": pwc,
+            "rollout_exe_boots": exe_boots,
+            "rollout_replicas": len(boot_sources),
+        })
+        if rollout_err:
+            failures.append(f"rollout failed: {rollout_err}")
+        if r_hung or r_bad:
+            failures.append(f"rollout dropped/diverged requests "
+                            f"{(r_hung + r_bad)[:5]}")
+        if not tail_ok:
+            failures.append("post-rollout tail request failed")
+        if pwc != 0:
+            failures.append(f"{pwc} post-warmup compiles on the rolled "
+                            "fleet (must be 0)")
+        if exe_boots != len(boot_sources):
+            failures.append(
+                f"only {exe_boots}/{len(boot_sources)} replicas booted "
+                "every program from the program set (program_set:exe)")
+
+    out["fleet_counters"] = fleet.manager.counters()
+    out["health"] = {k: v for k, v in fleet.health().items()
+                     if k != "replicas"}
+    fleet.close()
+    faults.reset()
+    if failures:
+        out["failures"] = failures
+    print("FLEET" + json.dumps(out), flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
