@@ -841,18 +841,22 @@ def measure_gateway():
 
 
 def measure_fleet():
-    """ISSUE-12 acceptance artifact: probes/fleet_probe.py in a clean CPU
-    subprocess.  Publishes the multi-replica serving story as
-    `detail.fleet.{failover_p99_ms,dropped_streams,rollout_dropped}` —
-    bars: under Poisson traffic on a 3-replica fleet, a
-    SIGKILL-equivalent replica loss mid-decode leaves ZERO hung
-    consumers (every stream completes bit-identical to its solo-generate
-    oracle via migration/resubmission or ends in a typed terminal
-    error), a browned-out replica is fenced by step-time health and its
-    residents migrate bit-identical, and a full rolling restart (every
-    replica rebooted from an AOT program set under continuous traffic)
-    drops zero requests with zero post-warmup compiles on the rolled
-    fleet."""
+    """ISSUE-12/13 acceptance artifact: probes/fleet_probe.py in a clean
+    CPU subprocess.  Publishes the multi-replica serving story as
+    `detail.fleet.{failover_p99_ms,dropped_streams,rollout_dropped,
+    wedge_detect_ms,restart_ok}` — bars: under Poisson traffic on a
+    3-replica fleet, a SIGKILL-equivalent replica loss mid-decode leaves
+    ZERO hung consumers (every stream completes bit-identical to its
+    solo-generate oracle via migration/resubmission or ends in a typed
+    terminal error), a browned-out replica is fenced by step-time health
+    and its residents migrate bit-identical, a full rolling restart
+    (every replica rebooted from an AOT program set under continuous
+    traffic) drops zero requests with zero post-warmup compiles on the
+    rolled fleet, and — process isolation — a real SIGKILL and a
+    PDTPU_FAULT_REPLICA_WEDGE hang of SUBPROCESS workers both fence
+    within the out-of-band heartbeat threshold with the supervisor
+    restarting both workers from the program set (restart_ok) at zero
+    post-warmup compiles."""
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -871,6 +875,8 @@ def measure_fleet():
             return {"failover_p99_ms": rec.get("failover_p99_ms"),
                     "dropped_streams": rec.get("dropped_streams"),
                     "rollout_dropped": rec.get("rollout_dropped"),
+                    "wedge_detect_ms": rec.get("wedge_detect_ms"),
+                    "restart_ok": rec.get("restart_ok"),
                     "detail": rec}
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 

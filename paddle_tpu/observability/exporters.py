@@ -171,6 +171,14 @@ def report() -> dict:
         "lost_runs": stats.get("STAT_fleet_lost_runs", 0),
         "reroutes": stats.get("STAT_fleet_reroutes", 0),
         "drains": stats.get("STAT_fleet_drains", 0),
+        # subprocess workers (process isolation): live worker processes,
+        # heartbeat-age fences (wedges), supervised restarts and
+        # budget exhaustions
+        "worker_processes": _gauge_value("fleet_worker_processes"),
+        "wedges": stats.get("STAT_fleet_wedges", 0),
+        "worker_restarts": stats.get("STAT_fleet_worker_restarts", 0),
+        "restarts_exhausted": stats.get("STAT_fleet_restarts_exhausted",
+                                        0),
     }
     gateway = {
         "ttft_hi_seconds": _hist_summary("gateway_ttft_hi_seconds"),

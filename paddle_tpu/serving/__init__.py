@@ -109,7 +109,14 @@ crashed replica are re-prefilled from the prompt (``resubmit=True``,
 greedy-only) or fail with the typed `ReplicaLostError` — and
 `drain()`/`rollout()` give zero-downtime weight/program rollouts.
 ``ServingGateway(fleet, ...)`` turns the multi-tenant front door into a
-cluster front door.  See the README "Fleet serving" section.
+cluster front door.  ``fleet.add_worker(spec)`` makes a replica its own
+OS process (serving/worker.py): a subprocess engine worker booted from
+a model-factory spec + AOT program set, spoken to over a
+length-prefixed npz RPC, with OUT-OF-BAND heartbeat liveness (a wedged
+step — the hang an in-process fleet cannot survive — fences on
+heartbeat age, is SIGKILLed after a grace period, and is restarted by
+the supervisor with backoff under a budget).  See the README "Fleet
+serving" section.
 
 Program lifecycle
 -----------------
@@ -138,9 +145,11 @@ from .slo import ShedPolicy, Signals, SLOTracker, TenantConfig, TokenBucket
 from .gateway import (ServingGateway, GatewayServer, RateLimitedError,
                       SheddedError, serve_gateway, PRIORITY_HIGH,
                       PRIORITY_LOW)
-from .fleet import FleetRouter, ReplicaManager, Replica, ReplicaLostError
+from .fleet import (FleetRouter, ReplicaManager, Replica,
+                    SubprocessReplica, RestartBackoff, ReplicaLostError)
 from .transfer import (RunTransferError, encode_run, decode_run,
-                       run_to_bytes, run_from_bytes)
+                       run_to_bytes, run_from_bytes, engine_config_hash)
+from .worker import WorkerClient, WorkerDiedError, WireFormatError
 
 __all__ = [
     "ServingEngine", "Request", "Response", "RequestScheduler",
@@ -153,8 +162,11 @@ __all__ = [
     "TokenBucket", "ShedPolicy", "Signals", "SLOTracker",
     "RateLimitedError", "SheddedError", "PRIORITY_HIGH", "PRIORITY_LOW",
     # fleet (multi-replica router: health-driven failover, run
-    # migration, zero-downtime rollout)
-    "FleetRouter", "ReplicaManager", "Replica", "ReplicaLostError",
+    # migration, zero-downtime rollout, supervised subprocess workers)
+    "FleetRouter", "ReplicaManager", "Replica", "SubprocessReplica",
+    "RestartBackoff", "ReplicaLostError",
     "RunTransferError", "encode_run", "decode_run", "run_to_bytes",
-    "run_from_bytes",
+    "run_from_bytes", "engine_config_hash",
+    # subprocess worker replicas (process isolation + heartbeat)
+    "WorkerClient", "WorkerDiedError", "WireFormatError",
 ]

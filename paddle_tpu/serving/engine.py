@@ -288,7 +288,8 @@ class PreemptedRun:
     uninterrupted."""
 
     __slots__ = ("req", "resp", "pos", "produced", "last_token", "key",
-                 "kv_rows", "draft_kv_rows", "preempted_at")
+                 "kv_rows", "draft_kv_rows", "preempted_at",
+                 "source_config_hash")
 
     def __init__(self, run: _SlotRun, kv_rows, draft_kv_rows=None):
         self.req = run.req
@@ -305,6 +306,12 @@ class PreemptedRun:
         # to target-only throughput)
         self.draft_kv_rows = draft_kv_rows
         self.preempted_at = time.monotonic()
+        # the source engine's transfer-identity digest
+        # (transfer.engine_config_hash), stamped by preempt_slot so the
+        # hash survives every manager-side re-encode hop of a migration
+        # — a cross-manifest restore must be refused typed no matter how
+        # many times the snapshot was decoded and re-encoded in between
+        self.source_config_hash: Optional[str] = None
 
     @classmethod
     def from_state(cls, req, resp, pos: int, produced: int,
@@ -324,6 +331,7 @@ class PreemptedRun:
         paused.kv_rows = kv_rows
         paused.draft_kv_rows = draft_kv_rows
         paused.preempted_at = time.monotonic()
+        paused.source_config_hash = None
         return paused
 
 
@@ -1413,6 +1421,8 @@ class ServingEngine:
                                np.array(v[slot, :run.pos]))
                               for k, v in dhost]
         paused = PreemptedRun(run, kv_rows, draft_rows)
+        from .transfer import engine_config_hash
+        paused.source_config_hash = engine_config_hash(self)
         run.req.preempts += 1
         self._slots.pop(slot, None)
         self.scheduler.release(slot)

@@ -36,6 +36,24 @@ implement over IPC — and `ReplicaManager` owns their lifecycle:
   it, add it, drain the old one (`rollout()` sequences this across the
   whole fleet).
 
+**Process isolation** (serving/worker.py): `add_worker(spec)` spawns a
+replica as its OWN OS process — a `SubprocessReplica` whose engine
+proxy (`WorkerClient`) speaks the length-prefixed npz RPC and
+implements the exact engine surface above, so routing, affinity,
+gateway fronting, drain and rollout work unchanged over a MIXED
+in-process/subprocess fleet.  Subprocess health adds the signal the
+in-process fleet cannot have: an **out-of-band heartbeat** (the worker
+atomically rewrites a step-counter+wall-clock file after every step),
+so a replica whose step WEDGES — a hang, not a raise; the socket stays
+connected and no call ever returns — is fenced on heartbeat AGE
+(`heartbeat_timeout_s`), SIGKILLed after `kill_grace_s`, and restarted
+by the supervisor with exponential backoff + jitter (`RestartBackoff`
+over utils.retry) under a restart budget.  Residents of a wedged or
+crashed worker fail over through the existing paths (resubmit / typed
+`ReplicaLostError` / queue re-route — the local proxy queue holds
+every not-yet-shipped request); budget exhaustion removes the replica
+for good.
+
 The in-process threading contract mirrors the gateway's: ONE thread
 drives `step()` — either the fleet's own `start()` loop or a
 `ServingGateway` fronting the router (the router implements the
@@ -44,13 +62,16 @@ engine-facing surface the gateway consumes: `make_request`,
 views, `step`, `_abort_all`).  `submit` is safe from any thread.
 
 Chaos knobs (utils.faults): ``PDTPU_FAULT_REPLICA_CRASH=replica:tick``
-(SIGKILL-equivalent mid-decode loss) and
-``PDTPU_FAULT_REPLICA_SLOW=ms[:every_n[:replica]]`` (brownout) — the
-fleet probe (probes/fleet_probe.py) drives both under Poisson traffic
-plus a full rolling restart.
+(SIGKILL-equivalent mid-decode loss),
+``PDTPU_FAULT_REPLICA_SLOW=ms[:every_n[:replica]]`` (brownout) and
+``PDTPU_FAULT_REPLICA_WEDGE=replica:tick`` (a subprocess worker's step
+blocks forever — only the heartbeat can see it) — the fleet probe
+(probes/fleet_probe.py) drives all three under Poisson traffic plus a
+full rolling restart and a supervised worker restart.
 """
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -58,13 +79,16 @@ from typing import Callable, Dict, List, Optional
 from ..core.errors import InvalidArgumentError, UnavailableError
 from ..utils import faults
 from ..utils.monitor import stat_add
+from ..utils.retry import RetryPolicy
 from .engine import PreemptedRun, ServingEngine
 from .request import Request, Response, RequestCancelled
 from .scheduler import DeadlineExceededError, QueueFullError
 from .transfer import (RunTransferError, check_compatible, decode_run,
                        encode_run)
+from .worker import WorkerClient, WorkerDiedError
 
-__all__ = ["FleetRouter", "ReplicaManager", "Replica", "ReplicaLostError"]
+__all__ = ["FleetRouter", "ReplicaManager", "Replica",
+           "SubprocessReplica", "RestartBackoff", "ReplicaLostError"]
 
 # replica lifecycle states
 BOOTING = "booting"      # added, not yet warm — never routed to
@@ -72,6 +96,10 @@ HEALTHY = "healthy"      # warm + fast: routable
 DEGRADED = "degraded"    # fenced by slow-step health; residents migrate
 DRAINING = "draining"    # admissions stopped; residents migrate/finish
 CRASHED = "crashed"      # step raised / injected kill; state abandoned
+WEDGED = "wedged"        # subprocess heartbeat went stale mid-step: the
+#                          process is alive but not making progress —
+#                          fenced like a crash (its state is unreachable),
+#                          then SIGKILLed after the grace period
 CLOSED = "closed"        # engine closed (drain finished or shutdown)
 
 _LIVE = (BOOTING, HEALTHY, DEGRADED, DRAINING)
@@ -160,14 +188,59 @@ def _obs():
                 "fleet_migrated_runs_total",
                 "in-flight runs moved between replicas via the run "
                 "transfer codec"),
+            "hb_age": _m.gauge(
+                "serving_replica_heartbeat_age_seconds",
+                "seconds since the replica's last heartbeat (out-of-band "
+                "file for subprocess workers, step beat in-process) — "
+                "the subprocess-deployment alarm signal",
+                labelnames=("replica",)),
+            "workers": _m.gauge(
+                "fleet_worker_processes",
+                "live subprocess worker replicas (process alive)"),
+            "wedges": _m.counter(
+                "fleet_wedged_replicas_total",
+                "replicas fenced on heartbeat age (wedged step: process "
+                "alive, no progress)"),
+            "worker_restarts": _m.counter(
+                "fleet_worker_restarts_total",
+                "supervised subprocess worker restarts performed"),
         }
     return _obs_handles
+
+
+class RestartBackoff:
+    """The supervisor's restart schedule: exponential backoff with full
+    jitter over a hard restart budget — `utils.retry.RetryPolicy`'s
+    schedule (a crashed worker is just another flaky service), with the
+    sleep inverted into an absolute next-attempt time so the fleet tick
+    stays non-blocking.  `rng` is injectable (deterministic tests)."""
+
+    def __init__(self, max_restarts: int = 3, base_delay: float = 0.5,
+                 max_delay: float = 30.0, jitter: float = 0.5,
+                 rng: Optional[Callable[[float, float], float]] = None):
+        self.max_restarts = max(0, int(max_restarts))
+        self._policy = RetryPolicy(retries=self.max_restarts,
+                                   base_delay=base_delay,
+                                   max_delay=max_delay, jitter=jitter)
+        self._rng = rng if rng is not None else random.uniform
+
+    def delay_for(self, attempt: int) -> Optional[float]:
+        """Jittered delay before restart number `attempt` (1-based), or
+        None once the budget is exhausted."""
+        if attempt < 1 or attempt > self.max_restarts:
+            return None
+        delay = list(self._policy.delays())[attempt - 1]
+        if self._policy.jitter:
+            delay += self._rng(0.0, self._policy.jitter * delay)
+        return delay
 
 
 class Replica:
     """One managed ServingEngine + its health state.  `rid` is a
     monotonically increasing integer, never reused — it is also the
     index the replica fault knobs target."""
+
+    kind = "inproc"
 
     def __init__(self, rid: int, engine: ServingEngine):
         self.id = rid
@@ -197,8 +270,21 @@ class Replica:
             else:
                 self.fast_steps = 0
 
+    def observe_step(self, dt: float, threshold: Optional[float]):
+        """Health bookkeeping for one successful driving-tick step: the
+        step IS the heartbeat in-process (one thread drives everyone —
+        a step that returns proves liveness)."""
+        self.last_beat = time.monotonic()
+        self.note_step_time(dt, threshold)
+
+    def heartbeat_age(self) -> Optional[float]:
+        """Seconds since the last liveness evidence."""
+        return max(0.0, time.monotonic() - self.last_beat)
+
     def snapshot(self) -> Dict:
+        age = self.heartbeat_age()
         return {
+            "kind": self.kind,
             "state": self.state,
             "warm": bool(self.engine.warm),
             "occupancy": self.engine.scheduler.occupancy(),
@@ -206,11 +292,54 @@ class Replica:
             "steps": self.steps,
             "step_ewma_ms": (None if self.step_ewma is None
                              else round(self.step_ewma * 1e3, 3)),
-            "heartbeat_age_s": round(time.monotonic() - self.last_beat, 3),
+            "heartbeat_age_s": (None if age is None else round(age, 3)),
             "fence_reason": self.fence_reason,
             "post_warmup_compiles": (self.engine.post_warmup_compiles()
                                      if self.engine.warm else None),
         }
+
+
+class SubprocessReplica(Replica):
+    """A replica whose engine is a `WorkerClient` proxy over its own OS
+    process.  Same state machine, plus: out-of-band heartbeat age (the
+    wedge detector), worker-reported step times feeding the brownout
+    EWMA (pump time on this side measures nothing), and a `lineage`
+    record the supervisor uses to restart it — the spec, the stable
+    worker index the fault knobs target, and the cumulative restart
+    count the budget caps."""
+
+    kind = "subprocess"
+
+    def __init__(self, rid: int, client: WorkerClient, lineage: Dict):
+        super().__init__(rid, client)
+        self.lineage = lineage
+
+    def observe_step(self, dt: float, threshold: Optional[float]):
+        # dt here is manager-side PUMP time; the worker reports its real
+        # per-step wall times (brownout sleeps included) in status frames
+        for wdt in self.engine.take_step_times():
+            self.note_step_time(wdt, threshold)
+
+    def heartbeat_age(self, fresh: bool = False) -> Optional[float]:
+        age = self.engine.heartbeat_age(fresh=fresh)
+        if age is None:
+            # no beat file yet (early boot): fall back to manager-side
+            # evidence so the snapshot stays meaningful
+            return max(0.0, time.monotonic() - self.last_beat)
+        # mirror into last_beat so manager-side views stay consistent
+        self.last_beat = time.monotonic() - age
+        return age
+
+    def snapshot(self) -> Dict:
+        snap = super().snapshot()
+        snap.update({
+            "pid": self.engine.pid,
+            "process_alive": self.engine.process_alive(),
+            "worker_index": self.lineage.get("index"),
+            "restarts": self.lineage.get("restarts", 0),
+            "worker_steps": self.engine.heartbeat_steps(),
+        })
+        return snap
 
 
 class ReplicaManager:
@@ -222,7 +351,11 @@ class ReplicaManager:
     change up on its next tick."""
 
     def __init__(self, slow_threshold_ms: Optional[float] = None,
-                 probation_steps: int = 5):
+                 probation_steps: int = 5,
+                 heartbeat_timeout_s: Optional[float] = 10.0,
+                 kill_grace_s: float = 2.0,
+                 restart_backoff: Optional[RestartBackoff] = None,
+                 _clock: Callable[[], float] = time.monotonic):
         self._replicas: Dict[int, Replica] = {}
         self._next_id = 0
         self._lock = threading.Lock()
@@ -230,12 +363,27 @@ class ReplicaManager:
         self.slow_threshold_s = (None if slow_threshold_ms is None
                                  else float(slow_threshold_ms) / 1e3)
         self.probation_steps = int(probation_steps)
+        # subprocess liveness: a worker whose out-of-band heartbeat is
+        # older than this is WEDGED (fenced + failed over even though no
+        # in-band call returned), SIGKILLed kill_grace_s later, and
+        # restarted under restart_backoff's budget.  Applies ONLY to
+        # SubprocessReplica — in-process, a raising step IS the verdict.
+        self.heartbeat_timeout_s = (None if heartbeat_timeout_s is None
+                                    else float(heartbeat_timeout_s))
+        self.kill_grace_s = float(kill_grace_s)
+        self.restart_backoff = (RestartBackoff()
+                                if restart_backoff is None
+                                else restart_backoff)
+        self._clock = _clock
+        self._pending_kills: List[tuple] = []   # (rep, kill_at)
+        self._restarts: List[Dict] = []         # {lineage, at, from}
         # runs preempted off a fenced replica that no peer could hold
         # yet (paged-block shortfall): retried every tick, swept for
         # cancel/deadline, failed terminally at close
         self._parked: List[PreemptedRun] = []
         self._n = {"failovers": 0, "migrated": 0, "resubmits": 0,
-                   "lost": 0, "reroutes": 0, "drains": 0}
+                   "lost": 0, "reroutes": 0, "drains": 0, "wedges": 0,
+                   "worker_restarts": 0, "restarts_exhausted": 0}
 
     # -- membership ---------------------------------------------------
     def add(self, engine: ServingEngine) -> Replica:
@@ -248,6 +396,33 @@ class ReplicaManager:
             rid = self._next_id
             self._next_id += 1
             rep = Replica(rid, engine)
+            self._replicas[rid] = rep
+        self._publish_up(rep)
+        return rep
+
+    def add_worker(self, spec: Dict, lineage: Optional[Dict] = None,
+                   boot_timeout_s: float = 180.0,
+                   rpc_timeout_s: float = 15.0) -> "SubprocessReplica":
+        """Spawn a subprocess engine worker from a boot spec (model
+        factory + engine config + optional AOT program set — see
+        serving/worker.py) and register it BOOTING; the driving tick
+        polls the handshake and flips it healthy once the worker reports
+        warm.  `lineage` is internal (the supervisor's restart path
+        reuses the original spec/index/budget record)."""
+        client_kw = {"boot_timeout_s": float(boot_timeout_s),
+                     "rpc_timeout_s": float(rpc_timeout_s)}
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+        if lineage is None:
+            # the worker INDEX (fault-knob target) stays stable across
+            # restarts; the replica id never recurs
+            lineage = {"spec": dict(spec), "index": rid, "restarts": 0,
+                       "client_kw": client_kw, "exhausted": False}
+        client = WorkerClient(lineage["spec"], index=lineage["index"],
+                              **lineage.get("client_kw", client_kw))
+        rep = SubprocessReplica(rid, client, lineage)
+        with self._lock:
             self._replicas[rid] = rep
         self._publish_up(rep)
         return rep
@@ -272,11 +447,17 @@ class ReplicaManager:
             rep = self._replicas.get(rid)
             if rep is None:
                 return
-            if rep.state not in (CLOSED, CRASHED):
+            if rep.state not in (CLOSED, CRASHED, WEDGED):
                 raise InvalidArgumentError(
                     f"replica {rid} is {rep.state}; drain it before "
                     "remove (or let crash handling finish)")
             del self._replicas[rid]
+        if isinstance(rep, SubprocessReplica):
+            # reap: a removed worker leaves no orphan.  A crashed/wedged
+            # corpse gets the non-graceful path — it cannot answer a
+            # close verb, and the graceful 2s wait would stall the
+            # driving thread (every OTHER replica) for nothing
+            rep.engine.close(graceful=rep.state == CLOSED)
         _obs()["up"].labels(replica=str(rid)).set(0)
         self._publish_counts()
 
@@ -335,26 +516,38 @@ class ReplicaManager:
     def tick(self) -> bool:
         """One fleet iteration on the driving thread: step every live
         replica (crash fault + brownout fault consulted per step, wall
-        time fed to health), fence what the health verdicts demand,
-        migrate residents off fenced replicas, retry parked runs, close
-        drained-empty replicas."""
+        time fed to health), poll subprocess boot handshakes, fence what
+        the health verdicts — including out-of-band heartbeat age —
+        demand, SIGKILL wedged workers past their grace period, run the
+        restart supervisor, migrate residents off fenced replicas, retry
+        parked runs, close drained-empty replicas."""
         self._ticks += 1
         did = False
         crash_cfg = faults.replica_crash_config()
         for rep in self.replicas(_LIVE):
             if rep.state == BOOTING:
+                did = self._poll_boot(rep) or did
                 continue
             if (rep.state == DEGRADED and not rep.engine.has_work()
-                    and self._ticks % 16):
-                # probation sampling: an idle fenced replica is stepped
-                # only occasionally, so a browned-out replica's injected
-                # step latency cannot keep stalling the shared loop
+                    and self._ticks % 16
+                    and not isinstance(rep, SubprocessReplica)):
+                # probation sampling: an idle fenced IN-PROCESS replica
+                # is stepped only occasionally, so a browned-out
+                # replica's injected step latency cannot keep stalling
+                # the shared loop.  A subprocess pump is always cheap
+                # (the slow step runs in the worker) and skipping it
+                # would starve its status/health feed.
                 continue
             try:
                 # the brownout sleep counts INTO the measured step time
-                # (it models a slow replica; health must see it)
+                # (it models a slow replica; health must see it).  For a
+                # subprocess replica the knob fires in the WORKER loop;
+                # the manager-side call is a no-op there (index spaces
+                # are disjoint only by convention — the worker consults
+                # its own index).
                 t0 = time.perf_counter()
-                faults.maybe_slow_replica(rep.id, rep.steps)
+                if not isinstance(rep, SubprocessReplica):
+                    faults.maybe_slow_replica(rep.id, rep.steps)
                 if crash_cfg is not None and crash_cfg == (rep.id,
                                                            rep.steps):
                     rep.steps += 1
@@ -364,19 +557,156 @@ class ReplicaManager:
                 stepped = rep.engine.step()
                 dt = time.perf_counter() - t0
                 rep.steps += 1
-                rep.last_beat = time.monotonic()
-                rep.note_step_time(dt, self.slow_threshold_s)
+                rep.observe_step(dt, self.slow_threshold_s)
                 did = stepped or did
             except BaseException as e:  # noqa: BLE001 — fence, never hang
                 self._on_crash(rep, e)
                 did = True
+        self._check_heartbeats()
         self._update_health()
         did = self._pump_migrations() or did
         did = self._pump_parked() or did
         self._sweep_parked()
         did = self._finish_drains() or did
+        did = self._pump_kills() or did
+        did = self._pump_restarts() or did
         self._publish_inflight()
         return did
+
+    def _poll_boot(self, rep: Replica) -> bool:
+        """Advance a BOOTING subprocess replica's handshake (in-process
+        replicas become healthy via warm_all).  Boot failure — process
+        exit, typed fatal, timeout — burns a restart attempt."""
+        if not isinstance(rep, SubprocessReplica):
+            return False
+        try:
+            ready = rep.engine.poll_ready()
+        except WorkerDiedError as e:
+            rep.state = CRASHED
+            rep.fence_reason = f"boot failed: {e}"
+            self._publish_up(rep)
+            rep.engine.kill()
+            self._schedule_restart(rep)
+            return True
+        if ready and rep.state == BOOTING:
+            rep.state = HEALTHY
+            rep.last_beat = time.monotonic()
+            self._publish_up(rep)
+            return True
+        return False
+
+    # -- out-of-band heartbeat: the wedged-worker detector -------------
+    def _check_heartbeats(self):
+        """Fence any live subprocess replica whose heartbeat file age
+        exceeds the threshold — the case PR 12 could not see: the step
+        never returns, the socket stays connected, and only the
+        out-of-band signal says 'no progress'."""
+        if self.heartbeat_timeout_s is None:
+            return
+        for rep in self.replicas((HEALTHY, DEGRADED, DRAINING)):
+            if not isinstance(rep, SubprocessReplica):
+                continue
+            age = rep.heartbeat_age()
+            if age is not None and age > self.heartbeat_timeout_s:
+                # confirm against a FRESH file read before fencing: the
+                # cached record may predate the worker's warmup beat (a
+                # false wedge would burn a restart-budget attempt)
+                age = rep.heartbeat_age(fresh=True)
+                if age is not None and age > self.heartbeat_timeout_s:
+                    self._on_wedge(rep, age)
+
+    def _on_wedge(self, rep: Replica, age: float):
+        """A wedged worker's device state is UNREACHABLE (any RPC would
+        hang), so failover treats it exactly like a crash; the process
+        itself gets `kill_grace_s` to unwedge on its own (a GC pause, an
+        allocator stall) before SIGKILL, and the supervisor restarts it
+        under the backoff budget."""
+        rep.state = WEDGED
+        rep.fence_reason = (f"wedged: heartbeat age {age:.2f}s > "
+                            f"{self.heartbeat_timeout_s:.2f}s threshold")
+        self._n["wedges"] += 1
+        self._n["failovers"] += 1
+        stat_add("STAT_fleet_wedges")
+        stat_add("STAT_fleet_failovers")
+        _obs()["wedges"].inc()
+        _obs()["failovers"].inc()
+        self._publish_up(rep)
+        self._fail_over_all(rep)
+        self._pending_kills.append((rep, self._clock()
+                                    + self.kill_grace_s))
+        self._schedule_restart(rep)
+
+    def _pump_kills(self) -> bool:
+        """SIGKILL wedged workers whose grace period expired.  Double
+        kill of an already-dead pid is a no-op (WorkerClient.kill)."""
+        if not self._pending_kills:
+            return False
+        now = self._clock()
+        due = [e for e in self._pending_kills if e[1] <= now]
+        if not due:
+            return False
+        self._pending_kills = [e for e in self._pending_kills
+                               if e[1] > now]
+        for rep, _ in due:
+            rep.engine.kill()
+        return True
+
+    # -- the restart supervisor ----------------------------------------
+    def _schedule_restart(self, rep: Replica):
+        if not isinstance(rep, SubprocessReplica):
+            return
+        # a WEDGED worker keeps its kill_grace_s before SIGKILL; the
+        # replacement must not spawn (and reap the corpse) earlier, or
+        # the grace period the knob promises never actually happens
+        min_delay = (self.kill_grace_s + 0.05 if rep.state == WEDGED
+                     else 0.0)
+        self._schedule_restart_lineage(rep.lineage, from_id=rep.id,
+                                       min_delay=min_delay)
+
+    def _schedule_restart_lineage(self, lineage: Dict,
+                                  from_id: Optional[int] = None,
+                                  min_delay: float = 0.0):
+        if lineage.get("exhausted"):
+            return
+        attempt = lineage.get("restarts", 0) + 1
+        delay = self.restart_backoff.delay_for(attempt)
+        if delay is None:
+            # budget exhausted: the replica is gone for good.  Every
+            # consumer already reached a typed terminal state when the
+            # incarnation was fenced; this only stops the respawning.
+            lineage["exhausted"] = True
+            self._n["restarts_exhausted"] += 1
+            stat_add("STAT_fleet_restarts_exhausted")
+            if from_id is not None:
+                self.remove(from_id)
+            return
+        lineage["restarts"] = attempt
+        self._restarts.append({"lineage": lineage,
+                               "at": self._clock() + max(delay, min_delay),
+                               "from": from_id})
+
+    def _pump_restarts(self) -> bool:
+        if not self._restarts:
+            return False
+        now = self._clock()
+        due = [r for r in self._restarts if r["at"] <= now]
+        if not due:
+            return False
+        self._restarts = [r for r in self._restarts if r["at"] > now]
+        for r in due:
+            lineage = r["lineage"]
+            # retire the dead incarnation the moment its successor exists
+            if r.get("from") is not None and self.get(r["from"]) is not None:
+                self.remove(r["from"])
+            try:
+                self.add_worker(lineage["spec"], lineage=lineage)
+            except Exception:  # spawn itself failed: burn another attempt
+                self._schedule_restart_lineage(lineage)
+                continue
+            self._n["worker_restarts"] += 1
+            stat_add("STAT_fleet_worker_restarts")
+            _obs()["worker_restarts"].inc()
+        return True
 
     # -- health --------------------------------------------------------
     def _update_health(self):
@@ -407,15 +737,27 @@ class ReplicaManager:
         runs and its device state is gone.  Fence it, then give every
         resident stream a future — resubmission for greedy opt-ins,
         the typed ReplicaLostError for the rest, a plain re-route for
-        queued work that never started.  Parked OOM snapshots count as
-        lost too: in the real deployment they lived in the dead
-        process."""
+        queued work that never started.  A crashed subprocess worker is
+        additionally reaped (no zombies) and handed to the restart
+        supervisor."""
         rep.state = CRASHED
         rep.fence_reason = repr(exc)
         self._n["failovers"] += 1
         stat_add("STAT_fleet_failovers")
         _obs()["failovers"].inc()
         self._publish_up(rep)
+        self._fail_over_all(rep)
+        if isinstance(rep, SubprocessReplica):
+            rep.engine.kill()
+            self._schedule_restart(rep)
+
+    def _fail_over_all(self, rep: Replica):
+        """Give every consumer of an unreachable replica a future.
+        Parked OOM snapshots count as lost too: in the real deployment
+        they lived in the dead process.  For a subprocess replica,
+        `_slots` is the proxy's residency mirror (everything shipped to
+        the worker) and the scheduler queue is the LOCAL not-yet-shipped
+        backlog — together they cover every accepted request."""
         engine = rep.engine
         lost = [(run.req, run.resp) for run in engine._slots.values()]
         # release the scheduler's host-side slot bookkeeping too: the
@@ -425,14 +767,12 @@ class ReplicaManager:
         for slot in list(engine._slots):
             engine.scheduler.release(slot)
         engine._slots.clear()
-        if engine.kv == "paged":
+        if getattr(engine, "kv", "fixed") == "paged":
             lost.extend((p.req, p.resp) for p in engine._oom_paused)
             engine._oom_paused = []
         for req, resp in lost:
             self._failover_lost(req, resp, rep.id)
         # queued-but-never-prefilled: nothing was delivered, re-route
-        # (the in-process queue survives; a subprocess router holds the
-        # same queue on ITS side of the wire, so the semantics carry)
         for req, resp in engine.scheduler.drain_pending():
             self._reroute(req, resp, exclude_id=rep.id)
 
@@ -531,35 +871,66 @@ class ReplicaManager:
         dropping it."""
         did = False
         for rep in self.replicas((DRAINING, DEGRADED)):
-            for slot in sorted(rep.engine._slots):
-                target = self._pick_slot_target(exclude_id=rep.id)
-                if target is None:
-                    break  # fleet full: residents finish in place
-                run = rep.engine._slots.get(slot)
-                if run is None:
-                    continue
-                paused = rep.engine.preempt_slot(slot)
-                blob = encode_run(paused)
-                try:
-                    snap = decode_run(blob, req=paused.req,
-                                      resp=paused.resp,
-                                      engine=target.engine)
-                except RunTransferError as e:
-                    # incompatible peer: the run must fail typed, not be
-                    # written into a pool it does not fit
-                    self._n["lost"] += 1
-                    stat_add("STAT_fleet_lost_runs")
-                    paused.resp._fail(e)
-                    did = True
-                    continue
-                if target.engine.restore_run(snap):
-                    snap.req.migrations += 1
-                    self._n["migrated"] += 1
-                    stat_add("STAT_fleet_migrated_runs")
-                    _obs()["migrated"].inc()
-                else:
-                    self._parked.append(snap)
+            try:
+                did = self._migrate_residents(rep) or did
+            except WorkerDiedError as e:
+                # the SOURCE worker died mid-preempt (or turned out to
+                # be wedged): crash semantics take over
+                self._on_crash(rep, e)
                 did = True
+        return did
+
+    def _migrate_residents(self, rep: Replica) -> bool:
+        did = False
+        for slot in sorted(rep.engine._slots):
+            target = self._pick_slot_target(exclude_id=rep.id)
+            if target is None:
+                break  # fleet full: residents finish in place
+            run = rep.engine._slots.get(slot)
+            if run is None:
+                continue
+            try:
+                paused = rep.engine.preempt_slot(slot)
+            except InvalidArgumentError:
+                # the run finished in the race window (a subprocess
+                # worker keeps stepping between our scan and the RPC)
+                continue
+            blob = encode_run(paused)
+            try:
+                snap = decode_run(blob, req=paused.req,
+                                  resp=paused.resp,
+                                  engine=target.engine)
+            except RunTransferError as e:
+                # incompatible peer: the run must fail typed, not be
+                # written into a pool it does not fit
+                self._n["lost"] += 1
+                stat_add("STAT_fleet_lost_runs")
+                paused.resp._fail(e)
+                did = True
+                continue
+            try:
+                restored = target.engine.restore_run(snap)
+            except RunTransferError as e:
+                self._n["lost"] += 1
+                stat_add("STAT_fleet_lost_runs")
+                paused.resp._fail(e)
+                did = True
+                continue
+            except WorkerDiedError as e:
+                # the TARGET died mid-restore; the snapshot survives on
+                # this side — park it and let failover handle the peer
+                self._on_crash(target, e)
+                self._parked.append(snap)
+                did = True
+                continue
+            if restored:
+                snap.req.migrations += 1
+                self._n["migrated"] += 1
+                stat_add("STAT_fleet_migrated_runs")
+                _obs()["migrated"].inc()
+            else:
+                self._parked.append(snap)
+            did = True
         return did
 
     def _pick_slot_target(self, exclude_id: int) -> Optional[Replica]:
@@ -575,7 +946,14 @@ class ReplicaManager:
             for rep in self._targets():
                 if rep.engine.scheduler.free_slot_count() <= 0:
                     continue
-                if rep.engine.restore_run(snap):
+                try:
+                    restored = rep.engine.restore_run(snap)
+                except RunTransferError:
+                    continue  # incompatible peer: try the next one
+                except WorkerDiedError as e:
+                    self._on_crash(rep, e)
+                    continue
+                if restored:
                     snap.req.migrations += 1
                     self._n["migrated"] += 1
                     stat_add("STAT_fleet_migrated_runs")
@@ -624,10 +1002,21 @@ class ReplicaManager:
             p.resp._fail(make_exc(p.req))
 
     def close_all(self):
+        # the supervisor dies with the fleet: no restart may spawn a
+        # worker after close, and no wedged corpse may outlive it
+        self._restarts = []
+        self._pending_kills = []
         for rep in self.replicas(_LIVE):
             rep.engine.close()
             rep.state = CLOSED
             self._publish_up(rep)
+        # reap EVERY subprocess — crashed/wedged corpses still listed
+        # until remove() included: router close leaves no orphan
+        # processes and no zombies behind (corpses get the non-graceful
+        # path: no 2s wait on a process that cannot answer)
+        for rep in self.replicas():
+            if isinstance(rep, SubprocessReplica):
+                rep.engine.close(graceful=rep.state == CLOSED)
         parked, self._parked = self._parked, []
         for p in parked:
             p.resp._fail(RequestCancelled(
@@ -645,11 +1034,35 @@ class ReplicaManager:
 
     def _publish_inflight(self):
         obs = _obs()
+        workers_alive = 0
         for rep in self.replicas(_LIVE):
             obs["inflight"].labels(replica=str(rep.id)).set(rep.load())
+            age = rep.heartbeat_age()
+            if age is not None:
+                obs["hb_age"].labels(replica=str(rep.id)).set(age)
+            if (isinstance(rep, SubprocessReplica)
+                    and rep.engine.process_alive()):
+                workers_alive += 1
+        obs["workers"].set(workers_alive)
+
+    def stale_routable(self) -> List[int]:
+        """Routable replica ids whose heartbeat age exceeds the wedge
+        threshold RIGHT NOW — normally empty (a stale replica is fenced
+        on the next tick), but nonzero when the DRIVING LOOP itself has
+        stalled, which is exactly when an external health scraper is the
+        only observer left."""
+        if self.heartbeat_timeout_s is None:
+            return []
+        out = []
+        for rep in self.routable():
+            age = rep.heartbeat_age()
+            if age is not None and age > self.heartbeat_timeout_s:
+                out.append(rep.id)
+        return out
 
     def counters(self) -> Dict:
-        return dict(self._n, parked=len(self._parked))
+        return dict(self._n, parked=len(self._parked),
+                    pending_restarts=len(self._restarts))
 
 
 class _FleetSchedulerView:
@@ -699,10 +1112,19 @@ class FleetRouter:
 
     def __init__(self, replicas=(),
                  slow_threshold_ms: Optional[float] = None,
-                 affinity: bool = True, max_sessions: int = 4096):
-        self.manager = ReplicaManager(slow_threshold_ms=slow_threshold_ms)
+                 affinity: bool = True, max_sessions: int = 4096,
+                 heartbeat_timeout_s: Optional[float] = 10.0,
+                 kill_grace_s: float = 2.0,
+                 restart_backoff: Optional[RestartBackoff] = None,
+                 workers=()):
+        self.manager = ReplicaManager(
+            slow_threshold_ms=slow_threshold_ms,
+            heartbeat_timeout_s=heartbeat_timeout_s,
+            kill_grace_s=kill_grace_s, restart_backoff=restart_backoff)
         for engine in replicas:
             self.manager.add(engine)
+        for spec in workers:
+            self.manager.add_worker(spec)
         self._affinity_enabled = bool(affinity)
         # LRU-bounded: one entry per live session key, refreshed on use —
         # a long-lived fleet serving millions of distinct users must not
@@ -724,6 +1146,21 @@ class FleetRouter:
         if self._closed:
             raise UnavailableError("fleet is closed")
         return self.manager.add(engine).id
+
+    def add_worker(self, spec: Dict, boot_timeout_s: float = 180.0,
+                   rpc_timeout_s: float = 15.0) -> int:
+        """Spawn a SUBPROCESS replica from a worker boot spec (see
+        serving/worker.py: model factory + engine config + optional AOT
+        program set) and return its replica id.  The worker boots and
+        warms in its own process; the driving loop flips it routable at
+        the ready handshake (or block on `warmup()`).  Crash/wedge
+        handling, SIGKILL and supervised restart are automatic."""
+        if self._closed:
+            raise UnavailableError("fleet is closed")
+        rep = self.manager.add_worker(spec, boot_timeout_s=boot_timeout_s,
+                                      rpc_timeout_s=rpc_timeout_s)
+        self._work.set()
+        return rep.id
 
     def drain(self, rid: int):
         self.manager.drain(rid)
@@ -829,7 +1266,16 @@ class FleetRouter:
         rep = self.manager.get(rid)
         if rep is None or rep.state not in _LIVE:
             raise InvalidArgumentError(f"replica {rid} is not live")
-        return rep.engine.preempt_slot(slot)
+        try:
+            return rep.engine.preempt_slot(slot)
+        except WorkerDiedError as e:
+            # the worker turned out dead/wedged mid-preempt: crash
+            # semantics fail the victim over, and the caller (the
+            # gateway's preemption scan) sees the replica-not-live error
+            # it already tolerates
+            self.manager._on_crash(rep, e)
+            raise InvalidArgumentError(
+                f"replica {rid} died during preempt: {e}")
 
     def restore_run(self, paused: PreemptedRun) -> bool:
         """Resume a preempted run on ANY replica with capacity — the
@@ -842,8 +1288,14 @@ class FleetRouter:
                 check_compatible(encode_run(paused), rep.engine)
             except RunTransferError:
                 continue
-            if rep.engine.restore_run(paused):
-                return True
+            try:
+                if rep.engine.restore_run(paused):
+                    return True
+            except RunTransferError:
+                continue
+            except WorkerDiedError as e:
+                self.manager._on_crash(rep, e)
+                continue
         return False
 
     def step(self) -> bool:
@@ -969,13 +1421,26 @@ class FleetRouter:
     # -- introspection -------------------------------------------------
     def health(self) -> Dict:
         """Per-replica health + fleet aggregates — the gateway's
-        /healthz fleet block."""
+        /healthz fleet block.  `all_routable_stale` is the
+        subprocess-deployment alarm: every replica the router would
+        still send traffic to has a heartbeat older than the wedge
+        threshold (normal fencing would have caught one stale replica —
+        ALL stale means the driving loop itself stopped), and the
+        gateway answers 503 on it."""
         reps = self.manager.replicas()
+        stale = self.manager.stale_routable()
+        routable = self.manager.routable()
         return {
             "replicas": {str(r.id): r.snapshot() for r in reps},
-            "routable": len(self.manager.routable()),
+            "routable": len(routable),
             "total": len(reps),
+            "workers": sum(1 for r in reps
+                           if isinstance(r, SubprocessReplica)),
             "warm": self.warm,
+            "heartbeat_timeout_s": self.manager.heartbeat_timeout_s,
+            "stale_routable": stale,
+            "all_routable_stale": bool(routable)
+            and len(stale) == len(routable),
             **self.manager.counters(),
         }
 

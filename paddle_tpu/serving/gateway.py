@@ -543,7 +543,13 @@ class ServingGateway:
                         best, victim_slot = key, slot
             if victim_slot is None:
                 return  # everything active outranks the arrival
-            paused = self.engine.preempt_slot(victim_slot)
+            try:
+                paused = self.engine.preempt_slot(victim_slot)
+            except InvalidArgumentError:
+                # the victim finished — or, fleet-fronted, its replica
+                # died — between the scan and the preempt; failover owns
+                # the dead-replica case, this loop just retries later
+                return
             self._paused.append(paused)
             _obs()["preempt"].inc()
             stat_add("STAT_gateway_preemptions")
@@ -826,6 +832,12 @@ class ServingGateway:
                 health_fn = getattr(self.engine, "health", None)
                 fleet = health_fn() if callable(health_fn) else None
                 if fleet is not None and fleet.get("routable", 0) == 0:
+                    status = 503
+                # every still-routable replica has a stale heartbeat:
+                # the DRIVING LOOP itself stalled (normal fencing would
+                # have caught one wedged replica), so this scraper is
+                # the last observer — alarm, don't reassure
+                if fleet is not None and fleet.get("all_routable_stale"):
                     status = 503
                 return status, "application/json", json.dumps({
                     "ok": status == 200,

@@ -22,15 +22,25 @@ mid-decode resumes bit-identical to a run that never moved:
   Request/Response straight through instead (the consumer keeps
   iterating the same stream object).
 
-Every compatibility axis is checked loudly: layer count, per-layer row
-shapes and dtypes against the target engine's live pools, and the
-position budget against the target's max_len.  A mismatch raises the
-typed `RunTransferError` — a run must never be written into a pool it
-does not fit, and a quiet shape cast would corrupt the stream it was
-supposed to save.
+Every compatibility axis is checked loudly: codec version, layer count,
+per-layer row shapes and dtypes against the target engine's live pools,
+the position budget against the target's max_len, and — when the
+snapshot carries one — the source engine's CONFIG HASH against the
+target's (`engine_config_hash`: model class, weight-shape signature,
+length budget, spec/dtype axes — the axes a program-set manifest pins).
+A worker built from a different program-set manifest therefore rejects
+a migrated run with the typed `RunTransferError` instead of decoding
+garbage rows into its pools; a quiet shape cast would corrupt the
+stream the migration was supposed to save.
+
+Cross-process targets (the subprocess replica proxy) cannot expose live
+pools; they implement ``transfer_manifest()`` returning the same
+descriptor `target_manifest` derives from a live engine, and every
+check runs against that.
 """
 from __future__ import annotations
 
+import hashlib
 import io
 import json
 from typing import Optional
@@ -42,9 +52,13 @@ from .engine import PreemptedRun
 from .request import Request, Response
 
 __all__ = ["RunTransferError", "encode_run", "decode_run", "run_to_bytes",
-           "run_from_bytes", "check_compatible", "TRANSFER_VERSION"]
+           "run_from_bytes", "check_compatible", "engine_config_hash",
+           "target_manifest", "TRANSFER_VERSION"]
 
-TRANSFER_VERSION = 1
+# v2: the npz header gained the codec version INSIDE the wire form (not
+# only the in-memory blob) plus the source engine's config hash, so a
+# cross-process restore can be refused typed before any row is decoded.
+TRANSFER_VERSION = 2
 
 # Request fields the codec carries so a subprocess replica can rebuild
 # the request on its side of the wire (json-serializable scalars only)
@@ -55,17 +69,80 @@ _REQ_FIELDS = ("id", "max_new_tokens", "greedy", "temperature", "top_k",
 
 class RunTransferError(InvalidArgumentError):
     """The snapshot cannot be restored on the target replica: version,
-    layer-count, shape, dtype, or length-budget mismatch.  Typed so the
-    fleet can fail the stream terminally instead of corrupting it."""
+    config-hash, layer-count, shape, dtype, or length-budget mismatch.
+    Typed so the fleet can fail the stream terminally instead of
+    corrupting it."""
     code = "InvalidArgument"
 
 
-def encode_run(paused: PreemptedRun) -> dict:
+def engine_config_hash(engine) -> str:
+    """Digest of the config axes a run transfer depends on: model class,
+    weight shape/dtype signature (target and draft), max_len/pool_len,
+    spec_tokens, KV dtype override and RNG key width.  Deliberately
+    EXCLUDES the axes a run may legitimately cross — kv layout
+    (fixed/paged), block_size, max_slots, buckets, decode_chunk — a run
+    migrates between fixed- and paged-pool replicas of the same model by
+    design.  Two engines built from the same program-set manifest hash
+    equal; a worker built from a different manifest does not."""
+    tm = getattr(engine, "transfer_manifest", None)
+    if callable(tm):
+        return tm()["config_hash"]
+    from ..programs.program_set import _state_sig
+    ident = {
+        "model_class": type(engine.model).__name__,
+        "state_sig": _state_sig(engine._state),
+        "draft_state_sig": (_state_sig(engine._dstate)
+                            if engine.draft_model is not None else None),
+        "max_len": int(engine.max_len),
+        "pool_len": int(engine._pool_len),
+        "spec_tokens": (int(engine.spec_tokens)
+                        if engine.draft_model is not None else None),
+        "dtype": (str(engine._dtype)
+                  if engine._dtype is not None else None),
+        "key_width": int(engine._key_width),
+    }
+    return hashlib.sha256(
+        json.dumps(ident, sort_keys=True).encode()).hexdigest()[:16]
+
+
+def target_manifest(engine) -> dict:
+    """The restore-compatibility descriptor of an engine: per-layer KV
+    row trailing shapes + dtypes (target and draft halves), max_len, and
+    the config hash.  A live engine derives it from its pools; a
+    subprocess replica PROXY returns the one its worker computed at boot
+    via ``transfer_manifest()`` — so `check_compatible` works identically
+    against both."""
+    tm = getattr(engine, "transfer_manifest", None)
+    if callable(tm):
+        return tm()
+
+    def side(pools):
+        return [{"k_shape": [int(d) for d in k.shape[2:]],
+                 "v_shape": [int(d) for d in v.shape[2:]],
+                 "k_dtype": str(k.dtype), "v_dtype": str(v.dtype)}
+                for k, v in pools]
+
+    return {
+        "config_hash": engine_config_hash(engine),
+        "max_len": int(engine.max_len),
+        "kv": side(engine._pools),
+        "draft_kv": (side(engine._draft_pools)
+                     if engine.draft_model is not None else None),
+    }
+
+
+def encode_run(paused: PreemptedRun, engine=None) -> dict:
     """PreemptedRun -> portable blob: pure numpy + scalars, no live
     object references.  The blob alone (via `run_to_bytes`) is enough to
     resume the stream in another process; in-process callers pass the
     original req/resp back to `decode_run` so the consumer's stream
-    object survives the move."""
+    object survives the move.  The source engine's config hash rides
+    the manifest — from `engine=` when given, else from the hash
+    `preempt_slot` stamped on the PreemptedRun itself
+    (`source_config_hash`), so it survives manager-side
+    decode/re-encode hops of a migration and a cross-process restore
+    onto a worker built from a different program-set manifest is
+    refused typed on EVERY path."""
     kv = [(np.asarray(k), np.asarray(v)) for k, v in paused.kv_rows]
     draft = None
     if paused.draft_kv_rows is not None:
@@ -93,52 +170,70 @@ def encode_run(paused: PreemptedRun) -> dict:
             "draft_layers": None if draft is None else len(draft),
             "kv_shapes": [(list(k.shape), list(v.shape)) for k, v in kv],
             "kv_dtypes": [(str(k.dtype), str(v.dtype)) for k, v in kv],
+            "config_hash": (engine_config_hash(engine)
+                            if engine is not None
+                            else getattr(paused, "source_config_hash",
+                                         None)),
         },
     }
 
 
 def check_compatible(blob: dict, engine) -> None:
     """Raise RunTransferError unless `blob` can restore into `engine`'s
-    pools bit-exactly: same layer count, same per-row trailing shape and
-    dtype per layer (target AND draft halves), remaining budget within
-    the target's max_len, and a codec version this build understands."""
+    pools bit-exactly: a codec version this build understands, a
+    matching engine config hash (when the snapshot carries one), same
+    layer count, same per-row trailing shape and dtype per layer (target
+    AND draft halves), and remaining budget within the target's
+    max_len.  `engine` may be a live ServingEngine or anything exposing
+    ``transfer_manifest()`` (the subprocess replica proxy)."""
     if blob.get("version") != TRANSFER_VERSION:
         raise RunTransferError(
             f"run snapshot codec version {blob.get('version')!r} != "
             f"{TRANSFER_VERSION} — refusing a format this build does not "
             "understand")
     man = blob["manifest"]
+    target = target_manifest(engine)
+    src_hash = man.get("config_hash")
+    if src_hash is not None and src_hash != target["config_hash"]:
+        raise RunTransferError(
+            f"snapshot came from an engine with config hash {src_hash} "
+            f"but the target's is {target['config_hash']} — the replicas "
+            "were built from different program-set manifests (model, "
+            "weights signature, length budget, or spec config differ); "
+            "a silent restore would decode garbage rows")
 
-    def check_side(rows, pools, what):
-        if len(rows) != len(pools):
+    def check_side(rows, sides, what):
+        if len(rows) != len(sides):
             raise RunTransferError(
                 f"{what}: snapshot has {len(rows)} layers, target engine "
-                f"has {len(pools)} — replicas must serve the same model")
-        for i, ((rk, rv), (pk, pv)) in enumerate(zip(rows, pools)):
-            for r, p, half in ((rk, pk, "k"), (rv, pv, "v")):
+                f"has {len(sides)} — replicas must serve the same model")
+        for i, ((rk, rv), s) in enumerate(zip(rows, sides)):
+            for r, shape, dt, half in (
+                    (rk, s["k_shape"], s["k_dtype"], "k"),
+                    (rv, s["v_shape"], s["v_dtype"], "v")):
                 # pool leaves are (slots|blocks, rows, heads, dim); a
                 # snapshot row array is (pos, heads, dim) — trailing
                 # dims must agree exactly
-                if tuple(r.shape[1:]) != tuple(p.shape[2:]):
+                if list(r.shape[1:]) != list(shape):
                     raise RunTransferError(
                         f"{what} layer {i}/{half}: snapshot row shape "
                         f"{tuple(r.shape[1:])} != target pool row shape "
-                        f"{tuple(p.shape[2:])}")
-                if r.dtype != p.dtype:
+                        f"{tuple(shape)}")
+                if str(r.dtype) != dt:
                     raise RunTransferError(
                         f"{what} layer {i}/{half}: snapshot dtype "
-                        f"{r.dtype} != target pool dtype {p.dtype} — a "
+                        f"{r.dtype} != target pool dtype {dt} — a "
                         "silent cast would break bit-identity")
 
-    check_side(blob["kv_rows"], engine._pools, "KV rows")
+    check_side(blob["kv_rows"], target["kv"], "KV rows")
     if blob["draft_kv_rows"] is not None:
-        if engine.draft_model is None:
+        if target["draft_kv"] is None:
             raise RunTransferError(
                 "snapshot carries draft KV but the target engine has no "
                 "draft model")
-        check_side(blob["draft_kv_rows"], engine._draft_pools,
+        check_side(blob["draft_kv_rows"], target["draft_kv"],
                    "draft KV rows")
-    elif engine.draft_model is not None:
+    elif target["draft_kv"] is not None:
         # restorable (the draft pool just starts cold — correctness never
         # depends on draft KV), but the accept rate of the resumed stream
         # would silently collapse; the fleet treats this as a mismatch
@@ -148,14 +243,14 @@ def check_compatible(blob: dict, engine) -> None:
     pos = int(blob["pos"])
     plen = int(blob["prompt"].shape[0])
     budget = int(blob["req"]["max_new_tokens"])
-    if plen + budget > engine.max_len:
+    max_len = int(target["max_len"])
+    if plen + budget > max_len:
         raise RunTransferError(
             f"run needs {plen} prompt + {budget} new tokens but the "
-            f"target engine's max_len is {engine.max_len}")
-    if pos > engine.max_len:
+            f"target engine's max_len is {max_len}")
+    if pos > max_len:
         raise RunTransferError(
-            f"snapshot position {pos} exceeds target max_len "
-            f"{engine.max_len}")
+            f"snapshot position {pos} exceeds target max_len {max_len}")
     if man["layers"] != len(blob["kv_rows"]):
         raise RunTransferError(
             f"manifest says {man['layers']} layers, blob carries "
@@ -186,15 +281,20 @@ def decode_run(blob: dict, req: Optional[Request] = None,
                       resubmit=r["resubmit"])
     if resp is None:
         resp = Response(req)
-    return PreemptedRun.from_state(
+    paused = PreemptedRun.from_state(
         req, resp, pos=blob["pos"], produced=blob["produced"],
         last_token=blob["last_token"], key=blob["key"],
         kv_rows=blob["kv_rows"], draft_kv_rows=blob["draft_kv_rows"])
+    # keep the source hash on the decoded snapshot: a later re-encode
+    # (manager-side migration hop) must not silently drop the check
+    paused.source_config_hash = blob["manifest"].get("config_hash")
+    return paused
 
 
 def run_to_bytes(blob: dict) -> bytes:
     """Serialize a blob to one npz byte string (the subprocess wire
-    format): arrays under indexed keys, scalars in a json header."""
+    format): arrays under indexed keys, scalars — including the codec
+    version and the source engine's config hash — in a json header."""
     arrays = {"key": blob["key"], "prompt": blob["prompt"]}
     for i, (k, v) in enumerate(blob["kv_rows"]):
         arrays[f"k{i}"] = k
@@ -213,12 +313,19 @@ def run_to_bytes(blob: dict) -> bytes:
 
 
 def run_from_bytes(data: bytes) -> dict:
-    """Inverse of `run_to_bytes`."""
+    """Inverse of `run_to_bytes`.  Any malformed header — including a
+    codec version this build does not speak — is the typed
+    RunTransferError, never a KeyError deep in a pool write."""
     with np.load(io.BytesIO(data), allow_pickle=False) as z:
         try:
             header = json.loads(bytes(z["header"].tobytes()).decode())
         except Exception as e:
             raise RunTransferError(f"corrupt run snapshot header: {e!r}")
+        if header.get("version") != TRANSFER_VERSION:
+            raise RunTransferError(
+                f"run snapshot codec version {header.get('version')!r} "
+                f"!= {TRANSFER_VERSION} — refusing a wire format this "
+                "build does not understand")
         n = header["manifest"]["layers"]
         kv = [(z[f"k{i}"], z[f"v{i}"]) for i in range(n)]
         dn = header["manifest"]["draft_layers"]

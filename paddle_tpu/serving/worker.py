@@ -1,0 +1,1301 @@
+"""Process-isolated serving replicas: the subprocess engine worker.
+
+PR 12's fleet fronted N IN-PROCESS engines: one driving thread steps
+every replica, so a wedged step — a hang, not a raise — stalls every
+tenant, and a real SIGKILL takes the whole fleet down.  This module is
+the missing half of ROADMAP item 3's tail (and the TPU-native shape of
+the reference framework's FleetWrapper / parameter-server deployment:
+workers as separate OS processes behind an RPC, liveness decided by
+timeouts, a supervisor restarting the dead):
+
+- **The worker** (`main()` — ``python -m paddle_tpu.serving.worker``)
+  boots a full ServingEngine in its own process from a json boot spec
+  (model factory + engine config + optional PR-9 AOT program set, so a
+  restart costs seconds and zero compiles), then drives
+  ``engine.step()`` in a single-threaded loop that multiplexes a
+  length-prefixed frame RPC: submit / stream-chunk / preempt / restore /
+  cancel / metrics / fault / close verbs.  Every frame payload is the
+  same npz wire form serving/transfer.py uses (arrays + a json header),
+  and every malformed frame decodes to the typed `WireFormatError` —
+  never a KeyError three layers down.
+- **The heartbeat is out-of-band**: the worker atomically rewrites a
+  small heartbeat file (monotonic step counter + wall clock) after
+  every completed step.  The RPC socket proves the PROCESS is alive;
+  only the heartbeat proves it is MAKING PROGRESS — a wedged step
+  (``PDTPU_FAULT_REPLICA_WEDGE``) keeps the socket healthy while the
+  heartbeat age grows, which is exactly the signal the ReplicaManager
+  fences on.
+- **`WorkerClient`** is the manager-side handle: it spawns the process,
+  speaks the RPC from the fleet's driving thread, and implements the
+  ServingEngine surface `ReplicaManager`/`FleetRouter`/`ServingGateway`
+  consume (`make_request`/`try_admit`/`scheduler`/`_slots`/`step`/
+  `preempt_slot`/`restore_run`/`_abort_all`/`close`/`warm`/`metrics`),
+  so a subprocess replica drops into the PR-12 fleet unchanged — mixed
+  in-process/subprocess fleets route, migrate, drain and roll out
+  through the exact same code paths.  Runs migrate over the wire via
+  the transfer codec's npz byte form; the client's local queue IS the
+  admission queue (a request ships only once the worker has a free
+  slot), so crash failover sees every queued request without a network
+  round trip.
+
+Threading contract (mirrors the in-process fleet): all socket I/O and
+state mutation happens on the fleet's driving thread via `step()` /
+RPC calls; only `scheduler.submit` (caller threads) and `close()` touch
+the client elsewhere, both under their own locks.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import select
+import shutil
+import socket
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.errors import (FatalError, InvalidArgumentError,
+                           ResourceExhaustedError, UnavailableError)
+from ..utils.monitor import stat_add
+from .request import Request, Response, RequestCancelled
+from .scheduler import DeadlineExceededError, QueueFullError
+
+__all__ = ["WorkerClient", "WorkerDiedError", "WireFormatError",
+           "pack_frame", "unpack_frame", "build_gpt", "main",
+           "WIRE_VERSION"]
+
+WIRE_VERSION = 1
+_MAX_FRAME = 1 << 30  # a tiny-model KV snapshot is KBs; 1 GiB = corruption
+_LEN = struct.Struct(">Q")
+
+
+class WireFormatError(InvalidArgumentError):
+    """A frame could not be decoded: bad length prefix, corrupt npz,
+    missing/garbled header, or a wire version this build does not speak.
+    The RunTransferError stance applied to the RPC itself — fail typed
+    at the boundary, never decode garbage into engine state."""
+    code = "InvalidArgument"
+
+
+class WorkerDiedError(UnavailableError):
+    """The subprocess worker is gone or unresponsive: process exited,
+    socket closed, or an RPC timed out (the wedged case).  The manager
+    treats it exactly like a replica crash — fence + failover."""
+    code = "Unavailable"
+
+
+# ---------------------------------------------------------------------------
+# frame codec: length prefix + the transfer.py npz wire form
+# ---------------------------------------------------------------------------
+
+def pack_frame(verb: str, header: Optional[dict] = None,
+               arrays: Optional[Dict[str, np.ndarray]] = None) -> bytes:
+    """One RPC frame: u64 big-endian length + an npz holding every array
+    plus a json header under the reserved ``header`` key (the exact
+    shape `transfer.run_to_bytes` uses, so run snapshots embed without a
+    second codec)."""
+    h = {"v": WIRE_VERSION, "verb": str(verb)}
+    if header:
+        h.update(header)
+    arrs = {k: np.asarray(v) for k, v in (arrays or {}).items()}
+    if "header" in arrs:
+        raise InvalidArgumentError("'header' is a reserved frame key")
+    arrs["header"] = np.frombuffer(
+        json.dumps(h, default=str).encode(), dtype=np.uint8).copy()
+    buf = io.BytesIO()
+    np.savez(buf, **arrs)
+    payload = buf.getvalue()
+    return _LEN.pack(len(payload)) + payload
+
+
+def unpack_frame(payload: bytes) -> Tuple[str, dict, Dict[str, np.ndarray]]:
+    """payload (sans length prefix) -> (verb, header, arrays); raises
+    the typed WireFormatError on ANY decode mismatch."""
+    try:
+        z = np.load(io.BytesIO(payload), allow_pickle=False)
+    except Exception as e:
+        raise WireFormatError(f"corrupt RPC frame (npz decode): {e!r}")
+    with z:
+        try:
+            h = json.loads(bytes(z["header"].tobytes()).decode())
+        except Exception as e:
+            raise WireFormatError(f"corrupt RPC frame header: {e!r}")
+        if h.get("v") != WIRE_VERSION:
+            raise WireFormatError(
+                f"RPC wire version {h.get('v')!r} != {WIRE_VERSION} — "
+                "manager and worker builds disagree")
+        verb = h.get("verb")
+        if not isinstance(verb, str) or not verb:
+            raise WireFormatError("RPC frame carries no verb")
+        arrays = {k: z[k] for k in z.files if k != "header"}
+    return verb, h, arrays
+
+
+class _FrameConn:
+    """Length-prefixed frames over one stream socket.  Reads are
+    non-blocking (select-bounded); writes block up to `send_timeout` and
+    raise WorkerDiedError past it — the peer being too wedged to drain
+    its socket buffer is a liveness verdict, not a reason to hang the
+    fleet loop."""
+
+    def __init__(self, sock: socket.socket, send_timeout: float = 10.0):
+        self._sock = sock
+        self._sock.setblocking(False)
+        self._buf = bytearray()
+        self._wlock = threading.Lock()
+        self._send_timeout = send_timeout
+        self._closed = False
+
+    def send(self, verb: str, header: Optional[dict] = None,
+             arrays: Optional[dict] = None):
+        data = pack_frame(verb, header, arrays)
+        with self._wlock:
+            if self._closed:
+                raise WorkerDiedError("RPC connection is closed")
+            deadline = time.monotonic() + self._send_timeout
+            view = memoryview(data)
+            while view:
+                budget = deadline - time.monotonic()
+                if budget <= 0:
+                    raise WorkerDiedError(
+                        f"RPC send of {verb!r} stalled "
+                        f">{self._send_timeout}s — peer not draining")
+                _, w, _ = select.select([], [self._sock], [], budget)
+                if not w:
+                    continue
+                try:
+                    n = self._sock.send(view)
+                except (BlockingIOError, InterruptedError):
+                    continue
+                except OSError as e:
+                    raise WorkerDiedError(f"RPC send failed: {e!r}")
+                view = view[n:]
+
+    def recv_frames(self, max_wait: float = 0.0) -> List[Tuple]:
+        """Every complete frame currently available (waiting up to
+        `max_wait` for the first byte).  Raises WorkerDiedError when the
+        peer closed the connection."""
+        first = True
+        while True:
+            try:
+                r, _, _ = select.select([self._sock], [], [],
+                                        max_wait if first else 0.0)
+            except OSError as e:
+                raise WorkerDiedError(f"RPC socket lost: {e!r}")
+            first = False
+            if not r:
+                break
+            try:
+                chunk = self._sock.recv(1 << 16)
+            except (BlockingIOError, InterruptedError):
+                break
+            except OSError as e:
+                raise WorkerDiedError(f"RPC recv failed: {e!r}")
+            if not chunk:
+                raise WorkerDiedError("RPC peer closed the connection")
+            self._buf.extend(chunk)
+        frames = []
+        while True:
+            if len(self._buf) < _LEN.size:
+                break
+            (n,) = _LEN.unpack_from(self._buf)
+            if n > _MAX_FRAME:
+                raise WireFormatError(
+                    f"frame length {n} exceeds the {_MAX_FRAME} cap — "
+                    "corrupt stream")
+            if len(self._buf) < _LEN.size + n:
+                break
+            payload = bytes(self._buf[_LEN.size:_LEN.size + n])
+            del self._buf[:_LEN.size + n]
+            frames.append(unpack_frame(payload))
+        return frames
+
+    def close(self):
+        with self._wlock:
+            self._closed = True
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# heartbeat side channel
+# ---------------------------------------------------------------------------
+
+class _Heartbeat:
+    """Worker-side heartbeat writer: a small json file atomically
+    replaced after every completed step (throttled).  The file — not the
+    RPC socket — is the liveness signal: a wedged step stops the
+    rewrites while the socket stays connected."""
+
+    def __init__(self, path: str, min_interval: float = 0.02):
+        self._path = path
+        self._min_interval = min_interval
+        self._last = 0.0
+
+    def beat(self, steps: int, phase: str = "serve", force: bool = False):
+        now = time.time()
+        if not force and now - self._last < self._min_interval:
+            return
+        tmp = f"{self._path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                # `mono` (CLOCK_MONOTONIC — one timeline for every
+                # process on the machine) is what age is computed from:
+                # an NTP step / suspend-resume wall-clock jump must not
+                # falsely wedge-fence the whole fleet.  Wall `time`
+                # rides along for humans reading the file.
+                f.write(json.dumps({"steps": int(steps), "time": now,
+                                    "mono": time.monotonic(),
+                                    "pid": os.getpid(), "phase": phase}))
+            os.replace(tmp, self._path)
+            self._last = now
+        except OSError:
+            pass  # a failed beat reads as staleness — the safe direction
+
+
+def read_heartbeat(path: str) -> Optional[dict]:
+    """Last complete heartbeat record, or None (no beat yet / torn
+    file — os.replace makes torn reads near-impossible, but a missing
+    file during boot is normal)."""
+    try:
+        with open(path) as f:
+            return json.loads(f.read())
+    except (OSError, ValueError):
+        return None
+
+
+# ---------------------------------------------------------------------------
+# worker process: boot + serve loop
+# ---------------------------------------------------------------------------
+
+def build_gpt(seed: int = 0, **config):
+    """Deterministic GPT factory for boot specs: same seed + config in
+    any process reproduces bit-identical weights (jax PRNG init), so a
+    restarted worker serves the exact model its predecessor did without
+    shipping weights over the wire.  Real deployments point
+    ``spec["model"]["factory"]`` at their own loader (restoring a
+    jit.save artifact) instead."""
+    import paddle_tpu as paddle
+    from paddle_tpu import models
+    paddle.seed(int(seed))
+    model = models.GPTForPretraining(models.GPTConfig(**config))
+    model.eval()
+    return model
+
+
+def _resolve(path: str):
+    """'pkg.mod:callable' -> the callable."""
+    import importlib
+    mod, sep, name = path.partition(":")
+    if not sep or not name:
+        raise InvalidArgumentError(
+            f"factory {path!r} must be 'package.module:callable'")
+    return getattr(importlib.import_module(mod), name)
+
+
+def _build_engine(spec: dict):
+    from .engine import ServingEngine
+    model = _resolve(spec["model"]["factory"])(
+        **(spec["model"].get("kwargs") or {}))
+    draft = None
+    if spec.get("draft"):
+        draft = _resolve(spec["draft"]["factory"])(
+            **(spec["draft"].get("kwargs") or {}))
+    ekw = dict(spec.get("engine") or {})
+    if ekw.get("prefill_buckets") is not None:
+        ekw["prefill_buckets"] = tuple(int(b)
+                                       for b in ekw["prefill_buckets"])
+    return ServingEngine(model, draft_model=draft,
+                         program_set=spec.get("program_set"), **ekw)
+
+
+class _WireResponse(Response):
+    """Worker-local response that additionally records per-token logps
+    so stream chunks carry them across the wire (the base Response only
+    keeps the cumulative sum)."""
+
+    def __init__(self, req: Request):
+        super().__init__(req)
+        self.logps: List[float] = []
+
+    def _push_token(self, tok: int, logp: float = 0.0):
+        super()._push_token(tok, logp)
+        self.logps.append(float(logp))
+
+
+def _jsonable(obj):
+    """Best-effort scalar-tree copy for status/metrics headers."""
+    if isinstance(obj, dict):
+        return {str(k): _jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable(v) for v in obj]
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    if isinstance(obj, (str, int, bool)) or obj is None:
+        return obj
+    if isinstance(obj, float):
+        return obj if np.isfinite(obj) else None
+    return str(obj)
+
+
+class _WorkerServer:
+    """The worker's single-threaded drive loop (see module docstring)."""
+
+    def __init__(self, engine, conn: _FrameConn, hb: _Heartbeat,
+                 index: int):
+        from ..utils import faults
+        self._faults = faults
+        self.engine = engine
+        self.conn = conn
+        self.hb = hb
+        self.index = index
+        self.streams: Dict[int, list] = {}  # wid -> [resp, n_sent]
+        self.step_no = 0
+        self._ewma: Optional[float] = None
+        self._recent_dts: List[float] = []
+        self._last_status = 0.0
+        self._stopping = False
+
+    # -- inbound verbs --------------------------------------------------
+    def _handle(self, verb: str, h: dict, arrays: dict):
+        if verb == "submit":
+            self._on_submit(h, arrays)
+        elif verb == "cancel":
+            entry = self.streams.get(h.get("wid"))
+            if entry is not None:
+                entry[0].cancel()
+        elif verb == "preempt":
+            self._on_preempt(h)
+        elif verb == "restore":
+            self._on_restore(h, arrays)
+        elif verb == "metrics":
+            self.conn.send("metrics", {
+                "wid": h.get("wid"),
+                "metrics": _jsonable(self.engine.metrics())})
+        elif verb == "fault":
+            point, value = h.get("point"), h.get("value")
+            if value is None:
+                self._faults.disable(point)
+            else:
+                self._faults.enable(point, value)
+        elif verb == "close":
+            self._stopping = True
+        else:
+            self.conn.send("log", {"msg": f"unknown verb {verb!r} ignored"})
+
+    def _on_submit(self, h: dict, arrays: dict):
+        wid = int(h["wid"])
+        try:
+            req, _ = self.engine.make_request(
+                np.asarray(arrays["prompt"], np.int32),
+                int(h["max_new_tokens"]),
+                decode_strategy=h.get("decode_strategy", "greedy_search"),
+                temperature=h.get("temperature", 1.0),
+                top_k=h.get("top_k", 0), top_p=h.get("top_p", 1.0),
+                eos_token_id=h.get("eos_token_id"), seed=h.get("seed"),
+                deadline=h.get("deadline_remaining_s"),
+                priority=h.get("priority", 0), tenant=h.get("tenant"),
+                spec=h.get("spec"), session=h.get("session"),
+                resubmit=h.get("resubmit", False))
+            resp = _WireResponse(req)
+            self.engine.scheduler.submit(req, resp)
+        except Exception as e:
+            self.conn.send("failed", {"wid": wid,
+                                      "etype": type(e).__name__,
+                                      "msg": str(e)[:500]})
+            return
+        self.streams[wid] = [resp, 0]
+
+    def _find_slot(self, resp) -> Optional[int]:
+        for slot, run in self.engine._slots.items():
+            if run.resp is resp:
+                return slot
+        return None
+
+    def _on_preempt(self, h: dict):
+        from .transfer import encode_run, run_to_bytes
+        wid = int(h["wid"])
+        entry = self.streams.get(wid)
+        slot = None if entry is None else self._find_slot(entry[0])
+        if slot is None:
+            # finished / still queued / unknown — nothing resident to move
+            self.conn.send("preempted", {"wid": wid, "ok": False,
+                                         "reason": "not-resident"})
+            return
+        # flush BEFORE snapshotting: the manager must hold every token
+        # `produced` counts, or the migrated continuation would skip the
+        # in-flight tail and the stream would lose tokens silently
+        self._flush_one(wid, entry)
+        paused = self.engine.preempt_slot(slot)
+        blob = run_to_bytes(encode_run(paused, engine=self.engine))
+        self.streams.pop(wid, None)
+        self.conn.send("preempted", {"wid": wid, "ok": True},
+                       {"run": np.frombuffer(blob, np.uint8).copy()})
+
+    def _on_restore(self, h: dict, arrays: dict):
+        from .transfer import decode_run, run_from_bytes
+        wid = int(h["wid"])
+        try:
+            blob = run_from_bytes(arrays["run"].tobytes())
+            paused = decode_run(blob, engine=self.engine)
+            resp = _WireResponse(paused.req)
+            paused.resp = resp
+            ok = self.engine.restore_run(paused)
+        except Exception as e:
+            self.conn.send("restored", {"wid": wid, "ok": False,
+                                        "etype": type(e).__name__,
+                                        "msg": str(e)[:500]})
+            return
+        if ok:
+            self.streams[wid] = [resp, 0]
+        self.conn.send("restored", {"wid": wid, "ok": bool(ok)})
+
+    # -- outbound stream/status -----------------------------------------
+    def _flush_one(self, wid: int, entry: list) -> bool:
+        resp, sent = entry
+        toks = resp.tokens_so_far()
+        if len(toks) > sent:
+            self.conn.send(
+                "chunk", {"wid": wid},
+                {"toks": np.asarray(toks[sent:], np.int64),
+                 "logps": np.asarray(resp.logps[sent:len(toks)],
+                                     np.float64)})
+            entry[1] = len(toks)
+        if resp.done():
+            if resp.error is not None:
+                self.conn.send("failed",
+                               {"wid": wid,
+                                "etype": type(resp.error).__name__,
+                                "msg": str(resp.error)[:500]})
+            else:
+                self.conn.send("done", {"wid": wid,
+                                        "reason": resp.finish_reason})
+            return True
+        return False
+
+    def _flush(self):
+        for wid in list(self.streams):
+            if self._flush_one(wid, self.streams[wid]):
+                self.streams.pop(wid, None)
+
+    def _maybe_status(self):
+        now = time.time()
+        if now - self._last_status < 0.05:
+            return
+        self._last_status = now
+        sched = self.engine.scheduler
+        dts, self._recent_dts = self._recent_dts, []
+        self.conn.send(
+            "status",
+            {"occupancy": sched.occupancy(),
+             "queue_depth": sched.queue_depth(),
+             "free_slots": sched.free_slot_count(),
+             "steps": self.step_no,
+             "ewma_ms": (None if self._ewma is None
+                         else self._ewma * 1e3),
+             "post_warmup_compiles": self.engine.post_warmup_compiles(),
+             "metrics": _jsonable(self.engine.metrics())},
+            {"step_s": np.asarray(dts, np.float64)})
+
+    # -- the loop -------------------------------------------------------
+    def serve(self) -> int:
+        while True:
+            try:
+                frames = self.conn.recv_frames(
+                    0.0 if self.engine.has_work() else 0.002)
+            except WorkerDiedError as e:
+                # manager gone: a worker must never outlive its fleet
+                print(f"worker exiting: manager connection lost ({e})",
+                      file=sys.stderr, flush=True)
+                self.engine.close()
+                return 0
+            for verb, h, arrays in frames:
+                try:
+                    self._handle(verb, h, arrays)
+                except WorkerDiedError as e:
+                    # reply channel gone mid-handle: manager is dead
+                    print(f"worker exiting: manager connection lost "
+                          f"mid-frame ({e})", file=sys.stderr, flush=True)
+                    self.engine.close()
+                    return 0
+                except Exception as e:  # noqa: BLE001
+                    # a malformed/garbled frame (missing field, bad
+                    # type) must cost its sender an error report, never
+                    # the whole worker — the WireFormatError stance
+                    # applied to frame CONTENT too
+                    try:
+                        self.conn.send("log", {
+                            "error": f"frame {verb!r} failed: "
+                                     f"{type(e).__name__}: {e}"})
+                    except WorkerDiedError:
+                        pass
+            if self._stopping:
+                print("worker exiting: close verb received",
+                      file=sys.stderr, flush=True)
+                self.engine.close()
+                self._flush()
+                try:
+                    self.conn.send("bye", {})
+                except WorkerDiedError:
+                    pass
+                return 0
+            # the wedge fault blocks HERE forever when armed: the socket
+            # stays connected, frames pile up unread, and only the
+            # heartbeat file (below, never reached) goes stale
+            self._faults.maybe_wedge_replica(self.index, self.step_no)
+            t0 = time.perf_counter()
+            self._faults.maybe_slow_replica(self.index, self.step_no)
+            try:
+                self.engine.step()
+            except BaseException as e:  # noqa: BLE001 — report, then die
+                try:
+                    self.conn.send("dying", {"etype": type(e).__name__,
+                                             "msg": str(e)[:500]})
+                except WorkerDiedError:
+                    pass
+                return 4
+            dt = time.perf_counter() - t0
+            self.step_no += 1
+            self._ewma = (dt if self._ewma is None
+                          else 0.3 * dt + 0.7 * self._ewma)
+            self._recent_dts.append(dt)
+            self.hb.beat(self.step_no)
+            self._flush()
+            self._maybe_status()
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="paddle_tpu subprocess serving worker")
+    ap.add_argument("--spec", required=True,
+                    help="json boot spec (model factory + engine config)")
+    ap.add_argument("--port", type=int, required=True,
+                    help="manager RPC port on 127.0.0.1")
+    ap.add_argument("--heartbeat", required=True,
+                    help="out-of-band heartbeat file path")
+    ap.add_argument("--index", type=int, default=0,
+                    help="worker index (fault-knob target)")
+    args = ap.parse_args(argv)
+
+    # post-mortem hook for the failure mode this module exists to
+    # survive: SIGUSR1 dumps every thread's stack to the log file, so a
+    # wedged worker can be diagnosed before the manager SIGKILLs it
+    import faulthandler
+    import signal as _signal
+    faulthandler.register(_signal.SIGUSR1, file=sys.stderr)
+
+    hb = _Heartbeat(args.heartbeat)
+    hb.beat(0, phase="boot", force=True)
+    sock = socket.create_connection(("127.0.0.1", args.port), timeout=30)
+    conn = _FrameConn(sock)
+    try:
+        with open(args.spec) as f:
+            spec = json.load(f)
+        engine = _build_engine(spec)
+        warm = engine.warmup()
+        hb.beat(0, phase="warm", force=True)
+    except Exception as e:  # boot failure: report typed, exit nonzero
+        try:
+            conn.send("fatal", {"etype": type(e).__name__,
+                                "msg": str(e)[:800]})
+        except Exception:
+            pass
+        return 3
+    from .transfer import target_manifest
+    conn.send("ready", {
+        "config": {
+            "max_slots": engine.max_slots,
+            "max_len": engine.max_len,
+            "buckets": list(engine.buckets),
+            "max_queue_depth": engine.scheduler.max_queue_depth,
+            "has_draft": engine.draft_model is not None,
+            "kv": engine.kv,
+            "pid": os.getpid(),
+        },
+        "manifest": target_manifest(engine),
+        "warmup": {"seconds": warm.get("seconds"),
+                   "programs": warm.get("programs")},
+    })
+    return _WorkerServer(engine, conn, hb, args.index).serve()
+
+
+# ---------------------------------------------------------------------------
+# manager side: WorkerClient (the subprocess replica's engine proxy)
+# ---------------------------------------------------------------------------
+
+_WIRE_ERRORS = None
+
+
+def _error_types():
+    global _WIRE_ERRORS
+    if _WIRE_ERRORS is None:
+        from .engine import NonFiniteLogitsError
+        from .kv_pool import KVPoolExhaustedError
+        from .transfer import RunTransferError
+        _WIRE_ERRORS = {
+            "RequestCancelled": RequestCancelled,
+            "DeadlineExceededError": DeadlineExceededError,
+            "QueueFullError": QueueFullError,
+            "NonFiniteLogitsError": NonFiniteLogitsError,
+            "KVPoolExhaustedError": KVPoolExhaustedError,
+            "RunTransferError": RunTransferError,
+            "InvalidArgumentError": InvalidArgumentError,
+            "UnavailableError": UnavailableError,
+            "ResourceExhaustedError": ResourceExhaustedError,
+            "FatalError": FatalError,
+            "WireFormatError": WireFormatError,
+        }
+    return _WIRE_ERRORS
+
+
+def _mk_error(etype: str, msg: str) -> BaseException:
+    cls = _error_types().get(etype)
+    if cls is None:
+        return UnavailableError(f"worker reported {etype}: {msg}")
+    try:
+        return cls(msg)
+    except Exception:
+        return UnavailableError(f"worker reported {etype}: {msg}")
+
+
+class _ProxyRun:
+    """Manager-side mirror of one run resident on (or in flight to) the
+    worker — the `.req`/`.resp`/`.produced` duck shape
+    `ReplicaManager._on_crash`, `_pump_migrations` and the gateway's
+    preemption-victim scan consume from `engine._slots`."""
+    __slots__ = ("req", "resp", "cancel_sent")
+
+    def __init__(self, req: Request, resp: Response):
+        self.req = req
+        self.resp = resp
+        self.cancel_sent = False
+
+    @property
+    def produced(self) -> int:
+        # delivered tokens mirror the worker's committed count closely
+        # enough for victim ranking (the only consumer)
+        return len(self.resp.tokens_so_far())
+
+
+class _ProxyScheduler:
+    """The client's local admission queue + residency mirror, speaking
+    the RequestScheduler surface the fleet consumes.  The queue is
+    ENTIRELY local — a request ships to the worker only when a slot
+    mirror says it can admit — so `drain_pending` is complete on crash
+    and queue-depth backpressure needs no round trip."""
+
+    def __init__(self, client: "WorkerClient"):
+        self._c = client
+        self._pending: "deque[Tuple[Request, Response]]" = deque()
+        self._lock = threading.Lock()
+        self._space = threading.Condition(self._lock)
+
+    @property
+    def max_queue_depth(self) -> int:
+        return self._c.max_queue_depth
+
+    def submit(self, req: Request, resp: Response, block: bool = False,
+               timeout: Optional[float] = None):
+        with self._space:
+            if len(self._pending) >= self.max_queue_depth and block:
+                self._space.wait_for(
+                    lambda: len(self._pending) < self.max_queue_depth,
+                    timeout=timeout)
+            if len(self._pending) >= self.max_queue_depth:
+                stat_add("STAT_serving_rejects")
+                raise QueueFullError(
+                    f"worker replica queue full ({self.max_queue_depth} "
+                    "waiting); request rejected")
+            self._pending.append((req, resp))
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def occupancy(self) -> int:
+        return len(self._c._slots)
+
+    def free_slot_count(self) -> int:
+        return max(0, self._c.max_slots - len(self._c._slots))
+
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._pending) or bool(self._c._slots)
+
+    def release(self, wid):
+        self._c._slots.pop(wid, None)
+
+    def drain_pending(self):
+        with self._space:
+            drained = list(self._pending)
+            self._pending.clear()
+            self._space.notify_all()
+            return drained
+
+    def _pop_sendable(self) -> Optional[Tuple[Request, Response]]:
+        """Next queued request that is still worth shipping, failing
+        cancelled/expired entries in passing (scheduler.next_admission's
+        sweep, client-side)."""
+        with self._space:
+            while self._pending:
+                req, resp = self._pending.popleft()
+                self._space.notify()
+                if resp.cancelled:
+                    stat_add("STAT_serving_cancelled")
+                    resp._fail(RequestCancelled(
+                        f"request {req.id} cancelled before prefill"))
+                    continue
+                if req.deadline is not None and req.deadline.expired():
+                    stat_add("STAT_serving_deadline_expired")
+                    resp._fail(DeadlineExceededError(
+                        f"request {req.id} deadline "
+                        f"({req.deadline.seconds}s) expired while queued"))
+                    continue
+                return req, resp
+            return None
+
+
+class WorkerClient:
+    """Spawns one subprocess engine worker and implements the
+    ServingEngine surface the fleet consumes over its RPC (module
+    docstring).  All methods except `scheduler.submit` and `close` must
+    run on the fleet's driving thread."""
+
+    def __init__(self, spec: dict, index: int = 0,
+                 boot_timeout_s: float = 180.0,
+                 rpc_timeout_s: float = 15.0):
+        self.spec = dict(spec)
+        self.index = int(index)
+        self.boot_timeout_s = float(boot_timeout_s)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self._dir = tempfile.mkdtemp(prefix=f"pdtpu_worker{index}_")
+        self.heartbeat_path = os.path.join(self._dir, "heartbeat.json")
+        self.log_path = os.path.join(self._dir, "worker.log")
+        spec_path = os.path.join(self._dir, "spec.json")
+        with open(spec_path, "w") as f:
+            json.dump(self.spec, f)
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(1)
+        self._listener.setblocking(False)
+        port = self._listener.getsockname()[1]
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # never grab the TPU tunnel
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env["PYTHONPATH"] = (root + os.pathsep + env["PYTHONPATH"]
+                             if env.get("PYTHONPATH") else root)
+        self._log_f = open(self.log_path, "ab")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving.worker",
+             "--spec", spec_path, "--port", str(port),
+             "--heartbeat", self.heartbeat_path,
+             "--index", str(self.index)],
+            stdin=subprocess.DEVNULL, stdout=self._log_f,
+            stderr=subprocess.STDOUT, env=env, start_new_session=True)
+        self._conn: Optional[_FrameConn] = None
+        self._boot_deadline = time.monotonic() + self.boot_timeout_s
+        self._boot_error: Optional[str] = None
+        # engine-surface mirrors (filled by the ready handshake)
+        self._warm = False
+        self.max_slots = 0
+        self.max_len = 0
+        self.buckets: Tuple[int, ...] = ()
+        self.max_queue_depth = int(
+            (spec.get("engine") or {}).get("max_queue_depth", 64))
+        self.draft_model = None  # a sentinel object once the worker has one
+        self.kv = "fixed"        # crash-path duck shape; remote kv in spec
+        self._manifest: Optional[dict] = None
+        self.warmup_report: Optional[dict] = None
+        self._slots: Dict[int, _ProxyRun] = {}
+        self.scheduler = _ProxyScheduler(self)
+        self._status: dict = {}
+        self._step_times: List[float] = []
+        self._hb_cache = (0.0, None)  # (read_at, record)
+        self._rid = 0
+        self._wid = 0
+        self._rid_lock = threading.Lock()
+        self._thread = None          # ReplicaManager.add's loop check
+        self._warm_marks = None      # refresh_warm_marks duck slot
+        self._closed = False
+        self._dead: Optional[BaseException] = None
+        self._close_lock = threading.Lock()
+
+    # -- lifecycle ------------------------------------------------------
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    @property
+    def warm(self) -> bool:
+        return self._warm
+
+    def process_alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def poll_ready(self) -> bool:
+        """Advance the boot handshake without blocking; True once the
+        worker reported ready (warm).  Raises WorkerDiedError on boot
+        failure / exit / timeout."""
+        if self._warm:
+            return True
+        if self._conn is None:
+            try:
+                s, _ = self._listener.accept()
+                self._conn = _FrameConn(s)
+                self._listener.close()
+            except (BlockingIOError, OSError):
+                pass
+        if self._conn is not None:
+            try:
+                for frame in self._conn.recv_frames(0.0):
+                    self._dispatch(frame)
+            except WorkerDiedError:
+                pass  # fall through to the death checks below
+        if self._warm:
+            return True
+        if self._boot_error is not None:
+            raise WorkerDiedError(
+                f"worker {self.index} failed to boot: {self._boot_error} "
+                f"(log: {self.log_path})")
+        if self.proc.poll() is not None:
+            raise WorkerDiedError(
+                f"worker {self.index} exited rc={self.proc.returncode} "
+                f"during boot (log: {self.log_path})")
+        if time.monotonic() > self._boot_deadline:
+            raise WorkerDiedError(
+                f"worker {self.index} did not become ready within "
+                f"{self.boot_timeout_s}s (log: {self.log_path})")
+        return False
+
+    def warmup(self) -> dict:
+        """Block until the worker's boot warmup finished (it warms
+        itself; this just waits out the handshake)."""
+        while not self.poll_ready():
+            time.sleep(0.01)
+        return dict(self.warmup_report or {}, worker_pid=self.pid)
+
+    # -- frame dispatch -------------------------------------------------
+    def _dispatch(self, frame):
+        verb, h, arrays = frame
+        if verb == "chunk":
+            run = self._slots.get(h.get("wid"))
+            if run is not None:
+                toks = arrays["toks"].tolist()
+                logps = arrays.get("logps")
+                logps = (logps.tolist() if logps is not None
+                         else [0.0] * len(toks))
+                for tok, lp in zip(toks, logps):
+                    run.resp._push_token(int(tok), float(lp))
+        elif verb == "done":
+            run = self._slots.pop(h.get("wid"), None)
+            if run is not None:
+                run.resp._finish(h.get("reason") or "length")
+        elif verb == "failed":
+            run = self._slots.pop(h.get("wid"), None)
+            if run is not None:
+                run.resp._fail(_mk_error(h.get("etype", ""),
+                                         h.get("msg", "")))
+        elif verb == "status":
+            self._status = h
+            st = arrays.get("step_s")
+            if st is not None and st.size:
+                self._step_times.extend(float(x) for x in st)
+        elif verb == "ready":
+            cfg = h.get("config") or {}
+            self.max_slots = int(cfg.get("max_slots", 0))
+            self.max_len = int(cfg.get("max_len", 0))
+            self.buckets = tuple(int(b) for b in cfg.get("buckets", ()))
+            self.max_queue_depth = int(cfg.get("max_queue_depth",
+                                               self.max_queue_depth))
+            if cfg.get("has_draft"):
+                self.draft_model = object()  # truthy `is not None` duck
+            self._manifest = h.get("manifest")
+            self.warmup_report = h.get("warmup")
+            # drop the heartbeat cache: the last cached record predates
+            # warmup (the long no-beat boot window), and the wedge fence
+            # must never judge a freshly-healthy worker by it
+            self._hb_cache = (0.0, None)
+            self._warm = True
+        elif verb == "fatal":
+            self._boot_error = f"{h.get('etype')}: {h.get('msg')}"
+        elif verb == "dying":
+            self._dead = _mk_error(h.get("etype", ""), h.get("msg", ""))
+        elif verb in ("bye", "log", "metrics", "preempted", "restored"):
+            pass  # bye/log informational; RPC replies consumed by _rpc
+
+    def _rpc(self, verb: str, header: dict, arrays: Optional[dict],
+             reply_verb: str) -> Tuple[dict, dict]:
+        """Send one frame and pump until its reply arrives, dispatching
+        unrelated frames (chunks/status) normally.  Timeout or process
+        death -> WorkerDiedError (the wedged-worker verdict)."""
+        if self._conn is None:
+            raise WorkerDiedError(f"worker {self.index} has no connection")
+        self._conn.send(verb, header, arrays)
+        wid = header.get("wid")
+        deadline = time.monotonic() + self.rpc_timeout_s
+        while True:
+            if self.proc.poll() is not None:
+                raise WorkerDiedError(
+                    f"worker {self.index} exited rc={self.proc.returncode} "
+                    f"mid-RPC ({verb})")
+            for frame in self._conn.recv_frames(0.01):
+                v, h, a = frame
+                if v == reply_verb and h.get("wid") == wid:
+                    return h, a
+                self._dispatch(frame)
+            if time.monotonic() > deadline:
+                raise WorkerDiedError(
+                    f"worker {self.index} RPC {verb!r} timed out after "
+                    f"{self.rpc_timeout_s}s — wedged or overloaded "
+                    "beyond the liveness budget")
+
+    # -- engine surface: admission -------------------------------------
+    def make_request(self, prompt, max_new_tokens: int,
+                     decode_strategy: str = "greedy_search",
+                     temperature=1.0, top_k=0, top_p=1.0,
+                     eos_token_id: Optional[int] = None,
+                     seed: Optional[int] = None,
+                     deadline: Optional[float] = None, priority: int = 0,
+                     tenant: Optional[str] = None,
+                     spec: Optional[bool] = None,
+                     session: Optional[str] = None,
+                     resubmit: bool = False):
+        """ServingEngine.make_request's validation against the worker's
+        handshake config — no round trip; the worker re-validates on its
+        side and any disagreement comes back as a typed `failed`."""
+        if self._closed:
+            raise UnavailableError("worker replica is closed")
+        if self._dead is not None:
+            raise UnavailableError(
+                f"worker {self.index} died: {self._dead!r}")
+        if not self._warm:
+            raise UnavailableError(
+                f"worker {self.index} is still booting")
+        if decode_strategy not in ("greedy_search", "sampling"):
+            raise InvalidArgumentError(
+                f"serving supports 'greedy_search' or 'sampling', got "
+                f"{decode_strategy!r}")
+        if spec is None:
+            spec = self.draft_model is not None
+        elif spec and self.draft_model is None:
+            raise InvalidArgumentError(
+                "spec=True requires the worker engine to be built with "
+                "a draft model")
+        if resubmit and decode_strategy != "greedy_search":
+            raise InvalidArgumentError(
+                "resubmit=True (re-prefill-from-prompt crash recovery) "
+                "is greedy-only: a replayed sampled stream is not "
+                "covered by any engine contract — drop resubmit or use "
+                "greedy_search")
+        with self._rid_lock:
+            rid = self._rid
+            self._rid += 1
+        req = Request(rid, prompt, max_new_tokens,
+                      greedy=decode_strategy == "greedy_search",
+                      temperature=temperature, top_k=top_k, top_p=top_p,
+                      eos_token_id=eos_token_id,
+                      seed=seed if seed is not None else rid,
+                      deadline=deadline, priority=priority, tenant=tenant,
+                      spec=bool(spec), session=session, resubmit=resubmit)
+        plen = req.prompt.shape[0]
+        if plen > self.buckets[-1]:
+            stat_add("STAT_serving_rejects")
+            raise InvalidArgumentError(
+                f"prompt length {plen} exceeds the largest prefill "
+                f"bucket {self.buckets[-1]} (worker max_len="
+                f"{self.max_len})")
+        if plen + req.max_new_tokens > self.max_len:
+            stat_add("STAT_serving_rejects")
+            raise InvalidArgumentError(
+                f"prompt ({plen}) + max_new_tokens "
+                f"({req.max_new_tokens}) exceeds the worker's max_len "
+                f"{self.max_len}")
+        stat_add("STAT_serving_requests")
+        return req, Response(req)
+
+    def try_admit(self, req: Request, resp: Response) -> bool:
+        """Ship NOW if the residency mirror has room (the gateway's
+        direct-admission path; driving thread only)."""
+        if self._closed or not self._warm or self._conn is None:
+            return False
+        if self.scheduler.free_slot_count() <= 0:
+            return False
+        try:
+            self._ship(req, resp)
+        except WorkerDiedError as e:
+            # admission must answer False, not blow up the gateway loop;
+            # the next fleet tick's step() re-raises and fences us
+            self._dead = self._dead or e
+            return False
+        return True
+
+    def _ship(self, req: Request, resp: Response):
+        wid = self._wid
+        self._wid += 1
+        h = {"wid": wid, "max_new_tokens": req.max_new_tokens,
+             "decode_strategy": ("greedy_search" if req.greedy
+                                 else "sampling"),
+             "temperature": req.temperature, "top_k": req.top_k,
+             "top_p": req.top_p, "eos_token_id": req.eos_token_id,
+             "seed": req.seed,
+             "deadline_remaining_s": (None if req.deadline is None
+                                      else req.deadline.remaining()),
+             "priority": req.priority, "tenant": req.tenant,
+             "spec": bool(req.spec) if self.draft_model is not None
+             else False,
+             "session": req.session, "resubmit": req.resubmit}
+        self._conn.send("submit", h, {"prompt": req.prompt})
+        self._slots[wid] = _ProxyRun(req, resp)
+
+    # -- engine surface: the driving tick ------------------------------
+    def step(self) -> bool:
+        """One pump: propagate cancels, ship queued requests into free
+        slots, drain inbound frames.  Raises WorkerDiedError when the
+        process is gone — the fleet tick's crash path."""
+        if self._closed or self._conn is None:
+            return False
+        did = False
+        for wid, run in list(self._slots.items()):
+            if run.resp.cancelled and not run.cancel_sent:
+                self._conn.send("cancel", {"wid": wid})
+                run.cancel_sent = True
+                did = True
+        while self.scheduler.free_slot_count() > 0:
+            nxt = self.scheduler._pop_sendable()
+            if nxt is None:
+                break
+            self._ship(*nxt)
+            did = True
+        try:
+            frames = self._conn.recv_frames(0.0)
+        except WorkerDiedError:
+            if self.proc.poll() is not None:
+                raise WorkerDiedError(
+                    f"worker {self.index} exited "
+                    f"rc={self.proc.returncode} (log: {self.log_path})")
+            raise
+        for frame in frames:
+            self._dispatch(frame)
+            did = True
+        if self._dead is not None:
+            raise WorkerDiedError(
+                f"worker {self.index} step loop died: {self._dead!r}")
+        if self.proc.poll() is not None:
+            raise WorkerDiedError(
+                f"worker {self.index} exited rc={self.proc.returncode} "
+                f"(log: {self.log_path})")
+        return did
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    def take_step_times(self) -> List[float]:
+        """Worker-reported per-step wall times since the last call —
+        the fleet health EWMA's input (pump time on this side measures
+        nothing)."""
+        ts, self._step_times = self._step_times, []
+        return ts
+
+    def _heartbeat(self) -> Optional[dict]:
+        """Last heartbeat record, re-read at most every 50ms — the tick
+        polls this per replica, and age resolution far below the fence
+        threshold buys nothing for a file read per tick."""
+        now = time.monotonic()
+        read_at, rec = self._hb_cache
+        if now - read_at > 0.05:
+            rec = read_heartbeat(self.heartbeat_path)
+            self._hb_cache = (now, rec)
+        return rec
+
+    def heartbeat_age(self, fresh: bool = False) -> Optional[float]:
+        """Seconds since the worker's last out-of-band heartbeat write,
+        or None before the first beat.  Computed on the shared
+        CLOCK_MONOTONIC timeline (wall clock only as a legacy fallback)
+        so an NTP step cannot falsely wedge the fleet.  `fresh=True`
+        bypasses the 50ms cache — the fence decision re-reads the file
+        so a cached pre-warmup record can never wedge-fence a healthy
+        worker."""
+        if fresh:
+            self._hb_cache = (0.0, None)
+        d = self._heartbeat()
+        if d is None:
+            return None
+        try:
+            if "mono" in d:
+                return max(0.0, time.monotonic() - float(d["mono"]))
+            return max(0.0, time.time() - float(d["time"]))
+        except (TypeError, ValueError, KeyError):
+            return None
+
+    def heartbeat_steps(self) -> Optional[int]:
+        d = self._heartbeat()
+        try:
+            return None if d is None else int(d["steps"])
+        except (TypeError, ValueError, KeyError):
+            return None
+
+    # -- engine surface: migration -------------------------------------
+    def transfer_manifest(self) -> dict:
+        """The restore-compatibility descriptor the worker computed at
+        boot — `transfer.check_compatible`'s target view of this
+        replica."""
+        if self._manifest is None:
+            raise UnavailableError(
+                f"worker {self.index} has not completed its handshake")
+        return self._manifest
+
+    def preempt_slot(self, wid) -> "object":
+        """Preempt the run tracked under `wid` on the worker and decode
+        its snapshot against the ORIGINAL req/resp (the consumer's
+        stream object survives the move, exactly like the in-process
+        path).  WorkerDiedError on RPC failure; InvalidArgumentError if
+        the run finished in the race window."""
+        from .transfer import decode_run, run_from_bytes
+        run = self._slots.get(wid)
+        if run is None:
+            raise InvalidArgumentError(f"wid {wid} holds no active run")
+        h, a = self._rpc("preempt", {"wid": wid}, None, "preempted")
+        if not h.get("ok"):
+            raise InvalidArgumentError(
+                f"wid {wid} is not resident on worker {self.index} "
+                f"({h.get('reason')})")
+        blob = run_from_bytes(a["run"].tobytes())
+        paused = decode_run(blob, req=run.req, resp=run.resp)
+        self._slots.pop(wid, None)
+        run.req.preempts += 1
+        stat_add("STAT_serving_preemptions")
+        return paused
+
+    def restore_run(self, paused) -> bool:
+        """Restore a (possibly cross-replica) snapshot onto the worker.
+        False on capacity; typed RunTransferError if the worker rejects
+        the snapshot as incompatible (its engine re-checks)."""
+        from .transfer import RunTransferError, encode_run, run_to_bytes
+        if self._closed or not self._warm or self._conn is None:
+            return False
+        if self.scheduler.free_slot_count() <= 0:
+            return False
+        blob = run_to_bytes(encode_run(paused))
+        wid = self._wid
+        self._wid += 1
+        h, _ = self._rpc("restore", {"wid": wid},
+                         {"run": np.frombuffer(blob, np.uint8).copy()},
+                         "restored")
+        if h.get("ok"):
+            self._slots[wid] = _ProxyRun(paused.req, paused.resp)
+            paused.req.resumes += 1
+            paused.req.paused_seconds += (time.monotonic()
+                                          - paused.preempted_at)
+            stat_add("STAT_serving_resumes")
+            return True
+        if h.get("etype") == "RunTransferError":
+            raise RunTransferError(
+                f"worker {self.index} rejected the run snapshot: "
+                f"{h.get('msg')}")
+        return False
+
+    # -- engine surface: telemetry -------------------------------------
+    def metrics(self) -> dict:
+        m = dict(self._status.get("metrics") or {})
+        m["queue_depth"] = self.scheduler.queue_depth()
+        m["slot_occupancy"] = len(self._slots)
+        m["worker"] = {"pid": self.pid, "index": self.index,
+                       "alive": self.process_alive(),
+                       "steps": self._status.get("steps"),
+                       "heartbeat_age_s": self.heartbeat_age(),
+                       "log": self.log_path}
+        return m
+
+    def post_warmup_compiles(self) -> int:
+        if not self._warm:
+            return -1
+        v = self._status.get("post_warmup_compiles")
+        return 0 if v is None else int(v)
+
+    def _compile_marks(self) -> dict:
+        # the worker's program registry lives in ITS process: peers'
+        # warmups can never pollute it, so there is nothing to re-mark
+        return {"engine": 0, "registry": {}}
+
+    def set_fault(self, point: str, value: Optional[str]):
+        """Arm/disarm a utils.faults knob INSIDE the worker process
+        (env vars set after spawn don't cross the boundary)."""
+        if self._conn is None:
+            raise WorkerDiedError(
+                f"worker {self.index} has no connection")
+        self._conn.send("fault", {"point": point, "value": value})
+
+    # -- engine surface: teardown --------------------------------------
+    def _abort_all(self, make_exc):
+        for wid, run in list(self._slots.items()):
+            run.resp._fail(make_exc(run.req))
+        self._slots.clear()
+        for req, resp in self.scheduler.drain_pending():
+            resp._fail(make_exc(req))
+
+    def kill(self):
+        """SIGKILL + reap.  Idempotent: a second kill of an
+        already-dead (or already-reaped) pid is a no-op."""
+        try:
+            self.proc.kill()  # no-op once returncode is set
+        except (ProcessLookupError, OSError):
+            pass
+        try:
+            self.proc.wait(timeout=5)
+        except Exception:
+            pass
+
+    def close(self, graceful: bool = True):
+        """Stop the worker and reap the process (no orphans, no
+        zombies), failing anything still outstanding.  `graceful=True`
+        asks the worker to exit first and gives it 2s; the fleet passes
+        `graceful=False` for crashed/wedged corpses — a wedged process
+        would never read the close verb and the 2s wait would stall the
+        driving thread (and every healthy replica) for nothing.
+        Idempotent and safe under concurrent double-close (the
+        engine/gateway/fleet contract)."""
+        self._closed = True
+        with self._close_lock:
+            if graceful:
+                if self._conn is not None:
+                    try:
+                        self._conn.send("close", {})
+                    except (WorkerDiedError, WireFormatError):
+                        pass
+                try:
+                    self.proc.wait(timeout=2.0)
+                except Exception:
+                    pass
+            self.kill()
+            if self._conn is not None:
+                self._conn.close()
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            try:
+                self._log_f.close()
+            except OSError:
+                pass
+            self._abort_all(lambda req: RequestCancelled(
+                f"request {req.id} aborted: worker replica closed"))
+            shutil.rmtree(self._dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -99,6 +99,22 @@ Injection points (consumed elsewhere in the framework):
                   (default: every replica).  Live-read per step, nothing
                   baked into any trace.  Env: PDTPU_FAULT_REPLICA_SLOW=
                   "ms[:every_n[:replica]]".
+  replica_wedge   the subprocess fleet worker with index `replica` blocks
+                  INDEFINITELY inside its `tick`-th step (0-based) — a
+                  hang, not a crash: the worker process stays alive, its
+                  RPC socket stays connected, no exception ever raises.
+                  The one failure mode PDTPU_FAULT_REPLICA_CRASH cannot
+                  model, and exactly what the out-of-band heartbeat
+                  exists to catch: the worker's heartbeat file goes
+                  stale, the ReplicaManager fences the replica on
+                  heartbeat AGE (no in-band call ever returns), SIGKILLs
+                  the wedged process after the grace period, and the
+                  supervisor restarts it under the backoff budget.
+                  Consulted by the worker drive loop (serving/worker.py)
+                  — in-process replicas share one driving thread, so
+                  wedging one would wedge the fleet (the limitation that
+                  motivates subprocess isolation).  Env:
+                  PDTPU_FAULT_REPLICA_WEDGE="replica:tick".
 
 Deliberately import-light (no jax at module scope): DataLoader worker
 processes and the bench orchestrator consult it before any backend exists.
@@ -117,7 +133,8 @@ __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "draft_diverge_every", "poison_draft_logits", "kv_exhaust_cap",
            "prefetch_stall_config", "maybe_stall_prefetch",
            "row_corrupt_fetch", "replica_crash_config",
-           "replica_slow_config", "maybe_slow_replica"]
+           "replica_slow_config", "maybe_slow_replica",
+           "replica_wedge_config", "maybe_wedge_replica"]
 
 _ENV = {
     "nan_grads": "PDTPU_FAULT_NAN_GRADS",
@@ -132,6 +149,7 @@ _ENV = {
     "row_corrupt": "PDTPU_FAULT_ROW_CORRUPT",
     "replica_crash": "PDTPU_FAULT_REPLICA_CRASH",
     "replica_slow": "PDTPU_FAULT_REPLICA_SLOW",
+    "replica_wedge": "PDTPU_FAULT_REPLICA_WEDGE",
 }
 
 _lock = threading.Lock()
@@ -446,6 +464,35 @@ def maybe_slow_replica(replica_idx: int, step_no: int) -> float:
     secs = ms / 1000.0
     time.sleep(secs)
     return secs
+
+
+def replica_wedge_config() -> Optional[Tuple[int, int]]:
+    """(replica_index, tick) at which the targeted subprocess worker's
+    step BLOCKS forever (hang, not crash), or None when disarmed.
+    Consulted live per worker step by the worker drive loop — the
+    injection that proves OUT-OF-BAND heartbeat detection: the process
+    stays alive and connected, so only heartbeat age can see it."""
+    raw = get("replica_wedge")
+    if not raw:
+        return None
+    replica, tick = raw.split(":", 1)
+    return int(replica), int(tick)
+
+
+def maybe_wedge_replica(replica_idx: int, step_no: int):
+    """Block FOREVER when replica_wedge is armed for (replica_idx,
+    step_no) — the wedged-worker hang.  Never returns once it fires;
+    the manager's SIGKILL is the only way out (which is the point)."""
+    cfg = replica_wedge_config()
+    if cfg is None or cfg[0] != replica_idx or step_no < cfg[1]:
+        # >= not ==: the knob is usually armed over RPC against a live,
+        # fast-stepping worker — an exact-tick match could slip past
+        # between the arm and the next step, and a wedge that never
+        # fires is a vacuous chaos test
+        return
+    import time
+    while True:  # pragma: no cover — exits only via SIGKILL
+        time.sleep(3600)
 
 
 # -- backend_down ------------------------------------------------------------

@@ -24,6 +24,17 @@ ServingEngines, tiny GPT, CPU):
    replica boots with every program from the program set
    (``program_set:exe``) and the fleet reports ZERO post-warmup
    compiles under post-rollout traffic.
+4. **Process isolation** (ISSUE-13) — a MIXED fleet: one in-process
+   replica + two SUBPROCESS workers booted from the phase-3 AOT
+   program set.  A real SIGKILL of worker A mid-decode AND a
+   ``PDTPU_FAULT_REPLICA_WEDGE`` hang of worker B (step blocks forever,
+   socket stays up — only the out-of-band heartbeat can see it) must
+   BOTH fence within the heartbeat threshold; every affected stream
+   reaches a typed terminal or a bit-identical resubmitted completion
+   vs the solo oracle; the supervisor restarts both workers from the
+   program set (``program_set:exe``, zero post-warmup compiles) and
+   they serve bit-identical again; zero hung consumers anywhere.
+   Published as bench ``detail.fleet.{wedge_detect_ms,restart_ok}``.
 
 `--steps N` (N <= 5) is the CI smoke: phase 1 only, parity + terminal
 states, no perf bars.  Prints one `FLEET{json}` line; exits 1 on any
@@ -408,6 +419,169 @@ def main():
             failures.append(
                 f"only {exe_boots}/{len(boot_sources)} replicas booted "
                 "every program from the program set (program_set:exe)")
+
+    # ------------------------------------------------------------------
+    # phase 4: process isolation — subprocess workers, SIGKILL + wedge,
+    # heartbeat fencing, supervised restart from the AOT program set
+    # ------------------------------------------------------------------
+    if not smoke and not hung:
+        from paddle_tpu.serving import (ReplicaLostError as _RLE,
+                                        RestartBackoff)
+        import signal as _signal
+        hb_timeout = 1.5
+        w_failures = []
+        spec = {
+            "model": {"factory": "paddle_tpu.serving.worker:build_gpt",
+                      "kwargs": dict(vocab_size=vocab, hidden_size=32,
+                                     num_hidden_layers=2,
+                                     num_attention_heads=2,
+                                     hidden_dropout_prob=0.0,
+                                     attention_probs_dropout_prob=0.0,
+                                     max_position_embeddings=128,
+                                     seed=11)},
+            "engine": {"max_slots": args.slots, "max_len": 64,
+                       "prefill_buckets": [8],
+                       "decode_chunk": args.chunk,
+                       "max_queue_depth": max(64, n_req)},
+            "program_set": ps_path,
+        }
+        wfleet = FleetRouter(
+            [make_engine()], heartbeat_timeout_s=hb_timeout,
+            kill_grace_s=0.3,
+            restart_backoff=RestartBackoff(max_restarts=2,
+                                           base_delay=0.1,
+                                           max_delay=0.5))
+        wid_a = wfleet.add_worker(spec)
+        wid_b = wfleet.add_worker(spec)
+        wfleet.warmup()
+        wfleet.start()
+        rep_a = wfleet.manager.get(wid_a)
+        rep_b = wfleet.manager.get(wid_b)
+        first_exe = all(
+            v == "program_set:exe"
+            for r in (rep_a, rep_b)
+            for v in ((r.engine.warmup_report or {}).get("programs")
+                      or {}).values())
+
+        def resident(rep, budget, resubmit):
+            req, resp = rep.engine.make_request(
+                np.arange(1, 6, dtype=np.int32), budget,
+                resubmit=resubmit)
+            want(np.arange(1, 6, dtype=np.int32), budget)
+            rep.engine.scheduler.submit(req, resp)
+            t_end = time.monotonic() + 60
+            while (not len(resp.tokens_so_far())
+                   and time.monotonic() < t_end):
+                time.sleep(0.002)
+            return resp
+
+        budget = max(budgets) + 8
+        w_prompt = np.arange(1, 6, dtype=np.int32)
+        w_want = want(w_prompt, budget)
+        # -- worker A: real SIGKILL mid-decode -------------------------
+        rep_a.engine.set_fault("replica_slow",
+                               f"80:1:{rep_a.lineage['index']}")
+        a_opt = resident(rep_a, budget, True)
+        a_no = resident(rep_a, budget, False)
+        t_kill = time.monotonic()
+        os.kill(rep_a.engine.pid, _signal.SIGKILL)
+        t_end = time.monotonic() + 30
+        while rep_a.state != "crashed" and time.monotonic() < t_end:
+            time.sleep(0.002)
+        kill_detect_ms = (time.monotonic() - t_kill) * 1e3
+        # -- worker B: wedge (hang) — only the heartbeat can see it ----
+        rep_b.engine.set_fault("replica_slow",
+                               f"80:1:{rep_b.lineage['index']}")
+        b_opt = resident(rep_b, budget, True)
+        rep_b.engine.set_fault("replica_wedge",
+                               f"{rep_b.lineage['index']}:0")
+        t_wedge = time.monotonic()
+        t_end = time.monotonic() + 30
+        while rep_b.state != "wedged" and time.monotonic() < t_end:
+            time.sleep(0.002)
+        wedge_detect_ms = (time.monotonic() - t_wedge) * 1e3
+        # -- every affected stream: typed terminal or bit-identical ----
+        w_hung = 0
+        for name, resp, expect_lost in (("a_opt", a_opt, False),
+                                        ("a_no", a_no, True),
+                                        ("b_opt", b_opt, False)):
+            if not resp._done.wait(timeout=90):
+                w_hung += 1
+                w_failures.append(f"worker stream {name} hung")
+                continue
+            if expect_lost:
+                if not isinstance(resp.error, _RLE):
+                    w_failures.append(
+                        f"worker stream {name}: expected typed "
+                        f"ReplicaLostError, got {resp.error!r}")
+            elif resp.error is not None:
+                w_failures.append(f"worker stream {name}: {resp.error!r}")
+            elif resp.tokens() != w_want:
+                w_failures.append(
+                    f"worker stream {name} diverged from solo oracle")
+        if rep_a.state != "crashed":
+            w_failures.append(f"SIGKILL not fenced (A={rep_a.state})")
+        if rep_b.state != "wedged":
+            w_failures.append(f"wedge not fenced (B={rep_b.state})")
+        for nm, ms in (("kill", kill_detect_ms),
+                       ("wedge", wedge_detect_ms)):
+            if ms >= 2 * hb_timeout * 1e3:
+                w_failures.append(
+                    f"{nm} fenced in {ms:.0f}ms >= "
+                    f"{2 * hb_timeout * 1e3:.0f}ms bar "
+                    "(heartbeat threshold x2)")
+        # -- supervisor: both workers restart from the program set -----
+        t_end = time.monotonic() + 120
+        restarted = []
+        while time.monotonic() < t_end:
+            restarted = [r for r in wfleet.manager.replicas()
+                         if getattr(r, "kind", "") == "subprocess"
+                         and r.state == "healthy"]
+            if len(restarted) >= 2:
+                break
+            time.sleep(0.02)
+        restart_exe = len(restarted) >= 2 and all(
+            v == "program_set:exe"
+            for r in restarted
+            for v in ((r.engine.warmup_report or {}).get("programs")
+                      or {}).values())
+        tail_ok, pwc_ok = True, True
+        for r in restarted[:2]:
+            rq, rs = r.engine.make_request(w_prompt, budget)
+            r.engine.scheduler.submit(rq, rs)
+            if not rs._done.wait(timeout=90):
+                tail_ok = False
+                w_failures.append("post-restart tail stream hung")
+            elif rs.error is not None or rs.tokens() != w_want:
+                tail_ok = False
+                w_failures.append("post-restart tail diverged/failed")
+            if r.engine.post_warmup_compiles() != 0:
+                pwc_ok = False
+                w_failures.append(
+                    f"restarted worker {r.id} reports "
+                    f"{r.engine.post_warmup_compiles()} post-warmup "
+                    "compiles (must be 0)")
+        restart_ok = (len(restarted) >= 2 and first_exe and restart_exe
+                      and tail_ok and pwc_ok and w_hung == 0)
+        if len(restarted) < 2:
+            w_failures.append(
+                f"supervisor restarted only {len(restarted)}/2 workers")
+        if not first_exe or not restart_exe:
+            w_failures.append(
+                "workers did not boot every program from the program "
+                "set (program_set:exe)")
+        wc = wfleet.manager.counters()
+        out.update({
+            "worker_kill_detect_ms": round(kill_detect_ms, 1),
+            "wedge_detect_ms": round(wedge_detect_ms, 1),
+            "heartbeat_timeout_ms": hb_timeout * 1e3,
+            "worker_restarts": wc["worker_restarts"],
+            "wedges": wc["wedges"],
+            "restart_ok": restart_ok,
+            "worker_streams_hung": w_hung,
+        })
+        failures.extend(w_failures)
+        wfleet.close()
 
     out["fleet_counters"] = fleet.manager.counters()
     out["health"] = {k: v for k, v in fleet.health().items()

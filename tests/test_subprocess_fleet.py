@@ -1,0 +1,545 @@
+"""Process-isolated fleet replicas (ISSUE 13): subprocess engine
+workers behind the PR-12 router — RPC wire form, out-of-band heartbeat
+wedge fencing, SIGKILL + supervised restart under a backoff budget.
+
+Tier-1 keeps every subprocess test to <= 2 workers on the tiny GPT and
+arms a hard SIGALRM per-test timeout, so a hung worker (the very
+failure mode under test) can never wedge the suite; the full chaos
+matrix runs under `slow`.
+"""
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.serving import (FleetRouter, ReplicaLostError,
+                                RestartBackoff, ServingEngine,
+                                WireFormatError, WorkerDiedError)
+from paddle_tpu.serving.fleet import ReplicaManager, SubprocessReplica
+from paddle_tpu.serving.transfer import (RunTransferError, TRANSFER_VERSION,
+                                         check_compatible, encode_run,
+                                         engine_config_hash, run_from_bytes,
+                                         run_to_bytes)
+from paddle_tpu.serving.worker import pack_frame, unpack_frame
+from paddle_tpu.utils import faults
+
+pytestmark = pytest.mark.subprocess_fleet
+
+GPT_KW = dict(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+              num_attention_heads=2, hidden_dropout_prob=0.0,
+              attention_probs_dropout_prob=0.0,
+              max_position_embeddings=128)
+ENGINE_KW = dict(max_slots=2, max_len=64, prefill_buckets=(8,),
+                 decode_chunk=2)
+
+
+def worker_spec(**engine_overrides):
+    ekw = dict(ENGINE_KW, **engine_overrides)
+    ekw["prefill_buckets"] = list(ekw["prefill_buckets"])
+    return {"model": {"factory": "paddle_tpu.serving.worker:build_gpt",
+                      "kwargs": dict(GPT_KW, seed=11)},
+            "engine": ekw}
+
+
+def tiny_model():
+    paddle.seed(11)
+    m = models.GPTForPretraining(models.GPTConfig(**GPT_KW))
+    m.eval()
+    return m
+
+
+def oracle(model, prompt, max_new):
+    out, _ = model.generate(paddle.to_tensor(np.asarray(prompt)[None]),
+                            max_new_tokens=max_new)
+    return np.asarray(out.numpy())[0].tolist()
+
+
+@pytest.fixture
+def hard_timeout():
+    """The tier-1 wedge guard: SIGALRM aborts the test outright if a
+    worker hang ever leaks past the in-test timeouts."""
+    def handler(signum, frame):
+        raise TimeoutError("subprocess_fleet hard per-test timeout "
+                           "(a worker hang leaked past the in-test "
+                           "timeouts)")
+    old = signal.signal(signal.SIGALRM, handler)
+    signal.alarm(150)
+    yield
+    signal.alarm(0)
+    signal.signal(signal.SIGALRM, old)
+
+
+@pytest.fixture
+def fleet_guard():
+    """Closes every registered fleet at teardown — even a failing test
+    leaves no orphan worker processes behind."""
+    fleets = []
+    yield fleets.append
+    for fleet in fleets:
+        try:
+            fleet.close()
+        except Exception:
+            pass
+    faults.reset()
+
+
+def wait_for(pred, timeout, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out after {timeout}s waiting for {what}")
+
+
+# ---------------------------------------------------------------------------
+# pure units: no subprocess spawned
+# ---------------------------------------------------------------------------
+
+def test_restart_backoff_schedule_and_budget():
+    # deterministic rng: always the jitter midpoint
+    bo = RestartBackoff(max_restarts=3, base_delay=0.5, max_delay=10.0,
+                        jitter=0.5, rng=lambda a, b: (a + b) / 2)
+    # exponential doubling, each with the jitter-midpoint (0.25d) added
+    assert bo.delay_for(1) == pytest.approx(0.5 * 1.25)
+    assert bo.delay_for(2) == pytest.approx(1.0 * 1.25)
+    assert bo.delay_for(3) == pytest.approx(2.0 * 1.25)
+    assert bo.delay_for(4) is None  # budget exhausted
+    assert bo.delay_for(0) is None
+    # max_delay caps the pre-jitter schedule
+    bo2 = RestartBackoff(max_restarts=5, base_delay=1.0, max_delay=2.0,
+                         jitter=0.0)
+    assert [bo2.delay_for(i) for i in range(1, 6)] == [1.0, 2.0, 2.0,
+                                                       2.0, 2.0]
+    # jitter bounds: delay in [d, (1+jitter)*d]
+    bo3 = RestartBackoff(max_restarts=1, base_delay=1.0, jitter=0.5)
+    for _ in range(20):
+        d = bo3.delay_for(1)
+        assert 1.0 <= d <= 1.5
+
+
+def test_supervisor_schedule_under_injected_clock(monkeypatch):
+    """The restart supervisor's schedule is driven by the injected
+    clock: nothing spawns before the backoff delay elapses, each failure
+    doubles the delay, and the budget's end marks the lineage exhausted
+    and stops respawning."""
+    now = {"t": 100.0}
+    mgr = ReplicaManager(
+        heartbeat_timeout_s=None,
+        restart_backoff=RestartBackoff(max_restarts=2, base_delay=1.0,
+                                       jitter=0.0),
+        _clock=lambda: now["t"])
+    spawned = []
+    monkeypatch.setattr(mgr, "add_worker",
+                        lambda spec, lineage=None, **kw:
+                        spawned.append(lineage))
+    lineage = {"spec": {}, "index": 7, "restarts": 0,
+               "client_kw": {}, "exhausted": False}
+    mgr._schedule_restart_lineage(lineage)
+    assert lineage["restarts"] == 1
+    assert mgr._restarts[0]["at"] == pytest.approx(101.0)
+    assert not mgr._pump_restarts() and not spawned  # before due time
+    now["t"] = 100.5
+    assert not mgr._pump_restarts() and not spawned
+    now["t"] = 101.0
+    assert mgr._pump_restarts() and len(spawned) == 1
+    # second failure: doubled delay
+    mgr._schedule_restart_lineage(lineage)
+    assert lineage["restarts"] == 2
+    assert mgr._restarts[0]["at"] == pytest.approx(103.0)
+    now["t"] = 103.5
+    assert mgr._pump_restarts() and len(spawned) == 2
+    # third failure: budget (2) exhausted — typed terminal for the
+    # lineage, no further spawns ever
+    mgr._schedule_restart_lineage(lineage)
+    assert lineage["exhausted"]
+    assert mgr.counters()["restarts_exhausted"] == 1
+    assert not mgr._restarts
+    mgr._schedule_restart_lineage(lineage)  # idempotent once exhausted
+    assert not mgr._restarts
+
+
+def test_wire_frame_roundtrip_and_typed_mismatch():
+    frame = pack_frame("submit", {"wid": 3, "temperature": 0.5},
+                       {"prompt": np.arange(5, dtype=np.int32)})
+    n = int.from_bytes(frame[:8], "big")
+    assert n == len(frame) - 8
+    verb, h, arrays = unpack_frame(frame[8:])
+    assert verb == "submit" and h["wid"] == 3
+    assert h["temperature"] == 0.5
+    np.testing.assert_array_equal(arrays["prompt"],
+                                  np.arange(5, dtype=np.int32))
+    # corrupt payload -> typed, never a deep KeyError
+    with pytest.raises(WireFormatError):
+        unpack_frame(b"not an npz at all")
+    # a frame whose wire version disagrees is refused typed
+    bad = pack_frame("submit", {})
+    verb, h, arrays = unpack_frame(bad[8:])
+    h["v"] = 999
+    import io
+    buf = io.BytesIO()
+    np.savez(buf, header=np.frombuffer(json.dumps(h).encode(), np.uint8))
+    with pytest.raises(WireFormatError, match="wire version"):
+        unpack_frame(buf.getvalue())
+    # a headerless npz is typed too
+    buf = io.BytesIO()
+    np.savez(buf, x=np.zeros(3))
+    with pytest.raises(WireFormatError):
+        unpack_frame(buf.getvalue())
+
+
+def test_transfer_wire_carries_version_and_config_hash():
+    """ISSUE-13 satellite: the npz wire form embeds the codec version
+    and the source engine's config hash, and a target built from a
+    different manifest rejects the run TYPED before any row decodes."""
+    model = tiny_model()
+    eng_a = ServingEngine(model, **ENGINE_KW)
+    eng_b_cfg = dict(ENGINE_KW, max_len=48)
+    eng_b = ServingEngine(model, **eng_b_cfg)
+    ha, hb = engine_config_hash(eng_a), engine_config_hash(eng_b)
+    assert ha != hb  # max_len is a transfer-identity axis
+    assert ha == engine_config_hash(
+        ServingEngine(tiny_model(), **ENGINE_KW))  # deterministic
+    # handcraft a snapshot shaped like eng_a's pools
+    from paddle_tpu.serving.engine import PreemptedRun
+    from paddle_tpu.serving.request import Request, Response
+    req = Request(0, np.arange(1, 5, dtype=np.int32), 8)
+    rows = [(np.zeros((4,) + tuple(k.shape[2:]), k.dtype),
+             np.zeros((4,) + tuple(v.shape[2:]), v.dtype))
+            for k, v in eng_a._pools]
+    paused = PreemptedRun.from_state(
+        req, Response(req), pos=4, produced=1, last_token=1,
+        key=np.zeros(2, np.uint32), kv_rows=rows)
+    blob = encode_run(paused, engine=eng_a)
+    # version + hash ride the npz header across the wire
+    rt = run_from_bytes(run_to_bytes(blob))
+    assert rt["version"] == TRANSFER_VERSION
+    assert rt["manifest"]["config_hash"] == ha
+    check_compatible(rt, eng_a)  # self-restore fine
+    with pytest.raises(RunTransferError, match="config hash"):
+        check_compatible(rt, eng_b)
+    # without a source engine the hash is absent: shape checks still run
+    anon = run_from_bytes(run_to_bytes(encode_run(paused)))
+    assert anon["manifest"]["config_hash"] is None
+    check_compatible(anon, eng_a)
+    # a foreign codec version is refused at the byte boundary
+    old = dict(blob, version=1)
+    with pytest.raises(RunTransferError, match="codec version"):
+        run_from_bytes(run_to_bytes(old))
+
+
+def test_config_hash_rides_every_migration_hop():
+    """The hash must survive the REAL migration paths — preempt_slot
+    stamps it on the PreemptedRun, decode_run keeps it, and a plain
+    `encode_run(paused)` (the manager-side hop, no engine in hand)
+    still carries it — so a cross-manifest restore is refused typed no
+    matter how many decode/re-encode hops the snapshot took."""
+    from paddle_tpu.serving.transfer import decode_run
+    model = tiny_model()
+    eng_a = ServingEngine(model, **ENGINE_KW)
+    eng_b = ServingEngine(model, **dict(ENGINE_KW, max_len=48))
+    eng_a.warmup()
+    resp = eng_a.submit(np.arange(1, 5, dtype=np.int32), 6)
+    eng_a.step()
+    slot = next(iter(eng_a._slots))
+    paused = eng_a.preempt_slot(slot)
+    assert paused.source_config_hash == engine_config_hash(eng_a)
+    # the manager-side hop: encode WITHOUT an engine in hand
+    blob = encode_run(paused)
+    assert blob["manifest"]["config_hash"] == engine_config_hash(eng_a)
+    with pytest.raises(RunTransferError, match="config hash"):
+        check_compatible(blob, eng_b)
+    # a decode/re-encode round trip keeps it too (WorkerClient.preempt)
+    snap = decode_run(run_from_bytes(run_to_bytes(blob)))
+    assert snap.source_config_hash == engine_config_hash(eng_a)
+    with pytest.raises(RunTransferError, match="config hash"):
+        check_compatible(encode_run(snap), eng_b)
+    resp.cancel()
+    eng_a.close()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 subprocess smoke: <= 2 workers, tiny GPT, hard timeout
+# ---------------------------------------------------------------------------
+
+def test_worker_serves_bit_identical_then_reaps(hard_timeout, fleet_guard):
+    """One subprocess worker + one in-process replica: the worker's
+    greedy streams are bit-identical to solo generate (same-seed model
+    rebuild in the worker process), health/metrics surface the process
+    facts, and router close reaps the worker — no orphans, and a second
+    SIGKILL of the already-dead pid is a no-op."""
+    model = tiny_model()
+    fleet = FleetRouter([ServingEngine(model, **ENGINE_KW)],
+                        heartbeat_timeout_s=5.0)
+    fleet_guard(fleet)
+    rid = fleet.add_worker(worker_spec())
+    fleet.warmup()
+    fleet.start()
+    rep = fleet.manager.get(rid)
+    assert isinstance(rep, SubprocessReplica) and rep.state == "healthy"
+    prompt = np.arange(1, 6, dtype=np.int32)
+    want = oracle(model, prompt, 12)
+    # route one request explicitly onto the worker
+    req, resp = rep.engine.make_request(prompt, 12)
+    rep.engine.scheduler.submit(req, resp)
+    assert resp.tokens(timeout=60) == want
+    # and one through the front door (whichever replica wins)
+    assert fleet.submit(prompt, 12).tokens(timeout=60) == want
+    snap = rep.snapshot()
+    assert snap["kind"] == "subprocess" and snap["process_alive"]
+    assert snap["pid"] == rep.engine.pid
+    assert snap["heartbeat_age_s"] is not None
+    assert rep.engine.post_warmup_compiles() == 0
+    health = fleet.health()
+    assert health["workers"] == 1
+    assert health["all_routable_stale"] is False
+    pid = rep.engine.pid
+    fleet.close()
+    wait_for(lambda: not _pid_alive(pid), 10, "worker reaped on close")
+    # double-SIGKILL of the already-dead pid: no-op, never a raise
+    rep.engine.kill()
+    rep.engine.kill()
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    # still a zombie? reaped children disappear; our waiter reaps
+    try:
+        done, _ = os.waitpid(pid, os.WNOHANG)
+        return done == 0
+    except ChildProcessError:
+        return True  # alive but not our child
+
+
+def test_worker_sigkill_failover_resubmit_and_restart(hard_timeout,
+                                                      fleet_guard):
+    """SIGKILL of the worker mid-decode: the resubmit opt-in stream
+    completes bit-identical on the in-process survivor, the non-opt-in
+    ends in the typed ReplicaLostError, and the supervisor restarts the
+    worker which then serves bit-identical again."""
+    model = tiny_model()
+    fleet = FleetRouter(
+        [ServingEngine(model, **ENGINE_KW)], heartbeat_timeout_s=5.0,
+        restart_backoff=RestartBackoff(max_restarts=1, base_delay=0.05,
+                                       max_delay=0.2))
+    fleet_guard(fleet)
+    rid = fleet.add_worker(worker_spec())
+    fleet.warmup()
+    fleet.start()
+    rep = fleet.manager.get(rid)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    want = oracle(model, prompt, 24)
+    # slow ONLY the worker so both streams are still decoding at kill
+    rep.engine.set_fault("replica_slow", f"60:1:{rep.lineage['index']}")
+    r_opt, o_resp = rep.engine.make_request(prompt, 24, resubmit=True)
+    rep.engine.scheduler.submit(r_opt, o_resp)
+    r_no, n_resp = rep.engine.make_request(prompt, 24)
+    rep.engine.scheduler.submit(r_no, n_resp)
+    wait_for(lambda: len(o_resp.tokens_so_far()) >= 1
+             and len(n_resp.tokens_so_far()) >= 1, 60,
+             "both streams resident on the worker")
+    os.kill(rep.engine.pid, signal.SIGKILL)
+    # opt-in: seamless bit-identical continuation on the survivor
+    assert o_resp.tokens(timeout=60) == want
+    # non-opt-in: typed terminal, never a hang
+    with pytest.raises(ReplicaLostError):
+        n_resp.tokens(timeout=60)
+    assert rep.state == "crashed"
+    # the supervisor brings a NEW incarnation up (fresh replica id,
+    # same worker index/lineage) and it serves bit-identical
+    wait_for(lambda: any(
+        r.kind == "subprocess" and r.state == "healthy"
+        for r in fleet.manager.replicas()), 90, "supervised restart")
+    new_rep = next(r for r in fleet.manager.replicas()
+                   if r.kind == "subprocess" and r.state == "healthy")
+    assert new_rep.id != rid
+    assert new_rep.lineage["index"] == rep.lineage["index"]
+    assert new_rep.lineage["restarts"] == 1
+    req2, resp2 = new_rep.engine.make_request(prompt, 24)
+    new_rep.engine.scheduler.submit(req2, resp2)
+    assert resp2.tokens(timeout=60) == want
+    c = fleet.manager.counters()
+    assert c["worker_restarts"] == 1 and c["resubmits"] >= 1
+
+
+def test_wedge_heartbeat_fence_sigkill_and_budget(hard_timeout,
+                                                  fleet_guard):
+    """PDTPU_FAULT_REPLICA_WEDGE: the worker's step blocks forever —
+    the socket stays up, no call returns — and ONLY the out-of-band
+    heartbeat age fences it (the case PDTPU_FAULT_REPLICA_CRASH cannot
+    model).  The wedged process is SIGKILLed after the grace period;
+    with a zero restart budget the lineage is exhausted and the replica
+    removed, with every consumer typed-terminal."""
+    model = tiny_model()
+    fleet = FleetRouter(
+        [ServingEngine(model, **ENGINE_KW)],
+        heartbeat_timeout_s=0.8, kill_grace_s=0.2,
+        restart_backoff=RestartBackoff(max_restarts=0))
+    fleet_guard(fleet)
+    rid = fleet.add_worker(worker_spec())
+    fleet.warmup()
+    fleet.start()
+    rep = fleet.manager.get(rid)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    want = oracle(model, prompt, 24)
+    req, resp = rep.engine.make_request(prompt, 24, resubmit=True)
+    rep.engine.scheduler.submit(req, resp)
+    wait_for(lambda: len(resp.tokens_so_far()) >= 1, 60,
+             "stream resident on the worker")
+    pid = rep.engine.pid
+    rep.engine.set_fault("replica_wedge", f"{rep.lineage['index']}:0")
+    t_arm = time.monotonic()
+    # the opted-in stream fails over (resubmitted on the survivor,
+    # bit-identical) — driven purely by heartbeat age
+    assert resp.tokens(timeout=60) == want
+    detect_s = time.monotonic() - t_arm
+    assert rep.state == "wedged"
+    assert "heartbeat age" in rep.fence_reason
+    # fencing must land near the threshold, not after some RPC timeout
+    assert detect_s < 5.0
+    # grace period -> SIGKILL of the wedged pid
+    wait_for(lambda: rep.engine.proc.poll() is not None, 15,
+             "wedged worker SIGKILLed after grace")
+    # zero budget: lineage exhausted, replica removed, no respawn
+    wait_for(lambda: fleet.manager.get(rid) is None, 15,
+             "exhausted lineage removed")
+    assert rep.lineage["exhausted"]
+    c = fleet.manager.counters()
+    assert c["wedges"] == 1 and c["worker_restarts"] == 0
+    assert c["restarts_exhausted"] == 1
+    assert not any(r.kind == "subprocess"
+                   for r in fleet.manager.replicas())
+    _ = pid  # pid reaped via proc.poll above
+
+
+def test_drain_migrates_runs_across_process_boundary(hard_timeout,
+                                                     fleet_guard):
+    """Live run migration over the npz wire form, both directions:
+    drain the worker -> its resident restores onto the in-process peer
+    bit-identical; drain the in-process replica -> its resident
+    restores INTO a worker bit-identical."""
+    model = tiny_model()
+    eng = ServingEngine(model, **ENGINE_KW)
+    fleet = FleetRouter([eng], heartbeat_timeout_s=5.0)
+    fleet_guard(fleet)
+    rid = fleet.add_worker(worker_spec())
+    fleet.warmup()
+    fleet.start()
+    rep = fleet.manager.get(rid)
+    inproc_id = next(r.id for r in fleet.manager.replicas()
+                     if r.kind == "inproc")
+    prompt = np.arange(1, 6, dtype=np.int32)
+    want = oracle(model, prompt, 24)
+    # out of the worker
+    rep.engine.set_fault("replica_slow", f"50:1:{rep.lineage['index']}")
+    req, resp = rep.engine.make_request(prompt, 24)
+    rep.engine.scheduler.submit(req, resp)
+    wait_for(lambda: len(resp.tokens_so_far()) >= 1, 60,
+             "stream resident on the worker")
+    fleet.drain(rid)
+    assert resp.tokens(timeout=60) == want
+    assert req.migrations == 1
+    wait_for(lambda: fleet.manager.get(rid).state == "closed", 30,
+             "drained worker closed")
+    # into a fresh worker
+    rid2 = fleet.add_worker(worker_spec())
+    wait_for(lambda: fleet.manager.get(rid2).state == "healthy", 120,
+             "second worker healthy")
+    inproc = fleet.manager.get(inproc_id)
+    faults.enable("replica_slow", f"50:1:{inproc_id}")
+    req2, resp2 = inproc.engine.make_request(prompt, 24)
+    inproc.engine.scheduler.submit(req2, resp2)
+    wait_for(lambda: len(resp2.tokens_so_far()) >= 1, 60,
+             "stream resident in-process")
+    fleet.drain(inproc_id)
+    faults.disable("replica_slow")
+    assert resp2.tokens(timeout=60) == want
+    assert req2.migrations == 1
+    assert fleet.manager.counters()["migrated"] == 2
+
+
+def test_no_peer_budget_exhaustion_typed_matrix(hard_timeout, fleet_guard):
+    """Worker-only fleet, zero restart budget, SIGKILL: the resident
+    resubmit OPT-IN has no survivor to replay on and the locally queued
+    request has no peer queue — BOTH must reach the typed
+    ReplicaLostError (never a hang), and the exhausted lineage never
+    respawns."""
+    model = tiny_model()
+    fleet = FleetRouter([], heartbeat_timeout_s=5.0,
+                        restart_backoff=RestartBackoff(max_restarts=0))
+    fleet_guard(fleet)
+    rid = fleet.add_worker(worker_spec(max_slots=1))
+    fleet.warmup()
+    fleet.start()
+    rep = fleet.manager.get(rid)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    rep.engine.set_fault("replica_slow", f"60:1:{rep.lineage['index']}")
+    # resident (opted in — but there will be nobody left to resubmit to)
+    req_r, resp_r = rep.engine.make_request(prompt, 24, resubmit=True)
+    rep.engine.scheduler.submit(req_r, resp_r)
+    wait_for(lambda: len(resp_r.tokens_so_far()) >= 1, 60, "resident")
+    # queued behind the single slot: never ships (free mirror is 0)
+    req_q, resp_q = rep.engine.make_request(prompt, 8)
+    rep.engine.scheduler.submit(req_q, resp_q)
+    os.kill(rep.engine.pid, signal.SIGKILL)
+    with pytest.raises(ReplicaLostError):
+        resp_r.tokens(timeout=60)
+    with pytest.raises(ReplicaLostError):
+        resp_q.tokens(timeout=60)
+    wait_for(lambda: fleet.manager.get(rid) is None, 15,
+             "exhausted lineage removed")
+    c = fleet.manager.counters()
+    assert c["restarts_exhausted"] == 1 and c["worker_restarts"] == 0
+    assert c["lost"] == 2
+
+
+# ---------------------------------------------------------------------------
+# full chaos matrix (slow)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_gateway_over_mixed_fleet_with_worker_loss(hard_timeout,
+                                                   fleet_guard):
+    """ServingGateway fronting a mixed in-process/subprocess fleet:
+    traffic flows through the multi-tenant door, a worker SIGKILL mid
+    traffic leaves zero hung consumers, and /healthz reports the worker
+    block."""
+    from paddle_tpu.serving import ServingGateway
+    model = tiny_model()
+    fleet = FleetRouter(
+        [ServingEngine(model, **ENGINE_KW)], heartbeat_timeout_s=5.0,
+        restart_backoff=RestartBackoff(max_restarts=1, base_delay=0.05))
+    fleet_guard(fleet)
+    rid = fleet.add_worker(worker_spec())
+    fleet.warmup()
+    gw = ServingGateway(fleet)
+    fleet_guard(gw)
+    gw.start()
+    rep = fleet.manager.get(rid)
+    prompt = np.arange(1, 6, dtype=np.int32)
+    want = oracle(model, prompt, 12)
+    resps = [gw.submit(prompt, 12, resubmit=True, session=f"s{i}")
+             for i in range(6)]
+    time.sleep(0.1)
+    os.kill(rep.engine.pid, signal.SIGKILL)
+    results = []
+    for r in resps:
+        assert r._done.wait(timeout=90), "hung consumer"
+        if r.error is None:
+            results.append(r.tokens() == want)
+        else:
+            assert isinstance(r.error, ReplicaLostError)
+    assert results and all(results)
+    status, _, body = gw.handle("GET", "/healthz", b"")
+    payload = json.loads(body)
+    assert payload["fleet"]["workers"] >= 1
+    assert "all_routable_stale" in payload["fleet"]
+    gw.close()
