@@ -856,7 +856,15 @@ def measure_fleet():
     PDTPU_FAULT_REPLICA_WEDGE hang of SUBPROCESS workers both fence
     within the out-of-band heartbeat threshold with the supervisor
     restarting both workers from the program set (restart_ok) at zero
-    post-warmup compiles."""
+    post-warmup compiles — and network transparency: standalone remote
+    TCP workers attached by address boot from weights + program set
+    shipped over the wire with sha256 verification (weight_ship_ok: zero
+    seeded rebuilds, zero post-warmup compiles) and survive net chaos
+    (delay slowloris, mid-frame drop, hard partition) with the
+    partitioned replica fenced on beat-frame age within 2x the threshold
+    (partition_detect_ms), every stream bit-identical or typed, and the
+    healed worker re-attached under a higher epoch with zero
+    double-served tokens."""
     here = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
@@ -864,7 +872,7 @@ def measure_fleet():
     proc = subprocess.run(
         [sys.executable, os.path.join(here, "probes", "fleet_probe.py"),
          "--steps", os.environ.get("PDTPU_FLEET_PROBE_STEPS", "36")],
-        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+        capture_output=True, text=True, timeout=1500, env=env, cwd=here)
     for line in proc.stdout.splitlines():
         if line.startswith("FLEET"):
             rec = json.loads(line[len("FLEET"):])
@@ -877,6 +885,8 @@ def measure_fleet():
                     "rollout_dropped": rec.get("rollout_dropped"),
                     "wedge_detect_ms": rec.get("wedge_detect_ms"),
                     "restart_ok": rec.get("restart_ok"),
+                    "partition_detect_ms": rec.get("partition_detect_ms"),
+                    "weight_ship_ok": rec.get("weight_ship_ok"),
                     "detail": rec}
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 

@@ -179,6 +179,11 @@ def report() -> dict:
         "worker_restarts": stats.get("STAT_fleet_worker_restarts", 0),
         "restarts_exhausted": stats.get("STAT_fleet_restarts_exhausted",
                                         0),
+        # remote (network-attached) workers: boot-handshake artifact
+        # shipping volume — which weights a replica serves is per-replica
+        # in /healthz (weights_sha/epoch in each snapshot)
+        "weight_bytes_shipped": stats.get(
+            "STAT_fleet_weight_bytes_shipped", 0),
     }
     gateway = {
         "ttft_hi_seconds": _hist_summary("gateway_ttft_hi_seconds"),

@@ -85,10 +85,11 @@ from .request import Request, Response, RequestCancelled
 from .scheduler import DeadlineExceededError, QueueFullError
 from .transfer import (RunTransferError, check_compatible, decode_run,
                        encode_run)
-from .worker import WorkerClient, WorkerDiedError
+from .worker import RemoteWorkerClient, WorkerClient, WorkerDiedError
 
 __all__ = ["FleetRouter", "ReplicaManager", "Replica",
-           "SubprocessReplica", "RestartBackoff", "ReplicaLostError"]
+           "SubprocessReplica", "RemoteReplica", "RestartBackoff",
+           "ReplicaLostError"]
 
 # replica lifecycle states
 BOOTING = "booting"      # added, not yet warm — never routed to
@@ -338,7 +339,29 @@ class SubprocessReplica(Replica):
             "worker_index": self.lineage.get("index"),
             "restarts": self.lineage.get("restarts", 0),
             "worker_steps": self.engine.heartbeat_steps(),
+            # which weights this replica actually serves + its session
+            # epoch — the at-a-glance answer /healthz operators need
+            "weights_sha": getattr(self.engine, "weights_sha", None),
+            "epoch": getattr(self.engine, "epoch", 0),
         })
+        return snap
+
+
+class RemoteReplica(SubprocessReplica):
+    """A replica attached over real TCP (`RemoteWorkerClient`): the
+    manager never owned its process, liveness rides beat frames on a
+    dedicated side connection instead of a heartbeat file, and the
+    supervisor's 'restart' is a RE-ATTACH to the same address with an
+    incremented epoch token.  Everything else — wedge fencing on beat
+    age, failover, drain, rollout — is inherited unchanged: that is the
+    point of the epoch/beat design."""
+
+    kind = "remote"
+
+    def snapshot(self) -> Dict:
+        snap = super().snapshot()
+        snap["address"] = self.lineage.get("address")
+        snap["bytes_shipped"] = getattr(self.engine, "bytes_shipped", 0)
         return snap
 
 
@@ -402,15 +425,25 @@ class ReplicaManager:
 
     def add_worker(self, spec: Dict, lineage: Optional[Dict] = None,
                    boot_timeout_s: float = 180.0,
-                   rpc_timeout_s: float = 15.0) -> "SubprocessReplica":
+                   rpc_timeout_s: float = 15.0,
+                   address: Optional[str] = None,
+                   **client_extra) -> "SubprocessReplica":
         """Spawn a subprocess engine worker from a boot spec (model
         factory + engine config + optional AOT program set — see
         serving/worker.py) and register it BOOTING; the driving tick
         polls the handshake and flips it healthy once the worker reports
-        warm.  `lineage` is internal (the supervisor's restart path
-        reuses the original spec/index/budget record)."""
+        warm.  `address="HOST:PORT"` attaches to a STANDALONE remote
+        worker (``--listen``) over TCP instead of spawning one: the boot
+        spec (plus the ``spec["weights"]`` npz artifact and optionally
+        the program set) ships over the attach handshake under a
+        manager-issued epoch token, and a supervisor 'restart' is a
+        re-attach with the epoch incremented — the stale session is told
+        to abort, never to resume.  `lineage` is internal (the
+        supervisor's restart path reuses the original
+        spec/index/budget/address record)."""
         client_kw = {"boot_timeout_s": float(boot_timeout_s),
                      "rpc_timeout_s": float(rpc_timeout_s)}
+        client_kw.update(client_extra)
         with self._lock:
             rid = self._next_id
             self._next_id += 1
@@ -418,10 +451,22 @@ class ReplicaManager:
             # the worker INDEX (fault-knob target) stays stable across
             # restarts; the replica id never recurs
             lineage = {"spec": dict(spec), "index": rid, "restarts": 0,
-                       "client_kw": client_kw, "exhausted": False}
-        client = WorkerClient(lineage["spec"], index=lineage["index"],
-                              **lineage.get("client_kw", client_kw))
-        rep = SubprocessReplica(rid, client, lineage)
+                       "client_kw": client_kw, "exhausted": False,
+                       "address": address, "epoch": 0}
+        if lineage.get("address"):
+            # every (re)attach gets a FRESH epoch token: the fence that
+            # makes a healed stale session abort instead of double-serve
+            lineage["epoch"] = lineage.get("epoch", 0) + 1
+            client = RemoteWorkerClient(
+                lineage["spec"], address=lineage["address"],
+                index=lineage["index"], epoch=lineage["epoch"],
+                **lineage.get("client_kw", client_kw))
+            rep = RemoteReplica(rid, client, lineage)
+        else:
+            client = WorkerClient(lineage["spec"],
+                                  index=lineage["index"],
+                                  **lineage.get("client_kw", client_kw))
+            rep = SubprocessReplica(rid, client, lineage)
         with self._lock:
             self._replicas[rid] = rep
         self._publish_up(rep)
@@ -1148,17 +1193,29 @@ class FleetRouter:
         return self.manager.add(engine).id
 
     def add_worker(self, spec: Dict, boot_timeout_s: float = 180.0,
-                   rpc_timeout_s: float = 15.0) -> int:
+                   rpc_timeout_s: float = 15.0,
+                   address: Optional[str] = None,
+                   **client_extra) -> int:
         """Spawn a SUBPROCESS replica from a worker boot spec (see
         serving/worker.py: model factory + engine config + optional AOT
         program set) and return its replica id.  The worker boots and
         warms in its own process; the driving loop flips it routable at
         the ready handshake (or block on `warmup()`).  Crash/wedge
-        handling, SIGKILL and supervised restart are automatic."""
+        handling, SIGKILL and supervised restart are automatic.
+
+        `address="HOST:PORT"` attaches to a REMOTE standalone worker
+        (started with ``--listen``) instead of spawning one: weights
+        (``spec["weights"]``) and optionally the program set
+        (``spec["ship_program_set"]=True``) ship over the attach
+        handshake, liveness rides beat frames, and partition fencing is
+        epoch-tokened (see RemoteWorkerClient).  Extra keyword args
+        (`manager_silence_s`, `connect_timeout_s`, ...) pass through to
+        the client."""
         if self._closed:
             raise UnavailableError("fleet is closed")
         rep = self.manager.add_worker(spec, boot_timeout_s=boot_timeout_s,
-                                      rpc_timeout_s=rpc_timeout_s)
+                                      rpc_timeout_s=rpc_timeout_s,
+                                      address=address, **client_extra)
         self._work.set()
         return rep.id
 
@@ -1436,6 +1493,8 @@ class FleetRouter:
             "total": len(reps),
             "workers": sum(1 for r in reps
                            if isinstance(r, SubprocessReplica)),
+            "remote_workers": sum(1 for r in reps
+                                  if isinstance(r, RemoteReplica)),
             "warm": self.warm,
             "heartbeat_timeout_s": self.manager.heartbeat_timeout_s,
             "stale_routable": stale,

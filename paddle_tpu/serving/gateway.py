@@ -825,10 +825,14 @@ class ServingGateway:
                 except Exception:
                     pstore = None
                 # fleet-fronted gateways aggregate per-replica health:
-                # state, warm, step-time EWMA, heartbeat age and
-                # post-warmup compiles per replica, plus the routable
-                # count — the signals a cluster scheduler needs to decide
-                # whether THIS front door still has capacity behind it
+                # state, warm, step-time EWMA, heartbeat age,
+                # post-warmup compiles — and for worker replicas the
+                # served weights_sha + session epoch (a remote replica's
+                # snapshot also carries its address and bytes shipped),
+                # plus the routable count — the signals a cluster
+                # scheduler needs to decide whether THIS front door
+                # still has capacity behind it, and operators need to
+                # see which weights each replica is actually serving
                 health_fn = getattr(self.engine, "health", None)
                 fleet = health_fn() if callable(health_fn) else None
                 if fleet is not None and fleet.get("routable", 0) == 0:

@@ -53,7 +53,14 @@ from .request import Request, Response
 
 __all__ = ["RunTransferError", "encode_run", "decode_run", "run_to_bytes",
            "run_from_bytes", "check_compatible", "engine_config_hash",
-           "target_manifest", "TRANSFER_VERSION"]
+           "target_manifest", "TRANSFER_VERSION", "file_sha256",
+           "artifact_manifest", "iter_artifact_chunks",
+           "ARTIFACT_CHUNK_SIZE"]
+
+# weight / program-set shipping: frames this size keep any single RPC
+# frame small enough that a mid-frame connection cut loses at most one
+# chunk (and the per-chunk sha pinpoints exactly which one was torn)
+ARTIFACT_CHUNK_SIZE = 1 << 18
 
 # v2: the npz header gained the codec version INSIDE the wire form (not
 # only the in-memory blob) plus the source engine's config hash, so a
@@ -129,6 +136,55 @@ def target_manifest(engine) -> dict:
         "draft_kv": (side(engine._draft_pools)
                      if engine.draft_model is not None else None),
     }
+
+
+# ---------------------------------------------------------------------------
+# artifact shipping: weight / program-set files over the boot handshake
+# ---------------------------------------------------------------------------
+
+def file_sha256(path: str) -> str:
+    """Whole-file sha256 hex digest (streamed; artifacts can be GBs)."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def artifact_manifest(path: str,
+                      chunk_size: int = ARTIFACT_CHUNK_SIZE) -> dict:
+    """The shipping manifest of one artifact file (a jit.save weight npz
+    or a PR-9 program set): whole-artifact sha256 + per-chunk sha256s.
+    The receiving worker verifies EVERY chunk against this before any
+    byte reaches an engine — a mismatch is the typed reject, never
+    garbage weights."""
+    chunks = []
+    total = hashlib.sha256()
+    nbytes = 0
+    with open(path, "rb") as f:
+        while True:
+            data = f.read(chunk_size)
+            if not data:
+                break
+            total.update(data)
+            nbytes += len(data)
+            chunks.append({"sha256": hashlib.sha256(data).hexdigest(),
+                           "nbytes": len(data)})
+    return {"sha256": total.hexdigest(), "nbytes": nbytes,
+            "chunk_size": int(chunk_size), "chunks": chunks}
+
+
+def iter_artifact_chunks(path: str,
+                         chunk_size: int = ARTIFACT_CHUNK_SIZE):
+    """Yield (seq, bytes) chunks of the artifact in manifest order."""
+    seq = 0
+    with open(path, "rb") as f:
+        while True:
+            data = f.read(chunk_size)
+            if not data:
+                break
+            yield seq, data
+            seq += 1
 
 
 def encode_run(paused: PreemptedRun, engine=None) -> dict:

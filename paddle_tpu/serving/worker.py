@@ -42,6 +42,29 @@ Threading contract (mirrors the in-process fleet): all socket I/O and
 state mutation happens on the fleet's driving thread via `step()` /
 RPC calls; only `scheduler.submit` (caller threads) and `close()` touch
 the client elsewhere, both under their own locks.
+
+**Network-transparent mode** (the multi-host leg of ROADMAP item 3):
+``python -m paddle_tpu.serving.worker --listen HOST:PORT`` runs the
+worker STANDALONE — the manager no longer forks it, it outlives any one
+manager, and a `RemoteWorkerClient` attaches over real TCP.  The attach
+handshake ships the boot spec plus a real weight artifact (jit.save
+npz, chunked frames, per-chunk AND whole-artifact sha256 checked
+against a manifest — any mismatch is a typed `WeightShipError`, never
+garbage weights) and optionally a PR-9 program set, replacing the
+seeded rebuild for production boots.  Liveness moves onto the wire: the
+worker pushes beat frames (step counter + monotonic stamp) on a
+dedicated side connection, and the manager ages them by ARRIVAL time on
+its own clock — a wedged remote step fences on beat age exactly like
+the local heartbeat-file path (which stays for local workers).
+Partition safety is epoch-token-shaped: the manager issues a session
+epoch at every (re)attach; on partition it fences on beat age and
+resubmits elsewhere while the isolated worker self-aborts its residents
+typed after a manager-silence timeout, and a healed worker carrying a
+stale epoch is told to abort, never to resume — no split-brain
+double-serving, token for token.  Retried control verbs are idempotent
+(submit dedups on wid server-side, so a retried submit after a lost ack
+can never double-admit), and the PDTPU_FAULT_NET_* chaos knobs (delay /
+mid-frame drop / blackhole partition) prove each path.
 """
 from __future__ import annotations
 
@@ -64,11 +87,13 @@ import numpy as np
 
 from ..core.errors import (FatalError, InvalidArgumentError,
                            ResourceExhaustedError, UnavailableError)
+from ..utils import faults as _faults
 from ..utils.monitor import stat_add
 from .request import Request, Response, RequestCancelled
 from .scheduler import DeadlineExceededError, QueueFullError
 
-__all__ = ["WorkerClient", "WorkerDiedError", "WireFormatError",
+__all__ = ["WorkerClient", "RemoteWorkerClient", "WorkerDiedError",
+           "WireFormatError", "StaleEpochError", "WeightShipError",
            "pack_frame", "unpack_frame", "build_gpt", "main",
            "WIRE_VERSION"]
 
@@ -90,6 +115,25 @@ class WorkerDiedError(UnavailableError):
     socket closed, or an RPC timed out (the wedged case).  The manager
     treats it exactly like a replica crash — fence + failover."""
     code = "Unavailable"
+
+
+class StaleEpochError(UnavailableError):
+    """This worker session's manager-issued epoch token was superseded
+    (partition healed after a fence, a newer manager re-attached, or
+    the manager went silent past its deadline).  Every resident run
+    dies with this error HERE because its resubmitted twin may already
+    be streaming elsewhere — aborting typed is what makes double-serving
+    impossible, token for token."""
+    code = "Unavailable"
+
+
+class WeightShipError(InvalidArgumentError):
+    """A shipped boot artifact failed verification: chunk out of order,
+    per-chunk or whole-artifact sha256 mismatch, short ship, or the
+    assembled weights do not fit the model.  The RunTransferError stance
+    applied to weights — reject typed at the boundary, never serve
+    garbage parameters."""
+    code = "InvalidArgument"
 
 
 # ---------------------------------------------------------------------------
@@ -141,50 +185,114 @@ def unpack_frame(payload: bytes) -> Tuple[str, dict, Dict[str, np.ndarray]]:
 
 class _FrameConn:
     """Length-prefixed frames over one stream socket.  Reads are
-    non-blocking (select-bounded); writes block up to `send_timeout` and
-    raise WorkerDiedError past it — the peer being too wedged to drain
-    its socket buffer is a liveness verdict, not a reason to hang the
-    fleet loop."""
+    non-blocking (select-bounded) and a single frame's ASSEMBLY is
+    deadline-bounded: a peer trickling one frame byte-by-byte (the
+    slowloris case `PDTPU_FAULT_NET_DELAY` injects) raises the typed
+    WireFormatError instead of occupying `recv_frames` forever.  Writes
+    tolerate partial sends under `send_timeout` and raise WorkerDiedError
+    past it — the peer being too wedged to drain its socket buffer is a
+    liveness verdict, not a reason to hang the fleet loop.  When
+    `fault_index` names this endpoint's replica, every send/recv
+    consults the PDTPU_FAULT_NET_* chaos knobs (delay trickle, mid-frame
+    cut, blackhole partition with the socket alive)."""
 
-    def __init__(self, sock: socket.socket, send_timeout: float = 10.0):
+    def __init__(self, sock: socket.socket, send_timeout: float = 10.0,
+                 frame_deadline: Optional[float] = 30.0,
+                 fault_index: Optional[int] = None):
         self._sock = sock
         self._sock.setblocking(False)
+        try:
+            # every send is one complete frame — Nagle can only add
+            # latency here (the classic 40ms delayed-ACK stall turns an
+            # incremental chunk stream into one end-of-stream lump)
+            self._sock.setsockopt(socket.IPPROTO_TCP,
+                                  socket.TCP_NODELAY, 1)
+        except OSError:
+            pass  # not a TCP socket (tests wrap socketpairs)
         self._buf = bytearray()
         self._wlock = threading.Lock()
         self._send_timeout = send_timeout
+        self._frame_deadline = frame_deadline
+        self._fault_index = fault_index
+        self._asm_started: Optional[float] = None
+        self._sent_frames = 0
         self._closed = False
+        self._eof = False
+
+    def _send_view(self, view: memoryview, what: str):
+        """Push every byte of `view` under the send deadline, riding out
+        partial writes (a full socket buffer hands back short sends, not
+        errors)."""
+        deadline = time.monotonic() + self._send_timeout
+        while view:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise WorkerDiedError(
+                    f"RPC send of {what} stalled "
+                    f">{self._send_timeout}s — peer not draining")
+            _, w, _ = select.select([], [self._sock], [], budget)
+            if not w:
+                continue
+            try:
+                n = self._sock.send(view)
+            except (BlockingIOError, InterruptedError):
+                continue
+            except OSError as e:
+                raise WorkerDiedError(f"RPC send failed: {e!r}")
+            view = view[n:]
 
     def send(self, verb: str, header: Optional[dict] = None,
              arrays: Optional[dict] = None):
         data = pack_frame(verb, header, arrays)
+        if _faults.net_partition_active(self._fault_index):
+            return  # blackholed: the bytes vanish, the socket stays up
         with self._wlock:
             if self._closed:
                 raise WorkerDiedError("RPC connection is closed")
-            deadline = time.monotonic() + self._send_timeout
-            view = memoryview(data)
-            while view:
-                budget = deadline - time.monotonic()
-                if budget <= 0:
-                    raise WorkerDiedError(
-                        f"RPC send of {verb!r} stalled "
-                        f">{self._send_timeout}s — peer not draining")
-                _, w, _ = select.select([], [self._sock], [], budget)
-                if not w:
-                    continue
+            if _faults.maybe_net_drop():
+                # cut mid-frame: half the bytes land, then the socket
+                # dies under the peer's feet — the torn-stream case
                 try:
-                    n = self._sock.send(view)
-                except (BlockingIOError, InterruptedError):
-                    continue
-                except OSError as e:
-                    raise WorkerDiedError(f"RPC send failed: {e!r}")
-                view = view[n:]
+                    self._send_view(
+                        memoryview(data)[:max(1, len(data) // 2)],
+                        repr(verb))
+                finally:
+                    self._closed = True
+                    try:
+                        self._sock.close()
+                    except OSError:
+                        pass
+                raise WorkerDiedError(
+                    f"RPC send of {verb!r} cut mid-frame "
+                    "(PDTPU_FAULT_NET_DROP)")
+            seq = self._sent_frames
+            self._sent_frames += 1
+            delay = _faults.net_delay_config()
+            if delay is not None and seq % delay[1] == 0:
+                # slowloris: trickle the frame in tiny bursts so the
+                # RECEIVER's assembly deadline is what trips
+                view = memoryview(data)
+                while view:
+                    self._send_view(view[:64], repr(verb))
+                    view = view[64:]
+                    if view:
+                        time.sleep(delay[0] / 1000.0)
+                return
+            self._send_view(memoryview(data), repr(verb))
 
     def recv_frames(self, max_wait: float = 0.0) -> List[Tuple]:
         """Every complete frame currently available (waiting up to
         `max_wait` for the first byte).  Raises WorkerDiedError when the
-        peer closed the connection."""
+        peer closed the connection, WireFormatError when one frame's
+        assembly outlives `frame_deadline` (the slow-peer hold)."""
+        if _faults.net_partition_active(self._fault_index):
+            # blackholed: nothing readable, but no error either — the
+            # connection LOOKS idle, which is the whole point
+            if max_wait > 0:
+                time.sleep(min(max_wait, 0.002))
+            return []
         first = True
-        while True:
+        while not self._eof:
             try:
                 r, _, _ = select.select([self._sock], [], [],
                                         max_wait if first else 0.0)
@@ -200,7 +308,12 @@ class _FrameConn:
             except OSError as e:
                 raise WorkerDiedError(f"RPC recv failed: {e!r}")
             if not chunk:
-                raise WorkerDiedError("RPC peer closed the connection")
+                # EOF: deliver every COMPLETE frame already buffered
+                # before raising (a typed `fatal` sent right before the
+                # peer closed must never be lost to the close itself);
+                # the death verdict lands on the next call
+                self._eof = True
+                break
             self._buf.extend(chunk)
         frames = []
         while True:
@@ -216,6 +329,23 @@ class _FrameConn:
             payload = bytes(self._buf[_LEN.size:_LEN.size + n])
             del self._buf[:_LEN.size + n]
             frames.append(unpack_frame(payload))
+        if not frames and self._eof:
+            raise WorkerDiedError("RPC peer closed the connection")
+        if frames:
+            # progress: whatever partial tail remains is a NEW frame
+            self._asm_started = None
+        if self._buf:
+            now = time.monotonic()
+            if self._asm_started is None:
+                self._asm_started = now
+            elif (self._frame_deadline is not None
+                  and now - self._asm_started > self._frame_deadline):
+                raise WireFormatError(
+                    f"partial frame stuck {now - self._asm_started:.1f}s "
+                    f"(> {self._frame_deadline}s assembly deadline) — "
+                    "slow peer or torn stream")
+        else:
+            self._asm_started = None
         return frames
 
     def close(self):
@@ -225,6 +355,31 @@ class _FrameConn:
                 self._sock.close()
             except OSError:
                 pass
+
+    def drain_close(self, timeout: float = 5.0):
+        """Error-reply half-close: stop sending, then discard inbound
+        until the peer closes (bounded).  A plain close() with unread
+        bytes in the kernel buffer answers the peer with RST — which
+        destroys the typed `fatal` frame still in flight to it.  The
+        drain keeps the stream FIN-clean so the verdict arrives."""
+        with self._wlock:
+            self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                r, _, _ = select.select([self._sock], [], [], 0.1)
+                if r and not self._sock.recv(1 << 16):
+                    break
+            except OSError:
+                break
+        try:
+            self._sock.close()
+        except OSError:
+            pass
 
 
 # ---------------------------------------------------------------------------
@@ -303,10 +458,41 @@ def _resolve(path: str):
     return getattr(importlib.import_module(mod), name)
 
 
+def _apply_weights(model, path: str) -> str:
+    """Load a jit.save-style npz state dict onto `model` (the shipped /
+    shared-storage weight artifact) and return the artifact's sha256.
+    Any mismatch with the model is a typed WeightShipError — a worker
+    must never serve half-loaded parameters."""
+    from .transfer import file_sha256
+    try:
+        data = np.load(path, allow_pickle=False)
+    except Exception as e:
+        raise WeightShipError(f"weight artifact {path!r} unreadable: {e!r}")
+    with data:
+        state = {k: data[k] for k in data.files}
+    try:
+        missing, unexpected = model.set_state_dict(state)
+    except Exception as e:
+        raise WeightShipError(f"weight artifact does not fit the model: {e}")
+    if missing or unexpected:
+        raise WeightShipError(
+            f"weight artifact does not match the model: "
+            f"missing={sorted(missing)[:4]} "
+            f"unexpected={sorted(unexpected)[:4]}")
+    return file_sha256(path)
+
+
 def _build_engine(spec: dict):
+    """Boot spec -> (ServingEngine, weights_sha).  ``spec["weights"]``
+    (an npz path — shipped over the attach handshake or on shared
+    storage) replaces the factory's seeded parameters before the engine
+    captures them; weights_sha is None for seeded boots."""
     from .engine import ServingEngine
     model = _resolve(spec["model"]["factory"])(
         **(spec["model"].get("kwargs") or {}))
+    weights_sha = None
+    if spec.get("weights"):
+        weights_sha = _apply_weights(model, spec["weights"])
     draft = None
     if spec.get("draft"):
         draft = _resolve(spec["draft"]["factory"])(
@@ -316,7 +502,8 @@ def _build_engine(spec: dict):
         ekw["prefill_buckets"] = tuple(int(b)
                                        for b in ekw["prefill_buckets"])
     return ServingEngine(model, draft_model=draft,
-                         program_set=spec.get("program_set"), **ekw)
+                         program_set=spec.get("program_set"),
+                         **ekw), weights_sha
 
 
 class _WireResponse(Response):
@@ -351,16 +538,39 @@ def _jsonable(obj):
 
 
 class _WorkerServer:
-    """The worker's single-threaded drive loop (see module docstring)."""
+    """The worker's single-threaded drive loop (see module docstring).
+    Local mode: `hb` writes the heartbeat file and the manager owns the
+    process.  Remote mode (`listener` set): liveness is pushed as beat
+    frames on `beat_conn`, the session carries a manager-issued `epoch`
+    token, and losing the manager (connection or `manager_silence_s` of
+    inbound silence) aborts every resident typed and returns the worker
+    to its accept loop — it never exits just because one manager did."""
 
-    def __init__(self, engine, conn: _FrameConn, hb: _Heartbeat,
-                 index: int):
+    def __init__(self, engine, conn: _FrameConn, hb: Optional[_Heartbeat],
+                 index: int, epoch: int = 0,
+                 beat_conn: Optional[_FrameConn] = None,
+                 manager_silence_s: Optional[float] = None,
+                 listener: Optional[socket.socket] = None,
+                 weights_sha: Optional[str] = None,
+                 _clock=time.monotonic):
         from ..utils import faults
         self._faults = faults
         self.engine = engine
         self.conn = conn
         self.hb = hb
         self.index = index
+        self.epoch = int(epoch)
+        self.beat_conn = beat_conn
+        self.manager_silence_s = (None if manager_silence_s is None
+                                  else float(manager_silence_s))
+        self.listener = listener
+        self.weights_sha = weights_sha
+        self._clock = _clock
+        self._last_rx = _clock()
+        self._last_beat_tx = 0.0
+        self._seen_wids: set = set()  # submit dedup (exactly-once admit)
+        self.pending_attach = None    # (conn, header) epoch takeover
+        self.detach: Optional[str] = None
         self.streams: Dict[int, list] = {}  # wid -> [resp, n_sent]
         self.step_no = 0
         self._ewma: Optional[float] = None
@@ -392,11 +602,27 @@ class _WorkerServer:
                 self._faults.enable(point, value)
         elif verb == "close":
             self._stopping = True
+        elif verb == "ping":
+            pass  # liveness only: receipt already fed the silence clock
+        elif verb == "abort_epoch":
+            if int(h.get("epoch", -1)) == self.epoch:
+                # the manager declared this session stale: a resubmitted
+                # twin of every resident may already be live elsewhere
+                self._abort_residents(
+                    "epoch superseded (manager abort_epoch)")
+                self.detach = "abort_epoch"
         else:
             self.conn.send("log", {"msg": f"unknown verb {verb!r} ignored"})
 
     def _on_submit(self, h: dict, arrays: dict):
         wid = int(h["wid"])
+        if wid in self._seen_wids:
+            # retried submit after a lost/timed-out ack: exactly-once
+            # admission — re-ack, never double-admit
+            self.conn.send("accepted", {"wid": wid, "epoch": self.epoch,
+                                        "dup": True})
+            return
+        self._seen_wids.add(wid)
         try:
             req, _ = self.engine.make_request(
                 np.asarray(arrays["prompt"], np.int32),
@@ -417,6 +643,7 @@ class _WorkerServer:
                                       "msg": str(e)[:500]})
             return
         self.streams[wid] = [resp, 0]
+        self.conn.send("accepted", {"wid": wid, "epoch": self.epoch})
 
     def _find_slot(self, resp) -> Optional[int]:
         for slot, run in self.engine._slots.items():
@@ -503,29 +730,146 @@ class _WorkerServer:
              "queue_depth": sched.queue_depth(),
              "free_slots": sched.free_slot_count(),
              "steps": self.step_no,
+             "epoch": self.epoch,
+             "weights_sha": self.weights_sha,
              "ewma_ms": (None if self._ewma is None
                          else self._ewma * 1e3),
              "post_warmup_compiles": self.engine.post_warmup_compiles(),
              "metrics": _jsonable(self.engine.metrics())},
             {"step_s": np.asarray(dts, np.float64)})
 
+    def _push_beat(self, force: bool = False):
+        """Remote liveness: one tiny beat frame on the side connection
+        after each step (throttled).  Send failure is swallowed — a dead
+        beat channel reads as staleness on the manager, which is the
+        safe direction."""
+        if self.beat_conn is None:
+            return
+        now = self._clock()
+        if not force and now - self._last_beat_tx < 0.02:
+            return
+        self._last_beat_tx = now
+        try:
+            self.beat_conn.send("beat", {"steps": self.step_no,
+                                         "mono": time.monotonic(),
+                                         "epoch": self.epoch,
+                                         "phase": "serve"})
+        except (WorkerDiedError, WireFormatError, OSError):
+            pass
+
+    # -- remote-session fencing -----------------------------------------
+    def _abort_residents(self, reason: str, exc_cls=None):
+        """Fail every resident + queued run typed and report it to the
+        manager best-effort (during a partition these frames blackhole,
+        which is fine: the manager already fenced and resubmitted — what
+        matters is that THIS side stops decoding, so no token is ever
+        served twice)."""
+        cls = exc_cls or StaleEpochError
+        self.engine._abort_all(lambda req: cls(
+            f"request {req.id} aborted on worker {self.index} "
+            f"(epoch {self.epoch}): {reason}"))
+        try:
+            self._flush()
+        except (WorkerDiedError, WireFormatError):
+            pass
+        self.streams.clear()
+
+    def _check_manager_silence(self) -> bool:
+        """Partition self-fence: nothing inbound (frames OR pings) for
+        `manager_silence_s` means the manager either died or cannot
+        reach us — and in both cases it has fenced this replica on beat
+        age and resubmitted elsewhere, so the residents must die HERE."""
+        if self.manager_silence_s is None:
+            return False
+        if self._clock() - self._last_rx <= self.manager_silence_s:
+            return False
+        self._abort_residents(
+            f"manager silent >{self.manager_silence_s}s — assuming "
+            "partition; the fleet has resubmitted these runs elsewhere")
+        self.detach = "manager-silence"
+        return True
+
+    def _poll_listener(self) -> bool:
+        """Non-blocking accept on the standalone listener: a NEW attach
+        with a HIGHER epoch supersedes this session (a manager healed
+        from a partition re-attaches); lower-or-equal epochs are stale
+        managers and are refused with a typed fatal."""
+        if self.listener is None:
+            return False
+        try:
+            s, _ = self.listener.accept()
+        except (BlockingIOError, socket.timeout, OSError):
+            return False
+        nc = _FrameConn(s, fault_index=self.index)
+        try:
+            h, _ = _wait_frame(nc, "attach", timeout=5.0)
+        except (WorkerDiedError, WireFormatError):
+            nc.close()
+            return False
+        if int(h.get("epoch", 0)) <= self.epoch:
+            try:
+                nc.send("fatal", {
+                    "etype": "StaleEpochError",
+                    "msg": (f"attach epoch {h.get('epoch')} <= live "
+                            f"epoch {self.epoch} — refusing a stale "
+                            "manager")})
+            except (WorkerDiedError, WireFormatError):
+                pass
+            nc.close()
+            return False
+        self.pending_attach = (nc, h)
+        self._abort_residents(
+            f"superseded by attach epoch {h.get('epoch')}")
+        self.detach = "reattach"
+        return True
+
     # -- the loop -------------------------------------------------------
     def serve(self) -> int:
+        """Drive until exit.  Return codes: 0 = clean local exit, 4 =
+        engine step died, 5 = remote session over (abort residents done;
+        keep the process alive and go back to the accept loop)."""
+        remote = self.listener is not None
         while True:
             try:
                 frames = self.conn.recv_frames(
                     0.0 if self.engine.has_work() else 0.002)
             except WorkerDiedError as e:
-                # manager gone: a worker must never outlive its fleet
+                if remote:
+                    # standalone worker: the manager is gone but this
+                    # process is not its child — abort residents typed
+                    # (a resubmitted twin may already be streaming
+                    # elsewhere) and go back to listening
+                    self._abort_residents(f"manager connection lost ({e})")
+                    self.detach = "manager-lost"
+                    return 5
+                # manager gone: a spawned worker never outlives its fleet
                 print(f"worker exiting: manager connection lost ({e})",
                       file=sys.stderr, flush=True)
                 self.engine.close()
                 return 0
+            except WireFormatError as e:
+                # torn/trickled stream (the slowloris assembly deadline):
+                # this connection is unrecoverable
+                if remote:
+                    self._abort_residents(f"wire error ({e})")
+                    self.detach = "wire-error"
+                    return 5
+                print(f"worker exiting: wire error ({e})",
+                      file=sys.stderr, flush=True)
+                self.engine.close()
+                return 4
+            if frames:
+                self._last_rx = self._clock()
             for verb, h, arrays in frames:
                 try:
                     self._handle(verb, h, arrays)
                 except WorkerDiedError as e:
                     # reply channel gone mid-handle: manager is dead
+                    if remote:
+                        self._abort_residents(
+                            f"manager connection lost mid-frame ({e})")
+                        self.detach = "manager-lost"
+                        return 5
                     print(f"worker exiting: manager connection lost "
                           f"mid-frame ({e})", file=sys.stderr, flush=True)
                     self.engine.close()
@@ -542,6 +886,17 @@ class _WorkerServer:
                     except WorkerDiedError:
                         pass
             if self._stopping:
+                if remote:
+                    # close ends the SESSION, not the process — the
+                    # manager does not own a standalone worker
+                    self._abort_residents("manager closed the session",
+                                          exc_cls=RequestCancelled)
+                    try:
+                        self.conn.send("bye", {})
+                    except (WorkerDiedError, WireFormatError):
+                        pass
+                    self.detach = "close"
+                    return 5
                 print("worker exiting: close verb received",
                       file=sys.stderr, flush=True)
                 self.engine.close()
@@ -551,9 +906,16 @@ class _WorkerServer:
                 except WorkerDiedError:
                     pass
                 return 0
+            if self.detach is not None:  # abort_epoch landed
+                return 5
+            if self._check_manager_silence():
+                return 5
+            if self._poll_listener():
+                return 5
             # the wedge fault blocks HERE forever when armed: the socket
             # stays connected, frames pile up unread, and only the
-            # heartbeat file (below, never reached) goes stale
+            # heartbeat (file or beat frames — below, never reached)
+            # goes stale
             self._faults.maybe_wedge_replica(self.index, self.step_no)
             t0 = time.perf_counter()
             self._faults.maybe_slow_replica(self.index, self.step_no)
@@ -571,51 +933,28 @@ class _WorkerServer:
             self._ewma = (dt if self._ewma is None
                           else 0.3 * dt + 0.7 * self._ewma)
             self._recent_dts.append(dt)
-            self.hb.beat(self.step_no)
-            self._flush()
-            self._maybe_status()
+            if self.hb is not None:
+                self.hb.beat(self.step_no)
+            self._push_beat()
+            try:
+                self._flush()
+                self._maybe_status()
+            except (WorkerDiedError, WireFormatError) as e:
+                if remote:
+                    self._abort_residents(f"manager send path died ({e})")
+                    self.detach = "manager-lost"
+                    return 5
+                print(f"worker exiting: manager connection lost ({e})",
+                      file=sys.stderr, flush=True)
+                self.engine.close()
+                return 0
 
 
-def main(argv=None) -> int:
-    import argparse
-    ap = argparse.ArgumentParser(
-        description="paddle_tpu subprocess serving worker")
-    ap.add_argument("--spec", required=True,
-                    help="json boot spec (model factory + engine config)")
-    ap.add_argument("--port", type=int, required=True,
-                    help="manager RPC port on 127.0.0.1")
-    ap.add_argument("--heartbeat", required=True,
-                    help="out-of-band heartbeat file path")
-    ap.add_argument("--index", type=int, default=0,
-                    help="worker index (fault-knob target)")
-    args = ap.parse_args(argv)
-
-    # post-mortem hook for the failure mode this module exists to
-    # survive: SIGUSR1 dumps every thread's stack to the log file, so a
-    # wedged worker can be diagnosed before the manager SIGKILLs it
-    import faulthandler
-    import signal as _signal
-    faulthandler.register(_signal.SIGUSR1, file=sys.stderr)
-
-    hb = _Heartbeat(args.heartbeat)
-    hb.beat(0, phase="boot", force=True)
-    sock = socket.create_connection(("127.0.0.1", args.port), timeout=30)
-    conn = _FrameConn(sock)
-    try:
-        with open(args.spec) as f:
-            spec = json.load(f)
-        engine = _build_engine(spec)
-        warm = engine.warmup()
-        hb.beat(0, phase="warm", force=True)
-    except Exception as e:  # boot failure: report typed, exit nonzero
-        try:
-            conn.send("fatal", {"etype": type(e).__name__,
-                                "msg": str(e)[:800]})
-        except Exception:
-            pass
-        return 3
+def _ready_header(engine, warm: dict, epoch: int = 0,
+                  weights_sha: Optional[str] = None,
+                  shipped: Optional[dict] = None) -> dict:
     from .transfer import target_manifest
-    conn.send("ready", {
+    h = {
         "config": {
             "max_slots": engine.max_slots,
             "max_len": engine.max_len,
@@ -628,8 +967,333 @@ def main(argv=None) -> int:
         "manifest": target_manifest(engine),
         "warmup": {"seconds": warm.get("seconds"),
                    "programs": warm.get("programs")},
-    })
-    return _WorkerServer(engine, conn, hb, args.index).serve()
+        "epoch": int(epoch),
+        "weights_sha": weights_sha,
+    }
+    if shipped is not None:
+        h["shipped"] = {k: int(v) for k, v in shipped.items()}
+    return h
+
+
+def _wait_frame(conn: _FrameConn, want_verb: str,
+                timeout: float) -> Tuple[dict, dict]:
+    """Block (bounded) until the next frame, which must be `want_verb` —
+    the handshake protocol is strictly sequenced, so anything else is a
+    typed protocol error."""
+    deadline = time.monotonic() + timeout
+    while True:
+        for verb, h, arrays in conn.recv_frames(0.05):
+            if verb == want_verb:
+                return h, arrays
+            raise WireFormatError(
+                f"handshake expected {want_verb!r}, got {verb!r}")
+        if time.monotonic() > deadline:
+            raise WorkerDiedError(
+                f"no {want_verb!r} frame within {timeout}s")
+
+
+def _recv_artifacts(conn: _FrameConn, wants: dict,
+                    timeout: float = 300.0) -> dict:
+    """Receive the attach handshake's chunked artifact ship.  `wants`
+    maps name -> (manifest-or-None, dest_path); chunks must arrive in
+    order and every chunk AND the assembled file must match the
+    manifest's sha256 — any mismatch is a typed WeightShipError before a
+    single byte reaches an engine.  Returns name -> bytes received."""
+    import hashlib
+    verbs = {"weights_chunk": "weights", "program_chunk": "programs"}
+    state = {}
+    for name, (man, path) in wants.items():
+        if man is not None:
+            state[name] = {"f": open(path, "wb"), "h": hashlib.sha256(),
+                           "seq": 0, "bytes": 0, "man": man}
+    try:
+        deadline = time.monotonic() + timeout
+        done = False
+        while not done:
+            if time.monotonic() > deadline:
+                raise WorkerDiedError(
+                    f"artifact ship timed out after {timeout}s")
+            for verb, h, arrays in conn.recv_frames(0.05):
+                if verb == "attach_end":
+                    done = True
+                    break
+                name = verbs.get(verb)
+                if name is None:
+                    continue  # e.g. a keepalive ping mid-ship
+                st = state.get(name)
+                if st is None:
+                    raise WeightShipError(
+                        f"unsolicited {verb} (artifact not requested)")
+                seq = int(h.get("seq", -1))
+                if seq != st["seq"]:
+                    raise WeightShipError(
+                        f"{name} chunk {seq} out of order "
+                        f"(expected {st['seq']})")
+                chunks = st["man"].get("chunks") or []
+                data = arrays["data"].tobytes()
+                if (seq >= len(chunks)
+                        or hashlib.sha256(data).hexdigest()
+                        != chunks[seq].get("sha256")):
+                    raise WeightShipError(
+                        f"{name} chunk {seq} sha256 mismatch — refusing "
+                        "to assemble garbage weights")
+                st["f"].write(data)
+                st["h"].update(data)
+                st["seq"] += 1
+                st["bytes"] += len(data)
+        out = {}
+        for name, st in state.items():
+            st["f"].close()
+            chunks = st["man"].get("chunks") or []
+            if st["seq"] != len(chunks):
+                raise WeightShipError(
+                    f"{name} artifact short: {st['seq']}/{len(chunks)} "
+                    "chunks before attach_end")
+            if st["h"].hexdigest() != st["man"].get("sha256"):
+                raise WeightShipError(
+                    f"{name} whole-artifact sha256 mismatch")
+            out[name] = st["bytes"]
+        return out
+    finally:
+        for st in state.values():
+            try:
+                st["f"].close()
+            except OSError:
+                pass
+
+
+def _accept_beat(lsock: socket.socket, epoch: int, index: int,
+                 timeout: float = 30.0) -> _FrameConn:
+    """Accept the manager's dedicated beat side connection (it must
+    introduce itself with a matching-epoch `beat_attach`)."""
+    deadline = time.monotonic() + timeout
+    lsock.settimeout(0.2)
+    try:
+        while time.monotonic() < deadline:
+            try:
+                s, _ = lsock.accept()
+            except socket.timeout:
+                continue
+            except OSError as e:
+                raise WorkerDiedError(f"beat accept failed: {e!r}")
+            bc = _FrameConn(s, fault_index=index)
+            try:
+                h, _ = _wait_frame(bc, "beat_attach", timeout=5.0)
+            except (WorkerDiedError, WireFormatError):
+                bc.close()
+                continue
+            if int(h.get("epoch", -1)) != epoch:
+                bc.close()
+                continue
+            return bc
+        raise WorkerDiedError(
+            f"no beat side-connection within {timeout}s")
+    finally:
+        # the serve loop's listener poll needs non-blocking accepts
+        lsock.setblocking(False)
+
+
+def _serve_session(lsock: socket.socket, conn: _FrameConn, attach: dict,
+                   index: int, cache: dict) -> Tuple[int, Optional[tuple]]:
+    """One manager session on an accepted connection: attach handshake
+    (artifact ship + beat side channel + engine build/reuse), then serve
+    until detach.  Returns (rc, pending_attach); rc 5 means 'session
+    over, keep listening'.  The engine is CACHED across sessions keyed
+    on (spec, weights sha, programs sha): a manager re-attaching after a
+    partition pays zero rebuild and zero re-ship."""
+    epoch = int(attach.get("epoch", 0))
+    spec = dict(attach.get("spec") or {})
+    wman = attach.get("weights")
+    pman = attach.get("programs")
+    silence = attach.get("silence_s")
+    need_w = (wman is not None
+              and wman.get("sha256") != cache.get("weights_sha"))
+    need_p = (pman is not None
+              and pman.get("sha256") != cache.get("programs_sha"))
+    wpath = os.path.join(cache["dir"], "weights.npz")
+    ppath = os.path.join(cache["dir"], "programs")
+
+    def _fatal(e: BaseException) -> Tuple[int, None]:
+        print(f"worker session failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+        try:
+            conn.send("fatal", {"etype": type(e).__name__,
+                                "msg": str(e)[:800], "epoch": epoch})
+        except (WorkerDiedError, WireFormatError):
+            pass
+        # half-close + drain: the manager may still be mid-ship, and a
+        # plain close against its unread bytes would RST the typed
+        # fatal right out of its receive buffer
+        conn.drain_close()
+        return 5, None
+
+    try:
+        conn.send("attach_ok", {"epoch": epoch, "need_weights": need_w,
+                                "need_programs": need_p})
+        shipped = _recv_artifacts(conn, {
+            "weights": (wman if need_w else None, wpath),
+            "programs": (pman if need_p else None, ppath)})
+    except (WeightShipError, WireFormatError, WorkerDiedError) as e:
+        return _fatal(e)
+    if wman is not None:
+        spec["weights"] = wpath
+    if pman is not None:
+        spec["program_set"] = ppath
+    key = (json.dumps(attach.get("spec") or {}, sort_keys=True,
+                      default=str),
+           None if wman is None else wman.get("sha256"),
+           None if pman is None else pman.get("sha256"))
+    engine = cache.get("engine")
+    if engine is None or cache.get("key") != key:
+        if engine is not None:
+            try:
+                engine.close()
+            except Exception:
+                pass
+            cache.update(engine=None, key=None)
+        try:
+            engine, _sha = _build_engine(spec)
+            warm = engine.warmup()
+        except Exception as e:  # noqa: BLE001 — boot failure, typed up
+            return _fatal(e)
+        cache.update(
+            engine=engine, key=key, warm=warm,
+            weights_sha=None if wman is None else wman.get("sha256"),
+            programs_sha=None if pman is None else pman.get("sha256"))
+    warm = cache.get("warm") or {}
+    try:
+        beat_conn = _accept_beat(lsock, epoch, index)
+    except (WorkerDiedError, WireFormatError) as e:
+        return _fatal(e)
+    try:
+        conn.send("ready", _ready_header(
+            engine, warm, epoch=epoch,
+            weights_sha=cache.get("weights_sha"), shipped=shipped))
+    except (WorkerDiedError, WireFormatError):
+        beat_conn.close()
+        conn.close()
+        return 5, None
+    server = _WorkerServer(engine, conn, None, index, epoch=epoch,
+                           beat_conn=beat_conn, manager_silence_s=silence,
+                           listener=lsock,
+                           weights_sha=cache.get("weights_sha"))
+    server._push_beat(force=True)
+    rc = server.serve()
+    conn.close()
+    beat_conn.close()
+    return rc, server.pending_attach
+
+
+def _remote_main(host: str, port: int, index: int) -> int:
+    """Standalone remote worker: listen for manager attaches forever,
+    serving one epoch-tokened session at a time.  The worker owns its
+    own lifetime — a lost or closed manager ends the SESSION (residents
+    aborted typed), never the process."""
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind((host, port))
+    lsock.listen(4)
+    print(f"worker listening on {lsock.getsockname()[0]}:"
+          f"{lsock.getsockname()[1]}", flush=True)
+    cache = {"key": None, "engine": None, "weights_sha": None,
+             "programs_sha": None, "warm": None,
+             "dir": tempfile.mkdtemp(prefix=f"pdtpu_rworker{index}_")}
+    pending = None
+    try:
+        while True:
+            if pending is not None:
+                conn, attach = pending
+                pending = None
+            else:
+                lsock.settimeout(None)
+                try:
+                    s, _ = lsock.accept()
+                except OSError:
+                    return 0
+                conn = _FrameConn(s, fault_index=index)
+                try:
+                    attach, _ = _wait_frame(conn, "attach", timeout=30.0)
+                except (WorkerDiedError, WireFormatError) as e:
+                    print(f"worker: bad attach: {e}", file=sys.stderr,
+                          flush=True)
+                    conn.close()
+                    continue
+            rc, pending = _serve_session(lsock, conn, attach, index, cache)
+            if rc != 5:
+                return rc
+    finally:
+        try:
+            lsock.close()
+        except OSError:
+            pass
+        eng = cache.get("engine")
+        if eng is not None:
+            try:
+                eng.close()
+            except Exception:
+                pass
+        shutil.rmtree(cache["dir"], ignore_errors=True)
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        description="paddle_tpu subprocess serving worker")
+    ap.add_argument("--spec",
+                    help="json boot spec path (local mode; a remote "
+                         "worker receives its spec over the attach "
+                         "handshake)")
+    ap.add_argument("--port", type=int,
+                    help="manager RPC port on 127.0.0.1 (local mode)")
+    ap.add_argument("--heartbeat",
+                    help="out-of-band heartbeat file path (local mode)")
+    ap.add_argument("--index", type=int, default=0,
+                    help="worker index (fault-knob target)")
+    ap.add_argument("--listen", metavar="HOST:PORT",
+                    help="standalone remote mode: listen for manager "
+                         "attaches instead of dialing a spawning "
+                         "manager (spec + weights arrive over the wire)")
+    args = ap.parse_args(argv)
+
+    # post-mortem hook for the failure mode this module exists to
+    # survive: SIGUSR1 dumps every thread's stack to the log file, so a
+    # wedged worker can be diagnosed before the manager SIGKILLs it
+    import faulthandler
+    import signal as _signal
+    faulthandler.register(_signal.SIGUSR1, file=sys.stderr)
+
+    if args.listen:
+        host, _, port = args.listen.rpartition(":")
+        try:
+            return _remote_main(host or "127.0.0.1", int(port),
+                                args.index)
+        except KeyboardInterrupt:
+            return 0
+    if not (args.spec and args.port and args.heartbeat):
+        ap.error("local mode requires --spec, --port and --heartbeat "
+                 "(or use --listen HOST:PORT for remote mode)")
+
+    hb = _Heartbeat(args.heartbeat)
+    hb.beat(0, phase="boot", force=True)
+    sock = socket.create_connection(("127.0.0.1", args.port), timeout=30)
+    conn = _FrameConn(sock)
+    try:
+        with open(args.spec) as f:
+            spec = json.load(f)
+        engine, weights_sha = _build_engine(spec)
+        warm = engine.warmup()
+        hb.beat(0, phase="warm", force=True)
+    except Exception as e:  # boot failure: report typed, exit nonzero
+        try:
+            conn.send("fatal", {"etype": type(e).__name__,
+                                "msg": str(e)[:800]})
+        except Exception:
+            pass
+        return 3
+    conn.send("ready", _ready_header(engine, warm,
+                                     weights_sha=weights_sha))
+    return _WorkerServer(engine, conn, hb, args.index,
+                         weights_sha=weights_sha).serve()
 
 
 # ---------------------------------------------------------------------------
@@ -657,6 +1321,8 @@ def _error_types():
             "ResourceExhaustedError": ResourceExhaustedError,
             "FatalError": FatalError,
             "WireFormatError": WireFormatError,
+            "StaleEpochError": StaleEpochError,
+            "WeightShipError": WeightShipError,
         }
     return _WIRE_ERRORS
 
@@ -776,11 +1442,10 @@ class WorkerClient:
 
     def __init__(self, spec: dict, index: int = 0,
                  boot_timeout_s: float = 180.0,
-                 rpc_timeout_s: float = 15.0):
-        self.spec = dict(spec)
-        self.index = int(index)
-        self.boot_timeout_s = float(boot_timeout_s)
-        self.rpc_timeout_s = float(rpc_timeout_s)
+                 rpc_timeout_s: float = 15.0,
+                 verb_deadlines: Optional[Dict[str, float]] = None):
+        self._init_state(spec, index, boot_timeout_s, rpc_timeout_s,
+                         verb_deadlines)
         self._dir = tempfile.mkdtemp(prefix=f"pdtpu_worker{index}_")
         self.heartbeat_path = os.path.join(self._dir, "heartbeat.json")
         self.log_path = os.path.join(self._dir, "worker.log")
@@ -807,6 +1472,23 @@ class WorkerClient:
              "--index", str(self.index)],
             stdin=subprocess.DEVNULL, stdout=self._log_f,
             stderr=subprocess.STDOUT, env=env, start_new_session=True)
+
+    def _init_state(self, spec: dict, index: int, boot_timeout_s: float,
+                    rpc_timeout_s: float,
+                    verb_deadlines: Optional[Dict[str, float]]):
+        """Everything both the local (spawned) and remote (attached)
+        client share: the engine-surface mirrors, the admission queue,
+        the RPC bookkeeping."""
+        self.spec = dict(spec)
+        self.index = int(index)
+        self.boot_timeout_s = float(boot_timeout_s)
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        # per-verb deadlines on every blocking RPC: a cheap telemetry
+        # verb must never consume the full migration budget
+        self.verb_deadlines: Dict[str, float] = {
+            "metrics": min(5.0, self.rpc_timeout_s),
+            "fault": min(5.0, self.rpc_timeout_s)}
+        self.verb_deadlines.update(verb_deadlines or {})
         self._conn: Optional[_FrameConn] = None
         self._boot_deadline = time.monotonic() + self.boot_timeout_s
         self._boot_error: Optional[str] = None
@@ -816,7 +1498,7 @@ class WorkerClient:
         self.max_len = 0
         self.buckets: Tuple[int, ...] = ()
         self.max_queue_depth = int(
-            (spec.get("engine") or {}).get("max_queue_depth", 64))
+            (self.spec.get("engine") or {}).get("max_queue_depth", 64))
         self.draft_model = None  # a sentinel object once the worker has one
         self.kv = "fixed"        # crash-path duck shape; remote kv in spec
         self._manifest: Optional[dict] = None
@@ -834,6 +1516,9 @@ class WorkerClient:
         self._closed = False
         self._dead: Optional[BaseException] = None
         self._close_lock = threading.Lock()
+        self.epoch = 0               # manager-issued session token
+        self.weights_sha: Optional[str] = None
+        self._worker_pid: Optional[int] = None
 
     # -- lifecycle ------------------------------------------------------
     @property
@@ -926,6 +1611,8 @@ class WorkerClient:
                 self.draft_model = object()  # truthy `is not None` duck
             self._manifest = h.get("manifest")
             self.warmup_report = h.get("warmup")
+            self._worker_pid = cfg.get("pid")
+            self.weights_sha = h.get("weights_sha", self.weights_sha)
             # drop the heartbeat cache: the last cached record predates
             # warmup (the long no-beat boot window), and the wedge fence
             # must never judge a freshly-healthy worker by it
@@ -935,19 +1622,26 @@ class WorkerClient:
             self._boot_error = f"{h.get('etype')}: {h.get('msg')}"
         elif verb == "dying":
             self._dead = _mk_error(h.get("etype", ""), h.get("msg", ""))
-        elif verb in ("bye", "log", "metrics", "preempted", "restored"):
-            pass  # bye/log informational; RPC replies consumed by _rpc
+        elif verb in ("bye", "log", "metrics", "preempted", "restored",
+                      "accepted", "attach_ok"):
+            pass  # bye/log informational; RPC replies consumed by _rpc;
+            #       accepted acks matter only to the remote subclass
 
     def _rpc(self, verb: str, header: dict, arrays: Optional[dict],
-             reply_verb: str) -> Tuple[dict, dict]:
+             reply_verb: str,
+             timeout_s: Optional[float] = None) -> Tuple[dict, dict]:
         """Send one frame and pump until its reply arrives, dispatching
-        unrelated frames (chunks/status) normally.  Timeout or process
-        death -> WorkerDiedError (the wedged-worker verdict)."""
+        unrelated frames (chunks/status) normally.  Every blocking RPC
+        runs under its own per-verb deadline (`verb_deadlines`, default
+        `rpc_timeout_s`); timeout or process death -> WorkerDiedError
+        (the wedged-worker verdict)."""
         if self._conn is None:
             raise WorkerDiedError(f"worker {self.index} has no connection")
+        budget = (timeout_s if timeout_s is not None
+                  else self.verb_deadlines.get(verb, self.rpc_timeout_s))
         self._conn.send(verb, header, arrays)
         wid = header.get("wid")
-        deadline = time.monotonic() + self.rpc_timeout_s
+        deadline = time.monotonic() + budget
         while True:
             if self.proc.poll() is not None:
                 raise WorkerDiedError(
@@ -961,7 +1655,7 @@ class WorkerClient:
             if time.monotonic() > deadline:
                 raise WorkerDiedError(
                     f"worker {self.index} RPC {verb!r} timed out after "
-                    f"{self.rpc_timeout_s}s — wedged or overloaded "
+                    f"{budget}s — wedged, partitioned or overloaded "
                     "beyond the liveness budget")
 
     # -- engine surface: admission -------------------------------------
@@ -1044,22 +1738,25 @@ class WorkerClient:
             return False
         return True
 
+    def _submit_header(self, req: Request, wid: int) -> dict:
+        return {"wid": wid, "max_new_tokens": req.max_new_tokens,
+                "decode_strategy": ("greedy_search" if req.greedy
+                                    else "sampling"),
+                "temperature": req.temperature, "top_k": req.top_k,
+                "top_p": req.top_p, "eos_token_id": req.eos_token_id,
+                "seed": req.seed,
+                "deadline_remaining_s": (None if req.deadline is None
+                                         else req.deadline.remaining()),
+                "priority": req.priority, "tenant": req.tenant,
+                "spec": bool(req.spec) if self.draft_model is not None
+                else False,
+                "session": req.session, "resubmit": req.resubmit}
+
     def _ship(self, req: Request, resp: Response):
         wid = self._wid
         self._wid += 1
-        h = {"wid": wid, "max_new_tokens": req.max_new_tokens,
-             "decode_strategy": ("greedy_search" if req.greedy
-                                 else "sampling"),
-             "temperature": req.temperature, "top_k": req.top_k,
-             "top_p": req.top_p, "eos_token_id": req.eos_token_id,
-             "seed": req.seed,
-             "deadline_remaining_s": (None if req.deadline is None
-                                      else req.deadline.remaining()),
-             "priority": req.priority, "tenant": req.tenant,
-             "spec": bool(req.spec) if self.draft_model is not None
-             else False,
-             "session": req.session, "resubmit": req.resubmit}
-        self._conn.send("submit", h, {"prompt": req.prompt})
+        self._conn.send("submit", self._submit_header(req, wid),
+                        {"prompt": req.prompt})
         self._slots[wid] = _ProxyRun(req, resp)
 
     # -- engine surface: the driving tick ------------------------------
@@ -1295,6 +1992,328 @@ class WorkerClient:
             self._abort_all(lambda req: RequestCancelled(
                 f"request {req.id} aborted: worker replica closed"))
             shutil.rmtree(self._dir, ignore_errors=True)
+
+
+class _NullProc:
+    """Remote workers have no local child process: the base client's
+    poll/kill/wait liveness checks become no-ops against this stub —
+    death is decided on the wire (beat age + connection loss), never by
+    a pid this host does not own."""
+    pid = -1
+    returncode: Optional[int] = None
+
+    def poll(self):
+        return None
+
+    def kill(self):
+        pass
+
+    def wait(self, timeout=None):
+        return None
+
+
+class RemoteWorkerClient(WorkerClient):
+    """Manager-side handle for a STANDALONE remote worker started with
+    ``--listen HOST:PORT`` — the network-transparent half of the fleet.
+    Differences from the spawned-local base:
+
+    - **Attach, not fork**: connects over real TCP, sends an `attach`
+      carrying the manager-issued `epoch` token, the boot spec, and
+      manifests for the weight artifact (``spec["weights"]``, a jit.save
+      npz) and optionally the program set (``spec["ship_program_set"]``)
+      — then streams them as sha256-verified chunks.  The worker replies
+      `attach_ok` with what it actually needs, so a re-attach onto a
+      warm cached engine ships zero bytes and rebuilds nothing.
+    - **Liveness on the wire**: a dedicated beat side connection carries
+      the worker's step counter; `heartbeat_age` is the ARRIVAL age of
+      the last beat on THIS host's monotonic clock (the worker's stamps
+      belong to another machine's timeline), so the manager's wedge
+      fence works unchanged with no heartbeat file at all.
+    - **Partition-safe submits**: every submit is acked (`accepted`) and
+      retried on ack timeout; the worker dedups on wid, so a retried
+      submit after a lost ack can never double-admit.  Frames from a
+      stale epoch are answered with `abort_epoch` — a healed worker is
+      told to abort, never to resume.
+    """
+
+    def __init__(self, spec: dict, address: str, index: int = 0,
+                 epoch: int = 1, boot_timeout_s: float = 180.0,
+                 rpc_timeout_s: float = 15.0,
+                 connect_timeout_s: float = 10.0,
+                 manager_silence_s: float = 6.0,
+                 ack_timeout_s: float = 2.0, submit_retries: int = 2,
+                 verb_deadlines: Optional[Dict[str, float]] = None):
+        from .transfer import artifact_manifest
+        self._init_state(spec, index, boot_timeout_s, rpc_timeout_s,
+                         verb_deadlines)
+        host, _, port = str(address).rpartition(":")
+        if not port:
+            raise InvalidArgumentError(
+                f"remote worker address {address!r} must be HOST:PORT")
+        self.address = (host or "127.0.0.1", int(port))
+        self.epoch = int(epoch)
+        self.manager_silence_s = float(manager_silence_s)
+        self.ack_timeout_s = float(ack_timeout_s)
+        self.submit_retries = int(submit_retries)
+        self.proc = _NullProc()
+        self.heartbeat_path = None  # liveness is beat FRAMES, not a file
+        self.log_path = f"<remote {self.address[0]}:{self.address[1]}>"
+        self.bytes_shipped = 0
+        self._beat_conn: Optional[_FrameConn] = None
+        self._last_beat: Optional[dict] = None
+        self._last_beat_rx: Optional[float] = None  # ARRIVAL mono stamp
+        self._await_ack: Dict[int, list] = {}
+        self._last_tx = time.monotonic()
+        # shipped artifacts come OUT of the wire spec: their paths are
+        # THIS host's, meaningless on the worker's filesystem
+        wire_spec = dict(self.spec)
+        self._weights_path = wire_spec.pop("weights", None)
+        self._programs_path = None
+        if wire_spec.pop("ship_program_set", False):
+            self._programs_path = wire_spec.pop("program_set", None)
+        self._wire_spec = wire_spec
+        self._weights_man = (None if self._weights_path is None
+                             else artifact_manifest(self._weights_path))
+        self._programs_man = (None if self._programs_path is None
+                              else artifact_manifest(self._programs_path))
+        self._hs_state = "connect"
+        self._connect(float(connect_timeout_s))
+
+    # -- attach handshake ----------------------------------------------
+    def _connect(self, connect_timeout_s: float):
+        deadline = time.monotonic() + connect_timeout_s
+        while True:
+            try:
+                sock = socket.create_connection(self.address, timeout=2.0)
+                break
+            except OSError as e:
+                if time.monotonic() > deadline:
+                    raise WorkerDiedError(
+                        f"could not reach remote worker at "
+                        f"{self.address[0]}:{self.address[1]}: {e!r}")
+                time.sleep(0.1)
+        self._conn = _FrameConn(sock, fault_index=self.index)
+        self._conn.send("attach", {
+            "epoch": self.epoch, "index": self.index,
+            "silence_s": self.manager_silence_s,
+            "spec": self._wire_spec,
+            "weights": self._weights_man,
+            "programs": self._programs_man})
+        self._hs_state = "attach_sent"
+        self._last_tx = time.monotonic()
+
+    def _ship_artifacts(self, need_weights: bool, need_programs: bool):
+        import hashlib
+        from .transfer import iter_artifact_chunks
+        for need, path, verb in (
+                (need_weights, self._weights_path, "weights_chunk"),
+                (need_programs, self._programs_path, "program_chunk")):
+            if not need:
+                continue
+            if path is None:
+                raise WorkerDiedError(
+                    f"worker requested {verb} but the spec ships none")
+            for seq, data in iter_artifact_chunks(path):
+                self._conn.send(
+                    verb,
+                    {"seq": seq,
+                     "sha256": hashlib.sha256(data).hexdigest()},
+                    {"data": np.frombuffer(data, np.uint8).copy()})
+                self.bytes_shipped += len(data)
+        self._conn.send("attach_end", {})
+        self._last_tx = time.monotonic()
+        if self.bytes_shipped:
+            stat_add("STAT_fleet_weight_bytes_shipped",
+                     self.bytes_shipped)
+
+    def _open_beat_conn(self):
+        try:
+            s = socket.create_connection(self.address, timeout=5.0)
+        except OSError as e:
+            raise WorkerDiedError(
+                f"beat side-connection to {self.address[0]}:"
+                f"{self.address[1]} failed: {e!r}")
+        self._beat_conn = _FrameConn(s, fault_index=self.index)
+        self._beat_conn.send("beat_attach", {"epoch": self.epoch,
+                                             "index": self.index})
+
+    def poll_ready(self) -> bool:
+        if self._warm:
+            return True
+        try:
+            for frame in self._conn.recv_frames(0.0):
+                v, h, a = frame
+                if v == "attach_ok" and self._hs_state == "attach_sent":
+                    self._ship_artifacts(bool(h.get("need_weights")),
+                                         bool(h.get("need_programs")))
+                    self._open_beat_conn()
+                    self._hs_state = "await_ready"
+                else:
+                    self._dispatch(frame)
+        except WorkerDiedError as e:
+            if self._boot_error is None:
+                self._boot_error = f"connection lost mid-attach: {e}"
+        if self._warm:
+            return True
+        if self._boot_error is not None:
+            raise WorkerDiedError(
+                f"remote worker {self.index} at {self.log_path} failed "
+                f"to attach: {self._boot_error}")
+        if time.monotonic() > self._boot_deadline:
+            raise WorkerDiedError(
+                f"remote worker {self.index} at {self.log_path} not "
+                f"ready within {self.boot_timeout_s}s")
+        return False
+
+    # -- epoch-fenced dispatch -----------------------------------------
+    def _dispatch(self, frame):
+        verb, h, a = frame
+        ep = h.get("epoch")
+        if ep is not None and int(ep) != self.epoch and verb != "fatal":
+            # a frame from another session epoch of this worker: tell it
+            # to abort — its runs were already resubmitted elsewhere and
+            # a resumed stale stream would double-serve tokens
+            try:
+                self._conn.send("abort_epoch", {"epoch": int(ep)})
+            except (WorkerDiedError, WireFormatError):
+                pass
+            return
+        if verb == "accepted":
+            self._await_ack.pop(h.get("wid"), None)
+            return
+        super()._dispatch(frame)
+
+    # -- partition-safe submits ----------------------------------------
+    def _ship(self, req: Request, resp: Response):
+        wid = self._wid
+        self._wid += 1
+        h = self._submit_header(req, wid)
+        prompt = np.asarray(req.prompt, np.int32)
+        # the worker dedups on wid, so a retried submit is idempotent: a
+        # lost ack can cost a resend, never a double admission
+        self._await_ack[wid] = [time.monotonic() + self.ack_timeout_s,
+                                self.submit_retries, h, prompt]
+        # register the run BEFORE the send: a submit cut mid-frame (net
+        # drop) raises out of send() after the request already left the
+        # scheduler — it must sit in _slots so the fleet's failover
+        # sweep can resubmit it instead of orphaning the consumer
+        self._slots[wid] = _ProxyRun(req, resp)
+        self._conn.send("submit", h, {"prompt": prompt})
+        self._last_tx = time.monotonic()
+
+    def _pump_acks(self):
+        now = time.monotonic()
+        for wid in list(self._await_ack):
+            if wid not in self._slots:
+                # done/failed landed first: the stream already answered
+                self._await_ack.pop(wid, None)
+                continue
+            entry = self._await_ack[wid]
+            if now < entry[0]:
+                continue
+            if entry[1] <= 0:
+                self._await_ack.pop(wid, None)
+                run = self._slots.pop(wid, None)
+                if run is not None:
+                    run.resp._fail(WorkerDiedError(
+                        f"request {run.req.id}: remote worker "
+                        f"{self.index} never acknowledged submit "
+                        f"wid={wid} ({self.submit_retries} retries)"))
+                continue
+            entry[0] = now + self.ack_timeout_s
+            entry[1] -= 1
+            self._conn.send("submit", entry[2], {"prompt": entry[3]})
+            self._last_tx = now
+
+    def _maybe_ping(self):
+        """Keep the worker's manager-silence clock fed while idle — a
+        quiet-but-connected manager must not look like a partition."""
+        now = time.monotonic()
+        if now - self._last_tx < self.manager_silence_s / 3.0:
+            return
+        self._conn.send("ping", {})
+        self._last_tx = now
+
+    def step(self) -> bool:
+        if self._closed or self._conn is None:
+            return False
+        did = super().step()
+        self._pump_acks()
+        self._drain_beats()
+        self._maybe_ping()
+        return did
+
+    # -- liveness on the wire ------------------------------------------
+    def _drain_beats(self):
+        if self._beat_conn is None:
+            return
+        try:
+            frames = self._beat_conn.recv_frames(0.0)
+        except (WorkerDiedError, WireFormatError):
+            return  # a dead beat channel reads as staleness — the safe
+            #         direction for a fence
+        for v, h, _ in frames:
+            if v != "beat":
+                continue
+            ep = h.get("epoch")
+            if ep is not None and int(ep) != self.epoch:
+                continue  # a stale session's beat proves nothing
+            self._last_beat = h
+            self._last_beat_rx = time.monotonic()
+
+    def heartbeat_age(self, fresh: bool = False) -> Optional[float]:
+        """Age of the last beat FRAME, clocked on ARRIVAL (this host's
+        monotonic clock — the worker's stamps belong to another
+        machine's timeline).  None before the first beat, exactly like
+        the file path during boot.  The file path's 50ms cache has no
+        analogue: draining a socket is cheap."""
+        self._drain_beats()
+        if self._last_beat_rx is None:
+            return None
+        return max(0.0, time.monotonic() - self._last_beat_rx)
+
+    def heartbeat_steps(self) -> Optional[int]:
+        self._drain_beats()
+        try:
+            return (None if self._last_beat is None
+                    else int(self._last_beat["steps"]))
+        except (TypeError, ValueError, KeyError):
+            return None
+
+    def process_alive(self) -> bool:
+        # no pid to poll across a network: the session being open and
+        # un-dead IS aliveness; staleness is heartbeat_age's verdict
+        return not self._closed and self._dead is None
+
+    @property
+    def pid(self) -> int:
+        return -1 if self._worker_pid is None else int(self._worker_pid)
+
+    # -- teardown -------------------------------------------------------
+    def kill(self):
+        """No SIGKILL crosses a network: drop both connections.  The
+        worker sees manager-loss (or manager silence) and self-aborts
+        its residents typed — the fence holds without owning the
+        process."""
+        for c in (self._conn, self._beat_conn):
+            if c is not None:
+                c.close()
+
+    def close(self, graceful: bool = True):
+        """Detach from the worker (the manager does not own a standalone
+        process: `close` ends the SESSION — the worker aborts residents
+        and goes back to listening).  Idempotent."""
+        self._closed = True
+        with self._close_lock:
+            if graceful and self._conn is not None:
+                try:
+                    self._conn.send("close", {})
+                except (WorkerDiedError, WireFormatError):
+                    pass
+            self.kill()
+            self._abort_all(lambda req: RequestCancelled(
+                f"request {req.id} aborted: remote worker replica "
+                "detached"))
 
 
 if __name__ == "__main__":

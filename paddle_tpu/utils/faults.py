@@ -99,6 +99,37 @@ Injection points (consumed elsewhere in the framework):
                   (default: every replica).  Live-read per step, nothing
                   baked into any trace.  Env: PDTPU_FAULT_REPLICA_SLOW=
                   "ms[:every_n[:replica]]".
+  net_delay       the fleet RPC's frame sender trickles every `every_n`-th
+                  frame byte-chunk-by-byte with `ms` milliseconds between
+                  chunks (default every frame) — the slowloris peer: a
+                  frame that takes arbitrarily long to ASSEMBLE on the
+                  receiving side while the socket stays healthy.  The
+                  receiver's per-frame assembly deadline
+                  (worker._FrameConn) must fence it with the typed
+                  WireFormatError instead of holding the drive loop
+                  hostage.  Consulted live per frame send, host-side
+                  only.  Env: PDTPU_FAULT_NET_DELAY="ms[:every_n]".
+  net_drop        the `n`-th RPC frame sent by this process (1-based,
+                  counted across every connection) is cut MID-FRAME: half
+                  its bytes go out, then the socket is hard-closed — a
+                  connection reset in the middle of a length-prefixed
+                  frame.  Fires once.  The receiver must fail typed
+                  (WorkerDiedError on the closed peer / WireFormatError
+                  on the torn frame), never decode garbage.  Env:
+                  PDTPU_FAULT_NET_DROP="n".
+  net_partition   a hard network partition against the replica with index
+                  `replica`, lasting `secs` seconds from the first
+                  consult after arming: every frame SENT to/from that
+                  replica is silently blackholed and every receive sees
+                  nothing, in BOTH directions, while both processes and
+                  their sockets stay alive — the split-brain drill.  The
+                  manager must fence on beat age and resubmit elsewhere;
+                  the isolated worker must self-abort its residents after
+                  the manager-silence timeout; a healed worker presenting
+                  the stale epoch must be told to abort, never resume.
+                  Arm it on BOTH sides (faults.enable locally + the
+                  worker's `fault` RPC verb).  Env:
+                  PDTPU_FAULT_NET_PARTITION="replica:secs".
   replica_wedge   the subprocess fleet worker with index `replica` blocks
                   INDEFINITELY inside its `tick`-th step (0-based) — a
                   hang, not a crash: the worker process stays alive, its
@@ -134,7 +165,9 @@ __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "prefetch_stall_config", "maybe_stall_prefetch",
            "row_corrupt_fetch", "replica_crash_config",
            "replica_slow_config", "maybe_slow_replica",
-           "replica_wedge_config", "maybe_wedge_replica"]
+           "replica_wedge_config", "maybe_wedge_replica",
+           "net_delay_config", "net_drop_frame", "maybe_net_drop",
+           "net_partition_config", "net_partition_active"]
 
 _ENV = {
     "nan_grads": "PDTPU_FAULT_NAN_GRADS",
@@ -150,11 +183,15 @@ _ENV = {
     "replica_crash": "PDTPU_FAULT_REPLICA_CRASH",
     "replica_slow": "PDTPU_FAULT_REPLICA_SLOW",
     "replica_wedge": "PDTPU_FAULT_REPLICA_WEDGE",
+    "net_delay": "PDTPU_FAULT_NET_DELAY",
+    "net_drop": "PDTPU_FAULT_NET_DROP",
+    "net_partition": "PDTPU_FAULT_NET_PARTITION",
 }
 
 _lock = threading.Lock()
 _registry = {}          # point -> raw config string (authoritative mirror)
 _save_counter = {"n": 0}  # kill_mid_save is counted per process
+_net_state = {"frames": 0, "drop_fired": False, "partitions": {}}
 
 
 def enable(point: str, value="1"):
@@ -181,6 +218,9 @@ def reset():
         disable(point)
     with _lock:
         _save_counter["n"] = 0
+        _net_state["frames"] = 0
+        _net_state["drop_fired"] = False
+        _net_state["partitions"] = {}
 
 
 def get(point: str) -> Optional[str]:
@@ -493,6 +533,78 @@ def maybe_wedge_replica(replica_idx: int, step_no: int):
     import time
     while True:  # pragma: no cover — exits only via SIGKILL
         time.sleep(3600)
+
+
+# -- net_delay / net_drop / net_partition ------------------------------------
+
+def net_delay_config() -> Optional[Tuple[float, int]]:
+    """(chunk_sleep_ms, every_n) or None when disarmed — the slowloris
+    knob.  Consulted live per frame SEND by the fleet RPC
+    (worker._FrameConn): a matched frame is dribbled out in small byte
+    chunks with `ms` sleeps between them, so its assembly on the peer
+    takes arbitrarily long while the socket stays healthy."""
+    raw = get("net_delay")
+    if not raw:
+        return None
+    parts = raw.split(":", 1)
+    ms = float(parts[0])
+    every = int(parts[1]) if len(parts) == 2 else 1
+    return ms, max(1, every)
+
+
+def net_drop_frame() -> Optional[int]:
+    """1-based frame number (counted across every connection in this
+    process) to cut mid-frame, or None when disarmed."""
+    raw = get("net_drop")
+    if not raw:
+        return None
+    return int(raw)
+
+
+def maybe_net_drop() -> bool:
+    """Count one frame send; True exactly once, on the armed frame
+    number — the caller sends HALF the frame and hard-closes the socket
+    (a mid-frame connection cut).  Single-shot per process until
+    reset()."""
+    target = net_drop_frame()
+    if target is None:
+        return False
+    with _lock:
+        _net_state["frames"] += 1
+        if _net_state["drop_fired"] or _net_state["frames"] != target:
+            return False
+        _net_state["drop_fired"] = True
+    return True
+
+
+def net_partition_config() -> Optional[Tuple[int, float]]:
+    """(replica_index, seconds) or None when disarmed."""
+    raw = get("net_partition")
+    if not raw:
+        return None
+    replica, secs = raw.split(":", 1)
+    return int(replica), float(secs)
+
+
+def net_partition_active(replica_idx: Optional[int]) -> bool:
+    """True while the partition window against `replica_idx` is open.
+    The window starts at the FIRST consult after arming (each process
+    starts its own clock — arm both sides near-simultaneously: the
+    manager via enable(), the worker via its `fault` RPC verb) and
+    closes `secs` later: the partition HEALS, with both processes still
+    alive — the split-brain reconciliation this knob exists to force."""
+    cfg = net_partition_config()
+    if cfg is None or replica_idx is None or cfg[0] != int(replica_idx):
+        return False
+    raw = get("net_partition")
+    import time
+    now = time.monotonic()
+    with _lock:
+        start = _net_state["partitions"].get(raw)
+        if start is None:
+            start = now
+            _net_state["partitions"][raw] = start
+    return (now - start) < cfg[1]
 
 
 # -- backend_down ------------------------------------------------------------

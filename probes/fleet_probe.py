@@ -35,6 +35,22 @@ ServingEngines, tiny GPT, CPU):
    program set (``program_set:exe``, zero post-warmup compiles) and
    they serve bit-identical again; zero hung consumers anywhere.
    Published as bench ``detail.fleet.{wedge_detect_ms,restart_ok}``.
+5. **Network transparency** (ISSUE-15) — two STANDALONE remote workers
+   (``--listen`` on ephemeral loopback ports) attached by ADDRESS and
+   booted from weights + the phase-3 program set shipped over the wire
+   (the spec factory is seeded differently from the shipped weights, so
+   bit-identity to the solo oracle proves zero seeded rebuilds; zero
+   post-warmup compiles proves the shipped program set covers serving).
+   Poisson traffic under ``PDTPU_FAULT_NET_DELAY`` slowloris, then a
+   ``PDTPU_FAULT_NET_DROP`` mid-frame cut (typed fence, bit-identical
+   failover, supervised re-attach), then a hard
+   ``PDTPU_FAULT_NET_PARTITION`` mid-decode: the manager fences on
+   beat-frame age within 2x the threshold and resubmits onto the
+   survivor; after the window heals the worker (which self-aborted its
+   stale epoch — zero double-served tokens) accepts a higher-epoch
+   re-attach and serves bit-identical again.  Worker PROCESSES survive
+   all of it.  Published as bench
+   ``detail.fleet.{partition_detect_ms,weight_ship_ok}``.
 
 `--steps N` (N <= 5) is the CI smoke: phase 1 only, parity + terminal
 states, no perf bars.  Prints one `FLEET{json}` line; exits 1 on any
@@ -582,6 +598,283 @@ def main():
         })
         failures.extend(w_failures)
         wfleet.close()
+
+    # ------------------------------------------------------------------
+    # phase 5: network transparency — remote TCP workers attached by
+    # address, weights + program set shipped over the wire, net chaos
+    # (delay slowloris, mid-frame drop, hard partition), healed
+    # higher-epoch re-attach with zero double-served tokens
+    # ------------------------------------------------------------------
+    if not smoke and not hung:
+        import subprocess as _subprocess
+        from paddle_tpu import jit as _jit
+        from paddle_tpu.serving.fleet import RemoteReplica
+        from paddle_tpu.serving.transfer import file_sha256
+        n_failures = []
+        net_hb = 1.5
+        # ship THIS model's saved weights under a factory seeded
+        # DIFFERENTLY (23 != 11): bit-identity of every remote stream
+        # to the solo oracle proves the shipped artifact — not a seeded
+        # rebuild — is what the workers serve
+        wdir = tempfile.mkdtemp(prefix="fleet_probe_wts_")
+        _jit.save(model, os.path.join(wdir, "m"))
+        wpath = os.path.join(wdir, "m.pdiparams.npz")
+        w_sha = file_sha256(wpath)
+        rspec = {
+            "model": {"factory": "paddle_tpu.serving.worker:build_gpt",
+                      "kwargs": dict(vocab_size=vocab, hidden_size=32,
+                                     num_hidden_layers=2,
+                                     num_attention_heads=2,
+                                     hidden_dropout_prob=0.0,
+                                     attention_probs_dropout_prob=0.0,
+                                     max_position_embeddings=128,
+                                     seed=23)},
+            "engine": {"max_slots": args.slots, "max_len": 64,
+                       "prefill_buckets": [8],
+                       "decode_chunk": args.chunk,
+                       "max_queue_depth": max(64, n_req)},
+            "weights": wpath,
+            "program_set": ps_path,
+            "ship_program_set": True,
+        }
+
+        def spawn_worker(index):
+            wenv = dict(os.environ)
+            wenv.pop("PALLAS_AXON_POOL_IPS", None)
+            root = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+            wenv["PYTHONPATH"] = (root + os.pathsep + wenv["PYTHONPATH"]
+                                  if wenv.get("PYTHONPATH") else root)
+            p = _subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.serving.worker",
+                 "--listen", "127.0.0.1:0", "--index", str(index)],
+                stdin=_subprocess.DEVNULL, stdout=_subprocess.PIPE,
+                stderr=_subprocess.STDOUT, text=True, env=wenv,
+                start_new_session=True)
+            while True:
+                line = p.stdout.readline()
+                if not line:
+                    raise RuntimeError(
+                        "remote worker exited before listening")
+                if "worker listening on" in line:
+                    waddr = line.strip().rsplit(" ", 1)[-1]
+                    break
+            # keep draining stdout so the worker can never block on a
+            # full pipe mid-probe
+            threading.Thread(target=lambda: p.stdout.read(),
+                             daemon=True).start()
+            return waddr, p
+
+        rfleet = FleetRouter(
+            [make_engine()], heartbeat_timeout_s=net_hb,
+            kill_grace_s=0.3,
+            # a mid-partition re-attach just times out against a
+            # blackholed socket: the first retry must land after the
+            # partition window heals
+            restart_backoff=RestartBackoff(max_restarts=3,
+                                           base_delay=2.0,
+                                           max_delay=3.0))
+        workers = [spawn_worker(1), spawn_worker(2)]
+        rrids = [rfleet.add_worker(dict(rspec), address=a,
+                                   boot_timeout_s=180.0,
+                                   manager_silence_s=2.0,
+                                   ack_timeout_s=30.0)
+                 for a, _p in workers]
+        rfleet.warmup()
+        rfleet.start()
+        rreps = [rfleet.manager.get(rid) for rid in rrids]
+        rsnaps = [r.snapshot() for r in rreps]
+        shipped_bytes = sum(s.get("bytes_shipped") or 0 for s in rsnaps)
+        ship_sha_ok = all(s.get("weights_sha") == w_sha for s in rsnaps)
+        if not all((s.get("bytes_shipped") or 0) > 0 for s in rsnaps):
+            n_failures.append("weights were not shipped over the wire")
+        if not ship_sha_ok:
+            n_failures.append("remote weights_sha != shipped artifact "
+                              "sha256")
+
+        # -- Poisson traffic under net-delay slowloris ------------------
+        for r in rreps:
+            r.engine.set_fault("net_delay", "2:5")
+        faults.enable("net_delay", "2:5")
+        d_plan = [{"prompt": draw_prompt(),
+                   "max_new": budgets[int(rng.randint(len(budgets)))]}
+                  for _ in range(8)]
+        for r_ in d_plan:
+            want(r_["prompt"], r_["max_new"])
+        d_resps = []
+        for i, r_ in enumerate(d_plan):
+            d_resps.append(rfleet.submit(r_["prompt"], r_["max_new"],
+                                         resubmit=True,
+                                         session=f"net{i % 4}"))
+            time.sleep(float(rng.exponential(1.0 / 50.0)))
+        d_hung = [i for i, r_ in enumerate(d_resps)
+                  if not r_._done.wait(timeout=120)]
+        d_parity = [i for i, r_ in enumerate(d_resps)
+                    if i not in d_hung and (
+                        r_.error is not None
+                        or r_.tokens(timeout=5) != want(
+                            d_plan[i]["prompt"], d_plan[i]["max_new"]))]
+        faults.disable("net_delay")
+        for r in rreps:
+            if r.state == "healthy":
+                r.engine.set_fault("net_delay", None)
+        pwc_remote = [r.engine.post_warmup_compiles() for r in rreps
+                      if r.state == "healthy"]
+        if d_hung:
+            n_failures.append(f"net-delay traffic hung: {d_hung[:5]}")
+        if d_parity:
+            n_failures.append(
+                f"net-delay traffic diverged/failed: {d_parity[:5]}")
+        if any(p != 0 for p in pwc_remote):
+            n_failures.append(
+                f"remote workers compiled post-warmup {pwc_remote} "
+                "(the shipped program set must cover serving)")
+
+        # -- mid-frame drop: the next manager frame to SOME remote is
+        # cut halfway and its socket dies mid-stream; the affected
+        # replica fences typed, its opted-in resident fails over
+        # bit-identical, and the supervisor re-attaches a fresh epoch
+        drop_budget = max(budgets) + 8
+        drop_prompt = np.arange(1, 6, dtype=np.int32)
+        drop_want = want(drop_prompt, drop_budget)
+        d_streams = []
+        for r in rreps:
+            r.engine.set_fault("replica_slow",
+                               f"60:1:{r.lineage['index']}")
+            rq, rs = r.engine.make_request(drop_prompt, drop_budget,
+                                           resubmit=True)
+            r.engine.scheduler.submit(rq, rs)
+            d_streams.append(rs)
+        t_end = time.monotonic() + 60
+        while (not all(len(rs.tokens_so_far()) for rs in d_streams)
+               and time.monotonic() < t_end):
+            time.sleep(0.005)
+        faults.enable("net_drop", "1")
+        drop_bad = [i for i, rs in enumerate(d_streams)
+                    if not rs._done.wait(timeout=120)
+                    or rs.error is not None
+                    or rs.tokens() != drop_want]
+        faults.disable("net_drop")
+        if drop_bad:
+            n_failures.append(
+                f"mid-frame drop: streams {drop_bad} hung/diverged")
+        t_end = time.monotonic() + 120
+        healthy_remotes = []
+        while time.monotonic() < t_end:
+            healthy_remotes = [r for r in rfleet.manager.replicas()
+                               if isinstance(r, RemoteReplica)
+                               and r.state == "healthy"]
+            if len(healthy_remotes) >= 2:
+                break
+            time.sleep(0.02)
+        if len(healthy_remotes) < 2:
+            n_failures.append(
+                f"only {len(healthy_remotes)}/2 remote workers healthy "
+                "after the mid-frame drop re-attach")
+
+        # -- hard partition mid-decode ---------------------------------
+        part_detect_ms = None
+        if healthy_remotes:
+            vic = healthy_remotes[-1]
+            vidx = vic.lineage["index"]
+            vic.engine.set_fault("replica_slow", f"60:1:{vidx}")
+            pq, presp = vic.engine.make_request(drop_prompt, drop_budget,
+                                                resubmit=True)
+            vic.engine.scheduler.submit(pq, presp)
+            t_end = time.monotonic() + 60
+            while (not len(presp.tokens_so_far())
+                   and time.monotonic() < t_end):
+                time.sleep(0.005)
+            # arm the WORKER side first (that RPC frame must still get
+            # through), then this side: both directions blackholed with
+            # every process alive
+            vic.engine.set_fault("net_partition", f"{vidx}:2.5")
+            faults.enable("net_partition", f"{vidx}:2.5")
+            t_arm = time.monotonic()
+            t_end = time.monotonic() + 60
+            while vic.state != "wedged" and time.monotonic() < t_end:
+                time.sleep(0.002)
+            if vic.state == "wedged":
+                part_detect_ms = (time.monotonic() - t_arm) * 1e3
+                if part_detect_ms >= 2 * net_hb * 1e3:
+                    n_failures.append(
+                        f"partition fenced in {part_detect_ms:.0f}ms "
+                        f">= {2 * net_hb * 1e3:.0f}ms bar "
+                        "(beat threshold x2)")
+                if "heartbeat age" not in (vic.fence_reason or ""):
+                    n_failures.append(
+                        "partition fence is not beat-age based: "
+                        f"{vic.fence_reason!r}")
+            else:
+                n_failures.append(
+                    f"partition not fenced (state={vic.state})")
+            if not presp._done.wait(timeout=120):
+                n_failures.append("partitioned stream hung")
+            elif presp.error is not None \
+                    or presp.tokens() != drop_want:
+                n_failures.append(
+                    "partitioned stream failed or diverged "
+                    f"({presp.error!r}) — lost or double-served tokens")
+            faults.disable("net_partition")
+            # heal: the worker self-aborted its residents on manager
+            # silence and went back to listening; it must accept the
+            # supervisor's HIGHER-epoch re-attach (the stale epoch died
+            # cleanly — zero double-served tokens) and serve again
+            healed = None
+            t_end = time.monotonic() + 120
+            while time.monotonic() < t_end:
+                healed = next(
+                    (r for r in rfleet.manager.replicas()
+                     if isinstance(r, RemoteReplica)
+                     and r.state == "healthy"
+                     and r.lineage["index"] == vidx), None)
+                if healed is not None:
+                    break
+                time.sleep(0.02)
+            if healed is None:
+                n_failures.append("partitioned worker never re-attached "
+                                  "after the window healed")
+            else:
+                if (healed.lineage["epoch"] < 2
+                        or healed.engine.epoch != healed.lineage["epoch"]):
+                    n_failures.append(
+                        "healed re-attach epoch not advanced "
+                        f"({healed.lineage['epoch']})")
+                healed.engine.set_fault("replica_slow", None)
+                hq, hresp = healed.engine.make_request(drop_prompt,
+                                                       drop_budget)
+                healed.engine.scheduler.submit(hq, hresp)
+                if (not hresp._done.wait(timeout=90)
+                        or hresp.error is not None
+                        or hresp.tokens() != drop_want):
+                    n_failures.append(
+                        "healed worker does not serve bit-identical")
+        if any(p.poll() is not None for _a, p in workers):
+            n_failures.append("a remote worker PROCESS died under net "
+                              "chaos (must survive drops/partitions)")
+        rc_counters = rfleet.manager.counters()
+        weight_ship_ok = (shipped_bytes > 0 and ship_sha_ok
+                          and not d_hung and not d_parity
+                          and bool(pwc_remote)
+                          and all(p == 0 for p in pwc_remote))
+        out.update({
+            "remote_workers": 2,
+            "weight_bytes_shipped": shipped_bytes,
+            "weight_ship_ok": weight_ship_ok,
+            "partition_detect_ms": (None if part_detect_ms is None
+                                    else round(part_detect_ms, 1)),
+            "net_heartbeat_timeout_ms": net_hb * 1e3,
+            "remote_resubmits": rc_counters["resubmits"],
+            "remote_worker_restarts": rc_counters["worker_restarts"],
+        })
+        failures.extend(n_failures)
+        rfleet.close()
+        for _a, p in workers:
+            try:
+                p.kill()
+                p.wait(timeout=10)
+            except Exception:
+                pass
 
     out["fleet_counters"] = fleet.manager.counters()
     out["health"] = {k: v for k, v in fleet.health().items()
