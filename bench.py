@@ -983,6 +983,37 @@ def measure_paged_serving():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_prefix_cache():
+    """ISSUE-17 acceptance artifact: probes/prefix_cache_probe.py in a
+    clean CPU subprocess.  Publishes the prefix-aware KV reuse story as
+    `detail.prefix.{warm_ttft_ratio,capacity_ratio,hit_rate}` — bars:
+    warm-prefix TTFT <= 0.5x the no-cache paged engine's cold TTFT on
+    templated traffic, >= 2x peak resident slots at the SAME block
+    budget, block hit rate >= 0.5 under Poisson template traffic, every
+    warm stream bit-identical to the cold leg, zero post-warmup compiles
+    on every leg (program registry asserted), compile bound unchanged at
+    len(buckets)+1."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes",
+                                      "prefix_cache_probe.py"),
+         "--steps", os.environ.get("PDTPU_PREFIX_PROBE_STEPS", "24")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("PREFIX"):
+            rec = json.loads(line[len("PREFIX"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"prefix-cache bars failed: "
+                                 f"{rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return rec
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_hbm():
     """ISSUE-10 acceptance artifact: probes/hbm_probe.py in a clean CPU
     subprocess.  Publishes the conv-net memory-discipline story as
@@ -1286,6 +1317,7 @@ def main():
                          ("serving", measure_serving),
                          ("hbm", measure_hbm),
                          ("paged", measure_paged_serving),
+                         ("prefix", measure_prefix_cache),
                          ("program_cache", measure_program_cache),
                          ("spec_decode", measure_spec_decode),
                          ("gateway", measure_gateway),

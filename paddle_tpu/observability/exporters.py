@@ -154,6 +154,9 @@ def report() -> dict:
         "saves": stats.get("STAT_checkpoint_saves", 0),
         "async_writes": stats.get("STAT_checkpoint_async_writes", 0),
     }
+    pc_hits = _gauge_value("prefix_cache_hits_total") or 0
+    pc_misses = _gauge_value("prefix_cache_misses_total") or 0
+    pc_total = pc_hits + pc_misses
     serving = {
         "ttft_seconds": _hist_summary("serving_ttft_seconds"),
         "inter_token_seconds": _hist_summary("serving_inter_token_seconds"),
@@ -162,6 +165,15 @@ def report() -> dict:
         "queue_full_rejections": stats.get("STAT_serving_rejects", 0),
         "tokens_out": stats.get("STAT_serving_tokens", 0),
         "requests": stats.get("STAT_serving_requests", 0),
+        # prefix cache: block-level prompt reuse across admissions
+        "prefix_cache_hits": pc_hits,
+        "prefix_cache_misses": pc_misses,
+        "prefix_cache_hit_rate": (pc_hits / pc_total if pc_total
+                                  else None),
+        "prefix_cache_evictions":
+            _gauge_value("prefix_cache_evictions_total") or 0,
+        "prefix_cache_cow_copies":
+            _gauge_value("prefix_cache_cow_copies_total") or 0,
     }
     fleet = {
         "replicas_up": _gauge_value("fleet_replicas_up"),

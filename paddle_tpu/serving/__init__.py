@@ -138,6 +138,7 @@ from __future__ import annotations
 
 from .engine import ServingEngine, NonFiniteLogitsError, PreemptedRun
 from .kv_pool import PagedKVPool, KVPoolExhaustedError
+from .prefix_cache import PrefixCache
 from .request import Request, Response, RequestCancelled
 from .scheduler import (RequestScheduler, QueueFullError,
                         DeadlineExceededError)
@@ -156,7 +157,7 @@ __all__ = [
     "QueueFullError", "DeadlineExceededError", "RequestCancelled",
     "NonFiniteLogitsError", "PreemptedRun",
     # distributed serving (paged KV pool + tensor-parallel engine)
-    "PagedKVPool", "KVPoolExhaustedError",
+    "PagedKVPool", "KVPoolExhaustedError", "PrefixCache",
     # gateway (multi-tenant SLO-aware admission over the engine)
     "ServingGateway", "GatewayServer", "serve_gateway", "TenantConfig",
     "TokenBucket", "ShedPolicy", "Signals", "SLOTracker",

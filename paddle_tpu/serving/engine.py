@@ -108,24 +108,36 @@ class NonFiniteLogitsError(FatalError):
     code = "Fatal"
 
 
+def _first_token_at(logits, idx, fold_pos, key, temp, top_k, top_p,
+                    greedy):
+    """Sample the first generated token from the logits row at `idx`,
+    folding the key at the ABSOLUTE position `fold_pos` — the general
+    form behind `_first_token`.  The cached-prefix prefill computes only
+    the prompt's uncached suffix, so its last-position logits sit at the
+    RELATIVE index (prompt_len - 1 - cached_len) while the key must
+    still fold at the absolute (prompt_len - 1) for stream parity with
+    the cold path."""
+    last = jax.lax.dynamic_index_in_dim(
+        logits[0].astype(jnp.float32), idx, axis=0, keepdims=False)
+    finite = jnp.isfinite(last).all()
+    proc = process_logits_dynamic(
+        last[None], temp[None], top_k[None], top_p[None], greedy[None])[0]
+    sampled = jax.random.categorical(
+        jax.random.fold_in(key, fold_pos), proc)
+    tok = jnp.where(greedy, jnp.argmax(proc, axis=-1),
+                    sampled).astype(jnp.int32)
+    logp = jax.nn.log_softmax(proc)[tok]
+    return tok, logp, finite
+
+
 def _first_token(logits, prompt_len, key, temp, top_k, top_p, greedy):
     """Sample the first generated token from the prompt's last-position
     logits (shared by the fixed and paged prefill programs).  Right
     padding never touches that position (causal mask), so this matches
     the solo generate prefill; the key is folded at (prompt_len - 1) and
     decode step j folds at prompt_len + j — counters never collide."""
-    last = jax.lax.dynamic_index_in_dim(
-        logits[0].astype(jnp.float32), prompt_len - 1, axis=0,
-        keepdims=False)
-    finite = jnp.isfinite(last).all()
-    proc = process_logits_dynamic(
-        last[None], temp[None], top_k[None], top_p[None], greedy[None])[0]
-    sampled = jax.random.categorical(
-        jax.random.fold_in(key, prompt_len - 1), proc)
-    tok = jnp.where(greedy, jnp.argmax(proc, axis=-1),
-                    sampled).astype(jnp.int32)
-    logp = jax.nn.log_softmax(proc)[tok]
-    return tok, logp, finite
+    return _first_token_at(logits, prompt_len - 1, prompt_len - 1, key,
+                           temp, top_k, top_p, greedy)
 
 
 def _sample_step(last, keys, pos, temp, top_k, top_p, greedy):
@@ -212,6 +224,21 @@ def _window_start(pos, n_rows, total_rows):
     them — idempotent by construction — instead of paying a permanently
     longer view just to keep dynamic_slice from clamping."""
     return jnp.maximum(0, jnp.minimum(pos, total_rows - n_rows))
+
+
+class _CachedPlan:
+    """Host-side warm-admission plan (see `_cached_plan`)."""
+
+    __slots__ = ("chain", "matched", "cow", "cached_len", "bucket",
+                 "new_live")
+
+    def __init__(self, chain, matched, cow, cached_len, bucket, new_live):
+        self.chain = chain            # cached block ids to adopt
+        self.matched = matched        # rows covered by the chain
+        self.cow = cow                # last chain block needs a COW copy
+        self.cached_len = cached_len  # dynamic prefill input
+        self.bucket = bucket          # SUFFIX bucket (plen - cached_len)
+        self.new_live = new_live      # fresh live blocks this admit costs
 
 
 def _paged_row_writer(block_size, sentinel, pool_len):
@@ -346,7 +373,8 @@ class ServingEngine:
                  decode_chunk: int = 4, draft_model=None,
                  spec_tokens: int = 4, kv: str = "fixed",
                  block_size: int = 16, num_blocks: Optional[int] = None,
-                 mesh=None, program_set=None):
+                 mesh=None, program_set=None, prefix_cache: bool = False,
+                 share_policy=None):
         from ..generation import _model_fns
         self.model = model
         self.max_slots = int(max_slots)
@@ -403,6 +431,20 @@ class ServingEngine:
             raise InvalidArgumentError(
                 f"kv must be 'fixed' or 'paged', got {kv!r}")
         self.kv = kv
+        # prefix-aware KV reuse (serving/prefix_cache.py): opt-in so the
+        # plain paged engine keeps its exact PR-8 allocation behavior
+        if prefix_cache and kv != "paged":
+            raise InvalidArgumentError(
+                "prefix_cache=True requires kv='paged'")
+        if prefix_cache and draft_model is not None:
+            raise InvalidArgumentError(
+                "prefix_cache does not compose with speculative decoding "
+                "yet (the draft pool shares block tables but its cached "
+                "prefill half is unimplemented)")
+        self.prefix_cache = None
+        self._share_policy = share_policy
+        self._share_groups: Dict[str, str] = {}
+        self._cow_fn = None
         self.block_size = int(block_size)
         if kv == "paged" and self.block_size < 1:
             raise InvalidArgumentError(
@@ -432,6 +474,20 @@ class ServingEngine:
             self._paged_cache = None  # (allocator version, tables, active)
             self._oom_preempts = 0
             self._oom_failed = 0
+            if prefix_cache:
+                from .prefix_cache import PrefixCache
+                self.prefix_cache = PrefixCache(self.kv_pool)
+
+                # copy-on-write device copy: ONE jitted block copy
+                # (src/dst are dynamic scalars — a single compile),
+                # precompiled at warmup against the sentinel dst so the
+                # zero-post-warmup-compiles contract holds under COW
+                def _cow(pools, src, dst):
+                    return [(kp.at[dst].set(kp[src], mode="drop"),
+                             vp.at[dst].set(vp[src], mode="drop"))
+                            for kp, vp in pools]
+
+                self._cow_fn = jax.jit(_cow, donate_argnums=(0,))
         else:
             self.kv_pool = None
             # THE pool: one gen_fixed_cache(max_slots, pool_len)
@@ -502,8 +558,12 @@ class ServingEngine:
                                if self.kv == "paged"
                                else self._build_decode())
         if self.kv == "paged":
-            self._prefill_fns = {b: self._build_prefill_paged(b)
-                                 for b in self.buckets}
+            # with a prefix cache every bucket's prefill is the cached
+            # variant (cached_len=0 IS the cold path) — the program
+            # family stays one prefill per bucket, bound unchanged
+            build = (self._build_prefill_cached if self.prefix_cache
+                     is not None else self._build_prefill_paged)
+            self._prefill_fns = {b: build(b) for b in self.buckets}
         else:
             self._prefill_fns = {b: self._build_prefill(b)
                                  for b in self.buckets}
@@ -888,6 +948,58 @@ class ServingEngine:
         from ..observability import track
         return track(name, jax.jit(prefill, donate_argnums=donate))
 
+    def _build_prefill_cached(self, bucket: int):
+        """Per-bucket prefill for prefix-cache engines: the slot's table
+        is gathered into its contiguous KV view (exactly like decode —
+        the cached prefix blocks already mapped in by admission supply
+        rows [0, cached_len)), the prompt's uncached SUFFIX runs through
+        the model at the dynamic offset `cached_len` (same traced-scalar
+        position the decode/verify programs use), and only the suffix
+        rows scatter back through the table.  cached_len=0 IS the cold
+        path: the gathered view is all-fresh blocks and the full bucket
+        computes — so cold and warm requests share one program per
+        bucket and the compile bound stays len(buckets)+1.  Buckets are
+        chosen by SUFFIX length, so a warm prefix pays a near-zero
+        prefill.  Suffix writes start at cached_len — a block boundary
+        for non-COW admissions, so shared blocks are never entered; a
+        clamped window near the pool's end re-writes gathered rows
+        value-identically, and any block it scrubs lies entirely inside
+        the window (fully rewritten), preserving shared content
+        bit-exactly."""
+        apply_fixed = self._apply
+        write_rows = _paged_row_writer(self.block_size,
+                                       self.kv_pool.num_blocks,
+                                       self._pool_len)
+        from ..ops.paged_attention import gather_block_rows
+
+        def count_trace():
+            self._compiles["prefill"][bucket] += 1  # trace-count (host)
+            stat_add("STAT_serving_compiles")
+
+        def prefill(state, pools, ids, table, prompt_len, cached_len,
+                    key, temp, top_k, top_p, greedy):
+            count_trace()
+            ctx = [(gather_block_rows(kp, table)[None],
+                    gather_block_rows(vp, table)[None])
+                   for kp, vp in pools]
+            logits, kv = apply_fixed(state, ids, ctx, cached_len)
+            total = kv[0][0].shape[1]
+            start = _window_start(cached_len, bucket, total)
+            rows = [
+                (jax.lax.dynamic_slice_in_dim(kc[0], start, bucket)[None],
+                 jax.lax.dynamic_slice_in_dim(vc[0], start, bucket)[None])
+                for kc, vc in kv]
+            new_pools = write_rows(pools, table[None], start[None],
+                                   rows, jnp.ones((1,), bool), bucket)
+            tok, logp, finite = _first_token_at(
+                logits, prompt_len - 1 - cached_len, prompt_len - 1, key,
+                temp, top_k, top_p, greedy)
+            return tok, logp, finite, new_pools
+
+        from ..observability import track
+        return track(f"serving_prefill_cached_b{bucket}",
+                     jax.jit(prefill, donate_argnums=(1,)))
+
     def _build_decode_paged(self):
         """THE paged decode step: gather every slot's block table into its
         contiguous KV view ONCE per compiled call (value-identical to the
@@ -1154,19 +1266,22 @@ class ServingEngine:
                top_k=0, top_p=1.0, eos_token_id: Optional[int] = None,
                seed: Optional[int] = None, deadline: Optional[float] = None,
                block: bool = False, timeout: Optional[float] = None,
-               spec: Optional[bool] = None) -> Response:
+               spec: Optional[bool] = None,
+               tenant: Optional[str] = None) -> Response:
         """Enqueue one request; returns its streaming Response.
 
-        Raises InvalidArgumentError for a prompt/budget the engine can
-        never serve (prompt longer than the largest prefill bucket, or
-        prompt + max_new_tokens past max_len), QueueFullError at
-        max_queue_depth (backpressure).
+        `tenant` scopes prefix-cache sharing (the gateway sets it from
+        its auth context; direct engine callers may pass it for the
+        same isolation).  Raises InvalidArgumentError for a
+        prompt/budget the engine can never serve (prompt longer than
+        the largest prefill bucket, or prompt + max_new_tokens past
+        max_len), QueueFullError at max_queue_depth (backpressure).
         """
         req, resp = self.make_request(
             prompt, max_new_tokens, decode_strategy=decode_strategy,
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_token_id=eos_token_id, seed=seed, deadline=deadline,
-            spec=spec)
+            spec=spec, tenant=tenant)
         self.scheduler.submit(req, resp, block=block, timeout=timeout)
         self._work.set()
         return resp
@@ -1245,12 +1360,72 @@ class ServingEngine:
         pool pressure hold FIRST claim on freed capacity — their resume
         blocks are RESERVED, and new work only admits from the surplus
         (work-conserving: a small request may still fill an idle slot,
-        but never at the price of starving a parked run)."""
+        but never at the price of starving a parked run).  With a prefix
+        cache the gate counts reusable blocks as free-for-this-request:
+        a warm prefix only charges the pool for its uncached suffix."""
         reserve = (self.kv_pool.blocks_for(self._oom_paused[0].pos)
                    if self._oom_paused else 0)
+        if self.prefix_cache is not None:
+            plan = self._cached_plan(req)
+            return self.kv_pool.free_blocks() >= plan.new_live + reserve
         bucket = self._bucket_for(req.prompt.shape[0])
         return (self.kv_pool.free_blocks()
                 >= self.kv_pool.blocks_for(bucket) + reserve)
+
+    # ------------------------------------------------------------------
+    # prefix cache: share policy + admission planning
+    # ------------------------------------------------------------------
+    def _share_key(self, req: Request) -> str:
+        """The cache partition this request may share KV with.  Default:
+        tenant-private (anonymous requests form one 'default' group);
+        gateway tenancy maps tenants into explicit share groups
+        (TenantConfig.kv_share_group); an engine-level `share_policy`
+        callable overrides both."""
+        if self._share_policy is not None:
+            return str(self._share_policy(req))
+        tenant = req.tenant if req.tenant is not None else "default"
+        return self._share_groups.get(tenant, tenant)
+
+    def set_share_groups(self, groups: Dict[str, str]):
+        """Tenant -> share-group mapping (gateway wiring)."""
+        self._share_groups = dict(groups)
+
+    def _cached_plan(self, req: Request, record: bool = False):
+        """Host-side warm-admission plan: the longest usable cached
+        chain, the dynamic `cached_len` the prefill program gets, the
+        SUFFIX bucket, and the block cost.  Two invariants are enforced
+        here rather than in-program: (1) `cached_len + bucket` never
+        exceeds the gathered view width, so the model's write offset
+        never clamps (a clamped write would land suffix KV over cached
+        rows) — chains trim from the tail until it holds; (2) a fully
+        block-aligned cached prompt recomputes its LAST token inside the
+        final cached block, which is therefore COW'd to a private copy
+        so shared blocks are never written."""
+        plen = int(req.prompt.shape[0])
+        bs = self.block_size
+        view_rows = self.kv_pool.max_blocks_per_slot * bs
+        chain = self.prefix_cache.match(self._share_key(req), req.prompt,
+                                        record=record)
+
+        def shape(chain):
+            matched = len(chain) * bs
+            cow = bool(chain) and matched == plen
+            cached_len = plen - 1 if cow else matched
+            return matched, cow, cached_len, self._bucket_for(
+                plen - cached_len)
+
+        matched, cow, cached_len, bucket = shape(chain)
+        while chain and cached_len + bucket > view_rows:
+            chain = chain[:-1]
+            matched, cow, cached_len, bucket = shape(chain)
+        total_blocks = min(self.kv_pool.blocks_for(cached_len + bucket),
+                           self.kv_pool.max_blocks_per_slot)
+        revive = sum(1 for b in chain
+                     if self.kv_pool.block_ref(b) == 0)
+        new_live = (max(0, total_blocks - len(chain))
+                    + (1 if cow else 0) + revive)
+        return _CachedPlan(chain, matched, cow, cached_len, bucket,
+                           new_live)
 
     def _sweep(self):
         for slot in list(self._slots):
@@ -1290,6 +1465,8 @@ class ServingEngine:
                           ).astype(np.uint32)
 
     def _admit(self, req: Request, resp: Response, slot: int):
+        if self.prefix_cache is not None:
+            return self._admit_prefix(req, resp, slot)
         span = self._span("serving_prefill")
         try:
             plen = req.prompt.shape[0]
@@ -1332,6 +1509,73 @@ class ServingEngine:
             if not bool(finite):
                 self._fail_slot(slot, resp, "prefill")
                 return
+            tok = int(tok)
+            run = _SlotRun(req, resp, pos=plen, first_token=tok, key=key)
+            self._slots[slot] = run
+            self._batch_dirty = True
+            self._emit(run, tok, float(logp))
+            stat_add("STAT_serving_tokens")
+            self._maybe_finish(slot, run, tok)
+        finally:
+            if span is not None:
+                span.__exit__(None, None, None)
+
+    def _admit_prefix(self, req: Request, resp: Response, slot: int):
+        """Warm-path admission: adopt the longest cached prefix chain
+        into the slot's table, COW the final block when the whole prompt
+        is cached, and prefill ONLY the uncached suffix (per-slot
+        dynamic `cached_len` into the same per-bucket program family —
+        `cached_len == 0` IS the cold path, so a miss costs nothing
+        extra and the compile bound is unchanged)."""
+        span = self._span("serving_prefill")
+        try:
+            plen = int(req.prompt.shape[0])
+            share_key = self._share_key(req)
+            plan = self._cached_plan(req, record=True)
+
+            def exhausted(stage):
+                stat_add("STAT_serving_kv_exhausted")
+                with self._m_lock:
+                    self._errored += 1
+                resp._fail(KVPoolExhaustedError(
+                    f"request {req.id}: KV block pool exhausted at "
+                    f"admission/{stage} ({self.kv_pool.free_blocks()} "
+                    f"free of {self.kv_pool.capacity()} usable)"))
+                self.scheduler.release(slot)
+
+            if plan.chain and not self.kv_pool.adopt(slot, plan.chain):
+                return exhausted("adopt")
+            if plan.cow:
+                pair = self.kv_pool.cow_last(slot)
+                if pair is None:
+                    self.kv_pool.free(slot)
+                    return exhausted("cow")
+                src, dst = pair
+                # device copy BEFORE any program can write the new block
+                self._pools = self._cow_fn(self._pools, jnp.int32(src),
+                                           jnp.int32(dst))
+                self.prefix_cache.note_cow()
+            if not self.kv_pool.ensure(slot, plan.cached_len + plan.bucket):
+                self.kv_pool.free(slot)
+                return exhausted("suffix")
+            slot_arg = jnp.asarray(self.kv_pool.table_array(slot))
+            suffix = plen - plan.cached_len
+            ids = np.full((1, plan.bucket), self.pad_token_id, np.int32)
+            ids[0, :suffix] = req.prompt[plan.cached_len:]
+            key = self._request_key(req)
+            tok, logp, finite, self._pools = self._prefill_fns[plan.bucket](
+                self._state, self._pools, jnp.asarray(ids), slot_arg,
+                jnp.int32(plen), jnp.int32(plan.cached_len),
+                jnp.asarray(key), jnp.float32(req.temperature),
+                jnp.int32(req.top_k), jnp.float32(req.top_p),
+                jnp.asarray(req.greedy))
+            stat_add("STAT_serving_prefills")
+            if not bool(finite):
+                self._fail_slot(slot, resp, "prefill")
+                return
+            self.prefix_cache.insert(
+                share_key, req.prompt,
+                self.kv_pool.block_ids(slot)[:plen // self.block_size])
             tok = int(tok)
             run = _SlotRun(req, resp, pos=plen, first_token=tok, key=key)
             self._slots[slot] = run
@@ -1444,6 +1688,11 @@ class ServingEngine:
         if slot is None:
             return False
         if self.kv == "paged":
+            if self.prefix_cache is not None:
+                if not self._restore_paged_prefix(slot, paused):
+                    self.scheduler.release(slot)
+                    return False
+                return self._finish_restore(slot, paused)
             if not self.kv_pool.alloc(slot, paused.pos):
                 self.scheduler.release(slot)
                 return False
@@ -1485,6 +1734,34 @@ class ServingEngine:
                                            paused.draft_kv_rows)
         return self._finish_restore(slot, paused)
 
+    def _restore_paged_prefix(self, slot: int, paused: PreemptedRun) -> bool:
+        """Re-pin a restored run's shared prefix instead of re-uploading
+        it: re-match the prompt against the LOCAL cache (the run may
+        have migrated from another replica, or its blocks may have been
+        evicted while parked), adopt whatever chain is still resident,
+        and upload only the snapshot rows past it.  A fully cached
+        prompt drops its last chain block — the prefill recomputed that
+        block's final row in a private COW copy which was freed with the
+        slot, so its snapshot rows upload into a fresh block instead —
+        preserving the never-write-shared-blocks invariant.  On failure
+        nothing is held (the caller releases the scheduler slot)."""
+        req = paused.req
+        plen = int(req.prompt.shape[0])
+        bs = self.block_size
+        chain = self.prefix_cache.match(self._share_key(req), req.prompt)
+        if chain and len(chain) * bs >= plen:
+            chain = chain[:-1]
+        if chain and not self.kv_pool.adopt(slot, chain):
+            return False
+        if not self.kv_pool.ensure(slot, paused.pos):
+            self.kv_pool.free(slot)
+            return False
+        shared_rows = len(chain) * bs
+        self._pools = self._paged_upload(self._pools, slot,
+                                         paused.kv_rows, paused.pos,
+                                         start_row=shared_rows)
+        return True
+
     def _finish_restore(self, slot: int, paused: PreemptedRun) -> bool:
         """Resume bookkeeping shared by both KV layouts: one copy, so a
         future lifecycle counter cannot diverge between them."""
@@ -1498,20 +1775,28 @@ class ServingEngine:
         stat_add("STAT_serving_resumes")
         return True
 
-    def _paged_upload(self, pools, slot: int, rows, pos: int):
+    def _paged_upload(self, pools, slot: int, rows, pos: int,
+                      start_row: int = 0):
         """Publish snapshot rows into the slot's freshly allocated blocks
         (host build + one eager scatter per leaf; block tails past `pos`
-        zero-filled, so the upload is also the scrub)."""
-        ids = jnp.asarray(np.asarray(self.kv_pool.block_ids(slot),
-                                     np.int32))
+        zero-filled, so the upload is also the scrub).  `start_row`
+        (block-aligned) skips leading rows whose blocks were ADOPTED
+        from the prefix cache — their device content is already the
+        snapshot's, and a shared block must never be written."""
         bs = self.block_size
-        nb_used = int(ids.shape[0])
+        skip = start_row // bs
+        ids_np = np.asarray(self.kv_pool.block_ids(slot), np.int32)[skip:]
+        nb_used = int(ids_np.shape[0])
+        if nb_used == 0:
+            return pools
+        ids = jnp.asarray(ids_np)
         new_pools = []
         for (kp, vp), (rk, rv) in zip(pools, rows):
             def blocks_of(r, pool):
                 buf = np.zeros((nb_used * bs,) + tuple(pool.shape[2:]),
                                pool.dtype)
-                buf[:r.shape[0]] = r
+                tail = r[start_row:]
+                buf[:tail.shape[0]] = tail
                 return jnp.asarray(
                     buf.reshape((nb_used, bs) + tuple(pool.shape[2:])))
             kp = kp.at[ids].set(blocks_of(rk, kp), mode="drop")
@@ -2025,9 +2310,12 @@ class ServingEngine:
             slot_arg = jnp.int32(0)
         ids = np.full((1, bucket), self.pad_token_id, np.int32)
         zero_key = jnp.asarray(np.zeros(self._key_width, np.uint32))
-        common = (jnp.asarray(ids), slot_arg, jnp.int32(1), zero_key,
-                  jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0),
-                  jnp.asarray(True))
+        plen_args = ((jnp.int32(1), jnp.int32(0))   # plen, cached_len
+                     if self.prefix_cache is not None
+                     else (jnp.int32(1),))
+        common = (jnp.asarray(ids), slot_arg) + plen_args + (
+            zero_key, jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0),
+            jnp.asarray(True))
         if self.draft_model is not None:
             return (self._state, self._dstate, self._pools,
                     self._draft_pools) + common
@@ -2116,6 +2404,12 @@ class ServingEngine:
             sources["decode"] = (
                 "program_set:stablehlo" if isinstance(fn, LoadedProgram)
                 else "traced")
+        if self._cow_fn is not None:
+            # precompile the COW block copy with the sentinel dst (mode=
+            # "drop" makes it a no-op) so the first real COW pays no trace
+            self._pools = self._cow_fn(self._pools, jnp.int32(0),
+                                       jnp.int32(self.kv_pool.num_blocks))
+            sources["cow_copy"] = "traced"
         self._warm = True
         self._warm_marks = self._compile_marks()
         report = {"seconds": time.perf_counter() - t0,
@@ -2221,10 +2515,13 @@ class ServingEngine:
         if self.kv != "paged":
             return {"kind": "fixed", "max_slots": self.max_slots,
                     "pool_len": self._pool_len}
-        return {"kind": "paged", **self.kv_pool.stats(),
-                "oom_preempts": self._oom_preempts,
-                "oom_failed": self._oom_failed,
-                "oom_paused": len(self._oom_paused)}
+        out = {"kind": "paged", **self.kv_pool.stats(),
+               "oom_preempts": self._oom_preempts,
+               "oom_failed": self._oom_failed,
+               "oom_paused": len(self._oom_paused)}
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = self.prefix_cache.stats()
+        return out
 
     def _spec_metrics(self):
         if self.draft_model is None:

@@ -1158,6 +1158,8 @@ class FleetRouter:
     def __init__(self, replicas=(),
                  slow_threshold_ms: Optional[float] = None,
                  affinity: bool = True, max_sessions: int = 4096,
+                 prefix_affinity: bool = False,
+                 prefix_affinity_tokens: int = 32,
                  heartbeat_timeout_s: Optional[float] = 10.0,
                  kill_grace_s: float = 2.0,
                  restart_backoff: Optional[RestartBackoff] = None,
@@ -1171,6 +1173,12 @@ class FleetRouter:
         for spec in workers:
             self.manager.add_worker(spec)
         self._affinity_enabled = bool(affinity)
+        # prefix-affine routing (opt-in): sessionless requests pin by a
+        # hash of (tenant, leading prompt tokens), so templated traffic
+        # concentrates where its cached prefix blocks live — same LRU
+        # map, same eviction policy, same fence re-homing as sessions
+        self._prefix_affinity = bool(prefix_affinity)
+        self._prefix_tokens = max(1, int(prefix_affinity_tokens))
         # LRU-bounded: one entry per live session key, refreshed on use —
         # a long-lived fleet serving millions of distinct users must not
         # grow an entry per user ever seen
@@ -1308,13 +1316,38 @@ class FleetRouter:
         return reps[0].engine.make_request(prompt, max_new_tokens,
                                            **kwargs)
 
+    def _affinity_key(self, req: Request) -> Optional[str]:
+        """The routing-affinity key: an explicit session always wins;
+        with `prefix_affinity` on, a sessionless request pins by a hash
+        of its tenant + leading prompt tokens (the same prefix the radix
+        cache indexes), so warm prefixes land where their blocks live."""
+        if req.session:
+            return req.session
+        if not self._prefix_affinity:
+            return None
+        import hashlib
+        import numpy as np
+        head = np.asarray(req.prompt[:self._prefix_tokens], np.int32)
+        h = hashlib.blake2b((req.tenant or "").encode() + b"\0"
+                            + head.tobytes(), digest_size=8)
+        return "px:" + h.hexdigest()
+
+    def set_share_groups(self, groups: Dict[str, str]):
+        """Broadcast the gateway's tenant -> KV share-group mapping to
+        every replica engine that supports a prefix cache."""
+        for rep in self.manager.replicas(_LIVE):
+            fn = getattr(rep.engine, "set_share_groups", None)
+            if fn is not None:
+                fn(groups)
+
     def try_admit(self, req: Request, resp: Response) -> bool:
         """Place the request NOW on the best replica (affinity, then
         least-loaded) — the gateway's admission path; must run on the
         driving thread."""
-        for rep in self._route_order(req.session):
+        akey = self._affinity_key(req)
+        for rep in self._route_order(akey):
             if rep.engine.try_admit(req, resp):
-                self._note_affinity(req.session, rep.id)
+                self._note_affinity(akey, rep.id)
                 return True
         return False
 
@@ -1378,14 +1411,15 @@ class FleetRouter:
         replica later dies (failover / resubmit / typed error)."""
         req, resp = self.make_request(prompt, max_new_tokens, **kwargs)
         last_exc = None
-        for rep in self._route_order(req.session):
+        akey = self._affinity_key(req)
+        for rep in self._route_order(akey):
             try:
                 rep.engine.scheduler.submit(req, resp, block=block,
                                             timeout=timeout)
             except QueueFullError as e:
                 last_exc = e
                 continue
-            self._note_affinity(req.session, rep.id)
+            self._note_affinity(akey, rep.id)
             self._work.set()
             return resp
         raise last_exc or UnavailableError(
@@ -1534,6 +1568,7 @@ class FleetRouter:
             "routable": len(self.manager.routable()),
             "live": len(live),
             "sessions": len(self._affinity),
+            "prefix_affinity": self._prefix_affinity,
             "max_slots": self.max_slots,
             "warm": self.warm,
             "post_warmup_compiles": (self.post_warmup_compiles()

@@ -208,6 +208,14 @@ class ServingGateway:
         self._n = {"requests": 0, "admitted": 0, "shed": 0,
                    "rate_limited": 0, "preempted": 0, "resumed": 0,
                    "rejected_invalid": 0}
+        # tenancy IS the prefix-cache share policy: tenants naming a
+        # kv_share_group share cached KV; everyone else stays private
+        groups = {name: cfg.kv_share_group
+                  for name, cfg in (tenants or {}).items()
+                  if cfg.kv_share_group is not None}
+        set_groups = getattr(engine, "set_share_groups", None)
+        if groups and set_groups is not None:
+            set_groups(groups)
 
     # ------------------------------------------------------------------
     # submission (caller threads)
@@ -843,9 +851,14 @@ class ServingGateway:
                 # the last observer — alarm, don't reassure
                 if fleet is not None and fleet.get("all_routable_stale"):
                     status = 503
+                # prefix-cache effectiveness (engine-fronted; a fleet's
+                # per-replica caches report through fleet metrics)
+                pc = getattr(self.engine, "prefix_cache", None)
+                prefix = pc.stats() if pc is not None else None
                 return status, "application/json", json.dumps({
                     "ok": status == 200,
                     "fleet": fleet,
+                    "prefix_cache": prefix,
                     # readiness: warm=True means every serving program is
                     # precompiled (engine.warmup ran) — no admitted
                     # request will ever pay a trace

@@ -72,21 +72,27 @@ class KVPoolExhaustedError(ResourceExhaustedError):
     code = "ResourceExhausted"
 
 
-_obs_handles = None
+_obs_handles: Dict[str, tuple] = {}
 
 
-def _obs():
-    """(blocks_used_gauge, blocks_free_gauge) — cached handles
-    (registry.reset() zeroes values in place)."""
-    global _obs_handles
-    if _obs_handles is None:
+def _obs(pool: str):
+    """(blocks_used_gauge, blocks_free_gauge) bound to this pool's
+    `pool=` label — cached handles (registry.reset() zeroes values in
+    place).  Labeling keeps two allocators in one process (a target and
+    a draft pool, or two fleet replicas) from overwriting each other in
+    /metrics."""
+    handles = _obs_handles.get(pool)
+    if handles is None:
         from ..observability import metrics as _m
-        _obs_handles = (
-            _m.gauge("serving_kv_blocks_used",
-                     "paged KV pool blocks currently allocated"),
-            _m.gauge("serving_kv_blocks_free",
-                     "paged KV pool blocks free (after any fault cap)"))
-    return _obs_handles
+        used = _m.gauge("serving_kv_blocks_used",
+                        "paged KV pool blocks currently allocated",
+                        labelnames=("pool",))
+        free = _m.gauge("serving_kv_blocks_free",
+                        "paged KV pool blocks free (after any fault cap)",
+                        labelnames=("pool",))
+        handles = _obs_handles[pool] = (used.labels(pool=pool),
+                                        free.labels(pool=pool))
+    return handles
 
 
 class PagedKVPool:
@@ -105,10 +111,12 @@ class PagedKVPool:
     All mutation happens on the engine loop thread; the lock only guards
     the metric snapshots other threads read."""
 
-    def __init__(self, num_blocks: int, block_size: int, pool_len: int):
+    def __init__(self, num_blocks: int, block_size: int, pool_len: int,
+                 name: str = "target"):
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.pool_len = int(pool_len)
+        self.name = str(name)
         if self.block_size < 1:
             raise InvalidArgumentError(
                 f"block_size must be >= 1, got {self.block_size}")
@@ -122,6 +130,21 @@ class PagedKVPool:
         # first (deterministic recycling — the scrub proof relies on it)
         self._free: List[int] = list(range(self.num_blocks - 1, -1, -1))
         self._tables: Dict[int, List[int]] = {}
+        # block id -> number of slot tables referencing it.  Without a
+        # prefix cache every block has at most one reference and the
+        # accounting reduces to the PR-8 free-list; with one, shared
+        # prefix blocks carry ref > 1 and `free` only recycles a block
+        # when its LAST reference drops.
+        self._refs: Dict[int, int] = {}
+        self._live = 0          # distinct blocks with ref > 0
+        # blocks owned by the prefix cache: at ref 0 they stay RESIDENT
+        # (evictable, not free-listed) until the cache evicts them
+        self._cached: set = set()
+        # prefix-cache hooks (engine loop thread only): reclaim(n) asks
+        # the cache to evict >= n evictable blocks back to the free
+        # list; unref(ids) tells it these cached blocks just hit ref 0
+        self._on_reclaim = None
+        self._on_cached_unref = None
         # debug/test aid: the most recent block ids handed out, in order —
         # the scrub-on-recycle proof reads which blocks were RE-served
         self.served_log: "deque[int]" = deque(maxlen=512)
@@ -129,6 +152,11 @@ class PagedKVPool:
         # caches its device-side (tables, active) batch inputs against it
         # so unchanged ticks re-upload nothing
         self.version = 0
+
+    def set_cache_hooks(self, reclaim, unref):
+        """Attach a prefix cache (serving/prefix_cache.py)."""
+        self._on_reclaim = reclaim
+        self._on_cached_unref = unref
 
     # -- capacity ------------------------------------------------------------
     def capacity(self) -> int:
@@ -138,11 +166,24 @@ class PagedKVPool:
         return self.num_blocks if cap is None else min(self.num_blocks, cap)
 
     def used_blocks(self) -> int:
+        """Distinct blocks with at least one table reference.  Cached
+        refcount-0 blocks are NOT used: they are resident but evictable,
+        so they count as free for admission (block-aware gate)."""
         with self._lock:
-            return sum(len(t) for t in self._tables.values())
+            return self._live
 
     def free_blocks(self) -> int:
         return max(0, self.capacity() - self.used_blocks())
+
+    def block_ref(self, block: int) -> int:
+        """Live reference count of one block (0 = unreferenced)."""
+        with self._lock:
+            return self._refs.get(block, 0)
+
+    def cached_blocks(self) -> int:
+        """Blocks currently owned by the prefix cache (any refcount)."""
+        with self._lock:
+            return len(self._cached)
 
     def blocks_for(self, rows: int) -> int:
         """Blocks needed to hold `rows` KV rows."""
@@ -159,7 +200,10 @@ class PagedKVPool:
     def ensure(self, slot: int, rows: int) -> bool:
         """Grow slot's table to cover `rows` rows (clamped to the
         per-slot maximum).  Returns False — nothing allocated — when the
-        free-list (after the fault cap) cannot supply the growth."""
+        capacity (after the fault cap) cannot supply the growth.  When
+        the free list is short but a prefix cache holds evictable
+        refcount-0 blocks, the cache is asked to evict (LRU order) —
+        cached-but-unreferenced blocks are reclaimable capacity."""
         rows = min(int(rows), self.pool_len)
         with self._lock:
             table = self._tables.setdefault(slot, [])
@@ -167,16 +211,30 @@ class PagedKVPool:
                        self.max_blocks_per_slot) - len(table)
             if need <= 0:
                 return True
-            used = sum(len(t) for t in self._tables.values())
-            if used + need > self.capacity() or need > len(self._free):
+            if self._live + need > self.capacity():
+                return False
+        if need > len(self._free):
+            self._reclaim(need - len(self._free))
+        with self._lock:
+            if need > len(self._free):
                 return False
             for _ in range(need):
                 b = self._free.pop()
                 table.append(b)
+                self._refs[b] = 1
+                self._live += 1
                 self.served_log.append(b)
             self.version += 1
         self._note_gauges()
         return True
+
+    def _reclaim(self, shortfall: int):
+        """Ask the prefix cache (if attached) to evict at least
+        `shortfall` evictable blocks back to the free list.  Engine loop
+        thread only; called outside the lock (the cache calls back into
+        `release_cached`)."""
+        if self._on_reclaim is not None and shortfall > 0:
+            self._on_reclaim(shortfall)
 
     def alloc(self, slot: int, rows: int) -> bool:
         """Fresh allocation for a slot that must not already hold blocks
@@ -188,16 +246,122 @@ class PagedKVPool:
                     f"{len(self._tables[slot])} blocks")
         return self.ensure(slot, rows)
 
+    # -- prefix-cache sharing ------------------------------------------------
+    def adopt(self, slot: int, block_ids: List[int]) -> bool:
+        """Map already-resident (cached) blocks into an EMPTY slot's
+        table, bumping their refcounts — the warm-prefix admission path.
+        Returns False (nothing mapped) when reviving the refcount-0
+        blocks among them would exceed the live capacity cap."""
+        with self._lock:
+            if self._tables.get(slot):
+                raise InvalidArgumentError(
+                    f"slot {slot} already holds "
+                    f"{len(self._tables[slot])} blocks")
+            revive = sum(1 for b in block_ids if self._refs.get(b, 0) == 0)
+            if self._live + revive > self.capacity():
+                return False
+            table = self._tables[slot] = []
+            for b in block_ids:
+                r = self._refs.get(b, 0)
+                if r == 0:
+                    self._live += 1
+                self._refs[b] = r + 1
+                table.append(b)
+            if table:
+                self.version += 1
+        if block_ids:
+            self._note_gauges()
+        return True
+
+    def cow_last(self, slot: int):
+        """Copy-on-write divergence: replace the LAST block of the
+        slot's table (a shared cached block about to be written) with a
+        fresh private block.  Returns (src, dst) block ids — the caller
+        must copy the device content src -> dst BEFORE any program
+        writes through the table — or None when no block is available.
+        Engine loop thread only: src's content stays intact until a
+        later allocation re-serves it, so the copy is race-free."""
+        with self._lock:
+            table = self._tables.get(slot)
+            if not table:
+                raise InvalidArgumentError(f"slot {slot} holds no blocks")
+            src = table[-1]
+            short = self.capacity() < self._live + 1
+        if short:
+            return None
+        if not self._free:
+            self._reclaim(1)
+        with self._lock:
+            if not self._free:
+                return None
+            dst = self._free.pop()
+            self._refs[dst] = 1
+            self._live += 1
+            self.served_log.append(dst)
+            unref = []
+            r = self._refs.get(src, 1) - 1
+            if r > 0:
+                self._refs[src] = r
+            else:
+                self._refs.pop(src, None)
+                self._live -= 1
+                if src in self._cached:
+                    unref.append(src)
+                else:
+                    self._free.append(src)
+            table[-1] = dst
+            self.version += 1
+        if unref and self._on_cached_unref is not None:
+            self._on_cached_unref(unref)
+        self._note_gauges()
+        return src, dst
+
+    def register_cached(self, block: int):
+        """The prefix cache takes ownership of a block: at ref 0 it will
+        stay resident (evictable) instead of returning to the free
+        list."""
+        with self._lock:
+            self._cached.add(block)
+
+    def release_cached(self, block_ids: List[int]):
+        """The prefix cache evicted these blocks: recycle any that are
+        unreferenced back to the free list (LIFO, so the scrub proof
+        sees them re-served first)."""
+        with self._lock:
+            for b in block_ids:
+                self._cached.discard(b)
+                if self._refs.get(b, 0) == 0:
+                    self._free.append(b)
+        self._note_gauges()
+
     def free(self, slot: int) -> int:
-        """Recycle every block the slot holds; returns how many.  The
-        block CONTENT is scrubbed at re-serve time inside the compiled
-        programs (module docstring) — free itself is pure bookkeeping."""
+        """Drop the slot's reference on every block it holds; returns
+        how many table entries were released.  A block whose LAST
+        reference drops is recycled to the free list — unless the
+        prefix cache owns it, in which case it stays device-resident
+        (evictable) and the cache is notified.  Shared blocks other
+        slots still reference are never double-freed.  Block CONTENT is
+        scrubbed at re-serve time inside the compiled programs (module
+        docstring) — free itself is pure bookkeeping."""
         with self._lock:
             table = self._tables.pop(slot, [])
-            self._free.extend(table)
             n = len(table)
+            unref = []
+            for b in table:
+                r = self._refs.get(b, 1) - 1
+                if r > 0:
+                    self._refs[b] = r
+                    continue
+                self._refs.pop(b, None)
+                self._live -= 1
+                if b in self._cached:
+                    unref.append(b)
+                else:
+                    self._free.append(b)
             if n:
                 self.version += 1
+        if unref and self._on_cached_unref is not None:
+            self._on_cached_unref(unref)
         if n:
             self._note_gauges()
         return n
@@ -230,15 +394,21 @@ class PagedKVPool:
 
     def stats(self) -> Dict:
         used = self.used_blocks()
-        return {"num_blocks": self.num_blocks,
+        with self._lock:
+            shared = sum(1 for r in self._refs.values() if r > 1)
+            cached = len(self._cached)
+        return {"pool": self.name,
+                "num_blocks": self.num_blocks,
                 "block_size": self.block_size,
                 "capacity": self.capacity(),
                 "used_blocks": used,
                 "free_blocks": self.free_blocks(),
+                "shared_blocks": shared,
+                "cached_blocks": cached,
                 "max_blocks_per_slot": self.max_blocks_per_slot}
 
     def _note_gauges(self):
-        used_g, free_g = _obs()
+        used_g, free_g = _obs(self.name)
         used_g.set(self.used_blocks())
         free_g.set(self.free_blocks())
 

@@ -1,0 +1,262 @@
+"""Prefix-aware KV reuse: a radix index over cached paged-KV blocks.
+
+At scale most prompts share long prefixes — system prompts, few-shot
+templates, multi-turn history — yet the PR-8 paged engine recomputes and
+re-stores KV for every one of those tokens on every admission.  The
+block-table indirection is exactly the substrate vLLM's PagedAttention
+(Kwon et al., 2023) and SGLang's RadixAttention (Zheng et al., 2023) use
+to turn the pool from a per-request scratchpad into a shared cache:
+
+- **Refcounted blocks** (kv_pool.py): a block freed by one slot stays
+  device-resident while any other slot references it, and — once this
+  cache owns it — while it remains cache-resident at refcount 0, until
+  LRU eviction reclaims it for the free list.
+- **Radix index** (here): a trie over FULL prompt blocks.  Each node is
+  one block's worth of token ids, keyed by (share key, parent node,
+  exact token bytes) with a rolling blake2b digest chained from the
+  parent for content identity.  `match` walks the longest resident
+  prefix; `insert` registers a freshly prefilled prompt's full blocks.
+  Only full blocks enter the index: a cached block is immutable while
+  resident (decode writes land at positions >= prompt_len, past every
+  full prompt block), so a chain can be mapped into any later slot.
+- **Share policy**: the share key partitions the index — tenant-private
+  by default, opt-in groups via `TenantConfig(kv_share_group=...)`.  A
+  block cached under one key is INVISIBLE to every other key: cross-
+  tenant reuse is impossible by construction, extending the PR-8
+  scrub contract to cached blocks (an evicted block returns to the
+  free list and is scrubbed at re-serve time inside the compiled
+  programs, so recycling across tenants stays leak-free too).
+- **LRU eviction over refcount-0 leaves only**: referenced blocks and
+  interior nodes with resident children are never evicted, so a
+  resident chain is always reachable root-first and parents outlive
+  children.  ``PDTPU_FAULT_PREFIX_EVICT=N`` caps the number of
+  resident refcount-0 cached blocks (consulted live) to force
+  eviction/COW churn on CPU without filling a real pool.
+- **Copy-on-write** (engine policy, `kv_pool.cow_last`): when a prompt
+  is fully block-aligned-cached, its last token's row must be
+  recomputed inside a shared block — the engine allocates a private
+  copy first so shared blocks are never written.
+
+Pure host bookkeeping on the engine loop thread; nothing here is ever
+traced.
+"""
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils import faults
+
+__all__ = ["PrefixCache"]
+
+_obs_handles = None
+
+
+def _obs():
+    """(hits, misses, evictions, cow_copies) counter handles — cached
+    (registry.reset() zeroes values in place)."""
+    global _obs_handles
+    if _obs_handles is None:
+        from ..observability import metrics as _m
+        _obs_handles = (
+            _m.counter("prefix_cache_hits_total",
+                       "prompt blocks served from the prefix cache"),
+            _m.counter("prefix_cache_misses_total",
+                       "prompt blocks prefilled cold (no cached prefix)"),
+            _m.counter("prefix_cache_evictions_total",
+                       "cached blocks LRU-evicted back to the free list"),
+            _m.counter("prefix_cache_cow_copies_total",
+                       "copy-on-write private copies of shared blocks"))
+    return _obs_handles
+
+
+class _Node:
+    """One full block of token ids resident in the cache."""
+
+    __slots__ = ("id", "parent", "key", "block", "digest", "children")
+
+    def __init__(self, node_id: int, parent: int, key, block: int,
+                 digest: bytes):
+        self.id = node_id
+        self.parent = parent      # parent node id (0 = share-key root)
+        self.key = key            # index key, kept for O(1) removal
+        self.block = block        # pool block id holding this KV
+        self.digest = digest      # rolling content hash along the chain
+        self.children = 0
+
+
+class PrefixCache:
+    """Host-side radix index over a ``PagedKVPool``'s cached blocks.
+
+    The engine drives it at three points: ``match`` at admission (and
+    from the admission gate, with ``record=False``), ``insert`` after a
+    successful prefill, and the pool hooks fire on release/allocation
+    pressure.  All mutation happens on the engine loop thread."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self.block_size = pool.block_size
+        self._nodes: Dict[int, _Node] = {}
+        self._index: Dict[Tuple[str, int, bytes], int] = {}
+        self._by_block: Dict[int, int] = {}      # block id -> node id
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self._next_id = 1
+        # host-side tallies (cheap to read; the registry counters mirror
+        # them for /metrics)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.cow_copies = 0
+        pool.set_cache_hooks(reclaim=self._reclaim, unref=self._on_unref)
+
+    # -- lookup --------------------------------------------------------------
+    def match(self, share_key: str, tokens: np.ndarray,
+              record: bool = False) -> List[int]:
+        """Longest resident prefix of `tokens` under `share_key`, as the
+        chain of pool block ids (root-first; each covers one FULL
+        block).  Touches the walked chain's LRU position.  With
+        `record`, tallies block-level hits and misses (the admission
+        path sets it; the admission gate re-matches without counting)."""
+        bs = self.block_size
+        chain: List[int] = []
+        parent = 0
+        nb = len(tokens) // bs
+        for i in range(nb):
+            key = (share_key, parent,
+                   np.asarray(tokens[i * bs:(i + 1) * bs],
+                              np.int32).tobytes())
+            nid = self._index.get(key)
+            if nid is None:
+                break
+            self._lru.move_to_end(nid)
+            chain.append(self._nodes[nid].block)
+            parent = nid
+        if record:
+            h, m = len(chain), nb - len(chain)
+            self.hits += h
+            self.misses += m
+            hits_c, miss_c, _, _ = _obs()
+            if h:
+                hits_c.inc(h)
+            if m:
+                miss_c.inc(m)
+        return chain
+
+    # -- insertion -----------------------------------------------------------
+    def insert(self, share_key: str, tokens: np.ndarray,
+               block_ids: List[int]):
+        """Register a freshly prefilled prompt's FULL blocks.
+        `block_ids` is the owning slot's table prefix (one id per full
+        block of `tokens`).  Existing nodes win — a duplicate block
+        (two slots racing the same cold prefix) stays slot-private and
+        recycles normally on free."""
+        bs = self.block_size
+        parent = 0
+        digest = b""
+        for i in range(len(tokens) // bs):
+            if i >= len(block_ids):
+                break
+            raw = np.asarray(tokens[i * bs:(i + 1) * bs], np.int32).tobytes()
+            key = (share_key, parent, raw)
+            digest = hashlib.blake2b(digest + raw, digest_size=16).digest()
+            nid = self._index.get(key)
+            if nid is None:
+                nid = self._next_id
+                self._next_id += 1
+                node = _Node(nid, parent, key, int(block_ids[i]), digest)
+                self._nodes[nid] = node
+                self._index[key] = nid
+                self._by_block[node.block] = nid
+                if parent:
+                    self._nodes[parent].children += 1
+                self.pool.register_cached(node.block)
+            if nid in self._lru:
+                self._lru.move_to_end(nid)
+            else:
+                self._lru[nid] = None
+            parent = nid
+
+    def note_cow(self):
+        self.cow_copies += 1
+        _obs()[3].inc()
+
+    # -- eviction ------------------------------------------------------------
+    def _evictable(self, node: _Node) -> bool:
+        return node.children == 0 and self.pool.block_ref(node.block) == 0
+
+    def evict(self, n: int) -> List[int]:
+        """Evict up to `n` blocks, oldest evictable leaves first
+        (evicting a leaf can make its parent evictable, so chains drain
+        child-before-parent).  Returns the freed block ids after handing
+        them back to the pool's free list."""
+        freed: List[int] = []
+        while len(freed) < n:
+            victim = None
+            for nid in self._lru:                 # oldest first
+                if self._evictable(self._nodes[nid]):
+                    victim = nid
+                    break
+            if victim is None:
+                break
+            freed.append(self._remove(victim))
+        if freed:
+            self.evictions += len(freed)
+            _obs()[2].inc(len(freed))
+            self.pool.release_cached(freed)
+        return freed
+
+    def _remove(self, nid: int) -> int:
+        node = self._nodes.pop(nid)
+        del self._index[node.key]
+        self._lru.pop(nid, None)
+        self._by_block.pop(node.block, None)
+        if node.parent:
+            parent = self._nodes.get(node.parent)
+            if parent is not None:
+                parent.children -= 1
+        return node.block
+
+    # -- pool hooks ----------------------------------------------------------
+    def _reclaim(self, shortfall: int) -> int:
+        """Pool allocation pressure: free at least `shortfall` blocks if
+        evictable ones exist."""
+        return len(self.evict(shortfall))
+
+    def _on_unref(self, block_ids: List[int]):
+        """Cached blocks just dropped to refcount 0 (still resident).
+        Enforce the live PDTPU_FAULT_PREFIX_EVICT cap."""
+        self.enforce_cap()
+
+    def enforce_cap(self):
+        cap = faults.prefix_evict_cap()
+        if cap is None:
+            return
+        while True:
+            resident0 = sum(1 for node in self._nodes.values()
+                            if self.pool.block_ref(node.block) == 0)
+            if resident0 <= cap or not self.evict(resident0 - cap):
+                break
+
+    # -- views ---------------------------------------------------------------
+    def resident_nodes(self) -> int:
+        return len(self._nodes)
+
+    def block_owner(self, block: int) -> Optional[int]:
+        """Node id owning a block, or None (tests/debug)."""
+        return self._by_block.get(block)
+
+    def hit_rate(self) -> float:
+        total = self.hits + self.misses
+        return self.hits / total if total else 0.0
+
+    def stats(self) -> Dict:
+        return {"nodes": len(self._nodes),
+                "resident_blocks": self.pool.cached_blocks(),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "cow_copies": self.cow_copies,
+                "hit_rate": self.hit_rate()}

@@ -78,19 +78,27 @@ class TenantConfig:
     max_priority    highest priority lane this tenant may use (requests
                     asking for more are clamped — priority is a tenant
                     entitlement, not a caller free-for-all)
+    kv_share_group  prefix-cache share partition.  None (default) keeps
+                    the tenant's cached KV blocks private to it; tenants
+                    naming the same group share each other's cached
+                    prefixes.  Cross-group reuse is impossible by
+                    construction (serving/prefix_cache.py).
     """
 
-    __slots__ = ("rate", "burst", "weight", "max_priority")
+    __slots__ = ("rate", "burst", "weight", "max_priority",
+                 "kv_share_group")
 
     def __init__(self, rate: float = float("inf"),
                  burst: Optional[float] = None, weight: float = 1.0,
-                 max_priority: int = 1):
+                 max_priority: int = 1,
+                 kv_share_group: Optional[str] = None):
         self.rate = float(rate)
         self.burst = burst
         self.weight = float(weight)
         if self.weight <= 0:
             raise ValueError(f"tenant weight must be positive, got {weight}")
         self.max_priority = int(max_priority)
+        self.kv_share_group = kv_share_group
 
     def make_bucket(self) -> TokenBucket:
         return TokenBucket(self.rate, self.burst)

@@ -73,6 +73,14 @@ Injection points (consumed elsewhere in the framework):
                   low-priority run, and the typed KVPoolExhaustedError
                   terminal state.  Arm/disarm takes effect on the next
                   allocator call.  Env: PDTPU_FAULT_KV_EXHAUST="N".
+  prefix_evict    the serving prefix cache caps the number of RESIDENT
+                  refcount-0 cached blocks at N (consulted live on every
+                  release/insert, host-side only — nothing is baked into
+                  any trace), forcing LRU eviction and copy-on-write
+                  churn on CPU without filling a real pool.  N=0 means
+                  nothing stays cached after its last reference drops —
+                  every warm request becomes a cold one.
+                  Env: PDTPU_FAULT_PREFIX_EVICT="N".
   slow_decode     the serving engine sleeps `ms` milliseconds on the host
                   before every `every_n`-th decode call (default every
                   call).  Purely host-side — the compiled decode program
@@ -162,6 +170,7 @@ __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "maybe_kill_mid_save", "backend_down", "nan_logits_request",
            "poison_logits", "slow_decode_config", "maybe_slow_decode",
            "draft_diverge_every", "poison_draft_logits", "kv_exhaust_cap",
+           "prefix_evict_cap",
            "prefetch_stall_config", "maybe_stall_prefetch",
            "row_corrupt_fetch", "replica_crash_config",
            "replica_slow_config", "maybe_slow_replica",
@@ -178,6 +187,7 @@ _ENV = {
     "slow_decode": "PDTPU_FAULT_SLOW_DECODE",
     "draft_diverge": "PDTPU_FAULT_DRAFT_DIVERGE",
     "kv_exhaust": "PDTPU_FAULT_KV_EXHAUST",
+    "prefix_evict": "PDTPU_FAULT_PREFIX_EVICT",
     "prefetch_stall": "PDTPU_FAULT_PREFETCH_STALL",
     "row_corrupt": "PDTPU_FAULT_ROW_CORRUPT",
     "replica_crash": "PDTPU_FAULT_REPLICA_CRASH",
@@ -410,6 +420,19 @@ def kv_exhaust_cap() -> Optional[int]:
     call — pure host bookkeeping, no trace ever sees it — so a running
     engine reacts to arm/disarm immediately."""
     raw = get("kv_exhaust")
+    if not raw:
+        return None
+    return max(0, int(raw))
+
+
+# -- prefix_evict ------------------------------------------------------------
+
+def prefix_evict_cap() -> Optional[int]:
+    """Forced cap on RESIDENT refcount-0 prefix-cache blocks, or None
+    when disarmed.  Consulted LIVE on every cache release/insert — pure
+    host bookkeeping, no trace ever sees it — so a running engine reacts
+    to arm/disarm immediately.  N=0 disables retention entirely."""
+    raw = get("prefix_evict")
     if not raw:
         return None
     return max(0, int(raw))

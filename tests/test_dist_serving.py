@@ -212,6 +212,61 @@ def test_recycled_block_is_scrubbed():
         assert not leaked, f"block {b} leaked prior-tenant KV {leaked}"
 
 
+@pytest.mark.prefix_cache
+def test_cached_block_never_crosses_tenants_and_scrubs_on_recycle():
+    """The scrub contract extended to CACHED blocks: a block tenant A's
+    prompt left resident in the prefix cache (1) is never mapped into
+    tenant B's block table for the SAME prompt without a share policy,
+    and (2) once evicted back to the free list and re-served, carries
+    nothing of A on device."""
+    paddle.seed(3)
+    m = MarkerModel()
+    m.eval()
+    eng = ServingEngine(m, max_slots=1, max_len=32, prefill_buckets=(8,),
+                        decode_chunk=2, kv="paged", block_size=4,
+                        num_blocks=8, prefix_cache=True)
+    prompt_a = np.arange(1, 9)                     # 2 full cached blocks
+    ra = eng.submit(prompt_a, max_new_tokens=2, tenant="a")
+    eng.run_until_drained(timeout=60)
+    assert ra.done() and eng.kv_pool.used_blocks() == 0
+    a_chain = eng.prefix_cache.match("a", prompt_a)
+    assert len(a_chain) == 2
+    # (1) tenant B, SAME prompt: admission must not adopt A's blocks
+    rb = eng.submit(prompt_a, max_new_tokens=2, tenant="b")
+    eng.run_until_drained(timeout=60)
+    assert rb.done()
+    b_chain = eng.prefix_cache.match("b", prompt_a)
+    assert b_chain and set(b_chain).isdisjoint(a_chain), \
+        "tenant B's table reused tenant A's cached blocks"
+    # (2) evict everything, then a third tenant recycles the blocks:
+    # the in-program scrub must erase the cached markers
+    faults.enable("prefix_evict", "0")
+    try:
+        evicted = set(a_chain) | set(b_chain)
+        eng.prefix_cache.enforce_cap()
+        assert eng.kv_pool.cached_blocks() == 0
+        k_before = np.asarray(eng._pools[0][0])
+        assert all(np.any(k_before[b] != 0) for b in evicted), \
+            "sanity: evicted blocks still hold markers on device"
+        mark = len(eng.kv_pool.served_log)
+        prompt_c = np.arange(9, 13)
+        rc = eng.submit(prompt_c, max_new_tokens=6, tenant="c")
+        eng.run_until_drained(timeout=60)
+        assert rc.done()
+        served = set(list(eng.kv_pool.served_log)[mark:])
+        assert served & evicted, "sanity: recycling must reuse evictees"
+        k = np.asarray(eng._pools[0][0])
+        allowed = ({0.0, 1.0} | {float(v + 1) for v in prompt_c}
+                   | {float(t + 1) for t in rc.tokens()})
+        for b in served:
+            vals = set(np.unique(k[b]).tolist())
+            leaked = vals - allowed
+            assert not leaked, \
+                f"recycled cached block {b} leaked KV {leaked}"
+    finally:
+        faults.reset()
+
+
 # ---------------------------------------------------------------------------
 # paged engine: parity, compile bound, overflow, preempt/restore
 # ---------------------------------------------------------------------------

@@ -1,0 +1,391 @@
+"""Prefix-aware KV reuse (ISSUE-17): radix cache over paged blocks,
+copy-on-write, and prefix-affine fleet routing.
+
+Covers: the radix index units (full-block matching, share-key
+partitioning, LRU eviction over refcount-0 leaves with child-before-
+parent drain, first-wins duplicate insertion), the allocator's refcount
+lifecycle (adopt/free/shared accounting, cached-counts-as-free
+admission, reclaim-under-pressure, cow_last), warm-hit stream
+bit-identity (greedy AND sampled, mixed warm/cold traffic, compile
+bound unchanged at len(buckets)+1 with ZERO post-warmup compiles),
+COW parity + counters, tenant isolation of CACHED blocks (a block
+cached by tenant A is never mapped into tenant B's table without an
+explicit share group), the PDTPU_FAULT_PREFIX_EVICT live cap, paged
+preempt/restore re-pinning, and the fleet router's prefix-hash affinity
+(bounded LRU shared with session affinity, re-homing on drain)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import models
+from paddle_tpu.serving import (FleetRouter, PagedKVPool, PrefixCache,
+                                ServingEngine)
+from paddle_tpu.utils import faults
+
+pytestmark = pytest.mark.prefix_cache
+
+
+def tiny_gpt():
+    cfg = models.GPTConfig(vocab_size=13, hidden_size=16,
+                           num_hidden_layers=2, num_attention_heads=2,
+                           hidden_dropout_prob=0.0,
+                           attention_probs_dropout_prob=0.0,
+                           max_position_embeddings=64)
+    paddle.seed(7)
+    m = models.GPTForPretraining(cfg)
+    m.eval()
+    return m
+
+
+def solo(model, prompt, max_new, **kw):
+    out, _ = model.generate(paddle.to_tensor(
+        np.asarray(prompt, np.int32)[None]), max_new_tokens=max_new, **kw)
+    return np.asarray(out.numpy())[0].tolist()
+
+
+def prefix_engine(m, **kw):
+    args = dict(max_slots=3, max_len=48, prefill_buckets=(8, 16),
+                decode_chunk=4, kv="paged", block_size=8,
+                prefix_cache=True)
+    args.update(kw)
+    return ServingEngine(m, **args)
+
+
+# ---------------------------------------------------------------------------
+# radix index units
+# ---------------------------------------------------------------------------
+
+def test_radix_match_insert_and_share_partition():
+    pool = PagedKVPool(num_blocks=16, block_size=4, pool_len=32)
+    cache = PrefixCache(pool)
+    toks = np.arange(12, dtype=np.int32)          # 3 full blocks
+    assert pool.alloc(0, rows=12)
+    ids = pool.block_ids(0)
+    cache.insert("a", toks, ids)
+    assert cache.resident_nodes() == 3
+    # exact walk, longest-prefix, partial-block tail ignored
+    assert cache.match("a", toks) == ids
+    assert cache.match("a", toks[:8]) == ids[:2]
+    assert cache.match("a", np.concatenate([toks[:8], [99, 98, 97, 96]])
+                       ) == ids[:2]
+    assert cache.match("a", toks[:6]) == ids[:1]  # 1 full block only
+    # divergence in the FIRST block matches nothing
+    other = toks.copy()
+    other[0] = 9
+    assert cache.match("a", other) == []
+    # share-key partitioning: tenant b sees NOTHING of tenant a
+    assert cache.match("b", toks) == []
+    # first-wins: re-inserting the same content under new blocks keeps
+    # the original nodes (the duplicate stays slot-private)
+    assert pool.alloc(1, rows=12)
+    cache.insert("a", toks, pool.block_ids(1))
+    assert cache.resident_nodes() == 3
+    assert cache.match("a", toks) == ids
+
+
+def test_lru_eviction_is_leaf_first_and_refcount_aware():
+    pool = PagedKVPool(num_blocks=8, block_size=4, pool_len=32)
+    cache = PrefixCache(pool)
+    toks = np.arange(12, dtype=np.int32)
+    assert pool.alloc(0, rows=12)
+    ids = pool.block_ids(0)
+    cache.insert("t", toks, ids)
+    # while slot 0 still references the chain nothing is evictable
+    assert cache.evict(3) == []
+    pool.free(0)
+    assert pool.cached_blocks() == 3 and pool.used_blocks() == 0
+    # chains drain child-before-parent: deepest leaf goes first
+    freed = cache.evict(1)
+    assert freed == [ids[2]]
+    assert cache.match("t", toks) == ids[:2]
+    # a re-adopted chain pins its blocks against eviction again
+    assert pool.adopt(1, cache.match("t", toks))
+    assert cache.evict(2) == []
+    pool.free(1)
+    assert len(cache.evict(2)) == 2
+    assert cache.resident_nodes() == 0
+    assert pool.free_blocks() == 8
+
+
+def test_refcount_lifecycle_and_cached_counts_as_free():
+    pool = PagedKVPool(num_blocks=8, block_size=4, pool_len=32)
+    cache = PrefixCache(pool)
+    toks = np.arange(8, dtype=np.int32)
+    assert pool.alloc(0, rows=8)
+    ids = pool.block_ids(0)
+    cache.insert("t", toks, ids)
+    # adopt shares the SAME device blocks: refcount 2, live unchanged
+    assert pool.adopt(1, ids)
+    assert pool.block_ref(ids[0]) == 2
+    assert pool.used_blocks() == 2
+    assert pool.stats()["shared_blocks"] == 2
+    # one holder frees: blocks stay resident (cached), ref drops to 1
+    pool.free(0)
+    assert pool.block_ref(ids[0]) == 1 and pool.used_blocks() == 2
+    pool.free(1)
+    # cached refcount-0 blocks count as FREE for admission...
+    assert pool.used_blocks() == 0
+    assert pool.free_blocks() == 8
+    assert pool.cached_blocks() == 2
+    # ...and allocation pressure reclaims them through the cache hook
+    assert pool.alloc(2, rows=32)          # needs all 8 blocks
+    assert pool.cached_blocks() == 0 and cache.resident_nodes() == 0
+    assert cache.evictions == 2
+
+
+def test_cow_last_gives_private_copy():
+    pool = PagedKVPool(num_blocks=4, block_size=4, pool_len=16)
+    cache = PrefixCache(pool)
+    toks = np.arange(8, dtype=np.int32)
+    assert pool.alloc(0, rows=8)
+    ids = pool.block_ids(0)
+    cache.insert("t", toks, ids)
+    assert pool.adopt(1, ids)
+    src_dst = pool.cow_last(1)
+    assert src_dst is not None
+    src, dst = src_dst
+    assert src == ids[1] and dst not in ids
+    assert pool.block_ids(1) == [ids[0], dst]
+    # the shared source lost one reference but stays cache-resident
+    assert pool.block_ref(src) == 1 and src in pool._cached
+    pool.free(1)
+    pool.free(0)
+    assert pool.used_blocks() == 0
+    assert pool.cached_blocks() == 2
+
+
+# ---------------------------------------------------------------------------
+# engine: warm-hit bit-identity, COW parity, zero post-warmup compiles
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def warm_setup():
+    m = tiny_gpt()
+    eng = prefix_engine(m)
+    eng.warmup()
+    cold = ServingEngine(m, max_slots=3, max_len=48,
+                         prefill_buckets=(8, 16), decode_chunk=4,
+                         kv="paged", block_size=8)
+    cold.warmup()
+    return m, eng, cold
+
+
+def test_warm_streams_bit_identical_zero_post_warmup_compiles(warm_setup):
+    """Mixed warm/cold greedy+sampled traffic: every stream bit-identical
+    to its oracle, cache hits actually happen, and NOTHING compiles
+    after warmup — engine counters and the program registry agree."""
+    from paddle_tpu import observability
+    from paddle_tpu.core import op as core_op
+    m, eng, cold = warm_setup
+    reg = observability.get_program_registry()
+
+    def serving_compiles():
+        return {k: v["compiles"] for k, v in reg.snapshot().items()
+                if k.startswith("serving_")}
+
+    before = (eng.compile_counts(), serving_compiles(),
+              core_op.dispatch_cache_stats()["misses"])
+    rng = np.random.RandomState(4)
+    template = rng.randint(0, 13, (16,))
+    # cold leg populates the cache
+    r0 = eng.submit(template.copy(), max_new_tokens=6)
+    eng.run_until_drained(timeout=240)
+    assert r0.tokens() == solo(m, template, 6)
+    hits0 = eng.prefix_cache.hits
+    # warm leg: shared template + divergent suffixes, mixed greedy and
+    # sampled, interleaved with a cold (uncached) prompt
+    warm_prompts = [np.concatenate([template[:8], rng.randint(0, 13, (n,))])
+                    for n in (3, 5, 7)]
+    cold_prompt = rng.randint(0, 13, (11,))
+    greedy = [eng.submit(p, max_new_tokens=6) for p in warm_prompts]
+    outsider = eng.submit(cold_prompt, max_new_tokens=6)
+    kw = dict(max_new_tokens=5, decode_strategy="sampling",
+              temperature=0.8, top_k=4, seed=11)
+    sampled = eng.submit(warm_prompts[0], **kw)
+    eng.run_until_drained(timeout=240)
+    for p, r in zip(warm_prompts, greedy):
+        assert r.tokens(timeout=5) == solo(m, p, 6)
+    assert outsider.tokens(timeout=5) == solo(m, cold_prompt, 6)
+    # sampled warm parity: the no-cache paged engine is the oracle
+    oracle = cold.submit(warm_prompts[0], **kw)
+    cold.run_until_drained(timeout=240)
+    assert sampled.tokens(timeout=5) == oracle.tokens(timeout=5)
+    assert eng.prefix_cache.hits > hits0, "warm legs must hit the cache"
+    after = (eng.compile_counts(), serving_compiles(),
+             core_op.dispatch_cache_stats()["misses"])
+    assert after == before, "warm/cold mix must never compile post-warmup"
+    cc = eng.compile_counts()
+    assert cc["total"] <= cc["bound"] == len(eng.buckets) + 1
+    assert eng.kv_pool.used_blocks() == 0
+
+
+def test_fully_cached_prompt_takes_cow_path(warm_setup):
+    m, eng, _ = warm_setup
+    rng = np.random.RandomState(9)
+    p = rng.randint(0, 13, (16,))          # block-aligned: full-block COW
+    want = solo(m, p, 6)
+    r1 = eng.submit(p, max_new_tokens=6)
+    eng.run_until_drained(timeout=240)
+    assert r1.tokens() == want
+    cows = eng.prefix_cache.cow_copies
+    r2 = eng.submit(p, max_new_tokens=6)
+    eng.run_until_drained(timeout=240)
+    assert r2.tokens() == want
+    assert eng.prefix_cache.cow_copies == cows + 1
+    assert eng.kv_pool.used_blocks() == 0
+    stats = eng.metrics()["kv_pool"]["prefix_cache"]
+    assert stats["cow_copies"] == eng.prefix_cache.cow_copies
+    assert stats["hit_rate"] > 0
+
+
+def test_preempt_restore_repins_prefix(warm_setup):
+    """A preempted warm run restores bit-identically: the shared prefix
+    is re-adopted from the local cache (not re-uploaded) and nothing
+    double-frees at drain."""
+    m, eng, _ = warm_setup
+    rng = np.random.RandomState(13)
+    template = rng.randint(0, 13, (16,))
+    warm = eng.submit(template.copy(), max_new_tokens=1)
+    eng.run_until_drained(timeout=240)
+    p = np.concatenate([template[:8], rng.randint(0, 13, (4,))])
+    want = solo(m, p, 8)
+    r = eng.submit(p, max_new_tokens=8)
+    for _ in range(20):
+        eng.step()
+        if eng._slots:
+            break
+    slot = next(iter(eng._slots))
+    paused = eng.preempt_slot(slot)
+    assert eng.kv_pool.used_blocks() == 0
+    assert eng.restore_run(paused)
+    eng.run_until_drained(timeout=240)
+    assert warm.done() and r.tokens() == want
+    assert eng.kv_pool.used_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# tenant isolation + share policy
+# ---------------------------------------------------------------------------
+
+def test_tenant_isolation_and_share_groups(warm_setup):
+    """A block cached by tenant A is NEVER mapped into tenant B's table
+    without an explicit share group; with one, B reuses A's blocks.
+    Runs on the shared module engine: tenant partitions are independent
+    of whatever the default share key already cached."""
+    m, eng, _ = warm_setup
+    rng = np.random.RandomState(21)
+    p = rng.randint(0, 13, (16,))
+    want = solo(m, p, 4)
+    ra = eng.submit(p.copy(), max_new_tokens=4, tenant="alice")
+    eng.run_until_drained(timeout=240)
+    assert ra.tokens() == want
+    a_chain = eng.prefix_cache.match("alice", p)
+    assert len(a_chain) == 2
+    # tenant B: same prompt, zero hits, disjoint blocks
+    hits = eng.prefix_cache.hits
+    rb = eng.submit(p.copy(), max_new_tokens=4, tenant="bob")
+    eng.run_until_drained(timeout=240)
+    assert rb.tokens() == want
+    assert eng.prefix_cache.hits == hits, "cross-tenant hit is a leak"
+    b_chain = eng.prefix_cache.match("bob", p)
+    assert b_chain and set(b_chain).isdisjoint(a_chain)
+    # explicit share group: carol and alice pool their cached prefixes
+    eng.set_share_groups({"alice": "team", "carol": "team"})
+    rc = eng.submit(p.copy(), max_new_tokens=4, tenant="carol")
+    eng.run_until_drained(timeout=240)
+    assert rc.tokens() == want
+    # alice's blocks moved under the "team" key only going FORWARD; the
+    # pre-group blocks stay under "alice" — carol prefilled cold into
+    # the team partition and future alice traffic shares it
+    hits = eng.prefix_cache.hits
+    ra2 = eng.submit(p.copy(), max_new_tokens=4, tenant="alice")
+    eng.run_until_drained(timeout=240)
+    assert ra2.tokens() == want
+    assert eng.prefix_cache.hits > hits, "share group must enable reuse"
+    assert eng.kv_pool.used_blocks() == 0
+
+
+# ---------------------------------------------------------------------------
+# fault knob: PDTPU_FAULT_PREFIX_EVICT
+# ---------------------------------------------------------------------------
+
+@pytest.mark.faults
+def test_prefix_evict_fault_cap_is_live(warm_setup):
+    # shared module engine: the cap applies to however much the earlier
+    # tests left resident, which is exactly what a live knob must handle
+    m, eng, _ = warm_setup
+    rng = np.random.RandomState(31)
+    p = rng.randint(0, 13, (16,))
+    r = eng.submit(p.copy(), max_new_tokens=4)
+    eng.run_until_drained(timeout=240)
+    assert r.done() and eng.kv_pool.cached_blocks() >= 2
+    faults.enable("prefix_evict", "1")
+    try:
+        # the cap is consulted LIVE at the next release/insert
+        r2 = eng.submit(rng.randint(0, 13, (16,)), max_new_tokens=4)
+        eng.run_until_drained(timeout=240)
+        assert r2.done()
+        assert eng.kv_pool.cached_blocks() <= 1
+        faults.enable("prefix_evict", "0")
+        r3 = eng.submit(rng.randint(0, 13, (16,)), max_new_tokens=4)
+        eng.run_until_drained(timeout=240)
+        assert r3.done()
+        assert eng.kv_pool.cached_blocks() == 0, "N=0 disables retention"
+    finally:
+        faults.reset()
+    assert eng.kv_pool.used_blocks() == 0
+    assert eng.prefix_cache.evictions >= 2
+
+
+# ---------------------------------------------------------------------------
+# fleet: prefix-affine routing
+# ---------------------------------------------------------------------------
+
+def test_fleet_prefix_affinity_routes_and_rehomes():
+    """Sessionless requests sharing a prompt prefix pin to ONE replica
+    (where the cached blocks live); the pin lives in the same bounded
+    LRU as session affinity and re-homes when the replica drains."""
+    m = tiny_gpt()
+    # single prefill bucket: the routing claim needs two replicas, not
+    # two program families — keep tier-1 compile time down
+    engines = [prefix_engine(m, max_slots=2, prefill_buckets=(16,))
+               for _ in range(2)]
+    fleet = FleetRouter(engines, prefix_affinity=True,
+                        prefix_affinity_tokens=8)
+    fleet.warmup()
+    try:
+        rng = np.random.RandomState(41)
+        template = rng.randint(0, 13, (16,))
+        homes = set()
+        for i in range(4):
+            p = np.concatenate([template[:8], rng.randint(0, 13, (5,))])
+            r = fleet.submit(p, 4)
+            fleet.run_until_drained(timeout=240)
+            assert r.done()
+            key = [k for k in fleet._affinity if k.startswith("px:")]
+            assert len(key) == 1, "one prefix, one affinity entry"
+            homes.add(fleet._affinity[key[0]])
+        assert len(homes) == 1, "same prefix must pin to one replica"
+        home = homes.pop()
+        # an explicit session still wins over the prefix hash
+        rs = fleet.submit(template.copy(), 4, session="u1")
+        fleet.run_until_drained(timeout=240)
+        assert rs.done() and "u1" in fleet._affinity
+        # fence the affine replica: pins re-home, traffic keeps flowing
+        fleet.drain(home)
+        fleet.run_until_drained(timeout=240)
+        assert all(rid != home for rid in fleet._affinity.values())
+        r = fleet.submit(np.concatenate([template[:8], [1, 2, 3]]), 4)
+        fleet.run_until_drained(timeout=240)
+        assert r.done()
+        assert fleet.metrics()["prefix_affinity"] is True
+    finally:
+        fleet.close()
+
+
+def test_prefix_cache_requires_paged_and_no_spec():
+    from paddle_tpu.core.errors import InvalidArgumentError
+    m = tiny_gpt()
+    with pytest.raises(InvalidArgumentError):
+        ServingEngine(m, max_slots=2, max_len=32, prefill_buckets=(8,),
+                      prefix_cache=True)   # fixed KV layout
