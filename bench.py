@@ -891,6 +891,45 @@ def measure_fleet():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_elastic():
+    """ISSUE-18 acceptance artifact: probes/elastic_probe.py in a clean
+    CPU subprocess.  Publishes the train->serve loop story as
+    `detail.elastic.{refresh_to_first_token_s,shed_rate_elastic,
+    worker_hours_ratio,rollbacks_ok}` — bars: a mid-traffic weight
+    publish reaches every replica of a 3-replica fleet through the
+    canary gate with zero dropped streams, zero post-warmup compiles
+    and bit-identity to the new-weights oracle; a corrupt publish
+    (PDTPU_FAULT_PUBLISH_CORRUPT) and a canary-diverging publish
+    (PDTPU_FAULT_CANARY_DIVERGE) both quarantine + auto-roll-back with
+    the fleet serving verified weights throughout (rollbacks_ok); and a
+    diurnal Poisson replay against the autoscaled gateway holds shed
+    rate < 1% at <= 0.7x the static-max fleet's worker-hours with no
+    scale-flap (every action >= cooldown apart, <= 2 direction
+    reversals)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes", "elastic_probe.py"),
+         "--steps", os.environ.get("PDTPU_ELASTIC_PROBE_STEPS", "24")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("ELASTIC"):
+            rec = json.loads(line[len("ELASTIC"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"elastic bars failed: {rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return {"refresh_to_first_token_s":
+                        rec.get("refresh_to_first_token_s"),
+                    "shed_rate_elastic": rec.get("shed_rate_elastic"),
+                    "worker_hours_ratio": rec.get("worker_hours_ratio"),
+                    "rollbacks_ok": rec.get("rollbacks_ok"),
+                    "detail": rec}
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_spec_decode():
     """ISSUE-7 acceptance artifact: probes/spec_decode_probe.py in a clean
     CPU subprocess.  Publishes speculative decoding and int8 weight-only
@@ -1322,6 +1361,7 @@ def main():
                          ("spec_decode", measure_spec_decode),
                          ("gateway", measure_gateway),
                          ("fleet", measure_fleet),
+                         ("elastic", measure_elastic),
                          ("recsys", measure_recsys),
                          ("resilience", measure_resilience),
                          ("observability", measure_observability),

@@ -197,6 +197,15 @@ def report() -> dict:
         "weight_bytes_shipped": stats.get(
             "STAT_fleet_weight_bytes_shipped", 0),
     }
+    # train->serve loop: continuous weight refresh (canary-gated flips,
+    # quarantining rollbacks) + SLO-driven elastic membership
+    elastic = {
+        "target_replicas": _gauge_value("fleet_target_replicas"),
+        "weight_refreshes": stats.get("STAT_fleet_weight_refreshes", 0),
+        "rollbacks": stats.get("STAT_fleet_rollbacks", 0),
+        "scale_ups": stats.get("STAT_fleet_scale_up", 0),
+        "scale_downs": stats.get("STAT_fleet_scale_down", 0),
+    }
     gateway = {
         "ttft_hi_seconds": _hist_summary("gateway_ttft_hi_seconds"),
         "ttft_lo_seconds": _hist_summary("gateway_ttft_lo_seconds"),
@@ -252,6 +261,7 @@ def report() -> dict:
         "serving": serving,
         "gateway": gateway,
         "fleet": fleet,
+        "elastic": elastic,
         "embedding": embedding,
         "programs": get_program_registry().snapshot(),
         "program_store": program_store,

@@ -151,6 +151,8 @@ from .fleet import (FleetRouter, ReplicaManager, Replica,
 from .transfer import (RunTransferError, encode_run, decode_run,
                        run_to_bytes, run_from_bytes, engine_config_hash)
 from .worker import WorkerClient, WorkerDiedError, WireFormatError
+from .refresh import WeightPublisher, FleetRefresher, latest_publish
+from .autoscaler import Autoscaler
 
 __all__ = [
     "ServingEngine", "Request", "Response", "RequestScheduler",
@@ -170,4 +172,6 @@ __all__ = [
     "run_from_bytes", "engine_config_hash",
     # subprocess worker replicas (process isolation + heartbeat)
     "WorkerClient", "WorkerDiedError", "WireFormatError",
+    # train->serve loop (continuous weight refresh + elastic capacity)
+    "WeightPublisher", "FleetRefresher", "latest_publish", "Autoscaler",
 ]

@@ -616,6 +616,64 @@ class ServingEngine:
         # join + abort sequence and everyone else returns once it's done
         self._close_lock = threading.Lock()
         self._dead: Optional[BaseException] = None
+        # continuous weight refresh (serving/refresh.py): which published
+        # artifact this engine currently serves (None = constructor
+        # weights) and how many swaps it has absorbed.  Both are
+        # host-side bookkeeping only — the compiled programs take the
+        # state dict as a per-call argument, so swapping never retraces.
+        self.weights_sha: Optional[str] = None
+        self.refresh_epoch = 0
+
+    # ------------------------------------------------------------------
+    # continuous weight refresh
+    # ------------------------------------------------------------------
+    def swap_weights(self, state: Dict, weights_sha: Optional[str] = None):
+        """Rebind the served weights to `state` with ZERO recompiles.
+
+        Every compiled prefill/decode/verify program takes the state
+        dict as an explicit call argument (never a closed-over
+        constant), so a shape/dtype-stable swap reuses the loaded
+        program set untouched — the next engine step simply passes the
+        new arrays.  The caller (fleet flip choreography) guarantees the
+        engine is idle or between steps on the driving thread; any
+        in-progress compiled call keeps the OLD dict it was handed.
+
+        Validates the exact key set + per-leaf shape/dtype against the
+        current state and raises InvalidArgumentError on any mismatch —
+        a wrong-architecture publish must never half-apply.  Under a
+        mesh every leaf is re-placed with the incumbent leaf's sharding.
+        A prefix cache is flushed: cached KV embeds the old weights'
+        activations and would break new-weights bit-identity.
+        """
+        old = self._state
+        missing = set(old) - set(state)
+        unexpected = set(state) - set(old)
+        if missing or unexpected:
+            raise InvalidArgumentError(
+                f"swap_weights state-dict key mismatch: missing "
+                f"{sorted(missing)[:4]}, unexpected "
+                f"{sorted(unexpected)[:4]}")
+        for k, cur in old.items():
+            new = state[k]
+            if tuple(np.shape(new)) != tuple(np.shape(cur)):
+                raise InvalidArgumentError(
+                    f"swap_weights shape mismatch for {k!r}: "
+                    f"{tuple(np.shape(new))} != {tuple(np.shape(cur))}")
+        if self.mesh is not None:
+            state = {k: jax.device_put(np.asarray(v, dtype=np.asarray(
+                old[k]).dtype), old[k].sharding)
+                for k, v in state.items()}
+        else:
+            state = {k: jnp.asarray(np.asarray(v), dtype=jnp.asarray(
+                old[k]).dtype) for k, v in state.items()}
+        # atomic rebind: one reference assignment — readers see either
+        # the complete old dict or the complete new one
+        self._state = state
+        self.weights_sha = weights_sha
+        self.refresh_epoch += 1
+        if self.prefix_cache is not None:
+            # old-weights KV must never seed a new-weights stream
+            self.prefix_cache.evict(self.prefix_cache.resident_nodes())
 
     # ------------------------------------------------------------------
     # tensor parallelism over the mesh

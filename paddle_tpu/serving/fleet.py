@@ -205,6 +205,25 @@ def _obs():
             "worker_restarts": _m.counter(
                 "fleet_worker_restarts_total",
                 "supervised subprocess worker restarts performed"),
+            "refreshes": _m.counter(
+                "fleet_weight_refreshes_total",
+                "replica weight flips applied (continuous refresh — one "
+                "per replica per publish, rollbacks included)"),
+            "rollbacks": _m.counter(
+                "fleet_rollbacks_total",
+                "published weight sets rejected by the canary gate and "
+                "rolled back to the previous weights_sha"),
+            "scale_up": _m.counter(
+                "fleet_scale_up_total",
+                "autoscaler scale-up actions (workers spawned on "
+                "sustained SLO pressure)"),
+            "scale_down": _m.counter(
+                "fleet_scale_down_total",
+                "autoscaler scale-down actions (least-loaded replica "
+                "drained — never killed)"),
+            "target_replicas": _m.gauge(
+                "fleet_target_replicas",
+                "the autoscaler's current desired replica count"),
         }
     return _obs_handles
 
@@ -253,9 +272,19 @@ class Replica:
         self.fast_steps = 0
         self.fence_reason: Optional[str] = None
         self.created_at = time.monotonic()
+        # a replica with a weight flip pending is fenced from NEW
+        # admissions (routable() below) while its queued work finishes
+        # in place on the OLD weights — the flip applies at the idle
+        # boundary, so no stream ever spans two weight sets
+        self.flipping = False
+        # remove()-of-a-draining-replica: the autoscaler's retire path
+        # flags the replica instead of racing the drain completion; the
+        # drain-finish sweep performs the remove itself
+        self.remove_after_drain = False
 
     def routable(self) -> bool:
-        return self.state == HEALTHY and self.engine.warm
+        return (self.state == HEALTHY and self.engine.warm
+                and not self.flipping)
 
     def load(self) -> int:
         s = self.engine.scheduler
@@ -297,6 +326,12 @@ class Replica:
             "fence_reason": self.fence_reason,
             "post_warmup_compiles": (self.engine.post_warmup_compiles()
                                      if self.engine.warm else None),
+            # which weights this replica actually serves, how many flips
+            # it absorbed, and whether a flip is pending — the /healthz
+            # at-a-glance answer during a refresh
+            "weights_sha": getattr(self.engine, "weights_sha", None),
+            "refresh_epoch": getattr(self.engine, "refresh_epoch", 0),
+            "flipping": self.flipping,
         }
 
 
@@ -404,9 +439,16 @@ class ReplicaManager:
         # yet (paged-block shortfall): retried every tick, swept for
         # cancel/deadline, failed terminally at close
         self._parked: List[PreemptedRun] = []
+        # pending weight flips: {"rid", "sha", "path", "state", "done",
+        # "ok", "error"} — applied by _pump_flips at each replica's idle
+        # boundary (engine.has_work() false), so a flip never lands
+        # mid-stream
+        self._flips: List[Dict] = []
         self._n = {"failovers": 0, "migrated": 0, "resubmits": 0,
                    "lost": 0, "reroutes": 0, "drains": 0, "wedges": 0,
-                   "worker_restarts": 0, "restarts_exhausted": 0}
+                   "worker_restarts": 0, "restarts_exhausted": 0,
+                   "weight_refreshes": 0, "rollbacks": 0,
+                   "scale_up": 0, "scale_down": 0}
 
     # -- membership ---------------------------------------------------
     def add(self, engine: ServingEngine) -> Replica:
@@ -487,10 +529,22 @@ class ReplicaManager:
         return [r for r in self.replicas((HEALTHY,)) if r.routable()]
 
     def remove(self, rid: int):
-        """Forget a closed/crashed replica (rollout teardown)."""
+        """Forget a closed/crashed replica (rollout teardown).  Calling
+        it on a replica that is still MID-DRAIN — the autoscaler's
+        retire path, racing the drain completion — is NOT an error: the
+        replica is flagged and the drain-finish sweep removes it the
+        moment its last resident finishes.  Idempotent: repeat calls
+        (and a call landing after the drain completed) are no-ops or
+        plain removes."""
         with self._lock:
             rep = self._replicas.get(rid)
             if rep is None:
+                return
+            if rep.state == DRAINING:
+                # deferred remove: _finish_drains closes the engine at
+                # the idle boundary and performs the remove itself —
+                # never a close-vs-remove race on the state machine
+                rep.remove_after_drain = True
                 return
             if rep.state not in (CLOSED, CRASHED, WEDGED):
                 raise InvalidArgumentError(
@@ -612,6 +666,7 @@ class ReplicaManager:
         did = self._pump_migrations() or did
         did = self._pump_parked() or did
         self._sweep_parked()
+        did = self._pump_flips() or did
         did = self._finish_drains() or did
         did = self._pump_kills() or did
         did = self._pump_restarts() or did
@@ -1031,12 +1086,137 @@ class ReplicaManager:
     def _finish_drains(self) -> bool:
         did = False
         for rep in self.replicas((DRAINING,)):
-            if not rep.engine.has_work():
-                rep.engine.close()
+            if rep.engine.has_work():
+                continue
+            # flip state under the lock so a concurrent remove() sees
+            # either DRAINING (defers via the flag) or CLOSED (removes
+            # directly) — never a half-closed in-between
+            with self._lock:
                 rep.state = CLOSED
-                self._publish_up(rep)
-                did = True
+                do_remove = rep.remove_after_drain
+            rep.engine.close()
+            self._publish_up(rep)
+            if do_remove:
+                # outside self._lock: remove() takes it (non-reentrant)
+                self.remove(rep.id)
+            did = True
         return did
+
+    # -- continuous weight refresh ------------------------------------
+    def flip_weights(self, rid: int, path: Optional[str] = None,
+                     sha: Optional[str] = None,
+                     state: Optional[Dict] = None) -> Dict:
+        """Schedule a weight flip on replica `rid`: the replica is
+        fenced from NEW admissions immediately (`routable()` excludes a
+        flipping replica, so the router and the affinity map stop
+        feeding it and sessions re-home), its queued/resident work
+        finishes in place on the OLD weights, and `_pump_flips` applies
+        the swap at the idle boundary — zero recompiles (the engine's
+        compiled programs take the state as a per-call argument), zero
+        dropped streams, and no stream ever spans two weight sets.
+
+        In-process replicas take `state` (a host state dict) or `path`
+        (a jit.save npz); subprocess/remote replicas take `path` +
+        `sha` and the artifact ships over the sha256-verified channel.
+        Returns the flip entry — poll ``entry["done"]`` /
+        ``entry["ok"]`` / ``entry["error"]`` for the outcome.  A failed
+        flip (ship error, sha mismatch, shape mismatch) leaves the
+        replica serving the OLD weights and routable again."""
+        rep = self.get(rid)
+        if rep is None or rep.state not in _LIVE:
+            raise InvalidArgumentError(
+                f"replica {rid} is not live; cannot flip weights")
+        entry = {"rid": rid, "path": path, "sha": sha, "state": state,
+                 "done": False, "ok": None, "error": None}
+        rep.flipping = True
+        self._publish_up(rep)
+        self._flips.append(entry)
+        return entry
+
+    def _pump_flips(self) -> bool:
+        """Apply pending weight flips on replicas that reached their
+        idle boundary.  A flip onto a replica that crashed/was fenced
+        meanwhile fails typed; a worker dying mid-swap takes the normal
+        crash path (failover + supervised restart with the NEW spec)."""
+        if not self._flips:
+            return False
+        did = False
+        still = []
+        for entry in self._flips:
+            rep = self.get(entry["rid"])
+            if rep is None or rep.state not in _LIVE:
+                entry.update(done=True, ok=False,
+                             error=f"replica {entry['rid']} is no "
+                                   "longer live")
+                did = True
+                continue
+            if rep.engine.has_work():
+                still.append(entry)  # old-weights work still in flight
+                continue
+            try:
+                self._apply_flip(rep, entry)
+                entry.update(done=True, ok=True)
+                self._n["weight_refreshes"] += 1
+                stat_add("STAT_fleet_weight_refreshes")
+                _obs()["refreshes"].inc()
+            except WorkerDiedError as e:
+                # partition/death mid-flip: crash semantics — residents
+                # were already drained (idle boundary), the supervisor
+                # restarts from the updated lineage spec
+                entry.update(done=True, ok=False, error=repr(e))
+                self._on_crash(rep, e)
+            except Exception as e:  # noqa: BLE001 — typed ship/shape errs
+                # the swap was REJECTED (sha mismatch, shape mismatch,
+                # truncated artifact): the replica still serves the old
+                # weights — unfence it and report the failure
+                entry.update(done=True, ok=False,
+                             error=f"{type(e).__name__}: {e}")
+            rep.flipping = False
+            self._publish_up(rep)
+            did = True
+        self._flips = still
+        return did
+
+    def _apply_flip(self, rep: Replica, entry: Dict):
+        if isinstance(rep, SubprocessReplica):
+            if entry["path"] is None:
+                raise InvalidArgumentError(
+                    "a subprocess/remote replica flip needs a weight "
+                    "artifact path (state dicts do not cross processes)")
+            rep.engine.swap_weights(entry["path"], entry["sha"])
+            # restarts must converge onto the new weights, not resurrect
+            # the boot-time artifact
+            rep.lineage["spec"]["weights"] = entry["path"]
+        else:
+            state = entry["state"]
+            if state is None:
+                if entry["path"] is None:
+                    raise InvalidArgumentError(
+                        "flip_weights needs `state` or `path`")
+                import numpy as np
+                with np.load(entry["path"], allow_pickle=False) as z:
+                    state = {k: z[k] for k in z.files}
+            rep.engine.swap_weights(state, entry["sha"])
+
+    def flips_pending(self) -> int:
+        return len(self._flips)
+
+    # counters the refresher/autoscaler (which run OFF the driving
+    # thread) report through, so every counter/stat/gauge stays in one
+    # place
+    def note_rollback(self):
+        self._n["rollbacks"] += 1
+        stat_add("STAT_fleet_rollbacks")
+        _obs()["rollbacks"].inc()
+
+    def note_scale(self, up: bool):
+        key = "scale_up" if up else "scale_down"
+        self._n[key] += 1
+        stat_add(f"STAT_fleet_{key}")
+        _obs()[key].inc()
+
+    def set_target_replicas(self, n: int):
+        _obs()["target_replicas"].set(int(n))
 
     # -- shutdown ------------------------------------------------------
     def abort_all(self, make_exc: Callable):
@@ -1107,7 +1287,8 @@ class ReplicaManager:
 
     def counters(self) -> Dict:
         return dict(self._n, parked=len(self._parked),
-                    pending_restarts=len(self._restarts))
+                    pending_restarts=len(self._restarts),
+                    pending_flips=len(self._flips))
 
 
 class _FleetSchedulerView:
@@ -1191,6 +1372,10 @@ class FleetRouter:
         self._closed = False
         self._close_lock = threading.Lock()
         self._dead: Optional[BaseException] = None
+        # the attached FleetRefresher (serving/refresh.py), if any: it
+        # supplies the canary verdict behind `routable_verified` — with
+        # none attached, every routable replica counts as verified
+        self._refresher = None
 
     # -- membership / lifecycle ---------------------------------------
     def add_replica(self, engine: ServingEngine) -> int:
@@ -1233,6 +1418,26 @@ class FleetRouter:
             self._affinity = {s: r for s, r in self._affinity.items()
                               if r != rid}
         self._work.set()
+
+    def flip_weights(self, rid: int, path: Optional[str] = None,
+                     sha: Optional[str] = None,
+                     state: Optional[Dict] = None) -> Dict:
+        """Schedule a zero-recompile weight flip on one replica (see
+        ReplicaManager.flip_weights).  Sessions pinned to it re-home
+        while the flip is pending."""
+        entry = self.manager.flip_weights(rid, path=path, sha=sha,
+                                          state=state)
+        with self._lock:
+            self._affinity = {s: r for s, r in self._affinity.items()
+                              if r != rid}
+        self._work.set()
+        return entry
+
+    def attach_refresher(self, refresher):
+        """Register the FleetRefresher whose canary verdicts back the
+        `routable_verified` health field (and the gateway's 503-on-
+        unverified-fleet rule)."""
+        self._refresher = refresher
 
     def remove(self, rid: int):
         self.manager.remove(rid)
@@ -1521,9 +1726,20 @@ class FleetRouter:
         reps = self.manager.replicas()
         stale = self.manager.stale_routable()
         routable = self.manager.routable()
-        return {
+        # verified = serving a weight set the canary gate passed (or the
+        # boot-time weights, which predate any refresh).  No refresher
+        # attached -> every routable replica is verified by definition.
+        if self._refresher is None:
+            verified = len(routable)
+        else:
+            verified = sum(
+                1 for r in routable
+                if self._refresher.sha_ok(
+                    getattr(r.engine, "weights_sha", None)))
+        out = {
             "replicas": {str(r.id): r.snapshot() for r in reps},
             "routable": len(routable),
+            "routable_verified": verified,
             "total": len(reps),
             "workers": sum(1 for r in reps
                            if isinstance(r, SubprocessReplica)),
@@ -1536,6 +1752,9 @@ class FleetRouter:
             and len(stale) == len(routable),
             **self.manager.counters(),
         }
+        if self._refresher is not None:
+            out["refresh"] = self._refresher.status()
+        return out
 
     def post_warmup_compiles(self) -> int:
         """Worst replica's post-warmup compile count (the fleet contract

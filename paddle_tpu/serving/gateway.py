@@ -704,6 +704,27 @@ class ServingGateway:
             "engine": self.engine.metrics(),
         }
 
+    def scale_signals(self) -> Dict:
+        """The cheap SLO signals an autoscaler polls every tick: current
+        estimated queue wait (EWMA service time x depth / slots), lane
+        depths, and the monotonic shed/admitted counters (the caller
+        diffs them per tick to get a shed *rate*).  No engine round-trip
+        beyond depth reads — safe to call at high frequency."""
+        depth_hi, depth_lo = self._depths()
+        depth = depth_hi + depth_lo
+        with self._lock:
+            shed = self._n["shed"]
+            admitted = self._n["admitted"]
+        slots = max(1, int(getattr(self.engine, "max_slots", 1) or 1))
+        return {
+            "est_wait_s": self.tracker.est_wait(depth, slots),
+            "queue_depth": depth,
+            "lane_depth_hi": depth_hi,
+            "lane_depth_lo": depth_lo,
+            "shed_total": shed,
+            "admitted_total": admitted,
+        }
+
     # ------------------------------------------------------------------
     # OpenAI-shaped HTTP surface (port-free handler + stdlib server)
     # ------------------------------------------------------------------
@@ -844,6 +865,16 @@ class ServingGateway:
                 health_fn = getattr(self.engine, "health", None)
                 fleet = health_fn() if callable(health_fn) else None
                 if fleet is not None and fleet.get("routable", 0) == 0:
+                    status = 503
+                # a refresher-fronted fleet also reports how many
+                # routable replicas serve a canary-verified weights_sha:
+                # replicas are up but ALL of them serve weights the
+                # canary never blessed (mid-rollback, or a bad publish
+                # flipped everywhere before the canary caught it) —
+                # readiness must fail until verified capacity returns
+                if (fleet is not None and fleet.get("routable", 0) > 0
+                        and "routable_verified" in fleet
+                        and fleet.get("routable_verified", 0) == 0):
                     status = 503
                 # every still-routable replica has a stale heartbeat:
                 # the DRIVING LOOP itself stalled (normal fencing would

@@ -1,0 +1,489 @@
+"""Continuous weight refresh: the train->serve side of the loop.
+
+A trainer publishes checkpoints into a watch directory with the same
+atomic-rename contract the distributed checkpoint writer uses (tmp dir
+-> fsync -> ``os.rename`` -> fsync parent -> atomic LATEST pointer), so
+a reader can NEVER observe a half-written publish.  The serving side
+watches that directory and walks every new publish through three gates
+before the fleet converges onto it:
+
+1. **artifact gate** — the whole-file sha256 must match the manifest
+   (the manifest sha is computed from the good bytes BEFORE the rename,
+   so any post-publish corruption is detectable);
+2. **oracle gate** — an in-process reference engine swaps to the new
+   weights and generates the expected canary streams (shape/key
+   mismatches die here, before any serving replica is touched);
+3. **canary gate** — exactly ONE routable replica is flipped
+   (`FleetRouter.flip_weights`: fence -> idle boundary -> zero-recompile
+   swap) and its canary streams must be BIT-IDENTICAL to the oracle's.
+
+A publish that fails any gate is quarantined by content hash and the
+canary replica is flipped back to the last verified weights — a corrupt
+or regressed checkpoint degrades to "keep serving the old model", never
+to an outage.  Only after the canary passes does the refresher converge
+every remaining replica (and, via the updated restart lineage and its
+own convergence sweep, every replica that boots later).
+
+The refresher runs OFF the fleet's driving thread: it only schedules
+flips and polls their entries, so something else (the gateway loop or
+``fleet.start()``) must be driving ``fleet.step()``.
+
+Chaos knobs (utils.faults): ``PDTPU_FAULT_PUBLISH_CORRUPT=n`` bit-rots
+the n-th published artifact AFTER the atomic rename (gate 1 must catch
+it); ``PDTPU_FAULT_CANARY_DIVERGE=1`` forces the canary comparison to
+fail (gate 3's rollback choreography, drillable on demand).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..distributed.checkpoint import _fsync_dir, _write_atomic
+from ..utils import faults
+from .fleet import DEGRADED, HEALTHY
+from .transfer import file_sha256
+
+__all__ = ["WeightPublisher", "latest_publish", "FleetRefresher"]
+
+_PUSH_DIR_RE = re.compile(r"^push-(\d{9})$")
+_LATEST = "LATEST"
+_MANIFEST = "manifest.json"
+_WEIGHTS = "weights.npz"
+
+
+# ---------------------------------------------------------------------------
+# trainer side: atomic publishes
+# ---------------------------------------------------------------------------
+
+class WeightPublisher:
+    """Writes ``push-<step>/{weights.npz, manifest.json}`` publishes a
+    refresher can trust: the npz and manifest are written and fsynced in
+    a hidden tmp dir, the manifest records the sha256 of the GOOD npz
+    bytes, and one ``os.rename`` makes the publish visible — followed by
+    an atomic LATEST pointer update.  A crash mid-publish leaves only an
+    invisible tmp dir; a publish corrupted after the rename still
+    carries the pre-corruption sha and fails the refresher's artifact
+    gate."""
+
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._lock = threading.Lock()
+        # resume numbering past anything already on disk
+        step = 0
+        try:
+            for name in os.listdir(self.directory):
+                m = _PUSH_DIR_RE.match(name)
+                if m:
+                    step = max(step, int(m.group(1)) + 1)
+        except OSError:
+            pass
+        self._step = step
+
+    def publish(self, model=None, state: Optional[Dict] = None,
+                step: Optional[int] = None) -> Dict:
+        """Publish one weight set (a Layer via ``model=`` or a host
+        state dict via ``state=``); returns
+        ``{"dir", "step", "sha256", "path"}``."""
+        if (model is None) == (state is None):
+            raise InvalidArgumentError(
+                "publish takes exactly one of model= or state=")
+        if model is not None:
+            from ..jit import state_arrays
+            state = state_arrays(model)
+        arrs = {k: np.asarray(v) for k, v in state.items()}
+        with self._lock:
+            explicit = step is not None
+            step = self._step if step is None else int(step)
+            if not explicit:
+                # another publisher (or a previous process) may have
+                # taken this number: auto-assigned steps skip forward
+                while os.path.exists(os.path.join(
+                        self.directory, f"push-{step:09d}")):
+                    step += 1
+            self._step = max(self._step, step) + 1
+        name = f"push-{step:09d}"
+        final_dir = os.path.join(self.directory, name)
+        if os.path.exists(final_dir):
+            raise InvalidArgumentError(
+                f"publish step {step} already exists at {final_dir}")
+        tmp_dir = os.path.join(self.directory,
+                               f".{name}.tmp-{os.getpid()}")
+        os.makedirs(tmp_dir)
+        npz_tmp = os.path.join(tmp_dir, _WEIGHTS)
+        with open(npz_tmp, "wb") as f:
+            np.savez(f, **arrs)
+            f.flush()
+            os.fsync(f.fileno())
+        # sha of the good bytes, BEFORE the rename: later corruption of
+        # the visible artifact can only ever DISAGREE with the manifest
+        sha = file_sha256(npz_tmp)
+        with open(os.path.join(tmp_dir, _MANIFEST), "w") as f:
+            json.dump({"step": step, "sha256": sha, "file": _WEIGHTS,
+                       "keys": len(arrs)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        _fsync_dir(tmp_dir)
+        os.rename(tmp_dir, final_dir)
+        _fsync_dir(self.directory)
+        _write_atomic(os.path.join(self.directory, _LATEST), name)
+        path = os.path.join(final_dir, _WEIGHTS)
+        # chaos: bit-rot the artifact AFTER it became visible — the
+        # manifest still carries the good sha, so the refresher's
+        # artifact gate (not luck) must keep this off the fleet
+        faults.maybe_corrupt_publish(path)
+        return {"dir": final_dir, "step": step, "sha256": sha,
+                "path": path}
+
+
+def _load_publish(d: str) -> Optional[Dict]:
+    try:
+        with open(os.path.join(d, _MANIFEST)) as f:
+            man = json.load(f)
+    except (OSError, ValueError):
+        return None
+    path = os.path.join(d, str(man.get("file") or _WEIGHTS))
+    if not man.get("sha256") or not os.path.exists(path):
+        return None
+    return {"dir": d, "step": int(man.get("step", -1)),
+            "sha256": str(man["sha256"]), "path": path}
+
+
+def latest_publish(directory: str) -> Optional[Dict]:
+    """Newest complete publish in `directory`, or None.  The LATEST
+    pointer is a hint; a missing/torn pointer falls back to scanning
+    push-* dirs newest-first for one with a valid manifest (the same
+    stance as checkpoint.latest_step_dir)."""
+    try:
+        with open(os.path.join(directory, _LATEST)) as f:
+            hint = f.read().strip()
+    except OSError:
+        hint = ""
+    if hint and _PUSH_DIR_RE.match(hint):
+        pub = _load_publish(os.path.join(directory, hint))
+        if pub is not None:
+            return pub
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return None
+    for name in sorted((n for n in names if _PUSH_DIR_RE.match(n)),
+                       reverse=True):
+        pub = _load_publish(os.path.join(directory, name))
+        if pub is not None:
+            return pub
+    return None
+
+
+# ---------------------------------------------------------------------------
+# serving side: watch -> verify -> canary -> converge (or roll back)
+# ---------------------------------------------------------------------------
+
+class FleetRefresher:
+    """Watches a publish directory and walks the fleet onto each new
+    weight set through the three gates described in the module
+    docstring.  `oracle` is an in-process ServingEngine built from the
+    same model config as the fleet's replicas (deterministic greedy
+    decode makes its canary streams the bit-exact reference); it must
+    NOT be started — the refresher drives it synchronously — and should
+    be warmed by the caller before traffic starts if post-warmup
+    compiles are being asserted.
+
+    ``sha_ok()`` backs the fleet's ``routable_verified`` health field:
+    a replica serving a quarantined sha never counts as verified
+    capacity, and the gateway's /healthz turns 503 when NO routable
+    replica serves verified weights."""
+
+    def __init__(self, fleet, directory: str, oracle,
+                 canary_prompts: Sequence[Sequence[int]] = ((1, 2, 3),),
+                 canary_max_new_tokens: int = 8,
+                 poll_interval_s: float = 0.25,
+                 flip_timeout_s: float = 120.0,
+                 canary_timeout_s: float = 60.0,
+                 _clock=time.monotonic):
+        if getattr(oracle, "_thread", None) is not None:
+            raise InvalidArgumentError(
+                "the oracle engine must not be started: the refresher "
+                "drives it synchronously (run_until_drained)")
+        self.fleet = fleet
+        self.directory = os.path.abspath(directory)
+        self.oracle = oracle
+        self.canary_prompts = [list(map(int, p)) for p in canary_prompts]
+        if not self.canary_prompts:
+            raise InvalidArgumentError(
+                "at least one canary prompt is required")
+        self.canary_max_new_tokens = int(canary_max_new_tokens)
+        self.poll_interval_s = float(poll_interval_s)
+        self.flip_timeout_s = float(flip_timeout_s)
+        self.canary_timeout_s = float(canary_timeout_s)
+        self._clock = _clock
+        self._verified: set = set()
+        self._quarantined: Dict[str, str] = {}
+        self._current: Optional[Dict] = None   # last canary-passed publish
+        self._baseline: Optional[Dict] = None  # oracle boot-state arrays
+        self._last_error: Optional[str] = None
+        self._lock = threading.Lock()
+        self._poll_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        fleet.attach_refresher(self)
+
+    # -- health plumbing ----------------------------------------------
+    def sha_ok(self, sha: Optional[str]) -> bool:
+        """Is `sha` acceptable to serve?  Boot weights (sha None) are
+        implicitly good — they were never rolled back — and anything
+        else must have passed the canary and never been quarantined."""
+        with self._lock:
+            if sha in self._quarantined:
+                return False
+            return sha is None or sha in self._verified
+
+    def status(self) -> Dict:
+        with self._lock:
+            return {
+                "current_sha": (None if self._current is None
+                                else self._current["sha256"]),
+                "current_step": (None if self._current is None
+                                 else self._current["step"]),
+                "verified": len(self._verified),
+                "quarantined": dict(self._quarantined),
+                "last_error": self._last_error,
+            }
+
+    # -- one refresh cycle --------------------------------------------
+    def poll(self) -> Dict:
+        """One watch cycle: admit any new publish through the gates,
+        then converge stragglers (restarted / scaled-up replicas) onto
+        the current verified weights.  Safe to call from any single
+        thread; `start()` wraps it in a background loop."""
+        with self._poll_lock:
+            self._capture_baseline()
+            pub = latest_publish(self.directory)
+            if pub is not None:
+                sha = pub["sha256"]
+                with self._lock:
+                    stale = (sha in self._quarantined
+                             or sha in self._verified)
+                if not stale:
+                    self._admit(pub)
+            self._converge()
+            return self.status()
+
+    def _capture_baseline(self):
+        if self._baseline is None:
+            self._baseline = {k: np.asarray(v)
+                              for k, v in self.oracle._state.items()}
+
+    def _admit(self, pub: Dict):
+        sha = pub["sha256"]
+        # gate 1: the artifact's bytes vs the manifest's pre-rename sha
+        try:
+            actual = file_sha256(pub["path"])
+        except OSError as e:
+            self._quarantine(sha, f"artifact unreadable: {e!r}")
+            return
+        if actual != sha:
+            self._quarantine(sha,
+                             "artifact sha mismatch (corrupt publish)")
+            return
+        try:
+            with np.load(pub["path"], allow_pickle=False) as z:
+                state = {k: z[k] for k in z.files}
+        except Exception as e:  # noqa: BLE001 — any decode failure
+            self._quarantine(sha, f"artifact undecodable: {e!r}")
+            return
+        # gate 2: the oracle swaps first — shape/key mismatches die
+        # here; then it generates the expected canary streams
+        try:
+            self.oracle.swap_weights(state, sha)
+            expected = self._oracle_tokens()
+        except Exception as e:  # noqa: BLE001 — typed swap/gen errors
+            self._quarantine(
+                sha, f"oracle rejected publish: {type(e).__name__}: {e}")
+            self._oracle_rollback()
+            return
+        # gate 3: one canary replica, bit-identity required
+        rep = self._pick_canary()
+        if rep is None:
+            # no routable capacity right now — leave the publish
+            # unjudged and retry next cycle (oracle back to old weights
+            # keeps poll idempotent)
+            self._oracle_rollback()
+            with self._lock:
+                self._last_error = ("no routable replica for canary; "
+                                    "deferred")
+            return
+        try:
+            entry = self.fleet.flip_weights(rep.id, path=pub["path"],
+                                            sha=sha, state=state)
+        except InvalidArgumentError as e:
+            self._oracle_rollback()
+            with self._lock:
+                self._last_error = f"canary flip not schedulable: {e}"
+            return
+        if not self._wait_entry(entry):
+            self._quarantine(sha, "canary flip failed: "
+                             f"{entry.get('error') or 'timeout'}")
+            self._oracle_rollback()
+            return
+        try:
+            got = self._replica_tokens(rep)
+        except Exception as e:  # noqa: BLE001 — failed canary = diverged
+            got = f"canary request failed: {type(e).__name__}: {e}"
+        if faults.canary_diverge() or got != expected:
+            self._rollback_canary(rep)
+            self._quarantine(
+                sha, "canary diverged from the new-weights oracle")
+            self._oracle_rollback()
+            return
+        with self._lock:
+            self._verified.add(sha)
+            self._current = pub
+            self._last_error = None
+
+    def _converge(self):
+        """Flip every serving replica that is not on the current
+        verified weights — the sweep that heals restarts, rollout
+        replacements and scale-ups without special cases."""
+        cur = self._current
+        if cur is None:
+            return
+        sha = cur["sha256"]
+        state = None
+        for rep in self.fleet.manager.replicas((HEALTHY, DEGRADED)):
+            if rep.flipping or not getattr(rep.engine, "warm", False):
+                continue
+            if getattr(rep.engine, "weights_sha", None) == sha:
+                continue
+            if state is None:
+                with np.load(cur["path"], allow_pickle=False) as z:
+                    state = {k: z[k] for k in z.files}
+            try:
+                self.fleet.flip_weights(rep.id, path=cur["path"],
+                                        sha=sha, state=state)
+            except InvalidArgumentError:
+                pass  # lost liveness between the snapshot and the flip
+
+    # -- internals -----------------------------------------------------
+    def _oracle_tokens(self) -> List[List[int]]:
+        resps = [self.oracle.submit(
+            p, max_new_tokens=self.canary_max_new_tokens)
+            for p in self.canary_prompts]
+        self.oracle.run_until_drained(timeout=self.canary_timeout_s)
+        return [list(r.tokens(timeout=1.0)) for r in resps]
+
+    def _replica_tokens(self, rep) -> List[List[int]]:
+        resps = []
+        for p in self.canary_prompts:
+            req, resp = rep.engine.make_request(
+                p, self.canary_max_new_tokens)
+            rep.engine.scheduler.submit(req, resp)
+            resps.append(resp)
+        # the fleet's driving loop executes these; wake it
+        self.fleet._work.set()
+        return [list(r.tokens(timeout=self.canary_timeout_s))
+                for r in resps]
+
+    def _pick_canary(self):
+        reps = self.fleet.manager.routable()
+        if not reps:
+            return None
+        return min(reps, key=lambda r: r.load())
+
+    def _wait_entry(self, entry: Dict,
+                    timeout: Optional[float] = None) -> bool:
+        deadline = self._clock() + (self.flip_timeout_s
+                                    if timeout is None else timeout)
+        while not entry["done"]:
+            if self._clock() > deadline:
+                return False
+            time.sleep(0.01)
+        return bool(entry["ok"])
+
+    def _rollback_target(self):
+        """(path, sha, state) of the weights a bad canary rolls back
+        to: the last verified publish, or — before any publish passed —
+        the oracle's boot state, materialized as an artifact once (a
+        subprocess canary needs a PATH to roll back to)."""
+        with self._lock:
+            cur = self._current
+        if cur is not None:
+            return cur["path"], cur["sha256"], None
+        d = os.path.join(self.directory, ".baseline")
+        path = os.path.join(d, _WEIGHTS)
+        if not os.path.exists(path):
+            os.makedirs(d, exist_ok=True)
+            tmp = path + f".tmp-{os.getpid()}"
+            with open(tmp, "wb") as f:
+                np.savez(f, **self._baseline)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        sha = file_sha256(path)
+        with self._lock:
+            # the baseline IS the boot weights: implicitly verified
+            self._verified.add(sha)
+        return path, sha, self._baseline
+
+    def _rollback_canary(self, rep):
+        path, sha, state = self._rollback_target()
+        try:
+            back = self.fleet.flip_weights(rep.id, path=path, sha=sha,
+                                           state=state)
+            self._wait_entry(back)
+        except InvalidArgumentError:
+            pass  # replica died meanwhile: restart converges it
+
+    def _oracle_rollback(self):
+        path, sha, state = self._rollback_target()
+        if state is None:
+            with np.load(path, allow_pickle=False) as z:
+                state = {k: z[k] for k in z.files}
+        self.oracle.swap_weights(state, sha)
+
+    def _quarantine(self, sha: str, reason: str):
+        with self._lock:
+            self._quarantined[sha] = reason
+            self._last_error = f"{sha[:12]}: {reason}"
+        self.fleet.manager.note_rollback()
+
+    # -- background loop ----------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.poll()
+                except Exception as e:  # noqa: BLE001 — keep watching
+                    with self._lock:
+                        self._last_error = (
+                            f"poll failed: {type(e).__name__}: {e}")
+                self._stop.wait(self.poll_interval_s)
+
+        self._thread = threading.Thread(target=loop,
+                                        name="fleet-refresher",
+                                        daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -552,6 +552,7 @@ class _WorkerServer:
                  manager_silence_s: Optional[float] = None,
                  listener: Optional[socket.socket] = None,
                  weights_sha: Optional[str] = None,
+                 cache: Optional[dict] = None,
                  _clock=time.monotonic):
         from ..utils import faults
         self._faults = faults
@@ -561,6 +562,10 @@ class _WorkerServer:
         self.index = index
         self.epoch = int(epoch)
         self.beat_conn = beat_conn
+        # the remote session's engine cache (remote mode only): a weight
+        # swap updates its sha/key so a post-partition re-attach reuses
+        # the swapped engine and ships zero bytes
+        self._cache = cache
         self.manager_silence_s = (None if manager_silence_s is None
                                   else float(manager_silence_s))
         self.listener = listener
@@ -600,6 +605,8 @@ class _WorkerServer:
                 self._faults.disable(point)
             else:
                 self._faults.enable(point, value)
+        elif verb == "swap_weights":
+            self._on_swap(h)
         elif verb == "close":
             self._stopping = True
         elif verb == "ping":
@@ -688,6 +695,70 @@ class _WorkerServer:
         if ok:
             self.streams[wid] = [resp, 0]
         self.conn.send("restored", {"wid": wid, "ok": bool(ok)})
+
+    def _on_swap(self, h: dict):
+        """Continuous weight refresh: rebind the engine's served
+        weights to a new artifact with ZERO recompiles
+        (ServingEngine.swap_weights — the compiled programs take the
+        state as a per-call argument).  Local mode: the artifact is a
+        path on this host, sha256-verified before a byte reaches the
+        engine.  Remote mode: the header carries a manifest and the
+        bytes follow as chunk frames after the `swap_ready` ack, over
+        the same verified channel the attach handshake uses.  Any
+        failure — truncated file, sha mismatch, shape mismatch — is
+        reported typed and leaves the OLD weights serving."""
+        from .transfer import file_sha256
+        wid = h.get("wid")
+        sha = h.get("sha256")
+        man = h.get("manifest")
+        try:
+            if man is not None:
+                if self._cache is not None:
+                    path = os.path.join(self._cache["dir"], "weights.npz")
+                else:
+                    path = os.path.join(
+                        tempfile.mkdtemp(prefix="pdtpu_swap_"),
+                        "weights.npz")
+                self.conn.send("swap_ready", {"wid": wid})
+                self._recv_swap_chunks(man, path)
+                sha = man.get("sha256")
+            else:
+                path = h.get("path")
+                if not path:
+                    raise WeightShipError(
+                        "swap_weights needs a path (local) or a "
+                        "manifest (remote)")
+                actual = file_sha256(path)
+                if sha is not None and actual != sha:
+                    raise WeightShipError(
+                        f"weight artifact {path!r} sha256 {actual} != "
+                        f"published {sha} — refusing corrupt weights")
+                sha = actual
+            with np.load(path, allow_pickle=False) as z:
+                state = {k: z[k] for k in z.files}
+            self.engine.swap_weights(state, sha)
+        except Exception as e:  # noqa: BLE001 — typed rejection, old
+            #                     weights keep serving
+            self.conn.send("swapped", {"wid": wid, "ok": False,
+                                       "etype": type(e).__name__,
+                                       "msg": str(e)[:500]})
+            return
+        self.weights_sha = sha
+        if self._cache is not None:
+            # a post-partition re-attach carrying the NEW manifest must
+            # reuse this engine and ship zero bytes
+            self._cache["weights_sha"] = sha
+            key = self._cache.get("key")
+            if key is not None:
+                self._cache["key"] = (key[0], sha, key[2])
+        self.conn.send("swapped", {"wid": wid, "ok": True,
+                                   "weights_sha": sha})
+
+    def _recv_swap_chunks(self, man: dict, path: str):
+        """Receive the swap artifact's chunk stream (sent only after our
+        `swap_ready` ack, so no chunk can race into the serve loop's
+        frame batch ahead of this read)."""
+        _recv_artifacts(self.conn, {"weights": (man, path)})
 
     # -- outbound stream/status -----------------------------------------
     def _flush_one(self, wid: int, entry: list) -> bool:
@@ -1176,7 +1247,8 @@ def _serve_session(lsock: socket.socket, conn: _FrameConn, attach: dict,
     server = _WorkerServer(engine, conn, None, index, epoch=epoch,
                            beat_conn=beat_conn, manager_silence_s=silence,
                            listener=lsock,
-                           weights_sha=cache.get("weights_sha"))
+                           weights_sha=cache.get("weights_sha"),
+                           cache=cache)
     server._push_beat(force=True)
     rc = server.serve()
     conn.close()
@@ -1623,7 +1695,7 @@ class WorkerClient:
         elif verb == "dying":
             self._dead = _mk_error(h.get("etype", ""), h.get("msg", ""))
         elif verb in ("bye", "log", "metrics", "preempted", "restored",
-                      "accepted", "attach_ok"):
+                      "accepted", "attach_ok", "swap_ready", "swapped"):
             pass  # bye/log informational; RPC replies consumed by _rpc;
             #       accepted acks matter only to the remote subclass
 
@@ -1936,6 +2008,31 @@ class WorkerClient:
             raise WorkerDiedError(
                 f"worker {self.index} has no connection")
         self._conn.send("fault", {"point": point, "value": value})
+
+    # -- engine surface: continuous weight refresh ---------------------
+    def swap_weights(self, path: str, sha: Optional[str] = None,
+                     timeout_s: float = 60.0) -> str:
+        """Flip the worker's served weights to the npz artifact at
+        `path` (same host — the spawned worker shares our filesystem)
+        with zero recompiles.  The worker verifies `sha` against the
+        file before a byte reaches its engine; any rejection comes back
+        as the typed error (WeightShipError for corrupt artifacts,
+        InvalidArgumentError for shape mismatches) and the worker keeps
+        serving its OLD weights.  Returns the served sha.  Driving
+        thread only; the fleet calls this at the replica's idle
+        boundary."""
+        if self._conn is None:
+            raise WorkerDiedError(
+                f"worker {self.index} has no connection")
+        wid = self._wid
+        self._wid += 1
+        h, _ = self._rpc("swap_weights",
+                         {"wid": wid, "path": path, "sha256": sha},
+                         None, "swapped", timeout_s=timeout_s)
+        if not h.get("ok"):
+            raise _mk_error(h.get("etype", ""), h.get("msg", ""))
+        self.weights_sha = h.get("weights_sha", sha)
+        return self.weights_sha
 
     # -- engine surface: teardown --------------------------------------
     def _abort_all(self, make_exc):
@@ -2284,6 +2381,58 @@ class RemoteWorkerClient(WorkerClient):
         # no pid to poll across a network: the session being open and
         # un-dead IS aliveness; staleness is heartbeat_age's verdict
         return not self._closed and self._dead is None
+
+    # -- continuous weight refresh over the wire -----------------------
+    def swap_weights(self, path: str, sha: Optional[str] = None,
+                     timeout_s: float = 120.0) -> str:
+        """Ship the artifact at `path` to the remote worker and flip it
+        in, zero recompiles.  Two phases: the manifest goes first and
+        the chunk stream starts only after the worker's `swap_ready`
+        ack, so no chunk can land inside an unrelated frame batch.
+        Every chunk and the assembled file are sha256-verified on the
+        worker; a corrupt artifact is refused there (typed
+        WeightShipError here) with the old weights still serving."""
+        import hashlib
+        from .transfer import artifact_manifest, iter_artifact_chunks
+        if self._conn is None:
+            raise WorkerDiedError(
+                f"worker {self.index} has no connection")
+        man = artifact_manifest(path)
+        if sha is not None and man.get("sha256") != sha:
+            raise WeightShipError(
+                f"weight artifact {path!r} sha256 {man.get('sha256')} "
+                f"!= published {sha} — refusing to ship a corrupt "
+                "artifact")
+        sha = man.get("sha256")
+        wid = self._wid
+        self._wid += 1
+        self._rpc("swap_weights",
+                  {"wid": wid, "sha256": sha, "manifest": man},
+                  None, "swap_ready", timeout_s=timeout_s)
+        for seq, data in iter_artifact_chunks(path):
+            self._conn.send(
+                "weights_chunk",
+                {"seq": seq, "sha256": hashlib.sha256(data).hexdigest()},
+                {"data": np.frombuffer(data, np.uint8).copy()})
+            self.bytes_shipped += len(data)
+        self._conn.send("attach_end", {})
+        self._last_tx = time.monotonic()
+        # wait for the verdict, pumping unrelated frames normally
+        deadline = time.monotonic() + timeout_s
+        while True:
+            for frame in self._conn.recv_frames(0.01):
+                v, h, a = frame
+                if v == "swapped" and h.get("wid") == wid:
+                    if not h.get("ok"):
+                        raise _mk_error(h.get("etype", ""),
+                                        h.get("msg", ""))
+                    self.weights_sha = h.get("weights_sha", sha)
+                    return self.weights_sha
+                self._dispatch(frame)
+            if time.monotonic() > deadline:
+                raise WorkerDiedError(
+                    f"remote worker {self.index} swap_weights timed out "
+                    f"after {timeout_s}s")
 
     @property
     def pid(self) -> int:

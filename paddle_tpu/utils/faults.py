@@ -154,6 +154,28 @@ Injection points (consumed elsewhere in the framework):
                   wedging one would wedge the fleet (the limitation that
                   motivates subprocess isolation).  Env:
                   PDTPU_FAULT_REPLICA_WEDGE="replica:tick".
+  publish_corrupt the n-th weight artifact PUBLISHED by this process
+                  (1-based, counted per process) is corrupted in place
+                  POST-rename — truncated and bit-flipped AFTER the
+                  atomic publish already made it visible, so the watch
+                  signal fires on garbage bytes while the manifest
+                  (written pre-rename) still names the good sha256.
+                  The continuous-refresh pipeline must catch it at one
+                  of its verify gates — the refresher's whole-file sha
+                  check, the artifact channel's chunk verify, or the
+                  post-flip canary — and keep serving the OLD weights;
+                  corrupt weights must never reach a stream.  Consulted
+                  by serving/refresh.py's WeightPublisher.  Env:
+                  PDTPU_FAULT_PUBLISH_CORRUPT="n".
+  canary_diverge  while armed, the FleetRefresher's post-flip canary
+                  gate reports a stream mismatch regardless of the real
+                  comparison — the model-regressed-but-mechanically-
+                  valid publish (bad training step, wrong checkpoint):
+                  every byte verifies, yet the outputs changed.  The
+                  refresher must roll the canary replica back to the
+                  previous weights_sha, quarantine the publish, and
+                  leave the whole fleet converged on the old weights.
+                  Env: PDTPU_FAULT_CANARY_DIVERGE="1".
 
 Deliberately import-light (no jax at module scope): DataLoader worker
 processes and the bench orchestrator consult it before any backend exists.
@@ -176,7 +198,9 @@ __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "replica_slow_config", "maybe_slow_replica",
            "replica_wedge_config", "maybe_wedge_replica",
            "net_delay_config", "net_drop_frame", "maybe_net_drop",
-           "net_partition_config", "net_partition_active"]
+           "net_partition_config", "net_partition_active",
+           "publish_corrupt_n", "maybe_corrupt_publish",
+           "canary_diverge"]
 
 _ENV = {
     "nan_grads": "PDTPU_FAULT_NAN_GRADS",
@@ -196,11 +220,14 @@ _ENV = {
     "net_delay": "PDTPU_FAULT_NET_DELAY",
     "net_drop": "PDTPU_FAULT_NET_DROP",
     "net_partition": "PDTPU_FAULT_NET_PARTITION",
+    "publish_corrupt": "PDTPU_FAULT_PUBLISH_CORRUPT",
+    "canary_diverge": "PDTPU_FAULT_CANARY_DIVERGE",
 }
 
 _lock = threading.Lock()
 _registry = {}          # point -> raw config string (authoritative mirror)
 _save_counter = {"n": 0}  # kill_mid_save is counted per process
+_publish_counter = {"n": 0}  # publish_corrupt is counted per process
 _net_state = {"frames": 0, "drop_fired": False, "partitions": {}}
 
 
@@ -228,6 +255,7 @@ def reset():
         disable(point)
     with _lock:
         _save_counter["n"] = 0
+        _publish_counter["n"] = 0
         _net_state["frames"] = 0
         _net_state["drop_fired"] = False
         _net_state["partitions"] = {}
@@ -330,6 +358,57 @@ def maybe_kill_mid_save():
         n = _save_counter["n"]
     if n >= int(raw):
         os.kill(os.getpid(), signal.SIGKILL)
+
+
+# -- publish_corrupt ---------------------------------------------------------
+
+def publish_corrupt_n() -> Optional[int]:
+    """Which publish (1-based, per process) to corrupt, or None."""
+    raw = get("publish_corrupt")
+    if not raw:
+        return None
+    return int(raw)
+
+
+def maybe_corrupt_publish(path: str) -> bool:
+    """Called by the WeightPublisher AFTER the atomic rename made the
+    weight artifact at `path` visible.  Counts publishes per process; on
+    the n-th, the artifact is truncated and bit-flipped IN PLACE — the
+    manifest written pre-rename still names the good sha256, so the
+    corruption is exactly what a torn write / bad disk after the rename
+    looks like to a watcher.  Returns True when it fired.  One of the
+    refresh pipeline's verify gates (whole-file sha check, chunked ship
+    verify, canary) must catch it; corrupt weights must never serve."""
+    n = publish_corrupt_n()
+    if n is None:
+        return False
+    with _lock:
+        _publish_counter["n"] += 1
+        cnt = _publish_counter["n"]
+    if cnt != n:
+        return False
+    try:
+        size = os.path.getsize(path)
+        keep = max(1, int(size * 0.7))
+        with open(path, "r+b") as f:
+            f.truncate(keep)
+            pos = max(0, keep // 2)
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+    except OSError:
+        pass  # a vanished file corrupts even harder
+    return True
+
+
+# -- canary_diverge ----------------------------------------------------------
+
+def canary_diverge() -> bool:
+    """True while armed: the post-flip canary gate must report a stream
+    mismatch regardless of the real comparison, exercising auto-rollback
+    end to end (serving/refresh.py)."""
+    return bool(get("canary_diverge"))
 
 
 # -- nan_logits --------------------------------------------------------------
