@@ -1053,6 +1053,42 @@ def measure_prefix_cache():
     return {"error": (proc.stderr or proc.stdout)[-400:]}
 
 
+def measure_lora():
+    """PR-20 acceptance artifact: probes/lora_probe.py in a clean CPU
+    subprocess.  Publishes the batched multi-tenant LoRA story as
+    `detail.lora.{mixed_adapter_tokens_ratio,
+    adapter_ship_to_first_token_s,swap_zero_compiles}` — bars:
+    mixed-adapter Poisson traffic >= 0.8x the single-model ceiling's
+    tokens/sec with 8 live adapters, >= 8 DISTINCT adapters resident in
+    one decode tick, eager wrapper logits within 1e-4 of the dense
+    merged-weight oracle, every mixed-batch stream bit-identical to its
+    solo single-adapter oracle, adapter id 0 bit-identical to a no-LoRA
+    engine, loaded adapters SURVIVE a swap_weights base flip with zero
+    compiles, zero post-warmup compiles on every leg and the compile
+    bound UNCHANGED at len(buckets)+1 (an adapter is data, not a
+    program).  `adapter_ship_to_first_token_s` is measured on a fleet
+    of one in-process replica + one remote `--listen` worker: artifact
+    on disk -> chunked sha-verified ship -> first token, with NO
+    rollout."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "probes", "lora_probe.py"),
+         "--steps", os.environ.get("PDTPU_LORA_PROBE_STEPS", "24")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=here)
+    for line in proc.stdout.splitlines():
+        if line.startswith("LORA"):
+            rec = json.loads(line[len("LORA"):])
+            if rec.get("failures"):
+                # a bar miss must never publish at the headline keys
+                return {"error": f"lora bars failed: {rec['failures']}",
+                        "unpublished_failed_bars": rec}
+            return rec
+    return {"error": (proc.stderr or proc.stdout)[-400:]}
+
+
 def measure_hbm():
     """ISSUE-10 acceptance artifact: probes/hbm_probe.py in a clean CPU
     subprocess.  Publishes the conv-net memory-discipline story as
@@ -1357,6 +1393,7 @@ def main():
                          ("hbm", measure_hbm),
                          ("paged", measure_paged_serving),
                          ("prefix", measure_prefix_cache),
+                         ("lora", measure_lora),
                          ("program_cache", measure_program_cache),
                          ("spec_decode", measure_spec_decode),
                          ("gateway", measure_gateway),

@@ -1,0 +1,32 @@
+"""paddle_tpu.lora — batched LoRA adapters: train rank-r fine-tunes on a
+frozen base, export them as sha-verified artifacts, and serve a
+thousand of them on ONE base model with per-slot adapter ids as dynamic
+inputs to the unchanged serving program family.
+
+Train:   apply_lora(model, rank=8) -> train (only adapters move)
+         export_adapter(model, "tenant_a.npz")
+Serve:   eng = ServingEngine(model, lora=LoRAConfig(rank=8,
+                                                    max_adapters=8))
+         eng.load_adapter("tenant_a", "tenant_a.npz")
+         eng.make_request(prompt, 32, adapter="tenant_a")
+Fleet:   fleet.load_adapter("tenant_a", "tenant_a.npz")  # ships the
+         artifact sha256-verified to every subprocess/remote worker
+Gateway: Gateway(eng, tenants={"a": TenantConfig(adapter="tenant_a")})
+"""
+from .layers import (DEFAULT_TARGETS, LoRALinear, LoRAWrapper,  # noqa: F401
+                     adapter_context, apply_lora, attach_serving_lora,
+                     lora_keys)
+from .train import (ADAPTER_VERSION, AdapterIntegrityError,  # noqa: F401
+                    base_weights_hash, export_adapter, load_adapter,
+                    read_adapter)
+from .registry import (AdapterExhaustedError, AdapterNotFoundError,  # noqa: F401
+                       AdapterRegistry, LoRAConfig)
+
+__all__ = [
+    "LoRALinear", "LoRAWrapper", "apply_lora", "DEFAULT_TARGETS",
+    "lora_keys", "adapter_context", "attach_serving_lora",
+    "export_adapter", "read_adapter", "load_adapter", "base_weights_hash",
+    "ADAPTER_VERSION", "AdapterIntegrityError",
+    "LoRAConfig", "AdapterRegistry", "AdapterNotFoundError",
+    "AdapterExhaustedError",
+]

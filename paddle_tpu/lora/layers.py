@@ -1,0 +1,296 @@
+"""LoRA adapter layers: the train-side wrapper and the serve-side
+batched shim.
+
+Two distinct classes on purpose:
+
+- `LoRALinear` (train) owns REAL rank-r parameters (`lora_A`, `lora_B`)
+  registered on the layer, so they flow through `state_dict()`,
+  `jit.TrainStep` (which selects trainables by the `trainable` flag),
+  checkpointing and `recompute_policy` like any other parameter.  The
+  wrapped base layer's parameters are frozen (`trainable=False` +
+  `stop_gradient`) — `apply_lora` freezes the WHOLE model first, so
+  only adapter factors move under training.
+
+- the serve side does NO model surgery at all: `attach_serving_lora`
+  installs a forward POST-HOOK on each target linear that adds
+  `scale[aid] * (x @ A[aid]) @ B[aid]` to the layer's output.  The
+  factor STACKS `[max_adapters+1, ...]` arrive at trace time through a
+  thread-local `adapter_context` — they are ordinary program arguments
+  of the serving programs and the adapter id is a per-slot dynamic
+  input, so heterogeneous adapters batch inside ONE compiled
+  decode/verify program (the PR-4 per-slot dynamic-sampling pattern).
+  Slot 0 of every stack is all-zero with scale 0: adapter id 0 is the
+  base model, bit-identical (`y + 0.0*(...)`) to a no-LoRA engine.
+  Because the hook registers no parameters, buffers or sublayers, the
+  engine's `state_dict()` key set — and with it `swap_weights`
+  validation, weight refresh, `engine_config_hash` and the
+  run-transfer codec — is byte-for-byte unchanged.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, unwrap
+from ..nn.layer_base import Layer
+from ..nn import initializer as I
+
+__all__ = ["LoRALinear", "LoRAWrapper", "apply_lora", "DEFAULT_TARGETS",
+           "adapter_context", "attach_serving_lora", "lora_keys"]
+
+# GPTBlock's four projection Linears — the default adaptation surface.
+# Targets are matched by ATTRIBUTE NAME anywhere in the layer tree, so
+# the same tuple works for any stack of blocks.
+DEFAULT_TARGETS = ("qkv", "proj", "ffn_in", "ffn_out")
+
+
+def _linear_like(layer) -> bool:
+    """Anything with in/out feature counts and a callable forward can be
+    LoRA-wrapped — covers `nn.Linear` AND `quantization.
+    Int8WeightOnlyLinear` (int8 base weights compose; the adapter factors
+    stay fp32)."""
+    return (hasattr(layer, "in_features") and hasattr(layer, "out_features")
+            and isinstance(layer, Layer))
+
+
+class LoRALinear(Layer):
+    """Frozen base + trainable rank-r update: `y = base(x) +
+    (alpha/rank) * (x @ A) @ B`.
+
+    `A` is Normal(0, 1/rank)-initialised, `B` starts at zero — the
+    wrapped layer is EXACTLY the base layer at step 0, so wrapping never
+    perturbs a pretrained model until training moves `B`.
+    """
+
+    def __init__(self, base, rank: int = 8, alpha: Optional[float] = None):
+        super().__init__()
+        if not _linear_like(base):
+            raise TypeError(
+                f"LoRALinear needs a linear-like base layer with "
+                f"in_features/out_features, got {type(base).__name__}")
+        if rank <= 0:
+            raise ValueError(f"LoRA rank must be positive, got {rank}")
+        self.rank = int(rank)
+        self.alpha = float(alpha if alpha is not None else 2 * rank)
+        self.scaling = self.alpha / self.rank
+        self.base = base
+        for p in base.parameters():
+            p.trainable = False
+            p.stop_gradient = True
+        in_f, out_f = int(base.in_features), int(base.out_features)
+        self.in_features, self.out_features = in_f, out_f
+        self.lora_A = self.create_parameter(
+            (in_f, self.rank), default_initializer=I.Normal(0.0, 1.0 / rank))
+        self.lora_B = self.create_parameter(
+            (self.rank, out_f), default_initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        # traced ops, not raw jnp: the delta path must record tape nodes
+        # so d(loss)/d(lora_A|lora_B) flows while the base stays frozen
+        from ..tensor.linalg import matmul
+        y = self.base(x)
+        delta = matmul(matmul(x, self.lora_A), self.lora_B) * self.scaling
+        return y + delta
+
+    def merged_weight(self):
+        """The dense-equivalent weight `W + scaling * A @ B` (test oracle
+        and offline-merge export)."""
+        return (unwrap(self.base.weight)
+                + self.scaling * (unwrap(self.lora_A) @ unwrap(self.lora_B)))
+
+    def extra_repr(self):
+        return (f"rank={self.rank}, alpha={self.alpha}, "
+                f"base={type(self.base).__name__}")
+
+
+def _walk_targets(model: Layer, targets: Sequence[str], prefix=""):
+    """Yield (parent, attr_name, dotted_path, child) for every
+    linear-like child whose attribute name is in `targets`, in layer-tree
+    order (deterministic: OrderedDict)."""
+    for name, child in list(model._sub_layers.items()):
+        if child is None:
+            continue
+        path = f"{prefix}.{name}" if prefix else name
+        if name in targets and _linear_like(child):
+            yield model, name, path, child
+        else:
+            yield from _walk_targets(child, targets, path)
+
+
+def apply_lora(model: Layer, rank: int = 8, alpha: Optional[float] = None,
+               targets: Sequence[str] = DEFAULT_TARGETS):
+    """In-place LoRA conversion for TRAINING: freezes every parameter of
+    `model`, then swaps each target Linear for a `LoRALinear` wrapper
+    whose rank-r factors are the only trainables left.  Returns the list
+    of wrapped dotted paths (the adapter's key set).  Composes with
+    `TrainStep(accum_steps=)`, `jit.recompute_policy` and guarded steps
+    exactly like any other model surgery — the swap goes through
+    `setattr` so attribute-style forwards see the wrapper."""
+    for p in model.parameters():
+        p.trainable = False
+        p.stop_gradient = True
+    wrapped = []
+    for parent, name, path, child in _walk_targets(model, tuple(targets)):
+        if isinstance(child, LoRALinear):
+            continue
+        setattr(parent, name, LoRALinear(child, rank=rank, alpha=alpha))
+        wrapped.append(path)
+    if not wrapped:
+        raise ValueError(
+            f"apply_lora found no linear-like layers named {tuple(targets)} "
+            f"in {type(model).__name__}")
+    return wrapped
+
+
+class LoRAWrapper(Layer):
+    """Model-level LoRA handle: wraps `model` in place via `apply_lora`
+    and keeps the train->export lifecycle in one object.
+
+        w = LoRAWrapper(model, rank=8)      # freezes base, wraps targets
+        loss = w(ids).mean(); loss.backward()   # only factors move
+        sha = w.export("tenant_a.npz")      # adapter-only artifact
+
+    The wrapper is a thin Layer over the SAME (mutated) model — the
+    underlying module keeps working wherever it is already referenced,
+    and `state_dict`/`TrainStep`/checkpointing see the wrapped model's
+    parameters through the `model` sublayer hop.
+    """
+
+    def __init__(self, model: Layer, rank: int = 8,
+                 alpha: Optional[float] = None,
+                 targets: Sequence[str] = DEFAULT_TARGETS):
+        super().__init__()
+        self.paths = apply_lora(model, rank=rank, alpha=alpha,
+                                targets=targets)
+        self.model = model
+        self.rank = int(rank)
+        self.targets = tuple(targets)
+
+    def forward(self, *args, **kwargs):
+        return self.model(*args, **kwargs)
+
+    def trainable_parameters(self):
+        """Only the rank-r factors — everything else is frozen."""
+        return [p for p in self.model.parameters() if p.trainable]
+
+    def export(self, path: str, alpha=None) -> str:
+        """Write the adapter-only npz artifact; returns its file sha256."""
+        from .train import export_adapter
+        return export_adapter(self.model, path, alpha=alpha)
+
+    def load(self, path: str):
+        """Restore previously exported factors into this wrapper (resume
+        fine-tuning from an adapter artifact)."""
+        from .train import load_adapter
+        return load_adapter(self.model, path)
+
+    def extra_repr(self):
+        return f"rank={self.rank}, wrapped={len(self.paths)}"
+
+
+# ---------------------------------------------------------------------------
+# serving: batched adapter shim + trace-time context
+# ---------------------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+class adapter_context:
+    """Trace-time context supplying the factor stacks and the (traced)
+    adapter id to every `_BatchedLoRALinear` reached by the forward.
+    Entered INSIDE program bodies — per vmapped row for decode/verify,
+    once with a scalar id for prefill — so the values are tracers and the
+    context only exists while the program is being traced."""
+
+    __slots__ = ("stacks", "scales", "aid", "_prev")
+
+    def __init__(self, stacks: Dict[str, Tuple], scales, aid):
+        self.stacks = stacks
+        self.scales = scales
+        self.aid = aid
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "ctx", None)
+        _TLS.ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.ctx = self._prev
+        return False
+
+
+def _current_ctx():
+    return getattr(_TLS, "ctx", None)
+
+
+def _lora_post_hook(lora_key: str):
+    """The serving delta as a forward post-hook: outside any
+    `adapter_context` the layer is the base verbatim (warmup paths that
+    never enter a context, any non-serving use of the model); inside
+    one, the per-adapter factors are gathered by the (traced) adapter
+    id and added to the layer's output."""
+
+    def hook(layer, inputs, output):
+        ctx = _current_ctx()
+        if ctx is None:
+            return None
+        A, B = ctx.stacks[lora_key]
+        a = jnp.take(A, ctx.aid, axis=0, mode="clip")
+        b = jnp.take(B, ctx.aid, axis=0, mode="clip")
+        s = jnp.take(ctx.scales, ctx.aid, mode="clip")
+        xr = unwrap(inputs[0])
+        delta = ((xr @ a) @ b) * s
+        return output + Tensor(delta)
+    return hook
+
+
+def attach_serving_lora(model: Layer,
+                        targets: Sequence[str] = DEFAULT_TARGETS):
+    """Arm `model` for batched multi-adapter serving: installs the LoRA
+    forward post-hook on every target linear.  NO model surgery — no new
+    parameters, buffers or sublayers — so `state_dict()` keys,
+    `swap_weights` validation and weight-refresh artifacts are untouched
+    (int8-quantized bases hook identically: the adapter delta stays
+    fp32 on top of the int8 matmul).  Returns {dotted_path:
+    (in_features, out_features)} — the registry sizes its device stacks
+    from this.  Rejects a model that is already armed or train-wrapped
+    (double-hooking would double-apply adapters)."""
+    shapes = {}
+    for parent, name, path, child in _walk_targets(model, tuple(targets)):
+        if isinstance(child, LoRALinear):
+            raise ValueError(
+                f"layer {path} is a train-side LoRALinear; serve either "
+                "the merged model or the base model + exported adapter, "
+                "not the training wrapper")
+        if getattr(child, "_lora_serving_key", None) is not None:
+            raise ValueError(f"layer {path} already has serving LoRA "
+                             "attached")
+        child._lora_serving_key = path
+        child.register_forward_post_hook(_lora_post_hook(path))
+        shapes[path] = (int(child.in_features), int(child.out_features))
+    if not shapes:
+        raise ValueError(
+            f"no linear-like layers named {tuple(targets)} found in "
+            f"{type(model).__name__}")
+    return shapes
+
+
+def lora_keys(model: Layer):
+    """Sorted dotted paths of LoRA-wrapped layers (train or serve)."""
+    keys = []
+
+    def walk(layer, prefix=""):
+        for name, child in layer._sub_layers.items():
+            if child is None:
+                continue
+            path = f"{prefix}.{name}" if prefix else name
+            if (isinstance(child, LoRALinear)
+                    or getattr(child, "_lora_serving_key", None)):
+                keys.append(path)
+            else:
+                walk(child, path)
+    walk(model)
+    return sorted(keys)

@@ -1,0 +1,341 @@
+"""AdapterRegistry: page LoRA factor stacks in and out of a FIXED device
+buffer, the way the paged KV pool manages blocks.
+
+The registry owns, per wrapped layer key, two device stacks
+
+    A[max_adapters + 1, in_features, rank]
+    B[max_adapters + 1, rank, out_features]
+
+plus one `scale[max_adapters + 1]` vector.  Slot 0 is permanently the
+base model: all-zero factors with scale 0, so adapter id 0 is
+bit-identical to running without LoRA.  Slots 1..max_adapters hold
+loaded adapters; when all are occupied a new `register()` evicts the
+least-recently-used slot with ZERO active references (requests pin
+their adapter from admission to release), and if every slot is pinned
+it raises the typed `AdapterExhaustedError` backpressure signal instead
+of blocking.
+
+Page-in never compiles after construction: slot writes go through ONE
+jitted scatter per distinct stack shape, traced eagerly at
+construction with the out-of-bounds sentinel index (`mode="drop"` makes
+the warmup write a no-op) — the `_cow_fn` precompile pattern from the
+paged KV pool.  The writer deliberately does NOT donate its input:
+`load_adapter` runs on a command/RPC thread while the engine loop may
+hold references from an earlier `device_args()`, and donating would
+delete those buffers under a launching decode call.  The copy is
+O(stacks) per page-in — cheap, rare, and race-free.
+The stacks are ordinary arguments of the serving
+programs (`device_args()`), NOT engine state: the `state_dict()` key
+set, `swap_weights` validation and the run-transfer codec are
+untouched.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import (EnforceNotMet, InvalidArgumentError,
+                           NotFoundError, ResourceExhaustedError)
+from ..utils.monitor import stat_add
+from .layers import DEFAULT_TARGETS
+from .train import AdapterIntegrityError, read_adapter
+
+__all__ = ["LoRAConfig", "AdapterRegistry", "AdapterNotFoundError",
+           "AdapterExhaustedError", "AdapterIntegrityError"]
+
+
+class AdapterNotFoundError(NotFoundError):
+    """No adapter with that name is loaded in the registry (terminal
+    typed rejection — a consumer must never hang on an unknown
+    adapter)."""
+    code = "NotFound"
+
+
+class AdapterExhaustedError(ResourceExhaustedError):
+    """Every adapter slot is pinned by in-flight requests — typed
+    backpressure, retry after traffic drains."""
+    code = "ResourceExhausted"
+
+
+class LoRAConfig:
+    """Serve-side LoRA configuration for `ServingEngine(lora=...)`.
+
+    rank             factor rank every loadable adapter must match
+    max_adapters     loadable slots (the device buffer holds
+                     max_adapters + 1 stacks; slot 0 is the base model)
+    targets          attribute names to wrap (GPTBlock projections by
+                     default)
+    check_base_hash  verify each artifact's recorded base-weights hash
+                     against this engine's base model.  Set False when
+                     the serving base differs from the training base by
+                     construction — e.g. int8 weight-only quantization
+                     (adapters stay fp32 on top of the int8 base).
+    base_sha         expected base hash override (defaults to hashing
+                     the engine's model at registry construction).
+    """
+
+    __slots__ = ("rank", "max_adapters", "targets", "check_base_hash",
+                 "base_sha")
+
+    def __init__(self, rank: int = 8, max_adapters: int = 8,
+                 targets: Sequence[str] = DEFAULT_TARGETS,
+                 check_base_hash: bool = True,
+                 base_sha: Optional[str] = None):
+        if rank <= 0:
+            raise InvalidArgumentError(f"LoRA rank must be positive, "
+                                       f"got {rank}")
+        if max_adapters <= 0:
+            raise InvalidArgumentError(
+                f"max_adapters must be positive, got {max_adapters}")
+        self.rank = int(rank)
+        self.max_adapters = int(max_adapters)
+        self.targets = tuple(targets)
+        self.check_base_hash = bool(check_base_hash)
+        self.base_sha = base_sha
+
+    def spec(self) -> dict:
+        """json-portable form (worker boot specs, program-set
+        manifests)."""
+        return {"rank": self.rank, "max_adapters": self.max_adapters,
+                "targets": list(self.targets),
+                "check_base_hash": self.check_base_hash,
+                "base_sha": self.base_sha}
+
+    @classmethod
+    def from_spec(cls, spec: dict) -> "LoRAConfig":
+        return cls(rank=spec.get("rank", 8),
+                   max_adapters=spec.get("max_adapters", 8),
+                   targets=tuple(spec.get("targets", DEFAULT_TARGETS)),
+                   check_base_hash=spec.get("check_base_hash", True),
+                   base_sha=spec.get("base_sha"))
+
+
+class _Slot:
+    __slots__ = ("name", "refs", "tick", "file_sha")
+
+    def __init__(self):
+        self.name = None
+        self.refs = 0
+        self.tick = 0
+        self.file_sha = None
+
+
+class AdapterRegistry:
+    """Thread-safe adapter slot manager + device factor stacks."""
+
+    def __init__(self, cfg: LoRAConfig,
+                 shapes: Dict[str, Tuple[int, int]],
+                 base_sha: Optional[str] = None):
+        self.cfg = cfg
+        self.keys = tuple(sorted(shapes))
+        self.base_sha = cfg.base_sha or base_sha
+        self._lock = threading.RLock()
+        self._tick = 0
+        m = cfg.max_adapters + 1
+        self._A = {}
+        self._B = {}
+        for k in self.keys:
+            in_f, out_f = shapes[k]
+            self._A[k] = jnp.zeros((m, in_f, cfg.rank), jnp.float32)
+            self._B[k] = jnp.zeros((m, cfg.rank, out_f), jnp.float32)
+        self._scales = jnp.zeros((m,), jnp.float32)
+        self._slots = [_Slot() for _ in range(m)]
+        self._slots[0].name = "<base>"
+        self._slots[0].refs = 1  # the base slot is never evictable
+        self._by_name: Dict[str, int] = {}
+        self._evictions = 0
+        self._loads = 0
+        # one jitted slot-writer per distinct (stack, row) aval pair
+        # (jax.jit caches by aval); warmed NOW with the sentinel index
+        # so a live page-in never traces — the post-warmup zero-compile
+        # contract extends to adapter hot-load.  No donation: see the
+        # module docstring (thread-safety vs. the engine loop's
+        # device_args() references).
+        self._write = jax.jit(
+            lambda stack, idx, row: stack.at[idx].set(row, mode="drop"))
+        sent = jnp.int32(m)
+        for k in self.keys:
+            self._A[k] = self._write(
+                self._A[k], sent, jnp.zeros(self._A[k].shape[1:],
+                                            jnp.float32))
+            self._B[k] = self._write(
+                self._B[k], sent, jnp.zeros(self._B[k].shape[1:],
+                                            jnp.float32))
+        self._scales = self._write(self._scales, sent, jnp.float32(0.0))
+        self._publish_gauge()
+
+    # -- device-side views -------------------------------------------------
+    def device_args(self):
+        """The lora program-argument pytree: ((A,B) per key in `self.keys`
+        order, scales).  Passed to every prefill/decode call; the program
+        body rebuilds the {key: (A,B)} dict zip'd with the engine's
+        static key tuple."""
+        with self._lock:
+            return (tuple((self._A[k], self._B[k]) for k in self.keys),
+                    self._scales)
+
+    # -- lifecycle ---------------------------------------------------------
+    def register(self, name: str, path: str) -> int:
+        """Load an adapter artifact into a slot under `name`; returns the
+        slot index (the adapter id).  Idempotent for the same artifact
+        bytes (matching file sha re-uses the existing slot — the
+        zero-byte re-attach path).  Raises typed errors:
+        `AdapterIntegrityError` (corrupt artifact / wrong base),
+        `InvalidArgumentError` (rank/targets mismatch),
+        `AdapterExhaustedError` (all slots pinned)."""
+        if not name or name == "<base>":
+            raise InvalidArgumentError(
+                f"invalid adapter name {name!r}")
+        header, factors, file_sha = read_adapter(path)
+        if header["rank"] != self.cfg.rank:
+            raise InvalidArgumentError(
+                f"adapter {name!r} has rank {header['rank']}, engine "
+                f"was built with LoRAConfig(rank={self.cfg.rank}) — "
+                "ranks are baked into the compiled programs")
+        if sorted(header["keys"]) != list(self.keys):
+            raise InvalidArgumentError(
+                f"adapter {name!r} wraps {sorted(header['keys'])} but "
+                f"the engine wraps {list(self.keys)} "
+                f"(LoRAConfig(targets={list(self.cfg.targets)}))")
+        if (self.cfg.check_base_hash and self.base_sha is not None
+                and header.get("base_sha") != self.base_sha):
+            raise AdapterIntegrityError(
+                f"adapter {name!r} was trained against base weights "
+                f"{header.get('base_sha', '?')[:12]}..., this engine "
+                f"serves base {self.base_sha[:12]}... — refusing to "
+                "apply a mismatched adapter (pass LoRAConfig("
+                "check_base_hash=False) only for deliberate base "
+                "transforms like int8 quantization)")
+        with self._lock:
+            idx = self._by_name.get(name)
+            if idx is not None and self._slots[idx].file_sha == file_sha:
+                self._slots[idx].tick = self._bump()
+                return idx
+            if idx is None:
+                idx = self._alloc(name)
+            slot = self._slots[idx]
+            slot.name = name
+            slot.file_sha = file_sha
+            slot.tick = self._bump()
+            self._by_name[name] = idx
+            i = jnp.int32(idx)
+            for k in self.keys:
+                a, b = factors[k]
+                if (a.shape != self._A[k].shape[1:]
+                        or b.shape != self._B[k].shape[1:]):
+                    raise InvalidArgumentError(
+                        f"adapter {name!r} factor shapes for {k} "
+                        f"({a.shape}/{b.shape}) do not match the engine "
+                        f"({self._A[k].shape[1:]}/{self._B[k].shape[1:]})")
+                self._A[k] = self._write(self._A[k], i, jnp.asarray(a))
+                self._B[k] = self._write(self._B[k], i, jnp.asarray(b))
+            self._scales = self._write(
+                self._scales, i, jnp.float32(header["scaling"]))
+            self._loads += 1
+            stat_add("STAT_lora_adapter_loads")
+            self._publish_gauge()
+            return idx
+
+    def _alloc(self, name: str) -> int:
+        for i in range(1, len(self._slots)):
+            if self._slots[i].name is None:
+                return i
+        victim, oldest = None, None
+        for i in range(1, len(self._slots)):
+            s = self._slots[i]
+            if s.refs == 0 and (oldest is None or s.tick < oldest):
+                victim, oldest = i, s.tick
+        if victim is None:
+            raise AdapterExhaustedError(
+                f"all {self.cfg.max_adapters} adapter slots are pinned "
+                f"by in-flight requests; cannot load {name!r} — retry "
+                "after traffic drains or raise LoRAConfig(max_adapters=)")
+        old = self._slots[victim]
+        self._by_name.pop(old.name, None)
+        old.file_sha = None
+        self._evictions += 1
+        stat_add("STAT_lora_adapter_evictions")
+        return victim
+
+    def _bump(self) -> int:
+        self._tick += 1
+        return self._tick
+
+    # -- request pinning ---------------------------------------------------
+    def resolve(self, name: Optional[str]) -> int:
+        """Name -> adapter id WITHOUT pinning (admission-time lookup,
+        `make_request` validation).  None/'' means the base model."""
+        if not name:
+            return 0
+        with self._lock:
+            idx = self._by_name.get(name)
+            if idx is None:
+                raise AdapterNotFoundError(
+                    f"adapter {name!r} is not loaded on this engine "
+                    f"(loaded: {sorted(self._by_name) or 'none'}) — "
+                    "register it first (engine.load_adapter / "
+                    "fleet.load_adapter)")
+            return idx
+
+    def acquire(self, name: Optional[str]) -> int:
+        """resolve + pin: the slot cannot be evicted until `release`."""
+        if not name:
+            return 0
+        with self._lock:
+            idx = self.resolve(name)
+            self._slots[idx].refs += 1
+            self._slots[idx].tick = self._bump()
+            return idx
+
+    def release(self, idx: int):
+        if idx <= 0:
+            return
+        with self._lock:
+            s = self._slots[idx]
+            if s.refs > 0:
+                s.refs -= 1
+
+    # -- introspection -----------------------------------------------------
+    def loaded(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._by_name)
+
+    def file_sha(self, idx: int) -> Optional[str]:
+        """sha256 of the artifact resident in slot `idx` (the fleet's
+        zero-byte re-attach cache key)."""
+        with self._lock:
+            return self._slots[idx].file_sha
+
+    def shas(self) -> Dict[str, str]:
+        """name -> artifact sha256 of every resident adapter.  Cheap on
+        purpose: health snapshots poll this per replica per tick."""
+        with self._lock:
+            return {n: self._slots[i].file_sha
+                    for n, i in sorted(self._by_name.items())}
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rank": self.cfg.rank,
+                "max_adapters": self.cfg.max_adapters,
+                "loaded": len(self._by_name),
+                "pinned": sum(1 for s in self._slots[1:] if s.refs > 0),
+                "loads": self._loads,
+                "evictions": self._evictions,
+                "adapters": sorted(self._by_name),
+                "shas": {n: self._slots[i].file_sha
+                         for n, i in sorted(self._by_name.items())},
+            }
+
+    def _publish_gauge(self):
+        try:
+            from ..observability import gauge
+            gauge("lora_adapters_loaded",
+                  help="adapters resident in the registry").set(
+                      len(self._by_name))
+        except Exception:
+            pass

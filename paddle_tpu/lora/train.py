@@ -1,0 +1,252 @@
+"""Adapter artifacts: export a trained LoRA model's factors as one
+standalone npz, and read it back with integrity checks.
+
+Artifact layout (single `.npz`, the `jit.save` convention of a json
+header riding as a uint8 array):
+
+    __header__        uint8 json: {version, rank, alpha, scaling,
+                      targets, keys, base_sha, tensor_sha}
+    {key}.A           fp32 [in_features, rank]     per wrapped layer
+    {key}.B           fp32 [rank, out_features]
+
+`tensor_sha` records the sha256 of every factor array's raw bytes — the
+read side re-hashes and raises a typed `AdapterIntegrityError` on any
+mismatch (a poisoned read can reject, never deliver garbage factors).
+`base_sha` is the hash of the FROZEN base weights the adapter was
+trained against; the serving registry refuses to apply an adapter to a
+different base (unless the engine opted out for e.g. an int8-quantized
+base — see `LoRAConfig.check_base_hash`).
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import tempfile
+from typing import Dict, Tuple
+
+import numpy as np
+
+from ..core.errors import EnforceNotMet, InvalidArgumentError
+from .layers import LoRALinear
+
+__all__ = ["export_adapter", "read_adapter", "load_adapter",
+           "base_weights_hash", "AdapterIntegrityError", "ADAPTER_VERSION"]
+
+ADAPTER_VERSION = 1
+
+
+class AdapterIntegrityError(EnforceNotMet, IOError):
+    """Adapter artifact failed an integrity check (corrupt bytes, tensor
+    sha mismatch, or base-weights-hash mismatch)."""
+    code = "DataLoss"
+
+
+def _sha(b: bytes) -> str:
+    return hashlib.sha256(b).hexdigest()
+
+
+def _arr_sha(a: np.ndarray) -> str:
+    a = np.ascontiguousarray(a)
+    return _sha(str(a.dtype).encode() + str(a.shape).encode()
+                + a.tobytes())
+
+
+def state_hash(state: Dict[str, "np.ndarray"]) -> str:
+    """sha256 over a {key: array} state dict, excluding adapter factors
+    and normalising away the `.base` hop LoRA wrappers introduce.  The
+    digest equals `base_weights_hash` of a model carrying those arrays —
+    `swap_weights` uses it to re-pin a live registry's expected base to
+    the freshly-flipped weights without rebuilding anything."""
+    items = []
+    for k, v in state.items():
+        leaf = k.rsplit(".", 1)[-1]
+        if leaf in ("lora_A", "lora_B"):
+            continue
+        items.append((k.replace(".base.", "."), np.asarray(v)))
+    h = hashlib.sha256()
+    for k, a in sorted(items, key=lambda kv: kv[0]):
+        h.update(k.encode())
+        h.update(_arr_sha(a).encode())
+    return h.hexdigest()
+
+
+def base_weights_hash(model) -> str:
+    """sha256 over the model's NON-adapter parameters+buffers.  Keys are
+    normalised by stripping the `.base` hop LoRA wrappers introduce, so
+    the hash of a LoRA-wrapped model equals the hash of the plain base
+    model it was built from — the export/register handshake compares the
+    two directly."""
+    from ..jit import state_arrays
+    return state_hash(state_arrays(model))
+
+
+def _collect_factors(model) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+    out = {}
+
+    def walk(layer, prefix=""):
+        for name, child in layer._sub_layers.items():
+            if child is None:
+                continue
+            path = f"{prefix}.{name}" if prefix else name
+            if isinstance(child, LoRALinear):
+                out[path] = (np.asarray(child.lora_A._data, np.float32),
+                             np.asarray(child.lora_B._data, np.float32))
+            else:
+                walk(child, path)
+    walk(model)
+    return out
+
+
+def export_adapter(model, path: str, alpha=None) -> str:
+    """Write the adapter factors of a LoRA-wrapped `model` to `path` as a
+    standalone npz artifact and return the artifact's file sha256 (the
+    handle the sha-verified ship channel and the registry cache key
+    use)."""
+    factors = _collect_factors(model)
+    if not factors:
+        raise InvalidArgumentError(
+            "export_adapter: model has no LoRALinear layers — call "
+            "lora.apply_lora(model, ...) and train first")
+    ranks = {a.shape[1] for a, _ in factors.values()}
+    if len(ranks) != 1:
+        raise InvalidArgumentError(
+            f"export_adapter: mixed ranks {sorted(ranks)} in one model")
+    first = next(iter(_iter_lora(model)))
+    header = {
+        "version": ADAPTER_VERSION,
+        "rank": int(first.rank),
+        "alpha": float(first.alpha if alpha is None else alpha),
+        "scaling": float(first.scaling),
+        "targets": sorted({k.rsplit(".", 1)[-1] for k in factors}),
+        "keys": sorted(factors),
+        "base_sha": base_weights_hash(model),
+        "tensor_sha": {},
+    }
+    payload = {}
+    for k in sorted(factors):
+        a, b = factors[k]
+        payload[f"{k}.A"] = a
+        payload[f"{k}.B"] = b
+        header["tensor_sha"][f"{k}.A"] = _arr_sha(a)
+        header["tensor_sha"][f"{k}.B"] = _arr_sha(b)
+    payload["__header__"] = np.frombuffer(
+        json.dumps(header, sort_keys=True).encode(), dtype=np.uint8).copy()
+    # atomic publish: a reader (or a crashed exporter) must never see a
+    # half-written artifact
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    with open(path, "rb") as f:
+        return _sha(f.read())
+
+
+def load_adapter(model, path: str):
+    """Train-side restore: read a verified adapter artifact and assign its
+    factors into the matching `LoRALinear` layers of an already-wrapped
+    `model` (resume fine-tuning, or warm-start from another tenant).
+
+    The model's wrapped key set must equal the artifact's `keys` and the
+    ranks must match — mismatches are typed `InvalidArgumentError`s, not
+    silent partial loads.  Returns the artifact header."""
+    header, factors, _ = read_adapter(path)
+    wrapped = {}
+
+    def walk(layer, prefix=""):
+        for name, child in layer._sub_layers.items():
+            if child is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            if isinstance(child, LoRALinear):
+                wrapped[p] = child
+            else:
+                walk(child, p)
+    walk(model)
+    if not wrapped:
+        raise InvalidArgumentError(
+            "load_adapter: model has no LoRALinear layers — call "
+            "lora.apply_lora(model, rank=...) first")
+    if sorted(wrapped) != header["keys"]:
+        raise InvalidArgumentError(
+            f"load_adapter: model wraps {sorted(wrapped)} but artifact "
+            f"{path!r} carries {header['keys']}")
+    for k, lyr in wrapped.items():
+        a, b = factors[k]
+        if a.shape[1] != lyr.rank:
+            raise InvalidArgumentError(
+                f"load_adapter: artifact rank {a.shape[1]} != model rank "
+                f"{lyr.rank} at {k}")
+        if (a.shape[0], b.shape[1]) != (lyr.in_features, lyr.out_features):
+            raise InvalidArgumentError(
+                f"load_adapter: factor shapes {a.shape}x{b.shape} do not "
+                f"fit {k} ({lyr.in_features}->{lyr.out_features})")
+        from ..core.tensor import Tensor
+        lyr.lora_A._data = Tensor(a)._data
+        lyr.lora_B._data = Tensor(b)._data
+    return header
+
+
+def _iter_lora(model):
+    for layer in model.sublayers(include_self=True):
+        if isinstance(layer, LoRALinear):
+            yield layer
+
+
+def read_adapter(path: str):
+    """Load + verify an adapter artifact.  Returns `(header, factors,
+    file_sha)` with `factors = {key: (A, B)}` as fp32 numpy arrays.
+
+    The raw file bytes pass through the `adapter_corrupt` fault point
+    (PDTPU_FAULT_ADAPTER_CORRUPT=n poisons the n-th read) BEFORE any
+    verification, so an injected corruption is caught exactly where a
+    real one would be: a typed `AdapterIntegrityError`, never silently
+    garbage factors."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as e:
+        raise AdapterIntegrityError(
+            f"adapter artifact {path!r} unreadable: {e}") from e
+    from ..utils import faults
+    raw = faults.maybe_corrupt_adapter_read(raw, path)
+    file_sha = _sha(raw)
+    try:
+        z = np.load(io.BytesIO(raw), allow_pickle=False)
+        header = json.loads(bytes(z["__header__"].tobytes()).decode())
+        factors = {}
+        for k in header["keys"]:
+            factors[k] = (np.asarray(z[f"{k}.A"], np.float32),
+                          np.asarray(z[f"{k}.B"], np.float32))
+    except AdapterIntegrityError:
+        raise
+    except Exception as e:
+        raise AdapterIntegrityError(
+            f"adapter artifact {path!r} corrupt or malformed: "
+            f"{type(e).__name__}: {e}") from e
+    if header.get("version") != ADAPTER_VERSION:
+        raise AdapterIntegrityError(
+            f"adapter artifact {path!r}: version "
+            f"{header.get('version')!r} != supported {ADAPTER_VERSION}")
+    for k in header["keys"]:
+        a, b = factors[k]
+        for suffix, arr in ((f"{k}.A", a), (f"{k}.B", b)):
+            want = header["tensor_sha"].get(suffix)
+            got = _arr_sha(arr)
+            if want != got:
+                raise AdapterIntegrityError(
+                    f"adapter artifact {path!r}: tensor {suffix} sha256 "
+                    f"mismatch (recorded {want}, recomputed {got}) — "
+                    "refusing to load garbage factors; re-ship the "
+                    "artifact")
+    return header, factors, file_sha

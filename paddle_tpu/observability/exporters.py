@@ -174,6 +174,14 @@ def report() -> dict:
             _gauge_value("prefix_cache_evictions_total") or 0,
         "prefix_cache_cow_copies":
             _gauge_value("prefix_cache_cow_copies_total") or 0,
+        # multi-tenant LoRA on the serving path: registry residency and
+        # page-in/out churn (`adapter_active` mirrors the
+        # lora_adapters_loaded gauge; ship_retries counts artifact
+        # re-ships after a corrupt/failed transfer)
+        "adapter_loads": stats.get("STAT_lora_adapter_loads", 0),
+        "adapter_evictions": stats.get("STAT_lora_adapter_evictions", 0),
+        "adapter_ship_retries": stats.get("STAT_lora_ship_reships", 0),
+        "adapter_active": _gauge_value("lora_adapters_loaded") or 0,
     }
     fleet = {
         "replicas_up": _gauge_value("fleet_replicas_up"),
@@ -219,6 +227,16 @@ def report() -> dict:
         "preemptions": stats.get("STAT_gateway_preemptions", 0),
         "resumes": stats.get("STAT_gateway_resumes", 0),
     }
+    # multi-tenant LoRA: registry residency + artifact shipping volume
+    lora = {
+        "adapters_loaded": _gauge_value("lora_adapters_loaded"),
+        "adapter_loads": stats.get("STAT_lora_adapter_loads", 0),
+        "adapter_evictions": stats.get("STAT_lora_adapter_evictions", 0),
+        "rejects": stats.get("STAT_lora_rejects", 0),
+        "ship_bytes": stats.get("STAT_lora_ship_bytes", 0),
+        "ship_reattaches": stats.get("STAT_lora_ship_reattaches", 0),
+        "ship_retries": stats.get("STAT_lora_ship_reships", 0),
+    }
     gathered = stats.get("STAT_embedding_rows_gathered", 0)
     unique = stats.get("STAT_embedding_rows_unique", 0)
     pf_hits = stats.get("STAT_embedding_prefetch_hits", 0)
@@ -262,6 +280,7 @@ def report() -> dict:
         "gateway": gateway,
         "fleet": fleet,
         "elastic": elastic,
+        "lora": lora,
         "embedding": embedding,
         "programs": get_program_registry().snapshot(),
         "program_store": program_store,

@@ -120,6 +120,14 @@ def engine_manifest(engine) -> dict:
         "mesh": mesh,
         "dtype": (str(engine._dtype) if engine._dtype is not None else None),
         "key_width": engine._key_width,
+        # batched LoRA bakes the factor-stack avals (rank, slot count,
+        # wrapped layer set) into every program signature; adapter IDs
+        # and contents are dynamic and deliberately absent
+        "lora": (None if getattr(engine, "lora", None) is None else {
+            "rank": engine.lora.rank,
+            "max_adapters": engine.lora.max_adapters,
+            "targets": list(engine.lora.targets),
+        }),
     }
 
 

@@ -84,7 +84,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -288,10 +288,10 @@ def _default_buckets(max_len: int):
 class _SlotRun:
     """Host-side per-slot decode state."""
     __slots__ = ("req", "resp", "pos", "produced", "last_token",
-                 "last_token_at", "key")
+                 "last_token_at", "key", "aid")
 
     def __init__(self, req: Request, resp: Response, pos: int,
-                 first_token: int, key: np.ndarray):
+                 first_token: int, key: np.ndarray, aid: int = 0):
         self.req = req
         self.resp = resp
         self.pos = pos              # kv length so far (write offset)
@@ -299,6 +299,7 @@ class _SlotRun:
         self.last_token = first_token
         self.last_token_at = time.monotonic()
         self.key = key
+        self.aid = aid              # pinned adapter slot id (0 = base)
 
 
 class PreemptedRun:
@@ -374,7 +375,7 @@ class ServingEngine:
                  spec_tokens: int = 4, kv: str = "fixed",
                  block_size: int = 16, num_blocks: Optional[int] = None,
                  mesh=None, program_set=None, prefix_cache: bool = False,
-                 share_policy=None):
+                 share_policy=None, lora=None):
         from ..generation import _model_fns
         self.model = model
         self.max_slots = int(max_slots)
@@ -398,6 +399,47 @@ class ServingEngine:
         # dispatch amortization dominates on every backend.
         self.decode_chunk = max(1, int(decode_chunk))
         self.scheduler = RequestScheduler(self.max_slots, max_queue_depth)
+        # batched LoRA adapters (paddle_tpu.lora): per-slot adapter ids
+        # are DYNAMIC inputs to the same program family and the factor
+        # stacks ride as ordinary program arguments, so heterogeneous
+        # adapters batch in one tick at the unchanged compile bound.
+        # The hooks are armed BEFORE _model_fns so every traced program
+        # sees them; they add no state keys (swap_weights / refresh /
+        # transfer are untouched).
+        self.lora = lora
+        self._lora_reg = None
+        self._lora_keys: Tuple[str, ...] = ()
+        if lora is not None:
+            if draft_model is not None:
+                raise InvalidArgumentError(
+                    "lora=LoRAConfig(...) and draft_model= (speculative "
+                    "decoding) cannot be combined on one engine yet: the "
+                    "verify program's draft proposals would need their "
+                    "own per-slot adapter gathers.  Drop draft_model= on "
+                    "this engine (adapters usually matter more than spec "
+                    "speedup for multi-tenant traffic), or route "
+                    "speculative traffic to a separate non-LoRA engine "
+                    "until spec-decode composition lands.")
+            if prefix_cache:
+                raise InvalidArgumentError(
+                    "lora=LoRAConfig(...) and prefix_cache=True cannot "
+                    "be combined on one engine yet: cached KV blocks are "
+                    "computed under ONE adapter's projections, so a warm "
+                    "hit served to a different adapter would be silently "
+                    "wrong.  Drop prefix_cache=True on this engine, or "
+                    "serve prefix-heavy base-model traffic from a "
+                    "separate non-LoRA engine until per-adapter cache "
+                    "partitioning lands.")
+            from ..lora.layers import attach_serving_lora
+            from ..lora.registry import AdapterRegistry
+            from ..lora.train import base_weights_hash
+            shapes = attach_serving_lora(model, lora.targets)
+            base_sha = (base_weights_hash(model)
+                        if lora.check_base_hash and lora.base_sha is None
+                        else None)
+            self._lora_reg = AdapterRegistry(lora, shapes,
+                                             base_sha=base_sha)
+            self._lora_keys = self._lora_reg.keys
         self._state, self._apply = _model_fns(model)
         self.draft_model = draft_model
         self.spec_tokens = int(spec_tokens)
@@ -435,12 +477,23 @@ class ServingEngine:
         # plain paged engine keeps its exact PR-8 allocation behavior
         if prefix_cache and kv != "paged":
             raise InvalidArgumentError(
-                "prefix_cache=True requires kv='paged'")
+                f"prefix_cache=True cannot be combined with kv={kv!r}: "
+                "prefix reuse shares immutable KV BLOCKS between "
+                "requests, and only the paged layout has blocks to "
+                "share.  Pass kv='paged' on this engine, or drop "
+                "prefix_cache=True to keep the fixed layout.")
         if prefix_cache and draft_model is not None:
             raise InvalidArgumentError(
-                "prefix_cache does not compose with speculative decoding "
-                "yet (the draft pool shares block tables but its cached "
-                "prefill half is unimplemented)")
+                "prefix_cache=True and draft_model= (speculative "
+                "decoding) cannot be combined on one engine yet: the "
+                "draft pool shares the target's block tables, but the "
+                "cached-prefill half of the draft path is "
+                "unimplemented, so a warm hit would leave the draft KV "
+                "incoherent.  Drop one of the two knobs on this engine "
+                "— keep prefix_cache=True for prompt-template traffic, "
+                "or keep draft_model= for long-decode traffic — or "
+                "split the traffic across two engines until the "
+                "composition lands.")
         self.prefix_cache = None
         self._share_policy = share_policy
         self._share_groups: Dict[str, str] = {}
@@ -674,6 +727,33 @@ class ServingEngine:
         if self.prefix_cache is not None:
             # old-weights KV must never seed a new-weights stream
             self.prefix_cache.evict(self.prefix_cache.resident_nodes())
+        if self._lora_reg is not None and self.lora.check_base_hash:
+            # loaded adapters SURVIVE the flip (the factor stacks are
+            # registry state, not engine state, and the forward hooks
+            # live on the layer objects) — but the registry's base pin
+            # must follow the weights: a FUTURE register() now checks
+            # artifacts against the base actually being served, not the
+            # boot-time one
+            from ..lora.train import state_hash
+            self._lora_reg.base_sha = state_hash(self._state)
+
+    def load_adapter(self, name: str, path: str) -> str:
+        """Page a tenant's exported LoRA artifact into the adapter
+        registry under `name` — hot: ZERO recompiles (the factor stacks
+        are program ARGUMENTS; the slot write reuses the registry's
+        pre-traced scatter) and safe while the engine loop is serving
+        (no donation, see AdapterRegistry).  Idempotent for identical
+        artifact bytes.  Returns the artifact's file sha256 (the fleet's
+        re-attach cache key).  Typed failures: AdapterIntegrityError
+        (corrupt / wrong base), InvalidArgumentError (rank/target
+        mismatch), AdapterExhaustedError (every slot pinned)."""
+        if self.lora is None:
+            raise InvalidArgumentError(
+                "load_adapter requires an engine constructed with "
+                "lora=LoRAConfig(...) — this engine serves the base "
+                "model only")
+        idx = self._lora_reg.register(name, path)
+        return self._lora_reg.file_sha(idx)
 
     # ------------------------------------------------------------------
     # tensor parallelism over the mesh
@@ -730,13 +810,28 @@ class ServingEngine:
     # ------------------------------------------------------------------
     # compiled programs
     # ------------------------------------------------------------------
+    def _lora_ctx(self, lora_args, aid):
+        """Trace-time adapter context for program bodies: rebinds the
+        positional lora program argument ((A,B) per key + scales) to the
+        engine's static key tuple and scopes the (traced) adapter id so
+        the forward hooks installed by `attach_serving_lora` see it.
+        Entered per vmapped row in decode (aid is the row's scalar) and
+        once per prefill (aid is the request's scalar)."""
+        from ..lora.layers import adapter_context
+        pairs, scales = lora_args
+        return adapter_context(dict(zip(self._lora_keys, pairs)),
+                               scales, aid)
+
     def _build_prefill(self, bucket: int):
         """One per-bucket prefill program.  On a speculative engine the
         SAME program additionally prefills the draft pool (one draft
         forward over the same padded ids, slot row written with the same
         full-range overwrite) — the first token still comes from the
         target's last-prompt-position logits, so greedy parity is
-        identical with and without a draft."""
+        identical with and without a draft.  On a LoRA engine the same
+        program takes the factor stacks + a scalar adapter id as EXTRA
+        dynamic inputs (adapter id 0 = base model) — still one program
+        per bucket."""
         apply_fixed = self._apply
         model, draft = self.model, self.draft_model
         pool_len, dtype = self._pool_len, self._dtype
@@ -765,7 +860,20 @@ class ServingEngine:
             self._compiles["prefill"][bucket] += 1  # trace-count (host)
             stat_add("STAT_serving_compiles")
 
-        if draft is None:
+        if draft is None and self.lora is not None:
+            def prefill(state, pools, lora, ids, slot, prompt_len, aid,
+                        key, temp, top_k, top_p, greedy):
+                count_trace()
+                scratch = model.gen_fixed_cache(1, bucket, dtype)
+                with self._lora_ctx(lora, aid):
+                    logits, kv = apply_fixed(state, ids, scratch, 0)
+                new_pools = write_slot(pools, kv, slot)
+                tok, logp, finite = first_token(
+                    logits, prompt_len, key, temp, top_k, top_p, greedy)
+                return tok, logp, finite, new_pools
+
+            name, donate = f"serving_prefill_b{bucket}", self._donate
+        elif draft is None:
             def prefill(state, pools, ids, slot, prompt_len, key, temp,
                         top_k, top_p, greedy):
                 count_trace()
@@ -801,6 +909,43 @@ class ServingEngine:
         poison_armed = self._poison_target is not None
 
         chunk = self.decode_chunk
+
+        if self.lora is not None:
+            # LoRA decode: per-slot adapter ids ride next to the sampling
+            # params as one more dynamic input and each vmapped row
+            # gathers its own factors — heterogeneous adapters batch in
+            # ONE tick, one program (the PR-4 dynamic-sampling pattern)
+            def decode(state, pools, lora, tokens, pos, aids, keys, temp,
+                       top_k, top_p, greedy, poison):
+                self._compiles["decode"] += 1  # trace-count (host)
+                stat_add("STAT_serving_compiles")
+
+                def one(carry, _):
+                    tokens, pos, pools = carry
+
+                    def row(tok, caches, p, aid):
+                        c = [(k[None], v[None]) for (k, v) in caches]
+                        with self._lora_ctx(lora, aid):
+                            logits, new = apply_fixed(state,
+                                                      tok[None, None], c, p)
+                        return (logits[0, -1].astype(jnp.float32),
+                                [(k[0], v[0]) for (k, v) in new])
+
+                    last, pools = jax.vmap(row)(tokens, pools, pos, aids)
+                    if poison_armed:
+                        last = faults.poison_logits(last, poison)
+                    finite = jnp.isfinite(last).all(axis=-1)
+                    tok, logp = _sample_step(last, keys, pos, temp, top_k,
+                                             top_p, greedy)
+                    return (tok, pos + 1, pools), (tok, logp, finite)
+
+                (tokens, pos, pools), (toks, logps, finites) = jax.lax.scan(
+                    one, (tokens, pos, pools), None, length=chunk)
+                return toks, logps, finites, tokens, pos, pools
+
+            from ..observability import track
+            return track("serving_decode",
+                         jax.jit(decode, donate_argnums=self._donate))
 
         def decode(state, pools, tokens, pos, keys, temp, top_k, top_p,
                    greedy, poison):
@@ -975,7 +1120,20 @@ class ServingEngine:
             self._compiles["prefill"][bucket] += 1  # trace-count (host)
             stat_add("STAT_serving_compiles")
 
-        if draft is None:
+        if draft is None and self.lora is not None:
+            def prefill(state, pools, lora, ids, table, prompt_len, aid,
+                        key, temp, top_k, top_p, greedy):
+                count_trace()
+                scratch = model.gen_fixed_cache(1, bucket, dtype)
+                with self._lora_ctx(lora, aid):
+                    logits, kv = apply_fixed(state, ids, scratch, 0)
+                new_pools = write_blocks(pools, kv, table)
+                tok, logp, finite = _first_token(
+                    logits, prompt_len, key, temp, top_k, top_p, greedy)
+                return tok, logp, finite, new_pools
+
+            name, donate = f"serving_prefill_b{bucket}", (1,)
+        elif draft is None:
             def prefill(state, pools, ids, table, prompt_len, key, temp,
                         top_k, top_p, greedy):
                 count_trace()
@@ -1077,6 +1235,46 @@ class ServingEngine:
                                        self._pool_len)
 
         gather_ctx = _gather_ctx
+
+        if self.lora is not None:
+            def decode(state, pools, lora, tables, active, tokens, pos,
+                       aids, keys, temp, top_k, top_p, greedy, poison):
+                self._compiles["decode"] += 1  # trace-count (host)
+                stat_add("STAT_serving_compiles")
+                ctx = [(gather_ctx(kp, tables), gather_ctx(vp, tables))
+                       for (kp, vp) in pools]
+                pos0 = pos
+
+                def one(carry, _):
+                    tokens, pos, ctx = carry
+
+                    def row(tok, caches, p, aid):
+                        c = [(k[None], v[None]) for (k, v) in caches]
+                        with self._lora_ctx(lora, aid):
+                            logits, new = apply_fixed(state,
+                                                      tok[None, None], c, p)
+                        return (logits[0, -1].astype(jnp.float32),
+                                [(k[0], v[0]) for (k, v) in new])
+
+                    last, ctx = jax.vmap(row)(tokens, ctx, pos, aids)
+                    if poison_armed:
+                        last = faults.poison_logits(last, poison)
+                    finite = jnp.isfinite(last).all(axis=-1)
+                    tok, logp = _sample_step(last, keys, pos, temp, top_k,
+                                             top_p, greedy)
+                    return (tok, pos + 1, ctx), (tok, logp, finite)
+
+                (tokens, pos, ctx), (toks, logps, finites) = jax.lax.scan(
+                    one, (tokens, pos0, ctx), None, length=chunk)
+                start = _window_start(pos0, chunk, ctx[0][0].shape[1])
+                pools = write_rows(pools, tables, start,
+                                   _extract_rows(ctx, start, chunk),
+                                   active, chunk)
+                return toks, logps, finites, tokens, pos, pools
+
+            from ..observability import track
+            return track("serving_decode",
+                         jax.jit(decode, donate_argnums=(1,)))
 
         def decode(state, pools, tables, active, tokens, pos, keys, temp,
                    top_k, top_p, greedy, poison):
@@ -1235,7 +1433,8 @@ class ServingEngine:
                      tenant: Optional[str] = None,
                      spec: Optional[bool] = None,
                      session: Optional[str] = None,
-                     resubmit: bool = False):
+                     resubmit: bool = False,
+                     adapter: Optional[str] = None):
         """Validate + build one (Request, Response) pair WITHOUT enqueuing
         it — the gateway's admission layer owns its own lanes and hands
         requests to `try_admit` directly.  Raises InvalidArgumentError for
@@ -1275,6 +1474,23 @@ class ServingEngine:
                 "resubmit=True (re-prefill-from-prompt crash recovery) is "
                 "greedy-only: a replayed sampled stream is not covered by "
                 "any engine contract — drop resubmit or use greedy_search")
+        # LoRA: reject unknown adapters NOW, typed — a consumer must
+        # never hang on an adapter that was never (or is no longer)
+        # loaded.  The slot is pinned later, at admission; if the
+        # adapter is evicted while the request queues, admission fails
+        # the request with the same typed error.
+        if adapter is not None and self.lora is None:
+            stat_add("STAT_serving_rejects")
+            raise InvalidArgumentError(
+                f"adapter={adapter!r} requires the engine to be built "
+                "with lora=LoRAConfig(...)")
+        if self.lora is not None and adapter is not None:
+            try:
+                self._lora_reg.resolve(adapter)
+            except Exception:
+                stat_add("STAT_serving_rejects")
+                stat_add("STAT_lora_rejects")
+                raise
         with self._submit_lock:
             rid = self._rid
             self._rid += 1
@@ -1285,7 +1501,7 @@ class ServingEngine:
                       seed=seed if seed is not None else rid,
                       deadline=deadline, priority=priority, tenant=tenant,
                       spec=bool(spec), session=session,
-                      resubmit=resubmit)
+                      resubmit=resubmit, adapter=adapter)
         plen = req.prompt.shape[0]
         if plen > self.buckets[-1]:
             stat_add("STAT_serving_rejects")
@@ -1325,7 +1541,8 @@ class ServingEngine:
                seed: Optional[int] = None, deadline: Optional[float] = None,
                block: bool = False, timeout: Optional[float] = None,
                spec: Optional[bool] = None,
-               tenant: Optional[str] = None) -> Response:
+               tenant: Optional[str] = None,
+               adapter: Optional[str] = None) -> Response:
         """Enqueue one request; returns its streaming Response.
 
         `tenant` scopes prefix-cache sharing (the gateway sets it from
@@ -1339,7 +1556,7 @@ class ServingEngine:
             prompt, max_new_tokens, decode_strategy=decode_strategy,
             temperature=temperature, top_k=top_k, top_p=top_p,
             eos_token_id=eos_token_id, seed=seed, deadline=deadline,
-            spec=spec, tenant=tenant)
+            spec=spec, tenant=tenant, adapter=adapter)
         self.scheduler.submit(req, resp, block=block, timeout=timeout)
         self._work.set()
         return resp
@@ -1501,12 +1718,16 @@ class ServingEngine:
                 self._release(slot)
 
     def _release(self, slot: int):
-        self._slots.pop(slot, None)
+        run = self._slots.pop(slot, None)
         self.scheduler.release(slot)
         if self.kv == "paged":
             # blocks return to the free-list; their content is scrubbed
             # in-program the moment they are re-served (kv_pool docstring)
             self.kv_pool.free(slot)
+        if (self._lora_reg is not None and run is not None
+                and run.aid):
+            # unpin: a ref-0 adapter becomes evictable again
+            self._lora_reg.release(run.aid)
         self._batch_dirty = True
 
     def _bucket_for(self, plen: int) -> int:
@@ -1529,6 +1750,22 @@ class ServingEngine:
         try:
             plen = req.prompt.shape[0]
             bucket = self._bucket_for(plen)
+            aid = 0
+            if self.lora is not None:
+                # resolve + PIN the adapter for the life of the slot (the
+                # registry cannot evict a pinned adapter).  The request
+                # was validated at make_request, but the adapter may have
+                # been evicted while it queued — typed terminal failure,
+                # never a hung consumer.
+                try:
+                    aid = self._lora_reg.acquire(req.adapter)
+                except Exception as e:
+                    stat_add("STAT_lora_rejects")
+                    with self._m_lock:
+                        self._errored += 1
+                    resp._fail(e)
+                    self.scheduler.release(slot)
+                    return
             if self.kv == "paged":
                 # claim the prompt's blocks; only reachable without them
                 # when PDTPU_FAULT_KV_EXHAUST moved the cap between the
@@ -1542,6 +1779,8 @@ class ServingEngine:
                         f"admission ({self.kv_pool.free_blocks()} free of "
                         f"{self.kv_pool.capacity()} usable)"))
                     self.scheduler.release(slot)
+                    if self._lora_reg is not None and aid:
+                        self._lora_reg.release(aid)
                     return
                 slot_arg = jnp.asarray(self.kv_pool.table_array(slot))
             else:
@@ -1557,6 +1796,15 @@ class ServingEngine:
                     jnp.int32(plen), jnp.asarray(key),
                     jnp.float32(req.temperature), jnp.int32(req.top_k),
                     jnp.float32(req.top_p), jnp.asarray(req.greedy))
+            elif self.lora is not None:
+                # the adapter id is an ordinary dynamic input: a new
+                # adapter NEVER means a new program
+                tok, logp, finite, self._pools = self._prefill_fns[bucket](
+                    self._state, self._pools, self._lora_reg.device_args(),
+                    jnp.asarray(ids), slot_arg, jnp.int32(plen),
+                    jnp.int32(aid), jnp.asarray(key),
+                    jnp.float32(req.temperature), jnp.int32(req.top_k),
+                    jnp.float32(req.top_p), jnp.asarray(req.greedy))
             else:
                 tok, logp, finite, self._pools = self._prefill_fns[bucket](
                     self._state, self._pools, jnp.asarray(ids),
@@ -1565,10 +1813,15 @@ class ServingEngine:
                     jnp.float32(req.top_p), jnp.asarray(req.greedy))
             stat_add("STAT_serving_prefills")
             if not bool(finite):
+                # the run is not in _slots yet — _release won't see the
+                # pin, drop it here
+                if self._lora_reg is not None and aid:
+                    self._lora_reg.release(aid)
                 self._fail_slot(slot, resp, "prefill")
                 return
             tok = int(tok)
-            run = _SlotRun(req, resp, pos=plen, first_token=tok, key=key)
+            run = _SlotRun(req, resp, pos=plen, first_token=tok, key=key,
+                           aid=aid)
             self._slots[slot] = run
             self._batch_dirty = True
             self._emit(run, tok, float(logp))
@@ -1730,6 +1983,11 @@ class ServingEngine:
         self.scheduler.release(slot)
         if self.kv == "paged":
             self.kv_pool.free(slot)
+        if self._lora_reg is not None and run.aid:
+            # unpin while parked: the adapter NAME travels with the
+            # request; restore re-resolves (and may fail typed if the
+            # adapter was evicted meanwhile)
+            self._lora_reg.release(run.aid)
         self._batch_dirty = True
         stat_add("STAT_serving_preemptions")
         return paused
@@ -1823,8 +2081,28 @@ class ServingEngine:
     def _finish_restore(self, slot: int, paused: PreemptedRun) -> bool:
         """Resume bookkeeping shared by both KV layouts: one copy, so a
         future lifecycle counter cannot diverge between them."""
+        aid = 0
+        if self.lora is not None:
+            # the pin was dropped at preempt; re-resolve by NAME against
+            # THIS engine's registry (the run may have migrated).  An
+            # adapter evicted/never-loaded here is a typed terminal
+            # failure — returning True because the paused run is
+            # consumed, not parked for retry.
+            try:
+                aid = self._lora_reg.acquire(paused.req.adapter)
+            except Exception as e:
+                stat_add("STAT_lora_rejects")
+                with self._m_lock:
+                    self._errored += 1
+                paused.resp._fail(e)
+                self.scheduler.release(slot)
+                if self.kv == "paged":
+                    self.kv_pool.free(slot)
+                self._batch_dirty = True
+                return True
         run = _SlotRun(paused.req, paused.resp, pos=paused.pos,
-                       first_token=paused.last_token, key=paused.key)
+                       first_token=paused.last_token, key=paused.key,
+                       aid=aid)
         run.produced = paused.produced
         paused.req.resumes += 1
         paused.req.paused_seconds += time.monotonic() - paused.preempted_at
@@ -2030,6 +2308,7 @@ class ServingEngine:
         greedy = np.ones((s,), bool)
         poison = np.zeros((s,), bool)
         spec_on = np.zeros((s,), bool)
+        aids = np.zeros((s,), np.int32)  # idle slots decode as adapter 0
         for slot, run in self._slots.items():
             tokens[slot] = run.last_token
             pos[slot] = run.pos
@@ -2040,8 +2319,11 @@ class ServingEngine:
             greedy[slot] = run.req.greedy
             poison[slot] = run.req.poison
             spec_on[slot] = run.req.spec
+            aids[slot] = run.aid
         self._dev_tokens = jnp.asarray(tokens)
         self._dev_pos = jnp.asarray(pos)
+        if self.lora is not None:
+            self._dev_aids = jnp.asarray(aids)
         self._dev_params = tuple(jnp.asarray(a) for a in (
             keys, temp, top_k, top_p, greedy, poison, spec_on))
         self._batch_dirty = False
@@ -2069,11 +2351,25 @@ class ServingEngine:
             keys, temp, top_k, top_p, greedy, poison, _ = self._dev_params
             if self.kv == "paged":
                 tables, active = self._paged_batch()
+                if self.lora is not None:
+                    (toks, logps, finites, ntok, npos,
+                     self._pools) = self._decode_fn(
+                        self._state, self._pools,
+                        self._lora_reg.device_args(), tables, active,
+                        self._dev_tokens, self._dev_pos, self._dev_aids,
+                        keys, temp, top_k, top_p, greedy, poison)
+                else:
+                    (toks, logps, finites, ntok, npos,
+                     self._pools) = self._decode_fn(
+                        self._state, self._pools, tables, active,
+                        self._dev_tokens, self._dev_pos, keys, temp, top_k,
+                        top_p, greedy, poison)
+            elif self.lora is not None:
                 (toks, logps, finites, ntok, npos,
                  self._pools) = self._decode_fn(
-                    self._state, self._pools, tables, active,
-                    self._dev_tokens, self._dev_pos, keys, temp, top_k,
-                    top_p, greedy, poison)
+                    self._state, self._pools, self._lora_reg.device_args(),
+                    self._dev_tokens, self._dev_pos, self._dev_aids, keys,
+                    temp, top_k, top_p, greedy, poison)
             else:
                 (toks, logps, finites, ntok, npos,
                  self._pools) = self._decode_fn(
@@ -2290,6 +2586,8 @@ class ServingEngine:
             self.scheduler.release(slot)
             if self.kv == "paged":
                 self.kv_pool.free(slot)
+            if self._lora_reg is not None and run.aid:
+                self._lora_reg.release(run.aid)
             run.resp._fail(make_exc(run.req))
         for req, resp in self.scheduler.drain_pending():
             resp._fail(make_exc(req))
@@ -2371,12 +2669,19 @@ class ServingEngine:
         plen_args = ((jnp.int32(1), jnp.int32(0))   # plen, cached_len
                      if self.prefix_cache is not None
                      else (jnp.int32(1),))
+        if self.lora is not None:
+            # adapter id 0 = base: warmup decodes under the all-zero
+            # slot-0 factors, same avals as any live adapter id
+            plen_args = plen_args + (jnp.int32(0),)
         common = (jnp.asarray(ids), slot_arg) + plen_args + (
             zero_key, jnp.float32(1.0), jnp.int32(0), jnp.float32(1.0),
             jnp.asarray(True))
         if self.draft_model is not None:
             return (self._state, self._dstate, self._pools,
                     self._draft_pools) + common
+        if self.lora is not None:
+            return (self._state, self._pools,
+                    self._lora_reg.device_args()) + common
         return (self._state, self._pools) + common
 
     def _example_decode_args(self):
@@ -2392,12 +2697,18 @@ class ServingEngine:
                 jnp.zeros((s, self._key_width), jnp.uint32),
                 jnp.ones((s,), jnp.float32), jnp.zeros((s,), jnp.int32),
                 jnp.ones((s,), jnp.float32), jnp.ones((s,), bool)]
+        if self.lora is not None:
+            # per-slot adapter ids slide in right after `pos`
+            base.insert(2, jnp.zeros((s,), jnp.int32))
         if self.draft_model is not None:
             args = pre + base + [jnp.ones((s,), bool),
                                  jnp.zeros((s,), bool), jnp.asarray(False)]
             return (self._state, self._dstate, self._pools,
                     self._draft_pools, *args)
         args = pre + base + [jnp.zeros((s,), bool)]
+        if self.lora is not None:
+            return (self._state, self._pools,
+                    self._lora_reg.device_args(), *args)
         return (self._state, self._pools, *args)
 
     def _program_family(self):
@@ -2537,6 +2848,14 @@ class ServingEngine:
                           + sum(self._compiles["prefill"].values())),
                 "bound": len(self.buckets) + 1}
 
+    def adapter_shas(self) -> Optional[Dict[str, str]]:
+        """name -> artifact sha of every resident LoRA adapter, or None
+        on a no-LoRA engine.  Cheaper than metrics(): fleet health
+        snapshots call this per replica per tick."""
+        if self._lora_reg is None:
+            return None
+        return self._lora_reg.shas() or None
+
     def metrics(self) -> Dict:
         """Serving metrics snapshot (also published as STAT_serving_*
         monitor counters and, under enable_profile, in the profiler
@@ -2564,6 +2883,8 @@ class ServingEngine:
                                          if self._warm else None),
                 "program_set": self.program_set_info,
                 "kv_pool": self._kv_pool_metrics(),
+                "lora": (None if self._lora_reg is None
+                         else self._lora_reg.stats()),
                 "mesh": (None if self.mesh is None else {
                     "devices": int(self.mesh.devices.size),
                     "tp": int(self.mesh.shape.get("tp", 1))}),

@@ -74,7 +74,7 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..core.errors import InvalidArgumentError, UnavailableError
 from ..utils import faults
@@ -311,6 +311,20 @@ class Replica:
         """Seconds since the last liveness evidence."""
         return max(0.0, time.monotonic() - self.last_beat)
 
+    def _adapter_shas(self) -> Optional[Dict[str, str]]:
+        """name -> artifact sha256 of every LoRA adapter resident on
+        THIS replica (worker replicas report theirs through status-frame
+        metrics) — the /healthz "is tenant X actually loaded here"
+        answer, per replica."""
+        try:
+            fn = getattr(self.engine, "adapter_shas", None)
+            if fn is not None:
+                return fn() or None
+            lora = (self.engine.metrics() or {}).get("lora") or {}
+        except Exception:
+            return None
+        return lora.get("shas") or None
+
     def snapshot(self) -> Dict:
         age = self.heartbeat_age()
         return {
@@ -332,6 +346,8 @@ class Replica:
             "weights_sha": getattr(self.engine, "weights_sha", None),
             "refresh_epoch": getattr(self.engine, "refresh_epoch", 0),
             "flipping": self.flipping,
+            # loaded LoRA adapters (name -> artifact sha) on this replica
+            "adapters": self._adapter_shas(),
         }
 
 
@@ -444,6 +460,12 @@ class ReplicaManager:
         # boundary (engine.has_work() false), so a flip never lands
         # mid-stream
         self._flips: List[Dict] = []
+        # LoRA adapters the fleet serves: name -> (path, sha).  Applied
+        # to every live replica at load_adapter() and re-applied to each
+        # freshly-warm replica (boot, supervised restart) so the fleet
+        # converges — a restarted worker's empty registry must not make
+        # a tenant's adapter silently vanish from part of the fleet
+        self._adapters: Dict[str, Tuple[str, Optional[str]]] = {}
         self._n = {"failovers": 0, "migrated": 0, "resubmits": 0,
                    "lost": 0, "reroutes": 0, "drains": 0, "wedges": 0,
                    "worker_restarts": 0, "restarts_exhausted": 0,
@@ -569,6 +591,7 @@ class ReplicaManager:
                 reports[rep.id] = rep.engine.warmup()
             if rep.state == BOOTING and rep.engine.warm:
                 rep.state = HEALTHY
+                self._converge_adapters(rep)
                 self._publish_up(rep)
         self.refresh_warm_marks()
         return reports
@@ -691,6 +714,7 @@ class ReplicaManager:
         if ready and rep.state == BOOTING:
             rep.state = HEALTHY
             rep.last_beat = time.monotonic()
+            self._converge_adapters(rep)
             self._publish_up(rep)
             return True
         return False
@@ -1201,6 +1225,59 @@ class ReplicaManager:
     def flips_pending(self) -> int:
         return len(self._flips)
 
+    # -- multi-tenant LoRA: fleet-wide adapter hot-load ----------------
+    def load_adapter(self, name: str, path: str,
+                     sha: Optional[str] = None) -> Dict[int, str]:
+        """Page the LoRA adapter artifact at `path` into EVERY live
+        warm replica's registry under `name`.  Additive and
+        recompile-free, so unlike a weight flip there is NO idle
+        fencing: in-flight streams keep decoding on their adapters
+        while the new factor stacks page in.  In-process replicas read
+        the file directly; subprocess replicas verify it over the local
+        RPC; remote replicas receive it over the chunked
+        sha256-verified channel — zero bytes when the identical
+        artifact is already resident, one supervised re-ship when a
+        chunk or read is corrupt.  The adapter is recorded so every
+        later boot/restart converges (`_converge_adapters`).  Returns
+        {rid: file_sha} for the replicas that now hold it.  A replica
+        that refuses (corrupt read after re-ship, base mismatch, all
+        slots pinned) keeps serving what it had — partial success is
+        success, requests naming the adapter on the skewed replica fail
+        typed at admission; only when EVERY replica refuses is the
+        shared root cause re-raised and nothing recorded."""
+        results: Dict[int, str] = {}
+        errors: Dict[int, BaseException] = {}
+        for rep in self.replicas(_LIVE):
+            if not rep.engine.warm:
+                continue  # _converge_adapters loads it when warm
+            try:
+                results[rep.id] = rep.engine.load_adapter(name, path)
+            except WorkerDiedError as e:
+                errors[rep.id] = e
+                self._on_crash(rep, e)
+            except Exception as e:  # noqa: BLE001 — typed per-replica
+                #                     reject; the replica keeps serving
+                errors[rep.id] = e
+        if errors and not results:
+            # every replica refused: surface the (shared) root cause
+            raise next(iter(errors.values()))
+        self._adapters[name] = (path, sha)
+        stat_add("STAT_lora_fleet_loads")
+        return results
+
+    def _converge_adapters(self, rep: Replica):
+        """Re-load every recorded adapter onto a freshly-warm replica
+        (boot or supervised restart): a restarted worker's empty
+        registry must not silently drop a tenant's adapter from part
+        of the fleet.  A refusal leaves the replica serving — requests
+        naming the missing adapter fail typed at admission (never a
+        hung consumer) — but is counted so operators see the skew."""
+        for name, (path, _sha) in list(self._adapters.items()):
+            try:
+                rep.engine.load_adapter(name, path)
+            except Exception:  # noqa: BLE001 — typed refusal, counted
+                stat_add("STAT_lora_converge_failures")
+
     # counters the refresher/autoscaler (which run OFF the driving
     # thread) report through, so every counter/stat/gauge stays in one
     # place
@@ -1432,6 +1509,16 @@ class FleetRouter:
                               if r != rid}
         self._work.set()
         return entry
+
+    def load_adapter(self, name: str, path: str,
+                     sha: Optional[str] = None) -> Dict[int, str]:
+        """Fleet-wide LoRA adapter hot-load (see
+        ReplicaManager.load_adapter): page the artifact into every live
+        warm replica's registry — additive, recompile-free, no fencing,
+        and recorded so boots/restarts converge onto it."""
+        out = self.manager.load_adapter(name, path, sha=sha)
+        self._work.set()
+        return out
 
     def attach_refresher(self, refresher):
         """Register the FleetRefresher whose canary verdicts back the

@@ -268,6 +268,13 @@ class ServingGateway:
         stat_add("STAT_gateway_requests")
         with self._lock:
             self._n["requests"] += 1
+        if ts.cfg.adapter is not None:
+            # the tenant's LoRA adapter rides on every one of its
+            # requests; an unknown/unloaded adapter fails typed right
+            # here (AdapterNotFoundError via make_request validation),
+            # through the same no-consumer-ever-hangs path as any other
+            # malformed submission
+            kwargs.setdefault("adapter", ts.cfg.adapter)
         try:
             req, resp = self.engine.make_request(
                 prompt, max_new_tokens, priority=priority, tenant=tenant,
@@ -886,10 +893,16 @@ class ServingGateway:
                 # per-replica caches report through fleet metrics)
                 pc = getattr(self.engine, "prefix_cache", None)
                 prefix = pc.stats() if pc is not None else None
+                # multi-tenant LoRA: which adapters are resident, how
+                # many slots are pinned, load/eviction counters — the
+                # operator's "is tenant X actually loaded here" signal
+                reg = getattr(self.engine, "_lora_reg", None)
+                lora = reg.stats() if reg is not None else None
                 return status, "application/json", json.dumps({
                     "ok": status == 200,
                     "fleet": fleet,
                     "prefix_cache": prefix,
+                    "lora": lora,
                     # readiness: warm=True means every serving program is
                     # precompiled (engine.warmup ran) — no admitted
                     # request will ever pay a trace

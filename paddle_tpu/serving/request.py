@@ -35,7 +35,7 @@ class Request:
                  "top_k", "top_p", "eos_token_id", "seed", "deadline",
                  "poison", "priority", "tenant", "preempts", "resumes",
                  "paused_seconds", "spec", "session", "resubmit",
-                 "migrations")
+                 "migrations", "adapter")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  greedy: bool = True, temperature: float = 1.0,
@@ -45,7 +45,7 @@ class Request:
                  deadline: Optional[float] = None,
                  priority: int = 0, tenant: Optional[str] = None,
                  spec: bool = False, session: Optional[str] = None,
-                 resubmit: bool = False):
+                 resubmit: bool = False, adapter: Optional[str] = None):
         self.id = int(rid)
         self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
         if self.prompt.size == 0:
@@ -89,6 +89,13 @@ class Request:
         self.session = session
         self.resubmit = bool(resubmit)
         self.migrations = 0
+        # batched LoRA (paddle_tpu.lora): registry name of the adapter
+        # this request decodes under; None = the base model (adapter id
+        # 0).  Resolved to a slot index and pinned at admission, unpinned
+        # at release — the name (not the index) travels with the request
+        # across preempt/restore and replica migration, so a restore on
+        # a different replica re-resolves against ITS registry.
+        self.adapter = adapter
 
 
 _TOK, _END, _ERR = 0, 1, 2

@@ -83,15 +83,23 @@ class TenantConfig:
                     naming the same group share each other's cached
                     prefixes.  Cross-group reuse is impossible by
                     construction (serving/prefix_cache.py).
+    adapter         LoRA adapter registry NAME this tenant decodes under
+                    (paddle_tpu.lora).  None (default) serves the base
+                    model.  The gateway stamps it on every request the
+                    tenant submits; an adapter that is not loaded on the
+                    engine fails the request typed
+                    (AdapterNotFoundError) through the normal admission
+                    path — never a hung consumer.
     """
 
     __slots__ = ("rate", "burst", "weight", "max_priority",
-                 "kv_share_group")
+                 "kv_share_group", "adapter")
 
     def __init__(self, rate: float = float("inf"),
                  burst: Optional[float] = None, weight: float = 1.0,
                  max_priority: int = 1,
-                 kv_share_group: Optional[str] = None):
+                 kv_share_group: Optional[str] = None,
+                 adapter: Optional[str] = None):
         self.rate = float(rate)
         self.burst = burst
         self.weight = float(weight)
@@ -99,6 +107,7 @@ class TenantConfig:
             raise ValueError(f"tenant weight must be positive, got {weight}")
         self.max_priority = int(max_priority)
         self.kv_share_group = kv_share_group
+        self.adapter = adapter
 
     def make_bucket(self) -> TokenBucket:
         return TokenBucket(self.rate, self.burst)

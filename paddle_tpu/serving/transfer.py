@@ -71,7 +71,7 @@ TRANSFER_VERSION = 2
 # the request on its side of the wire (json-serializable scalars only)
 _REQ_FIELDS = ("id", "max_new_tokens", "greedy", "temperature", "top_k",
                "top_p", "eos_token_id", "seed", "priority", "tenant",
-               "spec", "session", "resubmit")
+               "spec", "session", "resubmit", "adapter")
 
 
 class RunTransferError(InvalidArgumentError):
@@ -334,7 +334,8 @@ def decode_run(blob: dict, req: Optional[Request] = None,
                       deadline=r.get("deadline_remaining_s"),
                       priority=r["priority"], tenant=r["tenant"],
                       spec=r["spec"], session=r["session"],
-                      resubmit=r["resubmit"])
+                      resubmit=r["resubmit"],
+                      adapter=r.get("adapter"))
     if resp is None:
         resp = Response(req)
     paused = PreemptedRun.from_state(

@@ -501,6 +501,9 @@ def _build_engine(spec: dict):
     if ekw.get("prefill_buckets") is not None:
         ekw["prefill_buckets"] = tuple(int(b)
                                        for b in ekw["prefill_buckets"])
+    if spec.get("lora"):
+        from ..lora import LoRAConfig
+        ekw["lora"] = LoRAConfig.from_spec(spec["lora"])
     return ServingEngine(model, draft_model=draft,
                          program_set=spec.get("program_set"),
                          **ekw), weights_sha
@@ -607,6 +610,8 @@ class _WorkerServer:
                 self._faults.enable(point, value)
         elif verb == "swap_weights":
             self._on_swap(h)
+        elif verb == "load_adapter":
+            self._on_load_adapter(h)
         elif verb == "close":
             self._stopping = True
         elif verb == "ping":
@@ -641,7 +646,8 @@ class _WorkerServer:
                 deadline=h.get("deadline_remaining_s"),
                 priority=h.get("priority", 0), tenant=h.get("tenant"),
                 spec=h.get("spec"), session=h.get("session"),
-                resubmit=h.get("resubmit", False))
+                resubmit=h.get("resubmit", False),
+                adapter=h.get("adapter"))
             resp = _WireResponse(req)
             self.engine.scheduler.submit(req, resp)
         except Exception as e:
@@ -759,6 +765,78 @@ class _WorkerServer:
         `swap_ready` ack, so no chunk can race into the serve loop's
         frame batch ahead of this read)."""
         _recv_artifacts(self.conn, {"weights": (man, path)})
+
+    def _on_load_adapter(self, h: dict):
+        """Multi-tenant LoRA hot-load: page one adapter artifact into
+        the engine's registry with ZERO recompiles (the factor stacks
+        are per-call program arguments, exactly like the swapped
+        weights).  Local mode: the artifact is a path on this host,
+        verified against the published sha256 before the registry reads
+        it.  Remote mode: the header carries a manifest; if the named
+        adapter is already resident with the SAME artifact sha the
+        worker answers `cached` and zero bytes ship, otherwise the
+        chunk stream follows our `adapter_ready` ack over the same
+        verified channel the attach handshake uses.  Any failure —
+        corrupt bytes, base-hash/rank mismatch, every slot pinned — is
+        reported typed and leaves the registry unchanged."""
+        from ..lora import AdapterIntegrityError
+        from .transfer import file_sha256
+        wid = h.get("wid")
+        name = h.get("name")
+        man = h.get("manifest")
+        try:
+            if getattr(self.engine, "lora", None) is None:
+                raise InvalidArgumentError(
+                    "worker engine was not built with lora="
+                    "LoRAConfig(...) — add a 'lora' key to the boot "
+                    "spec")
+            reg = self.engine._lora_reg
+            if man is not None:
+                idx = reg.loaded().get(name)
+                if (idx is not None
+                        and reg.file_sha(idx) == man.get("sha256")):
+                    # zero-byte re-attach: the identical artifact is
+                    # already resident under this name
+                    stat_add("STAT_lora_ship_reattaches")
+                    self.conn.send("adapter_ready",
+                                   {"wid": wid, "cached": True})
+                    self.conn.send("adapter_loaded",
+                                   {"wid": wid, "ok": True, "name": name,
+                                    "file_sha": man.get("sha256"),
+                                    "cached": True})
+                    return
+                if self._cache is not None:
+                    d = os.path.join(self._cache["dir"], "adapters")
+                else:
+                    d = tempfile.mkdtemp(prefix="pdtpu_adapter_")
+                os.makedirs(d, exist_ok=True)
+                path = os.path.join(
+                    d, f"{(man.get('sha256') or 'x')[:16]}.npz")
+                self.conn.send("adapter_ready",
+                               {"wid": wid, "cached": False})
+                _recv_artifacts(self.conn, {"adapter": (man, path)})
+            else:
+                path = h.get("path")
+                if not path:
+                    raise InvalidArgumentError(
+                        "load_adapter needs a path (local) or a "
+                        "manifest (remote)")
+                sha = h.get("sha256")
+                if sha is not None and file_sha256(path) != sha:
+                    raise AdapterIntegrityError(
+                        f"adapter artifact {path!r} sha256 != published "
+                        f"{sha} — refusing corrupt factors")
+            file_sha = self.engine.load_adapter(name, path)
+        except Exception as e:  # noqa: BLE001 — typed rejection, the
+            #                     registry keeps its previous contents
+            self.conn.send("adapter_loaded",
+                           {"wid": wid, "ok": False,
+                            "etype": type(e).__name__,
+                            "msg": str(e)[:500]})
+            return
+        self.conn.send("adapter_loaded", {"wid": wid, "ok": True,
+                                          "name": name,
+                                          "file_sha": file_sha})
 
     # -- outbound stream/status -----------------------------------------
     def _flush_one(self, wid: int, entry: list) -> bool:
@@ -1071,7 +1149,8 @@ def _recv_artifacts(conn: _FrameConn, wants: dict,
     manifest's sha256 — any mismatch is a typed WeightShipError before a
     single byte reaches an engine.  Returns name -> bytes received."""
     import hashlib
-    verbs = {"weights_chunk": "weights", "program_chunk": "programs"}
+    verbs = {"weights_chunk": "weights", "program_chunk": "programs",
+             "adapter_chunk": "adapter"}
     state = {}
     for name, (man, path) in wants.items():
         if man is not None:
@@ -1378,10 +1457,15 @@ _WIRE_ERRORS = None
 def _error_types():
     global _WIRE_ERRORS
     if _WIRE_ERRORS is None:
+        from ..lora import (AdapterExhaustedError, AdapterIntegrityError,
+                            AdapterNotFoundError)
         from .engine import NonFiniteLogitsError
         from .kv_pool import KVPoolExhaustedError
         from .transfer import RunTransferError
         _WIRE_ERRORS = {
+            "AdapterNotFoundError": AdapterNotFoundError,
+            "AdapterExhaustedError": AdapterExhaustedError,
+            "AdapterIntegrityError": AdapterIntegrityError,
             "RequestCancelled": RequestCancelled,
             "DeadlineExceededError": DeadlineExceededError,
             "QueueFullError": QueueFullError,
@@ -1695,7 +1779,8 @@ class WorkerClient:
         elif verb == "dying":
             self._dead = _mk_error(h.get("etype", ""), h.get("msg", ""))
         elif verb in ("bye", "log", "metrics", "preempted", "restored",
-                      "accepted", "attach_ok", "swap_ready", "swapped"):
+                      "accepted", "attach_ok", "swap_ready", "swapped",
+                      "adapter_ready", "adapter_loaded"):
             pass  # bye/log informational; RPC replies consumed by _rpc;
             #       accepted acks matter only to the remote subclass
 
@@ -1740,10 +1825,16 @@ class WorkerClient:
                      tenant: Optional[str] = None,
                      spec: Optional[bool] = None,
                      session: Optional[str] = None,
-                     resubmit: bool = False):
+                     resubmit: bool = False,
+                     adapter: Optional[str] = None):
         """ServingEngine.make_request's validation against the worker's
         handshake config — no round trip; the worker re-validates on its
-        side and any disagreement comes back as a typed `failed`."""
+        side and any disagreement comes back as a typed `failed`.
+        `adapter` names a LoRA adapter in the WORKER's registry; the
+        name cannot be resolved from here, so an unknown adapter fails
+        the response typed (AdapterNotFoundError) at worker admission
+        rather than at this call — still terminal, never a hung
+        consumer."""
         if self._closed:
             raise UnavailableError("worker replica is closed")
         if self._dead is not None:
@@ -1777,7 +1868,8 @@ class WorkerClient:
                       eos_token_id=eos_token_id,
                       seed=seed if seed is not None else rid,
                       deadline=deadline, priority=priority, tenant=tenant,
-                      spec=bool(spec), session=session, resubmit=resubmit)
+                      spec=bool(spec), session=session, resubmit=resubmit,
+                      adapter=adapter)
         plen = req.prompt.shape[0]
         if plen > self.buckets[-1]:
             stat_add("STAT_serving_rejects")
@@ -1822,7 +1914,8 @@ class WorkerClient:
                 "priority": req.priority, "tenant": req.tenant,
                 "spec": bool(req.spec) if self.draft_model is not None
                 else False,
-                "session": req.session, "resubmit": req.resubmit}
+                "session": req.session, "resubmit": req.resubmit,
+                "adapter": req.adapter}
 
     def _ship(self, req: Request, resp: Response):
         wid = self._wid
@@ -1979,6 +2072,12 @@ class WorkerClient:
         return False
 
     # -- engine surface: telemetry -------------------------------------
+    def adapter_shas(self):
+        """name -> artifact sha reported in the worker's latest status
+        frame (cheap cached read for fleet health snapshots)."""
+        lora = (self._status.get("metrics") or {}).get("lora") or {}
+        return lora.get("shas") or None
+
     def metrics(self) -> dict:
         m = dict(self._status.get("metrics") or {})
         m["queue_depth"] = self.scheduler.queue_depth()
@@ -2033,6 +2132,41 @@ class WorkerClient:
             raise _mk_error(h.get("etype", ""), h.get("msg", ""))
         self.weights_sha = h.get("weights_sha", sha)
         return self.weights_sha
+
+    # -- engine surface: multi-tenant LoRA hot-load --------------------
+    def load_adapter(self, name: str, path: str,
+                     sha: Optional[str] = None,
+                     timeout_s: float = 60.0, retries: int = 1) -> str:
+        """Page the adapter artifact at `path` (same host — the spawned
+        worker shares our filesystem) into the worker's registry under
+        `name`, with zero recompiles.  The worker verifies the artifact
+        before a factor reaches its device stacks; a corrupt read comes
+        back typed (AdapterIntegrityError) and is re-shipped once
+        (`retries`) — the supervised re-ship path, the registry never
+        holds garbage factors.  A persistent or non-retryable failure
+        (unknown base hash, rank mismatch, all slots pinned) propagates
+        typed.  Returns the resident artifact's sha256.  Driving thread
+        only."""
+        if self._conn is None:
+            raise WorkerDiedError(
+                f"worker {self.index} has no connection")
+        attempts = max(1, int(retries) + 1)
+        for i in range(attempts):
+            wid = self._wid
+            self._wid += 1
+            h, _ = self._rpc("load_adapter",
+                             {"wid": wid, "name": name, "path": path,
+                              "sha256": sha},
+                             None, "adapter_loaded", timeout_s=timeout_s)
+            if h.get("ok"):
+                return h.get("file_sha")
+            err = _mk_error(h.get("etype", ""), h.get("msg", ""))
+            retryable = h.get("etype") in ("AdapterIntegrityError",
+                                           "WeightShipError")
+            if not retryable or i == attempts - 1:
+                raise err
+            stat_add("STAT_lora_ship_reships")
+        raise err  # unreachable; loop always returns or raises
 
     # -- engine surface: teardown --------------------------------------
     def _abort_all(self, make_exc):
@@ -2433,6 +2567,74 @@ class RemoteWorkerClient(WorkerClient):
                 raise WorkerDiedError(
                     f"remote worker {self.index} swap_weights timed out "
                     f"after {timeout_s}s")
+
+    # -- multi-tenant LoRA: adapter hot-load over the wire --------------
+    def load_adapter(self, name: str, path: str,
+                     sha: Optional[str] = None,
+                     timeout_s: float = 120.0, retries: int = 1) -> str:
+        """Ship the adapter artifact at `path` to the remote worker and
+        page it into the registry, zero recompiles.  Manifest-first:
+        the chunk stream starts only after the worker's `adapter_ready`
+        ack; if the worker already holds the identically-hashed
+        artifact under `name` it answers `cached: True` and ZERO bytes
+        ship (the re-attach path).  Every chunk and the assembled file
+        are sha256-verified on the worker; a corrupt chunk or a
+        poisoned read is refused there typed and re-shipped once
+        (`retries`) — garbage factors never reach the registry."""
+        import hashlib
+        from .transfer import artifact_manifest, iter_artifact_chunks
+        if self._conn is None:
+            raise WorkerDiedError(
+                f"worker {self.index} has no connection")
+        man = artifact_manifest(path)
+        if sha is not None and man.get("sha256") != sha:
+            raise WeightShipError(
+                f"adapter artifact {path!r} sha256 {man.get('sha256')} "
+                f"!= published {sha} — refusing to ship a corrupt "
+                "artifact")
+        sha = man.get("sha256")
+        attempts = max(1, int(retries) + 1)
+        for i in range(attempts):
+            wid = self._wid
+            self._wid += 1
+            rh, _ = self._rpc("load_adapter",
+                              {"wid": wid, "name": name, "sha256": sha,
+                               "manifest": man},
+                              None, "adapter_ready", timeout_s=timeout_s)
+            if not rh.get("cached"):
+                for seq, data in iter_artifact_chunks(path):
+                    self._conn.send(
+                        "adapter_chunk",
+                        {"seq": seq,
+                         "sha256": hashlib.sha256(data).hexdigest()},
+                        {"data": np.frombuffer(data, np.uint8).copy()})
+                    self.bytes_shipped += len(data)
+                    stat_add("STAT_lora_ship_bytes", len(data))
+                self._conn.send("attach_end", {})
+                self._last_tx = time.monotonic()
+            # wait for the verdict, pumping unrelated frames normally
+            err = None
+            deadline = time.monotonic() + timeout_s
+            while err is None:
+                for frame in self._conn.recv_frames(0.01):
+                    v, h, a = frame
+                    if v == "adapter_loaded" and h.get("wid") == wid:
+                        if h.get("ok"):
+                            return h.get("file_sha", sha)
+                        err = _mk_error(h.get("etype", ""),
+                                        h.get("msg", ""))
+                        break
+                    self._dispatch(frame)
+                if err is None and time.monotonic() > deadline:
+                    raise WorkerDiedError(
+                        f"remote worker {self.index} load_adapter "
+                        f"timed out after {timeout_s}s")
+            retryable = isinstance(err, (WeightShipError,)) or (
+                type(err).__name__ == "AdapterIntegrityError")
+            if not retryable or i == attempts - 1:
+                raise err
+            stat_add("STAT_lora_ship_reships")
+        raise err  # unreachable; loop always returns or raises
 
     @property
     def pid(self) -> int:

@@ -167,6 +167,18 @@ Injection points (consumed elsewhere in the framework):
                   corrupt weights must never reach a stream.  Consulted
                   by serving/refresh.py's WeightPublisher.  Env:
                   PDTPU_FAULT_PUBLISH_CORRUPT="n".
+  adapter_corrupt the n-th LoRA adapter artifact READ by this process
+                  (1-based, counted per process) is poisoned in memory
+                  — a byte in the raw npz bytes is flipped AFTER the
+                  file read but BEFORE any verification, so the loader
+                  sees exactly what a torn ship / bad disk would hand
+                  it.  The read path (lora.read_adapter) must reject
+                  with a typed AdapterIntegrityError — never deliver
+                  garbage factors to a slot — and the supervised caller
+                  (worker load_adapter RPC, fleet.load_adapter)
+                  re-ships/re-reads: the counter has advanced, so the
+                  retry sees clean bytes.  Env:
+                  PDTPU_FAULT_ADAPTER_CORRUPT="n".
   canary_diverge  while armed, the FleetRefresher's post-flip canary
                   gate reports a stream mismatch regardless of the real
                   comparison — the model-regressed-but-mechanically-
@@ -200,6 +212,7 @@ __all__ = ["enable", "disable", "reset", "get", "nan_grads_window",
            "net_delay_config", "net_drop_frame", "maybe_net_drop",
            "net_partition_config", "net_partition_active",
            "publish_corrupt_n", "maybe_corrupt_publish",
+           "adapter_corrupt_n", "maybe_corrupt_adapter_read",
            "canary_diverge"]
 
 _ENV = {
@@ -221,6 +234,7 @@ _ENV = {
     "net_drop": "PDTPU_FAULT_NET_DROP",
     "net_partition": "PDTPU_FAULT_NET_PARTITION",
     "publish_corrupt": "PDTPU_FAULT_PUBLISH_CORRUPT",
+    "adapter_corrupt": "PDTPU_FAULT_ADAPTER_CORRUPT",
     "canary_diverge": "PDTPU_FAULT_CANARY_DIVERGE",
 }
 
@@ -228,6 +242,7 @@ _lock = threading.Lock()
 _registry = {}          # point -> raw config string (authoritative mirror)
 _save_counter = {"n": 0}  # kill_mid_save is counted per process
 _publish_counter = {"n": 0}  # publish_corrupt is counted per process
+_adapter_counter = {"n": 0}  # adapter_corrupt is counted per process
 _net_state = {"frames": 0, "drop_fired": False, "partitions": {}}
 
 
@@ -256,6 +271,7 @@ def reset():
     with _lock:
         _save_counter["n"] = 0
         _publish_counter["n"] = 0
+        _adapter_counter["n"] = 0
         _net_state["frames"] = 0
         _net_state["drop_fired"] = False
         _net_state["partitions"] = {}
@@ -400,6 +416,40 @@ def maybe_corrupt_publish(path: str) -> bool:
     except OSError:
         pass  # a vanished file corrupts even harder
     return True
+
+
+# -- adapter_corrupt ---------------------------------------------------------
+
+def adapter_corrupt_n() -> Optional[int]:
+    """Which adapter artifact read (1-based, per process) to poison, or
+    None."""
+    raw = get("adapter_corrupt")
+    if not raw:
+        return None
+    return int(raw)
+
+
+def maybe_corrupt_adapter_read(raw: bytes, path: str = "") -> bytes:
+    """Called by `lora.read_adapter` on the raw artifact bytes BEFORE
+    verification.  Counts reads per process; on the n-th, flips one byte
+    in the middle of the buffer — the loader's integrity checks must
+    turn this into a typed AdapterIntegrityError (garbage factors must
+    never reach a device slot), and the supervised caller re-ships.  The
+    file on disk is untouched, so the retry succeeds."""
+    n = adapter_corrupt_n()
+    if n is None:
+        return raw
+    with _lock:
+        _adapter_counter["n"] += 1
+        cnt = _adapter_counter["n"]
+    if cnt != n:
+        return raw
+    if not raw:
+        return b"\xff"
+    buf = bytearray(raw)
+    pos = len(buf) // 2
+    buf[pos] ^= 0xFF
+    return bytes(buf)
 
 
 # -- canary_diverge ----------------------------------------------------------
